@@ -1,0 +1,27 @@
+"""Test harness configuration.
+
+Tests run hermetically on CPU with a virtual 8-device mesh so multi-chip
+sharding paths are exercised without TPU hardware (the reference's analog is
+its Docker 2-node harness, test/local/p2p-docker-test.sh). Must run before
+any jax import, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_config(tmp_path):
+    """Hermetic Config rooted in a tempdir (reference: injected environ,
+    src/config.zig:160-166)."""
+    from zest_tpu.config import Config
+
+    return Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")

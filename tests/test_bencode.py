@@ -1,0 +1,103 @@
+"""Bencode codec tests — strictness parity with reference src/bencode.zig:269-345."""
+
+import pytest
+
+from zest_tpu.p2p import bencode
+from zest_tpu.p2p.bencode import BencodeError
+
+
+class TestEncode:
+    def test_int(self):
+        assert bencode.encode(42) == b"i42e"
+        assert bencode.encode(0) == b"i0e"
+        assert bencode.encode(-7) == b"i-7e"
+
+    def test_string(self):
+        assert bencode.encode(b"spam") == b"4:spam"
+        assert bencode.encode("spam") == b"4:spam"
+        assert bencode.encode(b"") == b"0:"
+
+    def test_list(self):
+        assert bencode.encode([b"spam", 42]) == b"l4:spami42ee"
+        assert bencode.encode([]) == b"le"
+
+    def test_dict_keys_sorted(self):
+        assert bencode.encode({b"b": 2, b"a": 1}) == b"d1:ai1e1:bi2ee"
+
+    def test_nested(self):
+        assert (
+            bencode.encode({b"m": {b"ut_xet": 3}, b"p": 6881})
+            == b"d1:md6:ut_xeti3ee1:pi6881ee"
+        )
+
+    def test_bool_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.encode(True)
+
+
+class TestDecode:
+    def test_roundtrip(self):
+        for v in [0, -123, b"hello", [b"a", [1, 2]], {b"k": {b"n": [b"x"]}}]:
+            assert bencode.decode(bencode.encode(v)) == v
+
+    def test_leading_zero_int_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"i042e")
+
+    def test_negative_zero_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"i-0e")
+
+    def test_zero_ok(self):
+        assert bencode.decode(b"i0e") == 0
+
+    def test_unsorted_dict_keys_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"d1:bi1e1:ai2ee")
+
+    def test_duplicate_dict_keys_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"d1:ai1e1:ai2ee")
+
+    def test_trailing_bytes_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"i1eX")
+
+    def test_truncated_string_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"10:short")
+
+    def test_leading_zero_strlen_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"04:spam")
+
+    def test_unterminated_rejected(self):
+        for bad in [b"i42", b"l1:a", b"d1:ai1e", b""]:
+            with pytest.raises(BencodeError):
+                bencode.decode(bad)
+
+    def test_hostile_deep_nesting_rejected(self):
+        # Untrusted DHT/tracker input must never escape BencodeError
+        # (a RecursionError would crash the packet handler).
+        with pytest.raises(BencodeError):
+            bencode.decode(b"l" * 10_000)
+        with pytest.raises(BencodeError):
+            bencode.decode(b"d" * 10_000)
+
+    def test_nondigit_string_length_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode.decode(b"1a:x")
+
+    def test_prefix_decode(self):
+        value, n = bencode.decode_prefix(b"i42eTRAILER")
+        assert value == 42 and n == 4
+
+
+class TestDictHelpers:
+    def test_typed_lookups(self):
+        d = bencode.decode(b"d1:ii7e1:ll1:xe1:s3:abce")
+        assert bencode.dict_get_int(d, b"i") == 7
+        assert bencode.dict_get_bytes(d, b"s") == b"abc"
+        assert bencode.dict_get_list(d, b"l") == [b"x"]
+        assert bencode.dict_get_int(d, b"s") is None
+        assert bencode.dict_get_dict(d, b"missing") is None

@@ -1,0 +1,74 @@
+"""Config tests (parity: reference src/config.zig:160-183)."""
+
+from pathlib import Path
+
+import pytest
+
+from zest_tpu.config import Config, MeshConfig
+
+
+def test_defaults_from_empty_env(tmp_path):
+    cfg = Config.load(env={"HF_HOME": str(tmp_path / "hf"),
+                           "ZEST_CACHE_DIR": str(tmp_path / "zest")})
+    assert cfg.listen_port == 6881
+    assert cfg.http_port == 9847
+    assert cfg.max_peers == 50
+    assert cfg.max_concurrent_downloads == 16
+    assert cfg.hf_token is None
+
+
+def test_env_overrides(tmp_path):
+    cfg = Config.load(env={
+        "HF_HOME": str(tmp_path),
+        "ZEST_CACHE_DIR": str(tmp_path),
+        "ZEST_HTTP_PORT": "1234",
+        "ZEST_MAX_PEERS": "7",
+        "HF_TOKEN": "hf_secret",
+    })
+    assert cfg.http_port == 1234
+    assert cfg.max_peers == 7
+    assert cfg.hf_token == "hf_secret"
+
+
+def test_token_file_fallback(tmp_path):
+    (tmp_path / "hf").mkdir()
+    (tmp_path / "hf" / "token").write_text("hf_from_file\n")
+    cfg = Config.load(env={"HF_HOME": str(tmp_path / "hf"),
+                           "ZEST_CACHE_DIR": str(tmp_path)})
+    assert cfg.hf_token == "hf_from_file"
+
+
+def test_snapshot_dir_layout(tmp_config):
+    d = tmp_config.model_snapshot_dir("openai-community/gpt2", "abc123")
+    assert d == tmp_config.hf_home / "hub" / "models--openai-community--gpt2" / "snapshots" / "abc123"
+
+
+def test_invalid_repo_id_rejected(tmp_config):
+    with pytest.raises(ValueError):
+        tmp_config.model_cache_dir("no-slash")
+    with pytest.raises(ValueError):
+        tmp_config.model_cache_dir("../../etc/passwd")
+
+
+def test_xorb_and_chunk_cache_paths(tmp_config):
+    h = "deadbeef" + "0" * 56
+    assert tmp_config.xorb_cache_path(h) == tmp_config.cache_dir / "xorbs" / "de" / h
+    assert tmp_config.chunk_cache_path(h) == tmp_config.cache_dir / "chunks" / "de" / h
+
+
+def test_mesh_config_from_env():
+    m = MeshConfig.from_env({
+        "ZEST_TPU_MESH": "data=2,model=4",
+        "ZEST_TPU_COORDINATOR": "10.0.0.1:8476",
+        "ZEST_TPU_PROCESS_ID": "3",
+        "ZEST_TPU_NUM_PROCESSES": "8",
+    })
+    assert m.mesh_axes == {"data": 2, "model": 4}
+    assert m.coordinator == "10.0.0.1:8476"
+    assert m.process_id == 3 and m.num_processes == 8
+    assert m.is_distributed
+
+
+def test_mesh_config_defaults():
+    m = MeshConfig.from_env({})
+    assert not m.is_distributed and m.mesh_axes == {}

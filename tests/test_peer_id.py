@@ -1,0 +1,37 @@
+"""Peer identity tests (parity: reference src/peer_id.zig:47-63)."""
+
+import hashlib
+
+from zest_tpu.p2p import peer_id
+
+
+def test_peer_id_prefix_and_length():
+    pid = peer_id.generate()
+    assert len(pid) == 20
+    assert pid.startswith(b"-ZT0100-")
+
+
+def test_peer_ids_differ():
+    assert peer_id.generate() != peer_id.generate()
+
+
+def test_info_hash_deterministic():
+    h = bytes(range(32))
+    a = peer_id.compute_info_hash(h)
+    b = peer_id.compute_info_hash(h)
+    assert a == b and len(a) == 20
+
+
+def test_info_hash_domain_separation():
+    # Must equal SHA-1("zest-xet-v1:" || hash) byte-for-byte for swarm
+    # interop with the reference (src/peer_id.zig:28-33).
+    h = b"\xab" * 32
+    expected = hashlib.sha1(b"zest-xet-v1:" + h).digest()
+    assert peer_id.compute_info_hash(h) == expected
+
+
+def test_info_hash_rejects_bad_length():
+    import pytest
+
+    with pytest.raises(ValueError):
+        peer_id.compute_info_hash(b"short")

@@ -1,0 +1,90 @@
+"""zest-tpu: TPU-native P2P acceleration for ML model distribution.
+
+A brand-new framework with the capabilities of the reference (praveer13/zest):
+pull HuggingFace models by resolving files to content-addressed xorb chunks
+via the Xet/CAS protocol, fetch chunks peer-first with CDN fallback, verify
+everything with BLAKE3 — except the "swarm" here is a TPU pod. Pod hosts are
+discovered via the JAX coordinator, bulk bytes move over ICI as collectives
+and over DCN as chunk RPC, the staging cache is a sharded ``jax.Array`` in
+HBM, and BLAKE3 verification runs as a Pallas kernel on-device, so
+``zest pull --device=tpu`` lands checkpoints directly into a pjit mesh.
+
+Public API (mirrors reference python/zest/__init__.py:33-66):
+
+    import zest_tpu as zest
+    zest.enable()                 # monkey-patch huggingface_hub
+    path = zest.pull("openai-community/gpt2")
+    zest.status(); zest.stop(); zest.disable()
+
+Auto-enable with ``ZEST=1`` in the environment (reference __init__.py:68-73).
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+from zest_tpu.version import __version__  # noqa: F401
+
+_client = None
+_server = None
+
+
+def _get_server():
+    global _server
+    if _server is None:
+        from zest_tpu.api.daemon import ZestServer
+
+        _server = ZestServer()
+    return _server
+
+
+def _get_client():
+    global _client
+    if _client is None:
+        from zest_tpu.api.client import ZestClient
+
+        _client = ZestClient()
+    return _client
+
+
+def enable() -> None:
+    """Start the local seeding daemon and patch huggingface_hub so
+    ``snapshot_download`` goes through the swarm (reference __init__.py:33-43)."""
+    _get_server().ensure_running()
+    from zest_tpu.api import hf_backend
+
+    hf_backend.patch_hf_hub(_get_client())
+
+
+def disable() -> None:
+    """Undo :func:`enable`'s monkey-patch."""
+    from zest_tpu.api import hf_backend
+
+    hf_backend.unpatch_hf_hub()
+
+
+def pull(repo_id: str, revision: str = "main", device: str | None = None):
+    """Download a model through the swarm; returns the snapshot directory.
+
+    With ``device="tpu"`` the checkpoint additionally lands in a sharded HBM
+    staging buffer ready for :mod:`zest_tpu.models` loading (the north-star
+    path; no reference counterpart).
+    """
+    return _get_client().pull(repo_id, revision=revision, device=device)
+
+
+def status() -> dict:
+    """Daemon status via the localhost REST API (reference client.py:48-54)."""
+    return _get_client().status()
+
+
+def stop() -> None:
+    """Stop the local daemon (reference __init__.py:59-62)."""
+    _get_server().stop()
+
+
+if _os.environ.get("ZEST") == "1":  # pragma: no cover - import side effect
+    try:
+        enable()
+    except Exception:
+        pass

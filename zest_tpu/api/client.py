@@ -1,0 +1,41 @@
+"""Client side of the public API: pull + daemon status.
+
+The reference shells out to a bundled native binary for ``pull``
+(python/zest/client.py:32-36); here the transfer pipeline is in-process
+Python/JAX, so ``pull`` calls it directly and ``status`` talks to the local
+daemon's REST API (python/zest/client.py:48-54).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import requests
+
+from zest_tpu.config import Config
+
+
+class ZestClient:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config.load()
+
+    def pull(
+        self,
+        repo_id: str,
+        revision: str = "main",
+        device: str | None = None,
+    ) -> Path:
+        """Download ``repo_id`` through the swarm; returns the snapshot dir."""
+        from zest_tpu.transfer.pull import pull_model
+
+        return pull_model(
+            self.config, repo_id, revision=revision, device=device
+        )
+
+    def status(self) -> dict:
+        """Daemon status via ``GET /v1/status`` on the loopback REST API."""
+        resp = requests.get(
+            f"http://127.0.0.1:{self.config.http_port}/v1/status", timeout=5
+        )
+        resp.raise_for_status()
+        return resp.json()

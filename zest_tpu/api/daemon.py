@@ -1,0 +1,68 @@
+"""Local seeding-daemon lifecycle management.
+
+Mirrors the reference's ZestServer (python/zest/server.py:27-95): health-check
+the loopback REST API, spawn a detached ``serve`` process when absent, poll
+``/v1/health`` until ready, stop via ``POST /v1/stop``. The spawned process is
+``python -m zest_tpu serve`` instead of a bundled binary.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import requests
+
+from zest_tpu.config import Config
+
+_HEALTH_TIMEOUT_S = 5.0
+_POLL_INTERVAL_S = 0.1
+
+
+class ZestServer:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config.load()
+        self._proc: subprocess.Popen | None = None
+
+    @property
+    def _base(self) -> str:
+        return f"http://127.0.0.1:{self.config.http_port}"
+
+    def is_running(self) -> bool:
+        try:
+            return (
+                requests.get(f"{self._base}/v1/health", timeout=1).status_code
+                == 200
+            )
+        except requests.RequestException:
+            return False
+
+    def ensure_running(self) -> None:
+        """Spawn the daemon if the health check fails (server.py:27-41)."""
+        if self.is_running():
+            return
+        self._proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "zest_tpu", "serve",
+                "--http-port", str(self.config.http_port),
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        deadline = time.monotonic() + _HEALTH_TIMEOUT_S
+        while time.monotonic() < deadline:
+            if self.is_running():
+                return
+            time.sleep(_POLL_INTERVAL_S)
+        raise RuntimeError(
+            f"zest daemon failed to become healthy within {_HEALTH_TIMEOUT_S}s"
+        )
+
+    def stop(self) -> None:
+        """Stop via the REST API; tolerate an already-stopped daemon."""
+        try:
+            requests.post(f"{self._base}/v1/stop", timeout=5)
+        except requests.RequestException:
+            pass

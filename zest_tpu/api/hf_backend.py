@@ -1,0 +1,42 @@
+"""huggingface_hub integration: transparent snapshot_download interception.
+
+Monkey-patches ``huggingface_hub.snapshot_download`` so existing
+``from_pretrained()`` code paths pull through the swarm with zero workflow
+change, falling back to the original implementation on ANY exception —
+zest must never make a download fail that would otherwise succeed
+(reference: python/zest/hf_backend.py:9-50).
+"""
+
+from __future__ import annotations
+
+_original_snapshot_download = None
+
+
+def patch_hf_hub(client) -> None:
+    global _original_snapshot_download
+    import huggingface_hub
+
+    if _original_snapshot_download is not None:
+        return  # already patched
+
+    original = huggingface_hub.snapshot_download
+
+    def zest_snapshot_download(repo_id: str, *args, **kwargs):
+        revision = kwargs.get("revision") or "main"
+        try:
+            return str(client.pull(repo_id, revision=revision))
+        except Exception:
+            return original(repo_id, *args, **kwargs)
+
+    _original_snapshot_download = original
+    huggingface_hub.snapshot_download = zest_snapshot_download
+
+
+def unpatch_hf_hub() -> None:
+    global _original_snapshot_download
+    if _original_snapshot_download is None:
+        return
+    import huggingface_hub
+
+    huggingface_hub.snapshot_download = _original_snapshot_download
+    _original_snapshot_download = None

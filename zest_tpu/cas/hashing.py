@@ -1,0 +1,148 @@
+"""Content-addressing hash conventions (the zig-xet `hashing` equivalent).
+
+Three things live here, mirroring what the reference gets from zig-xet
+(SURVEY.md §2.2, row `hashing`):
+
+1. **BLAKE3 dispatch** — one-shot hashing routed to the fastest available
+   backend: native C++ (zest_tpu/native) when built, else pure Python.
+   The Pallas on-device kernel (zest_tpu.ops.blake3_pallas) is used by the
+   HBM verification path, not here.
+
+2. **MerkleHash hex convention** — xorb cache keys and CAS API hex use the
+   *little-endian u64* encoding: the 32-byte hash is read as 4 u64 (LE) and
+   each is printed as 16 hex digits. This differs from plain byte hex and
+   MUST be used for xorb cache keys (reference: src/server.zig:201-204,
+   plain-hex counterpart at src/storage.zig:91-99).
+
+3. **Domain-separated chunk/node keys** — chunk hashes and Merkle interior
+   nodes use distinct BLAKE3 keyed modes so a chunk can never collide with
+   a subtree (xet-core convention). The concrete 32-byte keys are derived
+   from documented context strings; they are a compatibility seam — wire
+   them to the production Xet constants to interoperate with HF's CAS.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from zest_tpu.cas import blake3 as _py_blake3
+
+# Native backend is optional; loaded lazily to keep import cheap.
+_native = None
+_native_checked = False
+
+
+def _get_native():
+    global _native, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from zest_tpu.native import lib as _lib
+
+            _native = _lib if _lib.available() else None
+        except Exception:
+            _native = None
+    return _native
+
+
+HASH_LEN = 32
+
+# ── Domain-separation keys (compatibility seam; see module docstring) ──
+CHUNK_KEY = _py_blake3.blake3_derive_key("zest-tpu xet chunk hash v1", b"zest")
+NODE_KEY = _py_blake3.blake3_derive_key("zest-tpu xet merkle node v1", b"zest")
+
+
+def blake3_hash(data: bytes) -> bytes:
+    """Plain BLAKE3-256 of ``data`` via the fastest host backend."""
+    native = _get_native()
+    if native is not None:
+        return native.blake3(data)
+    return _py_blake3.blake3(data)
+
+
+def blake3_keyed(key: bytes, data: bytes) -> bytes:
+    native = _get_native()
+    if native is not None:
+        return native.blake3_keyed(key, data)
+    return _py_blake3.blake3_keyed(key, data)
+
+
+def chunk_hash(data: bytes) -> bytes:
+    """Content hash of one CDC chunk (keyed, chunk domain)."""
+    return blake3_keyed(CHUNK_KEY, data)
+
+
+# ── Merkle aggregation ──
+#
+# Leaves are (chunk_hash, byte_length); interior nodes hash the concatenation
+# of each child's ``hash || u64le(length)`` under the node key and carry the
+# summed length. Xorb hashes and file hashes use the same tree so dedup is
+# consistent at every level.
+
+
+def node_hash(children: list[tuple[bytes, int]]) -> bytes:
+    buf = bytearray()
+    for h, length in children:
+        if len(h) != HASH_LEN:
+            raise ValueError("child hash must be 32 bytes")
+        buf += h
+        buf += struct.pack("<Q", length)
+    return blake3_keyed(NODE_KEY, bytes(buf))
+
+
+def merkle_root(leaves: list[tuple[bytes, int]]) -> tuple[bytes, int]:
+    """Binary Merkle root over (hash, length) leaves.
+
+    Pairs children level by level; an odd tail node is promoted unchanged
+    (so a single chunk's xorb hash is that chunk's hash).
+    """
+    if not leaves:
+        return chunk_hash(b""), 0
+    level = list(leaves)
+    while len(level) > 1:
+        nxt: list[tuple[bytes, int]] = []
+        for i in range(0, len(level) - 1, 2):
+            pair = [level[i], level[i + 1]]
+            nxt.append((node_hash(pair), pair[0][1] + pair[1][1]))
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def xorb_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
+    """Content address of a xorb = Merkle root over its chunks."""
+    return merkle_root(chunk_hashes)[0]
+
+
+def file_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
+    """Content address of a file = Merkle root over its chunk sequence."""
+    return merkle_root(chunk_hashes)[0]
+
+
+# ── Hex conventions ──
+
+
+def hash_to_hex(h: bytes) -> str:
+    """MerkleHash hex: 4 little-endian u64 groups, each printed %016x.
+
+    Used for xorb cache keys and CAS API hex so keys match across writer
+    and reader (reference: src/server.zig:201-204).
+    """
+    if len(h) != HASH_LEN:
+        raise ValueError(f"hash must be {HASH_LEN} bytes, got {len(h)}")
+    a, b, c, d = struct.unpack("<4Q", h)
+    return f"{a:016x}{b:016x}{c:016x}{d:016x}"
+
+
+def hex_to_hash(s: str) -> bytes:
+    """Inverse of :func:`hash_to_hex` (zig-xet ``apiHexToHash``)."""
+    if len(s) != 64:
+        raise ValueError(f"hex hash must be 64 chars, got {len(s)}")
+    words = [int(s[i : i + 16], 16) for i in (0, 16, 32, 48)]
+    return struct.pack("<4Q", *words)
+
+
+def bytes_to_hex(h: bytes) -> str:
+    """Plain byte-order hex (chunk cache keys; src/storage.zig:91-99)."""
+    return h.hex()

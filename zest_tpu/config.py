@@ -1,0 +1,166 @@
+"""Configuration: cache layout, tokens, ports, and TPU mesh topology.
+
+Mirrors the layered config of the reference (src/config.zig:37-84): compiled
+defaults < environment variables < per-command CLI flags. Env compatibility is
+preserved (``HF_TOKEN``, ``HF_HOME``, ``ZEST_CACHE_DIR``, ``ZEST_HTTP_PORT``,
+``ZEST_MAX_PEERS``) and extended with TPU-native settings (``ZEST_TPU_*``)
+for the pod mesh, coordinator, and HBM staging budget that the reference has
+no counterpart for (SURVEY.md section 2, row 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from pathlib import Path
+
+# ── Compiled defaults (reference: src/config.zig:6-19) ──
+DEFAULT_LISTEN_PORT = 6881          # BT/seed listener + DHT UDP port
+DEFAULT_HTTP_PORT = 9847            # localhost REST control plane
+DEFAULT_MAX_PEERS = 50              # connection-pool cap
+DEFAULT_MAX_CONCURRENT_DOWNLOADS = 16
+DEFAULT_BATCH_MULTIPLIER = 8        # terms per batch = 16 * 8 = 128
+
+# TPU-native defaults (no reference counterpart).
+DEFAULT_DCN_PORT = 6991             # host-to-host chunk RPC listener
+DEFAULT_HBM_STAGING_BYTES = 2 << 30  # per-device staging buffer budget
+
+_REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
+
+
+def _expand(p: str) -> Path:
+    return Path(os.path.expanduser(p))
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Topology of the pod this process participates in.
+
+    The reference discovers peers dynamically via DHT/tracker; a TPU pod's
+    membership is static per job, so topology is configuration: the JAX
+    coordinator address, this process' index, total process count, and the
+    logical mesh axes used when landing checkpoints into a pjit mesh.
+    """
+
+    coordinator: str | None = None       # "host:port" for jax.distributed
+    process_id: int = 0
+    num_processes: int = 1
+    # Logical mesh axes for checkpoint landing, e.g. {"data": 1, "model": 8}.
+    mesh_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @staticmethod
+    def from_env(env: dict[str, str]) -> "MeshConfig":
+        axes: dict[str, int] = {}
+        spec = env.get("ZEST_TPU_MESH", "")
+        # Format: "data=2,model=4" (axis order is significant).
+        if spec:
+            for part in spec.split(","):
+                name, _, n = part.partition("=")
+                axes[name.strip()] = int(n)
+        return MeshConfig(
+            coordinator=env.get("ZEST_TPU_COORDINATOR") or None,
+            process_id=int(env.get("ZEST_TPU_PROCESS_ID", "0")),
+            num_processes=int(env.get("ZEST_TPU_NUM_PROCESSES", "1")),
+            mesh_axes=axes,
+        )
+
+
+@dataclasses.dataclass
+class Config:
+    """Resolved runtime configuration.
+
+    Build with :meth:`Config.load` so env overrides apply; construct directly
+    in tests for hermetic behavior (the reference achieves the same with an
+    injected ``environ``, src/config.zig:160-166).
+    """
+
+    hf_home: Path
+    cache_dir: Path                      # zest-private cache root
+    hf_token: str | None = None
+    listen_port: int = DEFAULT_LISTEN_PORT
+    http_port: int = DEFAULT_HTTP_PORT
+    dcn_port: int = DEFAULT_DCN_PORT
+    max_peers: int = DEFAULT_MAX_PEERS
+    max_concurrent_downloads: int = DEFAULT_MAX_CONCURRENT_DOWNLOADS
+    hbm_staging_bytes: int = DEFAULT_HBM_STAGING_BYTES
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    endpoint: str = "https://huggingface.co"
+
+    # ── Construction ──
+
+    @staticmethod
+    def load(env: dict[str, str] | None = None) -> "Config":
+        """Resolve config from the environment.
+
+        Token resolution order matches the reference (src/config.zig:136-158):
+        ``HF_TOKEN`` env var, then ``~/.cache/huggingface/token`` file.
+        """
+        env = dict(os.environ) if env is None else env
+        hf_home = _expand(env.get("HF_HOME", "~/.cache/huggingface"))
+        cache_dir = _expand(env.get("ZEST_CACHE_DIR", "~/.cache/zest"))
+
+        token = env.get("HF_TOKEN") or None
+        if not token:
+            token_file = hf_home / "token"
+            try:
+                token = token_file.read_text().strip() or None
+            except OSError:
+                token = None
+
+        return Config(
+            hf_home=hf_home,
+            cache_dir=cache_dir,
+            hf_token=token,
+            listen_port=int(env.get("ZEST_LISTEN_PORT", DEFAULT_LISTEN_PORT)),
+            http_port=int(env.get("ZEST_HTTP_PORT", DEFAULT_HTTP_PORT)),
+            dcn_port=int(env.get("ZEST_DCN_PORT", DEFAULT_DCN_PORT)),
+            max_peers=int(env.get("ZEST_MAX_PEERS", DEFAULT_MAX_PEERS)),
+            max_concurrent_downloads=int(
+                env.get("ZEST_MAX_CONCURRENT", DEFAULT_MAX_CONCURRENT_DOWNLOADS)
+            ),
+            hbm_staging_bytes=int(
+                env.get("ZEST_TPU_HBM_STAGING", DEFAULT_HBM_STAGING_BYTES)
+            ),
+            mesh=MeshConfig.from_env(env),
+            endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
+        )
+
+    # ── Path builders (reference: src/config.zig:95-133) ──
+
+    def hub_dir(self) -> Path:
+        return self.hf_home / "hub"
+
+    def model_cache_dir(self, repo_id: str) -> Path:
+        """``hub/models--{org}--{name}`` — HF cache layout."""
+        if not _REPO_RE.match(repo_id):
+            raise ValueError(f"invalid repo id: {repo_id!r}")
+        return self.hub_dir() / ("models--" + repo_id.replace("/", "--"))
+
+    def model_snapshot_dir(self, repo_id: str, commit_sha: str) -> Path:
+        """``hub/models--{org}--{name}/snapshots/{commit}`` (config.zig:97-113)."""
+        return self.model_cache_dir(repo_id) / "snapshots" / commit_sha
+
+    def model_refs_dir(self, repo_id: str) -> Path:
+        return self.model_cache_dir(repo_id) / "refs"
+
+    def xorb_cache_dir(self) -> Path:
+        return self.cache_dir / "xorbs"
+
+    def xorb_cache_path(self, hash_hex: str) -> Path:
+        """``xorbs/{2-char prefix}/{hash}`` (config.zig:116-123)."""
+        return self.xorb_cache_dir() / hash_hex[:2] / hash_hex
+
+    def chunk_cache_dir(self) -> Path:
+        return self.cache_dir / "chunks"
+
+    def chunk_cache_path(self, hash_hex: str) -> Path:
+        """``chunks/{2-char prefix}/{hash}`` (config.zig:126-133)."""
+        return self.chunk_cache_dir() / hash_hex[:2] / hash_hex
+
+    def pid_file(self) -> Path:
+        return self.cache_dir / "zest.pid"

@@ -1,0 +1,122 @@
+"""Native (C++) acceleration library: build + ctypes bindings.
+
+The reference's byte-level hot paths are native Zig (SURVEY.md §2.1); ours
+are C++ compiled on demand into ``libzest.so`` and bound via ctypes (pybind11
+is not in this image). Everything here has a pure-Python fallback — the
+native lib is a performance tier, never a functional requirement.
+
+Build is lazy and cached: first use compiles with g++ -O3 -march=native into
+``zest_tpu/native/build/``; set ``ZEST_NATIVE=0`` to disable entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_BUILD_DIR = _HERE / "build"
+_SOURCES = ["blake3.cc", "gearhash.cc", "lz4.cc"]
+
+_lock = threading.Lock()
+_dll: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> Path | None:
+    sources = [_HERE / s for s in _SOURCES if (_HERE / s).exists()]
+    if not sources:
+        return None
+    _BUILD_DIR.mkdir(exist_ok=True)
+    so_path = _BUILD_DIR / "libzest.so"
+    stamp = _BUILD_DIR / "libzest.stamp"
+    fingerprint = "|".join(
+        f"{s.name}:{s.stat().st_mtime_ns}" for s in sorted(sources)
+    )
+    if so_path.exists() and stamp.exists() and stamp.read_text() == fingerprint:
+        return so_path
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(so_path), *[str(s) for s in sources],
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        # A stale .so from a previous build must not mask the failure — the
+        # pure-Python fallback is always correct, old native code may not be.
+        return None
+    stamp.write_text(fingerprint)
+    return so_path
+
+
+def _load() -> ctypes.CDLL | None:
+    global _dll, _tried
+    with _lock:
+        if _tried:
+            return _dll
+        _tried = True
+        if os.environ.get("ZEST_NATIVE") == "0":
+            return None
+        so = _compile()
+        if so is None:
+            return None
+        try:
+            dll = ctypes.CDLL(str(so))
+        except OSError:
+            return None
+        dll.zest_blake3.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        dll.zest_blake3_keyed.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        dll.zest_blake3_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        dll.zest_blake3_keyed_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_char_p
+        ]
+        _dll = dll
+        return _dll
+
+
+class lib:
+    """Namespace of native entry points with ctypes marshalling."""
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    @staticmethod
+    def blake3(data: bytes) -> bytes:
+        dll = _load()
+        out = ctypes.create_string_buffer(32)
+        dll.zest_blake3(data, len(data), out)
+        return out.raw
+
+    @staticmethod
+    def blake3_keyed(key: bytes, data: bytes) -> bytes:
+        dll = _load()
+        out = ctypes.create_string_buffer(32)
+        dll.zest_blake3_keyed(key, data, len(data), out)
+        return out.raw
+
+    @staticmethod
+    def blake3_batch(data: bytes, count: int, item_len: int) -> bytes:
+        """Hash ``count`` contiguous equal-size items; returns count*32 bytes."""
+        dll = _load()
+        out = ctypes.create_string_buffer(32 * count)
+        dll.zest_blake3_batch(data, count, item_len, out)
+        return out.raw
+
+    @staticmethod
+    def blake3_keyed_batch(key: bytes, data: bytes, count: int,
+                           item_len: int) -> bytes:
+        dll = _load()
+        out = ctypes.create_string_buffer(32 * count)
+        dll.zest_blake3_keyed_batch(key, data, count, item_len, out)
+        return out.raw

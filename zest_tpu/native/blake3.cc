@@ -1,0 +1,240 @@
+// Native BLAKE3 for the host hot path (chunk verification, CDC dedup).
+//
+// Independent implementation from the BLAKE3 spec; validated against the
+// pure-Python anchor (zest_tpu/cas/blake3.py) and the official test
+// vectors in tests/test_blake3.py. The reference gets this from zig-xet's
+// `hashing` module (SURVEY.md section 2.2); its headline microbenchmark is
+// blake3_64kb at 3517 MB/s (BASELINE.md) — beat it here.
+//
+// Exposed C ABI (consumed via ctypes in zest_tpu/native/__init__.py):
+//   zest_blake3(data, len, out32)
+//   zest_blake3_keyed(key32, data, len, out32)
+//   zest_blake3_batch(data, count, item_len, out32xN)   — many equal-size items
+//
+// Layout notes: scalar core with aggressively unrolled rounds; compiled
+// -O3 -march=native so GCC vectorizes the 4-lane column/diagonal steps.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr size_t BLOCK_LEN = 64;
+constexpr size_t CHUNK_LEN = 1024;
+constexpr size_t KEY_WORDS = 8;
+
+constexpr uint32_t CHUNK_START = 1 << 0;
+constexpr uint32_t CHUNK_END = 1 << 1;
+constexpr uint32_t PARENT = 1 << 2;
+constexpr uint32_t ROOT = 1 << 3;
+constexpr uint32_t KEYED_HASH = 1 << 4;
+
+constexpr uint32_t IV[8] = {
+    0x6A09E667u, 0xBB67AE85u, 0x3C6EF372u, 0xA54FF53Au,
+    0x510E527Fu, 0x9B05688Cu, 0x1F83D9ABu, 0x5BE0CD19u,
+};
+
+inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+inline uint32_t load32le(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+inline void store32le(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)v;
+  p[1] = (uint8_t)(v >> 8);
+  p[2] = (uint8_t)(v >> 16);
+  p[3] = (uint8_t)(v >> 24);
+}
+
+#define G(a, b, c, d, mx, my)          \
+  do {                                 \
+    a = a + b + (mx);                  \
+    d = rotr32(d ^ a, 16);             \
+    c = c + d;                         \
+    b = rotr32(b ^ c, 12);             \
+    a = a + b + (my);                  \
+    d = rotr32(d ^ a, 8);              \
+    c = c + d;                         \
+    b = rotr32(b ^ c, 7);              \
+  } while (0)
+
+// One full compression. `out16` receives the 16-word extended output.
+void compress(const uint32_t cv[8], const uint32_t m_in[16], uint64_t counter,
+              uint32_t block_len, uint32_t flags, uint32_t out16[16]) {
+  static constexpr int P[16] = {2, 6, 3, 10, 7, 0, 4, 13,
+                                1, 11, 12, 5, 9, 14, 15, 8};
+  uint32_t v0 = cv[0], v1 = cv[1], v2 = cv[2], v3 = cv[3];
+  uint32_t v4 = cv[4], v5 = cv[5], v6 = cv[6], v7 = cv[7];
+  uint32_t v8 = IV[0], v9 = IV[1], v10 = IV[2], v11 = IV[3];
+  uint32_t v12 = (uint32_t)counter, v13 = (uint32_t)(counter >> 32);
+  uint32_t v14 = block_len, v15 = flags;
+
+  uint32_t m[16];
+  std::memcpy(m, m_in, sizeof(m));
+
+  for (int r = 0; r < 7; r++) {
+    G(v0, v4, v8, v12, m[0], m[1]);
+    G(v1, v5, v9, v13, m[2], m[3]);
+    G(v2, v6, v10, v14, m[4], m[5]);
+    G(v3, v7, v11, v15, m[6], m[7]);
+    G(v0, v5, v10, v15, m[8], m[9]);
+    G(v1, v6, v11, v12, m[10], m[11]);
+    G(v2, v7, v8, v13, m[12], m[13]);
+    G(v3, v4, v9, v14, m[14], m[15]);
+    if (r < 6) {
+      uint32_t t[16];
+      for (int i = 0; i < 16; i++) t[i] = m[P[i]];
+      std::memcpy(m, t, sizeof(m));
+    }
+  }
+
+  out16[0] = v0 ^ v8;
+  out16[1] = v1 ^ v9;
+  out16[2] = v2 ^ v10;
+  out16[3] = v3 ^ v11;
+  out16[4] = v4 ^ v12;
+  out16[5] = v5 ^ v13;
+  out16[6] = v6 ^ v14;
+  out16[7] = v7 ^ v15;
+  out16[8] = v8 ^ cv[0];
+  out16[9] = v9 ^ cv[1];
+  out16[10] = v10 ^ cv[2];
+  out16[11] = v11 ^ cv[3];
+  out16[12] = v12 ^ cv[4];
+  out16[13] = v13 ^ cv[5];
+  out16[14] = v14 ^ cv[6];
+  out16[15] = v15 ^ cv[7];
+}
+
+void load_block(const uint8_t* data, size_t len, uint32_t m[16]) {
+  uint8_t padded[BLOCK_LEN];
+  const uint8_t* src = data;
+  if (len < BLOCK_LEN) {
+    std::memset(padded, 0, sizeof(padded));
+    std::memcpy(padded, data, len);
+    src = padded;
+  }
+  for (int i = 0; i < 16; i++) m[i] = load32le(src + 4 * i);
+}
+
+// Hash one complete-or-final chunk; writes the chunk CV. If `root_out` is
+// non-null the chunk is the whole tree and the final block carries ROOT.
+void hash_chunk(const uint32_t key[8], const uint8_t* data, size_t len,
+                uint64_t chunk_counter, uint32_t base_flags, uint32_t cv_out[8],
+                uint8_t* root_out) {
+  uint32_t cv[8];
+  std::memcpy(cv, key, sizeof(cv));
+  size_t nblocks = len <= BLOCK_LEN ? 1 : (len + BLOCK_LEN - 1) / BLOCK_LEN;
+  uint32_t out16[16];
+  for (size_t i = 0; i < nblocks; i++) {
+    size_t off = i * BLOCK_LEN;
+    size_t blen = (i + 1 == nblocks) ? len - off : BLOCK_LEN;
+    uint32_t m[16];
+    load_block(data + off, blen, m);
+    uint32_t flags = base_flags;
+    if (i == 0) flags |= CHUNK_START;
+    if (i + 1 == nblocks) {
+      flags |= CHUNK_END;
+      if (root_out != nullptr) flags |= ROOT;
+    }
+    compress(cv, m, chunk_counter, (uint32_t)blen, flags, out16);
+    std::memcpy(cv, out16, 8 * sizeof(uint32_t));
+  }
+  std::memcpy(cv_out, cv, 8 * sizeof(uint32_t));
+  if (root_out != nullptr) {
+    for (int i = 0; i < 8; i++) store32le(root_out + 4 * i, cv[i]);
+  }
+}
+
+// Full-tree hash. Iterative chunk walk with a CV stack (max depth 54).
+void blake3_full(const uint32_t key[8], uint32_t base_flags,
+                 const uint8_t* data, size_t len, uint8_t out[32]) {
+  if (len <= CHUNK_LEN) {
+    uint32_t cv[8];
+    hash_chunk(key, data, len, 0, base_flags, cv, out);
+    return;
+  }
+
+  uint32_t cv_stack[54][8];
+  size_t stack_len = 0;
+  uint64_t chunk_counter = 0;
+  size_t pos = 0;
+  uint32_t out16[16];
+
+  // All chunks except the last are complete; the last is handled below so
+  // the root flag can be applied at the right node.
+  while (len - pos > CHUNK_LEN) {
+    uint32_t cv[8];
+    hash_chunk(key, data + pos, CHUNK_LEN, chunk_counter, base_flags, cv,
+               nullptr);
+    pos += CHUNK_LEN;
+    chunk_counter++;
+    uint64_t total = chunk_counter;
+    while ((total & 1) == 0) {
+      uint32_t m[16];
+      std::memcpy(m, cv_stack[--stack_len], 8 * sizeof(uint32_t));
+      std::memcpy(m + 8, cv, 8 * sizeof(uint32_t));
+      compress(key, m, 0, BLOCK_LEN, base_flags | PARENT, out16);
+      std::memcpy(cv, out16, 8 * sizeof(uint32_t));
+      total >>= 1;
+    }
+    std::memcpy(cv_stack[stack_len++], cv, 8 * sizeof(uint32_t));
+  }
+
+  // Final (partial or full) chunk.
+  uint32_t cv[8];
+  hash_chunk(key, data + pos, len - pos, chunk_counter, base_flags, cv,
+             nullptr);
+
+  // Fold the stack; the topmost fold is the root.
+  while (stack_len > 0) {
+    uint32_t m[16];
+    std::memcpy(m, cv_stack[--stack_len], 8 * sizeof(uint32_t));
+    std::memcpy(m + 8, cv, 8 * sizeof(uint32_t));
+    uint32_t flags = base_flags | PARENT;
+    if (stack_len == 0) flags |= ROOT;
+    compress(key, m, 0, BLOCK_LEN, flags, out16);
+    std::memcpy(cv, out16, 8 * sizeof(uint32_t));
+  }
+  for (int i = 0; i < 8; i++) store32le(out + 4 * i, cv[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+void zest_blake3(const uint8_t* data, size_t len, uint8_t out[32]) {
+  blake3_full(IV, 0, data, len, out);
+}
+
+void zest_blake3_keyed(const uint8_t key[32], const uint8_t* data, size_t len,
+                       uint8_t out[32]) {
+  uint32_t kw[KEY_WORDS];
+  for (size_t i = 0; i < KEY_WORDS; i++) kw[i] = load32le(key + 4 * i);
+  blake3_full(kw, KEYED_HASH, data, len, out);
+}
+
+// Hash `count` equal-length items laid out contiguously; out = count * 32.
+// Independent items — this is the chunk-verification hot loop.
+void zest_blake3_batch(const uint8_t* data, size_t count, size_t item_len,
+                       uint8_t* out) {
+  for (size_t i = 0; i < count; i++) {
+    blake3_full(IV, 0, data + i * item_len, item_len, out + i * 32);
+  }
+}
+
+void zest_blake3_keyed_batch(const uint8_t key[32], const uint8_t* data,
+                             size_t count, size_t item_len, uint8_t* out) {
+  uint32_t kw[KEY_WORDS];
+  for (size_t i = 0; i < KEY_WORDS; i++) kw[i] = load32le(key + 4 * i);
+  for (size_t i = 0; i < count; i++) {
+    blake3_full(kw, KEYED_HASH, data + i * item_len, item_len, out + i * 32);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,16 @@
+"""Version constants for zest-tpu.
+
+The wire-visible client version string rides in the BEP 10 extended
+handshake ("v" key) and the Azureus-style peer-id prefix, mirroring the
+reference's conventions (reference: src/peer_id.zig:10, src/bep_xet.zig:191).
+"""
+
+__version__ = "0.1.0"
+
+# Azureus-style prefix: ZT = zest-tpu, 01 = v0.1, 00 = patch 0.
+# The reference uses "-ZE0200-" (src/peer_id.zig:10); the prefix is client
+# identity only and does not affect swarm interop.
+CLIENT_PREFIX = b"-ZT0100-"
+
+# Client string advertised in the BEP 10 extended handshake.
+CLIENT_STRING = f"zest-tpu/{__version__}"
