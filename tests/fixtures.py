@@ -1,0 +1,283 @@
+"""Local HF Hub + CAS + CDN fixture server.
+
+The environment has zero network egress, so every integration test runs
+against this loopback server, which speaks the exact API shapes the real
+Hub/CAS do (see zest_tpu/cas/hub.py docstring). It plays the role the real
+network plays in the reference's shell harnesses (SURVEY.md §4):
+`verify-model.sh` equivalent tests pull from here instead of huggingface.co.
+
+``FixtureRepo`` content-addresses a dict of files exactly the way the
+framework itself does (CDC chunking -> xorbs -> merkle file hashes), so the
+client-side pipeline is verified against an independent server-side
+encoding path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from zest_tpu.cas import hashing, reconstruction as recon
+from zest_tpu.cas.xorb import XorbBuilder
+from zest_tpu.cas import chunking
+
+
+@dataclass
+class _XorbFixture:
+    hash_hex: str
+    blob: bytes
+    frame_offsets: list[int]  # len = num_chunks + 1
+
+
+@dataclass
+class _FileFixture:
+    path: str
+    data: bytes
+    xet_hash: str | None = None           # LE-u64 hex of file hash
+    terms: list[recon.Term] = field(default_factory=list)
+
+
+# File extensions stored in Xet CAS (everything else is a "regular" file
+# served via /resolve/, mirroring how HF stores configs vs weights).
+_XET_SUFFIXES = (".safetensors", ".bin", ".pt", ".h5", ".msgpack")
+
+
+class FixtureRepo:
+    """Content-addressed fixture repository.
+
+    ``chunks_per_xorb`` forces files to split across several xorbs so tests
+    exercise multi-term reconstruction and cross-xorb fetch planning.
+    """
+
+    def __init__(
+        self,
+        repo_id: str,
+        files: dict[str, bytes],
+        commit_sha: str = "f1x7ure5ha" + "0" * 30,
+        chunks_per_xorb: int = 0,  # 0 = unlimited (one xorb per file)
+    ):
+        self.repo_id = repo_id
+        self.commit_sha = commit_sha
+        self.files: dict[str, _FileFixture] = {}
+        self.xorbs: dict[str, _XorbFixture] = {}
+        self.reconstructions: dict[str, recon.Reconstruction] = {}
+        for path, data in files.items():
+            if path.endswith(_XET_SUFFIXES):
+                self._add_xet_file(path, data, chunks_per_xorb)
+            else:
+                self.files[path] = _FileFixture(path, data)
+
+    def _add_xet_file(self, path: str, data: bytes, chunks_per_xorb: int) -> None:
+        pieces = [piece for _, piece in chunking.chunk_stream(data)]
+        limit = chunks_per_xorb or len(pieces) or 1
+        terms: list[recon.Term] = []
+        all_chunk_hashes: list[tuple[bytes, int]] = []
+        fetch_info: dict[str, list[recon.FetchInfo]] = {}
+        for i in range(0, len(pieces), limit):
+            group = pieces[i : i + limit]
+            builder = XorbBuilder()
+            for piece in group:
+                builder.add_chunk(piece)
+            xh = builder.xorb_hash()
+            xh_hex = hashing.hash_to_hex(xh)
+            offs = builder.frame_offsets()
+            self.xorbs.setdefault(
+                xh_hex, _XorbFixture(xh_hex, builder.serialize(), offs)
+            )
+            n = len(group)
+            terms.append(
+                recon.Term(
+                    xorb_hash=xh,
+                    range=recon.ChunkRange(0, n),
+                    unpacked_length=sum(len(p) for p in group),
+                )
+            )
+            fetch_info.setdefault(xh_hex, []).append(
+                recon.FetchInfo(
+                    url=f"/xorbs/{xh_hex}",
+                    url_range_start=0,
+                    url_range_end=offs[n],
+                    range=recon.ChunkRange(0, n),
+                )
+            )
+            all_chunk_hashes.extend(builder.chunk_hashes())
+        file_hash = hashing.file_hash(all_chunk_hashes)
+        file_hex = hashing.hash_to_hex(file_hash)
+        self.files[path] = _FileFixture(path, data, file_hex, terms)
+        self.reconstructions[file_hex] = recon.Reconstruction(
+            file_hash=file_hash, terms=terms, fetch_info=fetch_info
+        )
+
+
+class FixtureHub:
+    """Threaded loopback server for one or more FixtureRepos."""
+
+    def __init__(self, *repos: FixtureRepo):
+        self.repos = {r.repo_id: r for r in repos}
+        self.requests_seen: list[str] = []
+        fixture = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, doc, code: int = 200):
+                self._send(code, json.dumps(doc).encode(), "application/json")
+
+            def do_GET(self):
+                fixture.requests_seen.append(f"GET {self.path}")
+                fixture._handle_get(self)
+
+            def do_POST(self):
+                fixture.requests_seen.append(f"POST {self.path}")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                fixture._handle_post(self, body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    # ── lifecycle ──
+
+    def __enter__(self) -> "FixtureHub":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    # ── request handling ──
+
+    def _repo_for(self, handler, parts):
+        repo_id = "/".join(parts[:2])
+        repo = self.repos.get(repo_id)
+        if repo is None:
+            handler._send_json({"error": "RepoNotFound"}, 404)
+        return repo
+
+    def _handle_get(self, handler) -> None:
+        path = handler.path
+        if path.startswith("/api/models/"):
+            rest = path[len("/api/models/"):].split("/")
+            repo = self._repo_for(handler, rest)
+            if repo is None:
+                return
+            action = rest[2] if len(rest) > 2 else ""
+            if action == "revision":
+                handler._send_json({
+                    "sha": repo.commit_sha,
+                    "siblings": [
+                        {"rfilename": p} for p in sorted(repo.files)
+                    ],
+                })
+            elif action == "xet-read-token":
+                handler._send_json({
+                    "casUrl": self.url,
+                    "accessToken": "fixture-access-token",
+                    "exp": 4102444800,
+                })
+            else:
+                handler._send_json({"error": "unknown api"}, 404)
+            return
+
+        if path.startswith("/v1/reconstructions/"):
+            if handler.headers.get("Authorization") != "Bearer fixture-access-token":
+                handler._send_json({"error": "unauthorized"}, 401)
+                return
+            file_hex = path.rsplit("/", 1)[-1]
+            for repo in self.repos.values():
+                rec = repo.reconstructions.get(file_hex)
+                if rec is not None:
+                    handler._send_json(recon.to_json(rec))
+                    return
+            handler._send_json({"error": "not found"}, 404)
+            return
+
+        if path.startswith("/xorbs/"):
+            xh_hex = path.rsplit("/", 1)[-1]
+            for repo in self.repos.values():
+                xf = repo.xorbs.get(xh_hex)
+                if xf is not None:
+                    self._send_ranged(handler, xf.blob)
+                    return
+            handler._send(404, b"not found")
+            return
+
+        # /{org}/{name}/resolve/{rev}/{filename...}
+        parts = path.lstrip("/").split("/")
+        if len(parts) >= 5 and parts[2] == "resolve":
+            repo = self._repo_for(handler, parts)
+            if repo is None:
+                return
+            filename = "/".join(parts[4:])
+            f = repo.files.get(filename)
+            if f is None:
+                handler._send(404, b"no such file")
+            else:
+                self._send_ranged(handler, f.data)
+            return
+
+        handler._send(404, b"unknown path")
+
+    def _handle_post(self, handler, body: bytes) -> None:
+        path = handler.path
+        if path.startswith("/api/models/") and "/paths-info/" in path:
+            rest = path[len("/api/models/"):].split("/")
+            repo = self._repo_for(handler, rest)
+            if repo is None:
+                return
+            requested = json.loads(body or b"{}").get("paths", [])
+            out = []
+            for p in requested:
+                f = repo.files.get(p)
+                if f is None:
+                    continue
+                item = {"path": p, "size": len(f.data), "type": "file"}
+                if f.xet_hash:
+                    item["xetHash"] = f.xet_hash
+                out.append(item)
+            handler._send_json(out)
+            return
+        handler._send(404, b"unknown path")
+
+    @staticmethod
+    def _send_ranged(handler, blob: bytes) -> None:
+        """Serve with HTTP Range support (bytes=a-b inclusive), like a CDN."""
+        range_header = handler.headers.get("Range")
+        if range_header and range_header.startswith("bytes="):
+            spec = range_header[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s) if start_s else 0
+            end = int(end_s) if end_s else len(blob) - 1
+            if start >= len(blob):
+                handler._send(416, b"range not satisfiable")
+                return
+            piece = blob[start : end + 1]
+            handler.send_response(206)
+            handler.send_header("Content-Type", "application/octet-stream")
+            handler.send_header(
+                "Content-Range", f"bytes {start}-{start+len(piece)-1}/{len(blob)}"
+            )
+            handler.send_header("Content-Length", str(len(piece)))
+            handler.end_headers()
+            handler.wfile.write(piece)
+        else:
+            handler._send(200, blob)

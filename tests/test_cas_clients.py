@@ -1,0 +1,158 @@
+"""Hub + CAS client tests against the loopback fixture server.
+
+This is the reference's integration-tier-1 analog (verify-model.sh) scoped
+to the metadata layer: real HTTP, real API shapes, zero egress.
+"""
+
+import os
+
+import pytest
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.client import CasClient, CasError
+from zest_tpu.cas.hub import HubClient, HubError
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.config import Config
+
+from fixtures import FixtureHub, FixtureRepo
+
+
+@pytest.fixture(scope="module")
+def rng_files():
+    rng = os.urandom
+    return {
+        "config.json": b'{"model_type": "test"}',
+        "model.safetensors": os.urandom(300_000),
+        "tokenizer.json": b'{"version": "1.0"}' * 100,
+    }
+
+
+@pytest.fixture(scope="module")
+def hub(rng_files):
+    repo = FixtureRepo("test-org/tiny-model", rng_files, chunks_per_xorb=2)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture
+def cfg(hub, tmp_path):
+    return Config(
+        hf_home=tmp_path / "hf",
+        cache_dir=tmp_path / "zest",
+        hf_token="hf_test",
+        endpoint=hub.url,
+    )
+
+
+class TestHubClient:
+    def test_resolve_revision(self, cfg):
+        client = HubClient(cfg)
+        sha = client.resolve_revision("test-org/tiny-model", "main")
+        assert sha.startswith("f1x7ure5ha")
+
+    def test_resolve_unknown_repo(self, cfg):
+        with pytest.raises(HubError):
+            HubClient(cfg).resolve_revision("nope/missing", "main")
+
+    def test_list_files_with_xet_detection(self, cfg, rng_files):
+        entries = {e.path: e for e in HubClient(cfg).list_files(
+            "test-org/tiny-model"
+        )}
+        assert set(entries) == set(rng_files)
+        assert entries["model.safetensors"].is_xet
+        assert not entries["config.json"].is_xet
+        assert entries["model.safetensors"].size == 300_000
+
+    def test_download_regular_file(self, cfg, tmp_path, rng_files):
+        dest = tmp_path / "out" / "config.json"
+        n = HubClient(cfg).download_regular_file(
+            "test-org/tiny-model", "main", "config.json", dest
+        )
+        assert dest.read_bytes() == rng_files["config.json"]
+        assert n == len(rng_files["config.json"])
+
+    def test_xet_token_exchange(self, cfg, hub):
+        cas_url, token = HubClient(cfg).xet_read_token("test-org/tiny-model")
+        assert cas_url == hub.url and token == "fixture-access-token"
+
+
+class TestCasClient:
+    def _cas(self, cfg):
+        cas_url, token = HubClient(cfg).xet_read_token("test-org/tiny-model")
+        return CasClient(cas_url, token)
+
+    def test_reconstruction_matches_file(self, cfg, hub, rng_files):
+        cas = self._cas(cfg)
+        entries = HubClient(cfg).list_files("test-org/tiny-model")
+        xet_file = next(e for e in entries if e.is_xet)
+        rec = cas.get_reconstruction(xet_file.xet_hash)
+        assert rec.total_bytes == len(rng_files["model.safetensors"])
+        # chunks_per_xorb=2 on a 300KB file must force multiple terms
+        assert len(rec.terms) > 1
+
+    def test_full_fetch_and_reassembly(self, cfg, hub, rng_files):
+        cas = self._cas(cfg)
+        entries = HubClient(cfg).list_files("test-org/tiny-model")
+        xet_file = next(e for e in entries if e.is_xet)
+        rec = cas.get_reconstruction(xet_file.xet_hash)
+        out = bytearray()
+        for term in rec.terms:
+            fi = rec.find_fetch_info(term)
+            assert fi is not None, "every term must have covering fetch info"
+            blob = cas.fetch_xorb_from_url(
+                hub.url + fi.url, (fi.url_range_start, fi.url_range_end)
+            )
+            reader = XorbReader(blob)
+            local_start = term.range.start - fi.range.start
+            local_end = term.range.end - fi.range.start
+            out += reader.extract_chunk_range(local_start, local_end)
+        assert bytes(out) == rng_files["model.safetensors"]
+
+    def test_byte_range_fetch_is_subset(self, cfg, hub):
+        cas = self._cas(cfg)
+        xh_hex = next(iter(hub.repos["test-org/tiny-model"].xorbs))
+        xf = hub.repos["test-org/tiny-model"].xorbs[xh_hex]
+        full = cas.fetch_xorb_from_url(hub.url + f"/xorbs/{xh_hex}")
+        assert full == xf.blob
+        part = cas.fetch_xorb_from_url(
+            hub.url + f"/xorbs/{xh_hex}", (0, xf.frame_offsets[1])
+        )
+        assert part == xf.blob[: xf.frame_offsets[1]]
+        assert len(XorbReader(part)) == 1
+
+    def test_unauthorized_reconstruction_rejected(self, cfg, hub):
+        cas = CasClient(hub.url, "wrong-token")
+        with pytest.raises(CasError):
+            cas.get_reconstruction("0" * 64)
+
+    def test_missing_reconstruction_404(self, cfg):
+        cas = self._cas(cfg)
+        with pytest.raises(CasError, match="no reconstruction"):
+            cas.get_reconstruction("f" * 64)
+
+    def test_invalid_byte_range_rejected(self, cfg, hub):
+        cas = self._cas(cfg)
+        with pytest.raises(CasError, match="invalid byte range"):
+            cas.fetch_xorb_from_url(hub.url + "/xorbs/xx", (5, 5))
+
+
+def test_fixture_dedup_across_files():
+    """Two files sharing content must share chunk hashes (CDC dedup)."""
+    shared = os.urandom(200_000)
+    repo = FixtureRepo(
+        "o/r",
+        {"a.safetensors": shared, "b.safetensors": shared + os.urandom(50_000)},
+    )
+    recs = list(repo.reconstructions.values())
+    assert len(recs) == 2
+    h0 = {hashing.hash_to_hex(t.xorb_hash) for t in recs[0].terms}
+    h1 = {hashing.hash_to_hex(t.xorb_hash) for t in recs[1].terms}
+    # Same leading content -> at least the first xorb content overlaps via
+    # shared chunks; verify chunk-level sharing through the xorb store.
+    chunk_sets = []
+    for hexes in (h0, h1):
+        s = set()
+        for xh in hexes:
+            s |= {h for h, _ in XorbReader(repo.xorbs[xh].blob).chunk_hashes()}
+        chunk_sets.append(s)
+    assert chunk_sets[0] & chunk_sets[1], "no shared chunks despite shared content"

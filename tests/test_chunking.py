@@ -1,0 +1,76 @@
+"""GearHash CDC tests: determinism, bounds, shift-invariance, native parity."""
+
+import os
+import random
+
+import pytest
+
+from zest_tpu.cas import chunking
+from zest_tpu.cas.chunking import MAX_CHUNK, MIN_CHUNK, _cut_points_py, cut_points
+
+
+def test_empty():
+    assert cut_points(b"") == []
+
+
+def test_small_input_single_chunk():
+    data = os.urandom(1000)
+    assert cut_points(data) == [1000]
+
+
+def test_chunks_cover_input_exactly():
+    data = os.urandom(1_000_000)
+    cuts = cut_points(data)
+    assert cuts[-1] == len(data)
+    assert cuts == sorted(set(cuts))
+    prev = 0
+    for c in cuts[:-1]:
+        assert MIN_CHUNK <= c - prev <= MAX_CHUNK
+        prev = c
+    assert c if cuts else True
+
+
+def test_deterministic():
+    data = os.urandom(500_000)
+    assert cut_points(data) == cut_points(data)
+
+
+def test_average_chunk_size_near_target():
+    rng = random.Random(7)
+    data = rng.randbytes(8 * 1024 * 1024)
+    cuts = cut_points(data)
+    avg = len(data) / len(cuts)
+    # CDC average should be within 2x of target either way.
+    assert chunking.TARGET_CHUNK / 2 < avg < chunking.TARGET_CHUNK * 2
+
+
+def test_content_defined_boundaries_survive_prefix_shift():
+    # Insert bytes at the front: boundaries must re-align after ~1 chunk,
+    # which is the entire point of CDC dedup.
+    rng = random.Random(42)
+    data = rng.randbytes(1_000_000)
+    cuts_a = set(cut_points(data))
+    shifted = rng.randbytes(777) + data
+    cuts_b = {c - 777 for c in cut_points(shifted)}
+    late_a = {c for c in cuts_a if c > 300_000}
+    assert late_a and late_a.issubset(cuts_b | {len(data)})
+
+
+def test_native_matches_python():
+    from zest_tpu.native import lib
+
+    if not lib.available():
+        pytest.skip("native lib unavailable")
+    rng = random.Random(3)
+    for n in (0, 100, MIN_CHUNK, 300_000, 1_000_000):
+        data = rng.randbytes(n)
+        assert lib.gear_cut_points(
+            data, MIN_CHUNK, MAX_CHUNK, chunking.MASK
+        ) == _cut_points_py(memoryview(data))
+
+
+def test_chunk_stream_reassembles():
+    data = os.urandom(400_000)
+    pieces = list(chunking.chunk_stream(data))
+    assert b"".join(p for _, p in pieces) == data
+    assert all(ch.length == len(p) for ch, p in pieces)

@@ -1,0 +1,143 @@
+"""LZ4 + transform scheme tests: roundtrips, cross-backend, hostile input."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from zest_tpu.cas import compression as comp
+from zest_tpu.cas.compression import (
+    CompressionError,
+    Scheme,
+    _lz4_compress_py,
+    _lz4_decompress_py,
+)
+
+
+def _native():
+    from zest_tpu.native import lib
+
+    return lib if lib.available() else None
+
+
+CASES = [
+    b"",
+    b"a",
+    b"abcd" * 100,
+    os.urandom(100),
+    b"\x00" * 10_000,
+    bytes(range(256)) * 300,
+    os.urandom(70_000),
+    b"The quick brown fox " * 5000,
+]
+
+
+class TestLZ4Python:
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_roundtrip(self, i):
+        data = CASES[i]
+        c = _lz4_compress_py(data)
+        assert _lz4_decompress_py(c, len(data)) == data
+
+    def test_compresses_repetitive(self):
+        data = b"x" * 100_000
+        assert len(_lz4_compress_py(data)) < 1000
+
+    def test_overlapping_match(self):
+        # offset 1 run replication — the classic RLE-via-LZ4 case
+        data = b"ab" + b"a" * 1000
+        c = _lz4_compress_py(data)
+        assert _lz4_decompress_py(c, len(data)) == data
+
+    def test_truncated_input_rejected(self):
+        c = _lz4_compress_py(b"hello world, hello world, hello world")
+        for cut in (1, len(c) // 2, len(c) - 1):
+            with pytest.raises(CompressionError):
+                _lz4_decompress_py(c[:cut], 37)
+
+    def test_bad_offset_rejected(self):
+        # token: 0 literals + match len 4, offset 5 with empty history
+        with pytest.raises(CompressionError):
+            _lz4_decompress_py(b"\x00\x05\x00", 4)
+
+    def test_wrong_expected_len_rejected(self):
+        c = _lz4_compress_py(b"abcdef")
+        with pytest.raises(CompressionError):
+            _lz4_decompress_py(c, 7)
+
+
+class TestLZ4NativeCross:
+    @pytest.fixture(scope="class")
+    def native(self):
+        lib = _native()
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        return lib
+
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_native_roundtrip(self, native, i):
+        data = CASES[i]
+        c = native.lz4_compress(data)
+        assert native.lz4_decompress(c, len(data)) == data
+
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_py_compress_native_decompress(self, native, i):
+        data = CASES[i]
+        assert native.lz4_decompress(_lz4_compress_py(data), len(data)) == data
+
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_native_compress_py_decompress(self, native, i):
+        data = CASES[i]
+        assert _lz4_decompress_py(native.lz4_compress(data), len(data)) == data
+
+    def test_native_rejects_garbage(self, native):
+        with pytest.raises(CompressionError):
+            native.lz4_decompress(b"\xff\xff\xff\xff", 100)
+
+    def test_native_rejects_garbage_for_zero_expected(self, native):
+        # Regression: n==0 return is ambiguous with expected_len==0.
+        with pytest.raises(CompressionError):
+            native.lz4_decompress(b"\xff\xff", 0)
+
+    def test_random_fuzz_cross(self, native):
+        rng = random.Random(99)
+        for _ in range(25):
+            n = rng.randrange(0, 5000)
+            data = rng.randbytes(n) if rng.random() < 0.5 else bytes(
+                rng.choices(b"abcab", k=n)
+            )
+            c1, c2 = _lz4_compress_py(data), native.lz4_compress(data)
+            assert native.lz4_decompress(c1, n) == data
+            assert _lz4_decompress_py(c2, n) == data
+
+
+class TestSchemes:
+    @pytest.mark.parametrize("scheme", list(Scheme))
+    def test_roundtrip_all_schemes(self, scheme):
+        data = np.arange(4096, dtype=np.float32).tobytes()
+        c = comp.compress(data, scheme)
+        assert comp.decompress(c, scheme, len(data)) == data
+
+    @pytest.mark.parametrize("scheme", list(Scheme))
+    @pytest.mark.parametrize("n", [0, 1, 3, 7, 1021])
+    def test_awkward_lengths(self, scheme, n):
+        data = os.urandom(n)
+        c = comp.compress(data, scheme)
+        assert comp.decompress(c, scheme, n) == data
+
+    def test_bg4_beats_plain_on_float_data(self):
+        # fp32 weights: planar regrouping should compress much better.
+        rng = np.random.default_rng(0)
+        data = (rng.standard_normal(16384) * 0.02).astype(np.float32).tobytes()
+        plain = comp.compress(data, Scheme.LZ4)
+        bg4 = comp.compress(data, Scheme.BG4_LZ4)
+        assert len(bg4) < len(plain)
+
+    def test_auto_picks_none_for_random(self):
+        scheme, payload = comp.compress_auto(os.urandom(4096))
+        assert scheme == Scheme.NONE and len(payload) == 4096
+
+    def test_auto_picks_compressed_for_text(self):
+        scheme, payload = comp.compress_auto(b"weights " * 1000)
+        assert scheme != Scheme.NONE and len(payload) < 8000

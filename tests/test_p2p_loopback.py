@@ -1,0 +1,224 @@
+"""Loopback peer-to-peer integration tests.
+
+The reference's 2-node Docker harness (p2p-docker-test.sh) in-process: a
+seeder (BtServer over a warm cache) and a leecher (pull with a direct
+peer), asserting bytes actually came from the peer and not the CDN. This
+is deeper than the reference's unit tier, which had no loopback peer test
+(SURVEY.md §4 limitation).
+"""
+
+import os
+
+import pytest
+
+from zest_tpu import storage
+from zest_tpu.cas import hashing
+from zest_tpu.config import Config
+from zest_tpu.p2p import peer_id as peer_id_mod
+from zest_tpu.p2p.peer import BtPeer, ChunkNotFoundError
+from zest_tpu.transfer.pull import pull_model
+from zest_tpu.transfer.server import BtServer
+from zest_tpu.transfer.swarm import SwarmDownloader
+
+from fixtures import FixtureHub, FixtureRepo
+
+FILES = {
+    "config.json": b'{"model_type": "loopback"}',
+    "model.safetensors": os.urandom(500_000),
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo("acme/p2p-model", FILES, chunks_per_xorb=2)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+def _cfg(hub, root, listen_port=0):
+    return Config(
+        hf_home=root / "hf",
+        cache_dir=root / "zest",
+        hf_token="hf_test",
+        endpoint=hub.url,
+        listen_port=listen_port,
+    )
+
+
+@pytest.fixture
+def seeder(hub, tmp_path):
+    """A host that pulled via CDN and now serves its cache."""
+    cfg = _cfg(hub, tmp_path / "seeder")
+    pull_model(cfg, "acme/p2p-model", no_p2p=True)
+    server = BtServer(cfg)
+    port = server.start()
+    yield cfg, port
+    server.shutdown()
+
+
+class TestRawPeerProtocol:
+    def test_handshake_and_chunk_fetch(self, hub, seeder, tmp_path):
+        seeder_cfg, port = seeder
+        cached = storage.list_cached_xorbs(seeder_cfg)
+        assert cached
+        xorb_hash = hashing.hex_to_hash(cached[0])
+        info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+
+        peer = BtPeer.connect(
+            "127.0.0.1", port, info_hash, peer_id_mod.generate()
+        )
+        try:
+            blob = storage.XorbCache(seeder_cfg).get(cached[0])
+            from zest_tpu.cas.xorb import XorbReader
+
+            n = len(XorbReader(blob))
+            result = peer.request_chunk(xorb_hash, 0, n)
+            assert result.chunk_offset == 0
+            assert result.data == blob
+        finally:
+            peer.close()
+
+    def test_range_request_gets_sliced_frames(self, hub, seeder):
+        seeder_cfg, port = seeder
+        from zest_tpu.cas.xorb import XorbReader
+
+        cached = storage.list_cached_xorbs(seeder_cfg)
+        key = next(
+            k for k in cached
+            if len(XorbReader(storage.XorbCache(seeder_cfg).get(k))) >= 2
+        )
+        xorb_hash = hashing.hex_to_hash(key)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(xorb_hash), peer_id_mod.generate(),
+        )
+        try:
+            result = peer.request_chunk(xorb_hash, 1, 2)
+            assert result.chunk_offset == 1
+            reader = XorbReader(result.data)
+            assert len(reader) == 1
+            full = XorbReader(storage.XorbCache(seeder_cfg).get(key))
+            assert reader.extract_chunk(0) == full.extract_chunk(1)
+        finally:
+            peer.close()
+
+    def test_unknown_chunk_not_found(self, hub, seeder):
+        _, port = seeder
+        missing = os.urandom(32)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(missing), peer_id_mod.generate(),
+        )
+        try:
+            with pytest.raises(ChunkNotFoundError):
+                peer.request_chunk(missing, 0, 1)
+        finally:
+            peer.close()
+
+    def test_chunk_cache_tier_served_as_frame_stream(self, hub, seeder):
+        """Tier-1 (chunk cache) responses must be parseable frame streams,
+        same shape as every other waterfall tier."""
+        from zest_tpu.cas.xorb import XorbReader
+
+        seeder_cfg, port = seeder
+        chunk = os.urandom(4000)
+        h = hashing.chunk_hash(chunk)
+        storage.write_chunk(seeder_cfg, h, chunk)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(h), peer_id_mod.generate(),
+        )
+        try:
+            result = peer.request_chunk(h, 0, 1)
+            reader = XorbReader(result.data)
+            assert len(reader) == 1
+            assert reader.extract_chunk(0) == chunk
+        finally:
+            peer.close()
+
+    def test_pipelined_requests(self, hub, seeder):
+        seeder_cfg, port = seeder
+        from zest_tpu.cas.xorb import XorbReader
+
+        cached = storage.list_cached_xorbs(seeder_cfg)
+        xorb_hash = hashing.hex_to_hash(cached[0])
+        blob = storage.XorbCache(seeder_cfg).get(cached[0])
+        n = len(XorbReader(blob))
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(xorb_hash), peer_id_mod.generate(),
+        )
+        try:
+            results = peer.request_chunks_pipelined(
+                [(xorb_hash, 0, n), (os.urandom(32), 0, 1), (xorb_hash, 0, n)]
+            )
+            assert results[0].data == blob
+            assert isinstance(results[1], ChunkNotFoundError)
+            assert results[2].data == blob
+        finally:
+            peer.close()
+
+
+class TestLeecherPull:
+    def test_pull_via_peer_not_cdn(self, hub, seeder, tmp_path):
+        """The docker-test pass criterion: >0 xorbs from peers; ideal 100%
+        P2P (reference: p2p-docker-test.sh:204-218). We assert the ideal:
+        all xorb bytes from the peer, zero CDN xorb fetches."""
+        _, seeder_port = seeder
+        leecher_cfg = _cfg(hub, tmp_path / "leecher")
+        swarm = SwarmDownloader(leecher_cfg)
+        swarm.add_direct_peer("127.0.0.1", seeder_port)
+        try:
+            result = pull_model(leecher_cfg, "acme/p2p-model", swarm=swarm)
+        finally:
+            swarm.close()
+
+        snap = result.snapshot_dir
+        for name, data in FILES.items():
+            assert (snap / name).read_bytes() == data, f"{name} corrupt"
+
+        fetch = result.stats["fetch"]
+        assert fetch["bytes"]["peer"] > 0, "no bytes from peers"
+        assert fetch["xorbs"]["cdn"] == 0, (
+            f"leecher hit CDN despite warm seeder: {fetch}"
+        )
+        assert result.stats["swarm"]["chunks_from_peers"] > 0
+
+    def test_leecher_becomes_seeder(self, hub, seeder, tmp_path):
+        """Seed-while-downloading: after a P2P pull, the leecher's cache
+        must serve a second leecher (swarm.zig:426-429 semantics)."""
+        _, seeder_port = seeder
+        l1_cfg = _cfg(hub, tmp_path / "l1")
+        swarm1 = SwarmDownloader(l1_cfg)
+        swarm1.add_direct_peer("127.0.0.1", seeder_port)
+        pull_model(l1_cfg, "acme/p2p-model", swarm=swarm1)
+        swarm1.close()
+
+        l1_server = BtServer(l1_cfg)
+        l1_port = l1_server.start()
+        try:
+            l2_cfg = _cfg(hub, tmp_path / "l2")
+            swarm2 = SwarmDownloader(l2_cfg)
+            swarm2.add_direct_peer("127.0.0.1", l1_port)
+            result = pull_model(l2_cfg, "acme/p2p-model", swarm=swarm2)
+            swarm2.close()
+            assert result.stats["fetch"]["xorbs"]["cdn"] == 0
+            assert (result.snapshot_dir / "model.safetensors").read_bytes() \
+                == FILES["model.safetensors"]
+        finally:
+            l1_server.shutdown()
+
+    def test_dead_peer_falls_back_to_cdn(self, hub, tmp_path):
+        """Waterfall resilience: unreachable peer must not break the pull
+        (never-slower-than-CDN guarantee, BASELINE.md scenario 1)."""
+        cfg = _cfg(hub, tmp_path / "orphan")
+        swarm = SwarmDownloader(cfg)
+        swarm.add_direct_peer("127.0.0.1", 1)  # nothing listens there
+        try:
+            result = pull_model(cfg, "acme/p2p-model", swarm=swarm)
+        finally:
+            swarm.close()
+        assert (result.snapshot_dir / "model.safetensors").read_bytes() == \
+            FILES["model.safetensors"]
+        assert result.stats["fetch"]["bytes"]["cdn"] > 0
+        assert result.stats["swarm"]["peer_failures"] > 0

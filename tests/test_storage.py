@@ -1,0 +1,91 @@
+"""Storage tier tests: refs, chunk cache, xorb cache range semantics, registry."""
+
+import os
+
+from zest_tpu import storage
+from zest_tpu.storage import XorbCache, XorbRegistry
+
+
+def test_atomic_write_creates_parents(tmp_config):
+    p = tmp_config.cache_dir / "a" / "b" / "c.bin"
+    storage.atomic_write(p, b"data")
+    assert p.read_bytes() == b"data"
+    assert not list(p.parent.glob(".tmp-*"))
+
+
+def test_refs_roundtrip(tmp_config):
+    storage.write_ref(tmp_config, "org/model", "main", "abc123")
+    assert storage.read_ref(tmp_config, "org/model", "main") == "abc123"
+    assert storage.read_ref(tmp_config, "org/model", "missing") is None
+
+
+def test_chunk_cache_roundtrip(tmp_config):
+    h = os.urandom(32)
+    assert storage.read_chunk(tmp_config, h) is None
+    storage.write_chunk(tmp_config, h, b"chunk bytes")
+    assert storage.read_chunk(tmp_config, h) == b"chunk bytes"
+
+
+class TestXorbCache:
+    def test_full_entry(self, tmp_config):
+        cache = XorbCache(tmp_config)
+        hex_key = "ab" * 32
+        assert not cache.has(hex_key)
+        assert cache.get_with_range(hex_key, 0) is None
+        cache.put(hex_key, b"full xorb")
+        assert cache.has(hex_key)
+        result = cache.get_with_range(hex_key, 5)
+        assert result.data == b"full xorb" and result.chunk_offset == 0
+
+    def test_partial_entry(self, tmp_config):
+        cache = XorbCache(tmp_config)
+        hex_key = "cd" * 32
+        cache.put_partial(hex_key, 7, b"partial blob")
+        # Full lookup misses, exact partial hits with rebase offset.
+        assert cache.get(hex_key) is None
+        result = cache.get_with_range(hex_key, 7)
+        assert result.data == b"partial blob" and result.chunk_offset == 7
+        # Different range start misses (exact-match semantics,
+        # reference swarm.zig:81-95).
+        assert cache.get_with_range(hex_key, 6) is None
+
+    def test_full_preferred_over_partial(self, tmp_config):
+        cache = XorbCache(tmp_config)
+        hex_key = "ef" * 32
+        cache.put_partial(hex_key, 3, b"part")
+        cache.put(hex_key, b"whole")
+        assert cache.get_with_range(hex_key, 3).chunk_offset == 0
+
+
+def test_list_cached_xorbs_excludes_partials(tmp_config):
+    cache = XorbCache(tmp_config)
+    cache.put("11" * 32, b"x")
+    cache.put("22" * 32, b"y")
+    cache.put_partial("33" * 32, 4, b"z")
+    assert storage.list_cached_xorbs(tmp_config) == ["11" * 32, "22" * 32]
+
+
+class TestRegistry:
+    def test_scan(self, tmp_config):
+        cache = XorbCache(tmp_config)
+        cache.put("aa" * 32, b"full blob")
+        cache.put_partial("bb" * 32, 12, b"part blob")
+        reg = XorbRegistry()
+        assert reg.scan(tmp_config) == 2
+        assert reg.has("aa" * 32)
+        assert reg.get("aa" * 32).size == 9
+        assert reg.get("bb" * 32).partial_starts == (12,)
+
+    def test_add_merges_partials(self):
+        reg = XorbRegistry()
+        reg.add("cc" * 32, 100, (3,))
+        reg.add("cc" * 32, 100, (9,))
+        assert reg.get("cc" * 32).partial_starts == (3, 9)
+        assert len(reg) == 1
+
+    def test_scan_ignores_tmp_files(self, tmp_config):
+        d = tmp_config.xorb_cache_dir() / "aa"
+        d.mkdir(parents=True)
+        (d / ".tmp-partial").write_bytes(b"junk")
+        reg = XorbRegistry()
+        assert reg.scan(tmp_config) == 0

@@ -1,0 +1,131 @@
+"""BT wire + BEP XET codec tests, fixed-buffer roundtrip style
+(parity: reference bt_wire.zig:160-233, bep_xet.zig:240-332)."""
+
+import os
+
+import pytest
+
+from zest_tpu.p2p import bep_xet, wire
+from zest_tpu.p2p.bep_xet import (
+    ChunkError,
+    ChunkNotFound,
+    ChunkRequest,
+    ChunkResponse,
+)
+
+
+class TestHandshake:
+    def test_roundtrip(self):
+        ih, pid = os.urandom(20), os.urandom(20)
+        buf = wire.encode_handshake(ih, pid)
+        assert len(buf) == 68
+        hs = wire.decode_handshake(buf)
+        assert hs.info_hash == ih and hs.peer_id == pid
+        assert hs.supports_bep10
+
+    def test_wire_layout(self):
+        buf = wire.encode_handshake(b"\x01" * 20, b"\x02" * 20)
+        assert buf[0] == 19
+        assert buf[1:20] == b"BitTorrent protocol"
+        assert buf[25] == 0x10  # reserved byte 5: BEP 10 bit
+
+    def test_bad_protocol_string_rejected(self):
+        buf = bytearray(wire.encode_handshake(b"\x01" * 20, b"\x02" * 20))
+        buf[5] ^= 0xFF
+        with pytest.raises(wire.WireError):
+            wire.decode_handshake(bytes(buf))
+
+    def test_bad_length_rejected(self):
+        with pytest.raises(wire.WireError):
+            wire.decode_handshake(b"short")
+        with pytest.raises(wire.WireError):
+            wire.encode_handshake(b"short", b"\x02" * 20)
+
+
+class TestFraming:
+    def test_message_layout(self):
+        buf = wire.encode_message(wire.MessageId.UNCHOKE)
+        assert buf == b"\x00\x00\x00\x01\x01"
+
+    def test_extended_layout(self):
+        buf = wire.encode_extended(3, b"payload")
+        # [len=2+7][20][3]payload
+        assert buf[:4] == (2 + 7).to_bytes(4, "big")
+        assert buf[4] == 20 and buf[5] == 3
+        assert buf[6:] == b"payload"
+
+    def test_keepalive(self):
+        assert wire.encode_keepalive() == b"\x00" * 4
+
+    def test_size_cap(self):
+        with pytest.raises(wire.WireError):
+            wire.decode_message_header((wire.MAX_MESSAGE_SIZE + 1).to_bytes(4, "big"))
+
+    def test_parse_extended(self):
+        ext_id, payload = wire.parse_extended(b"\x07hello")
+        assert ext_id == 7 and payload == b"hello"
+        with pytest.raises(wire.WireError):
+            wire.parse_extended(b"")
+
+
+class TestXetMessages:
+    def test_chunk_request_45_bytes(self):
+        h = os.urandom(32)
+        buf = bep_xet.encode(ChunkRequest(7, h, 3, 9))
+        assert len(buf) == 45 and buf[0] == 0x01
+        msg = bep_xet.decode(buf)
+        assert msg == ChunkRequest(7, h, 3, 9)
+
+    def test_chunk_response_roundtrip(self):
+        data = os.urandom(5000)
+        buf = bep_xet.encode(ChunkResponse(9, 12, data))
+        msg = bep_xet.decode(buf)
+        assert msg == ChunkResponse(9, 12, data)
+        assert buf[0] == 0x02 and len(buf) == 13 + len(data)
+
+    def test_chunk_not_found_37_bytes(self):
+        h = os.urandom(32)
+        buf = bep_xet.encode(ChunkNotFound(4, h))
+        assert len(buf) == 37 and buf[0] == 0x03
+        assert bep_xet.decode(buf) == ChunkNotFound(4, h)
+
+    def test_chunk_error_roundtrip(self):
+        buf = bep_xet.encode(ChunkError(2, 500, b"boom"))
+        assert buf[0] == 0x04
+        assert bep_xet.decode(buf) == ChunkError(2, 500, b"boom")
+
+    def test_length_field_mismatch_rejected(self):
+        buf = bytearray(bep_xet.encode(ChunkResponse(1, 0, b"abc")))
+        buf += b"EXTRA"
+        with pytest.raises(bep_xet.XetMessageError):
+            bep_xet.decode(bytes(buf))
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(bep_xet.XetMessageError):
+            bep_xet.decode(b"\x99" + b"\x00" * 44)
+
+    def test_truncated_rejected(self):
+        for bad in [b"", b"\x01short", b"\x02\x00\x00"]:
+            with pytest.raises(bep_xet.XetMessageError):
+                bep_xet.decode(bad)
+
+
+class TestExtHandshake:
+    def test_roundtrip(self):
+        buf = bep_xet.make_ext_handshake(3, listen_port=6881)
+        caps = bep_xet.parse_ext_handshake(buf)
+        assert caps.ut_xet_id == 3
+        assert caps.listen_port == 6881
+        assert caps.client and caps.client.startswith(b"zest-tpu/")
+
+    def test_no_ut_xet(self):
+        from zest_tpu.p2p import bencode
+
+        caps = bep_xet.parse_ext_handshake(
+            bencode.encode({b"m": {b"ut_other": 1}, b"v": b"x"})
+        )
+        assert caps.ut_xet_id is None
+
+    def test_garbage_rejected(self):
+        with pytest.raises(bep_xet.XetMessageError):
+            bep_xet.parse_ext_handshake(b"not bencode at all \xff")

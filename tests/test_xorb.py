@@ -1,0 +1,141 @@
+"""Xorb frame-stream tests: roundtrip, range slicing, verification, hostile input."""
+
+import os
+import struct
+
+import pytest
+
+from zest_tpu.cas import hashing, xorb
+from zest_tpu.cas.xorb import XorbBuilder, XorbFormatError, XorbReader
+
+
+def _build(chunks):
+    b = XorbBuilder()
+    for c in chunks:
+        b.add_chunk(c)
+    return b
+
+
+class TestRoundtrip:
+    def test_single_chunk(self):
+        b = _build([b"hello world" * 100])
+        r = XorbReader(b.serialize())
+        assert r.extract_chunk(0) == b"hello world" * 100
+        assert r.xorb_hash() == b.xorb_hash()
+
+    def test_many_chunks_range_extraction(self):
+        chunks = [os.urandom(1000 + i * 37) for i in range(20)]
+        r = XorbReader(_build(chunks).serialize())
+        assert len(r) == 20
+        assert r.extract_chunk_range(0, 20) == b"".join(chunks)
+        assert r.extract_chunk_range(5, 8) == b"".join(chunks[5:8])
+        assert r.extract_chunk_range(19, 20) == chunks[19]
+
+    def test_byte_slice_is_parseable_blob(self):
+        # The property the whole transfer economy relies on: a chunk-range
+        # byte slice is itself a valid xorb blob.
+        chunks = [os.urandom(2000) for _ in range(10)]
+        b = _build(chunks)
+        blob = b.serialize()
+        offs = b.frame_offsets()
+        sub = blob[offs[3] : offs[7]]
+        assert sub == XorbReader(blob).slice_range(3, 7)
+        r = XorbReader(sub)
+        assert len(r) == 4
+        assert r.extract_chunk_range(0, 4) == b"".join(chunks[3:7])
+
+    def test_compressible_chunks_shrink(self):
+        chunks = [b"wwww" * 8000 for _ in range(4)]
+        blob = _build(chunks).serialize()
+        assert len(blob) < sum(len(c) for c in chunks) // 4
+
+    def test_cdc_convenience(self):
+        data = os.urandom(300_000)
+        xh, blob, chunk_hashes = xorb.build_from_data(data)
+        r = XorbReader(blob)
+        assert r.extract_chunk_range(0, len(r)) == data
+        assert r.xorb_hash() == xh
+        assert r.chunk_hashes() == chunk_hashes
+
+    def test_identity_independent_of_compression(self):
+        data = b"model weights " * 1000
+        h = hashing.chunk_hash(data)
+        b = _build([data])
+        assert b.chunk_hashes()[0][0] == h
+
+    def test_empty_blob(self):
+        r = XorbReader(b"")
+        assert len(r) == 0
+
+
+class TestHostileInput:
+    def test_truncated_frame_header(self):
+        blob = _build([b"x" * 100]).serialize()
+        with pytest.raises(XorbFormatError):
+            XorbReader(blob[:10])
+
+    def test_payload_extends_past_end(self):
+        blob = _build([b"y" * 5000]).serialize()
+        with pytest.raises(XorbFormatError):
+            XorbReader(blob[:-10])
+
+    def test_unknown_scheme_rejected(self):
+        blob = bytearray(_build([b"z" * 100]).serialize())
+        blob[0] = 0xEE  # scheme byte
+        with pytest.raises(XorbFormatError):
+            XorbReader(bytes(blob))
+
+    def test_corrupted_chunk_fails_verification(self):
+        chunks = [os.urandom(5000)]
+        blob = bytearray(_build(chunks).serialize())
+        blob[-1] ^= 0xFF
+        r = XorbReader(bytes(blob))
+        with pytest.raises(Exception):  # hash mismatch or decode error
+            r.extract_chunk(0)
+
+    def test_corruption_skippable_without_verify(self):
+        chunks = [os.urandom(5000)]
+        r = XorbReader(_build(chunks).serialize())
+        assert r.extract_chunk(0, verify=False) == chunks[0]
+
+    def test_tampered_hash_detected(self):
+        blob = bytearray(_build([b"q" * 3000]).serialize())
+        blob[8] ^= 0x01  # first hash byte
+        r = XorbReader(bytes(blob))
+        with pytest.raises(XorbFormatError, match="hash mismatch"):
+            r.extract_chunk(0)
+
+    def test_absurd_uncompressed_len_rejected(self):
+        # Untrusted frame header must not dictate allocations: claim 4 GiB.
+        import struct as _struct
+
+        frame = bytearray(_build([b"x" * 100]).serialize())
+        _struct.pack_into("<I", frame, 4, 0xFFFFFFFF)
+        with pytest.raises(XorbFormatError, match="claims"):
+            XorbReader(bytes(frame))
+
+    def test_oversized_chunk_rejected_at_build(self):
+        from zest_tpu.cas.xorb import MAX_CHUNK_BYTES, encode_frame
+
+        with pytest.raises(XorbFormatError):
+            encode_frame(b"\x00" * (MAX_CHUNK_BYTES + 1))
+
+    def test_serialized_size_respects_wire_cap(self):
+        from zest_tpu.cas.xorb import MAX_XORB_BYTES
+        from zest_tpu.p2p import wire
+
+        b = XorbBuilder()
+        piece = os.urandom(1024 * 1024)
+        while not b.would_overflow(len(piece)):
+            b.add_chunk(piece)
+        blob = b.serialize()
+        assert len(blob) <= MAX_XORB_BYTES
+        # A full xorb must always fit in one BEP XET response frame.
+        framed = wire.encode_extended(3, b"\x02" + b"\x00" * 12 + blob)
+        assert len(framed) - 4 - 1 <= wire.MAX_MESSAGE_SIZE
+
+    def test_range_bounds_checked(self):
+        r = XorbReader(_build([b"a" * 100]).serialize())
+        for start, end in [(-1, 1), (0, 2), (1, 1), (2, 1)]:
+            with pytest.raises(XorbFormatError):
+                r.extract_chunk_range(start, end)

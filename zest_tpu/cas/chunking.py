@@ -1,0 +1,101 @@
+"""GearHash content-defined chunking (the zig-xet `chunking` equivalent).
+
+Splits byte streams into content-defined chunks (min 8KB / target 64KB /
+max 128KB — the Xet parameters, reference DESIGN.md:265-273) so identical
+content produces identical chunk boundaries regardless of surrounding bytes;
+this is what makes chunk-level dedup work across model revisions.
+
+Algorithm: GearHash rolling hash — ``h = (h << 1) + GEAR[byte]`` — with a cut
+when the top ``log2(target - min)`` bits of ``h`` are all zero. The gear
+table is deterministic (derived from BLAKE3 of the table index under a
+documented context) and is a compatibility seam: substitute the production
+Xet table for boundary-level interop with HF's CAS.
+
+Hot path dispatches to the native C++ scanner (zest_tpu/native/gearhash.cc)
+when available; the pure-Python implementation is the correctness anchor.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterator
+
+from zest_tpu.cas import blake3 as _b3
+
+MIN_CHUNK = 8 * 1024
+TARGET_CHUNK = 64 * 1024
+MAX_CHUNK = 128 * 1024
+
+# Cut when the top bits of the rolling hash are zero. With 16 mask bits the
+# expected gap between qualifying positions is 2^16 = 64 KiB; the MIN_CHUNK
+# skip shifts the mean to ~MIN + 64 KiB and MAX_CHUNK truncates the
+# geometric tail, landing the realized average near the 64 KiB Xet target.
+_MASK_BITS = TARGET_CHUNK.bit_length() - 1  # 16
+MASK = ((1 << _MASK_BITS) - 1) << (64 - _MASK_BITS)
+
+_GEAR_CONTEXT = "zest-tpu gearhash table v1"
+
+
+def _make_gear_table() -> tuple[int, ...]:
+    # 256 pseudorandom u64s, deterministically derived so every
+    # implementation (Python, C++, tests) agrees byte-for-byte.
+    material = _b3.blake3_derive_key(_GEAR_CONTEXT, b"gear", 256 * 8)
+    return struct.unpack("<256Q", material)
+
+
+GEAR = _make_gear_table()
+
+_U64 = (1 << 64) - 1
+
+
+@dataclass(frozen=True)
+class Chunk:
+    offset: int
+    length: int
+
+
+def _cut_points_py(data: memoryview) -> list[int]:
+    """Return chunk end offsets (exclusive) for ``data``."""
+    cuts: list[int] = []
+    n = len(data)
+    start = 0
+    h = 0
+    i = 0
+    while i < n:
+        h = ((h << 1) + GEAR[data[i]]) & _U64
+        i += 1
+        length = i - start
+        if length >= MIN_CHUNK and (h & MASK) == 0 or length >= MAX_CHUNK:
+            cuts.append(i)
+            start = i
+            h = 0
+    if start < n:
+        cuts.append(n)
+    return cuts
+
+
+def cut_points(data: bytes | memoryview) -> list[int]:
+    data = memoryview(data)
+    native = _get_native()
+    if native is not None and len(data) > 0:
+        return native.gear_cut_points(bytes(data), MIN_CHUNK, MAX_CHUNK, MASK)
+    return _cut_points_py(data)
+
+
+def chunk_stream(data: bytes | memoryview) -> Iterator[tuple[Chunk, bytes]]:
+    """Yield (Chunk, chunk bytes) pairs covering ``data`` exactly."""
+    data = memoryview(data)
+    start = 0
+    for end in cut_points(data):
+        yield Chunk(start, end - start), bytes(data[start:end])
+        start = end
+
+
+def _get_native():
+    try:
+        from zest_tpu.native import lib
+
+        return lib if lib.available() and hasattr(lib, "gear_cut_points") else None
+    except Exception:
+        return None

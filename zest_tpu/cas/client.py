@@ -1,0 +1,70 @@
+"""CAS client: reconstruction queries and ranged xorb fetches.
+
+The zig-xet `cas_client` equivalent (SURVEY.md §2.2): authenticated
+requests against the CAS endpoint obtained from the xet-read-token
+exchange, returning reconstruction plans and raw xorb bytes (full or HTTP
+byte-range). Every byte that leaves this client is still untrusted until
+chunk hashes verify during extraction.
+"""
+
+from __future__ import annotations
+
+import requests
+
+from zest_tpu.cas import reconstruction as recon
+
+
+class CasError(RuntimeError):
+    pass
+
+
+class CasClient:
+    def __init__(
+        self,
+        cas_url: str,
+        access_token: str | None = None,
+        session: requests.Session | None = None,
+    ):
+        self.cas_url = cas_url.rstrip("/")
+        self.access_token = access_token
+        self.session = session or requests.Session()
+
+    def _headers(self) -> dict[str, str]:
+        if self.access_token:
+            return {"Authorization": f"Bearer {self.access_token}"}
+        return {}
+
+    def get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
+        """GET /v1/reconstructions/{hex} -> terms + fetch_info."""
+        url = f"{self.cas_url}/v1/reconstructions/{file_hash_hex}"
+        resp = self.session.get(url, headers=self._headers(), timeout=30)
+        if resp.status_code == 404:
+            raise CasError(f"no reconstruction for {file_hash_hex}")
+        if resp.status_code != 200:
+            raise CasError(f"GET {url} -> {resp.status_code}")
+        return recon.from_json(file_hash_hex, resp.json())
+
+    def fetch_xorb_from_url(
+        self, url: str, byte_range: tuple[int, int] | None = None
+    ) -> bytes:
+        """Fetch xorb bytes; ``byte_range`` is half-open [start, end).
+
+        Presigned CDN URLs carry their own auth — the bearer header is only
+        sent to the CAS origin itself (same-origin check on the URL).
+        """
+        headers: dict[str, str] = {}
+        if url.startswith(self.cas_url):
+            headers.update(self._headers())
+        if byte_range is not None:
+            start, end = byte_range
+            if not (0 <= start < end):
+                raise CasError(f"invalid byte range [{start},{end})")
+            headers["Range"] = f"bytes={start}-{end - 1}"
+        resp = self.session.get(url, headers=headers, timeout=120)
+        if resp.status_code not in (200, 206):
+            raise CasError(f"GET {url} -> {resp.status_code}")
+        data = resp.content
+        if byte_range is not None and resp.status_code == 200:
+            # Origin ignored the Range header; slice locally.
+            data = data[byte_range[0] : byte_range[1]]
+        return data

@@ -1,0 +1,256 @@
+"""Chunk compression schemes (the zig-xet `compression` equivalent).
+
+Four schemes, matching the reference's set (SURVEY.md §2.2, row
+`compression`): None, LZ4, ByteGrouping4LZ4, FullBitsliceLZ4.
+
+- **LZ4** is the standard LZ4 block format, implemented from the public
+  spec (no frame header — the xorb chunk header carries lengths).
+- **ByteGrouping4LZ4** regroups bytes into 4 planes (byte k of every 4-byte
+  group) before LZ4 — fp32/bf16 tensor bytes compress far better planar,
+  because exponent bytes are highly repetitive.
+- **FullBitsliceLZ4** slices each byte into 8 bit-planes first; best for
+  quantized weights, costliest to (de)code.
+
+``compress_auto`` picks the smallest encoding per chunk, falling back to
+None when compression doesn't pay.  Hot paths dispatch to the native C++
+codec (zest_tpu/native/lz4.cc) when available.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class Scheme(enum.IntEnum):
+    NONE = 0
+    LZ4 = 1
+    BG4_LZ4 = 2
+    BITSLICE_LZ4 = 3
+
+
+class CompressionError(ValueError):
+    pass
+
+
+# ── LZ4 block format (pure Python; spec: lz4 block format description) ──
+
+_MIN_MATCH = 4
+_HASH_LOG = 16
+_MAX_OFFSET = 0xFFFF
+
+
+def _lz4_compress_py(data: bytes) -> bytes:
+    n = len(data)
+    out = bytearray()
+    if n == 0:
+        return b"\x00"  # single empty-literals token
+    table: dict[int, int] = {}
+    anchor = 0
+    pos = 0
+    # Spec end conditions: last 5 bytes are literals; last match starts
+    # at least 12 bytes before the end.
+    match_limit = n - 12
+    while pos < match_limit:
+        seq = data[pos : pos + 4]
+        key = int.from_bytes(seq, "little")
+        cand = table.get(key)
+        table[key] = pos
+        if cand is None or pos - cand > _MAX_OFFSET or data[cand : cand + 4] != seq:
+            pos += 1
+            continue
+        # Extend match forward (may run up to the 5-byte literal tail).
+        mlen = 4
+        limit = n - 5
+        while pos + mlen < limit and data[cand + mlen] == data[pos + mlen]:
+            mlen += 1
+        _emit_sequence(out, data, anchor, pos, pos - cand, mlen)
+        pos += mlen
+        anchor = pos
+    _emit_literal_tail(out, data, anchor)
+    return bytes(out)
+
+
+def _emit_varlen(out: bytearray, value: int) -> None:
+    while value >= 255:
+        out.append(255)
+        value -= 255
+    out.append(value)
+
+
+def _emit_sequence(out: bytearray, data: bytes, anchor: int, pos: int,
+                   offset: int, mlen: int) -> None:
+    lit_len = pos - anchor
+    ml = mlen - _MIN_MATCH
+    token = (min(lit_len, 15) << 4) | min(ml, 15)
+    out.append(token)
+    if lit_len >= 15:
+        _emit_varlen(out, lit_len - 15)
+    out += data[anchor:pos]
+    out += offset.to_bytes(2, "little")
+    if ml >= 15:
+        _emit_varlen(out, ml - 15)
+
+
+def _emit_literal_tail(out: bytearray, data: bytes, anchor: int) -> None:
+    lit_len = len(data) - anchor
+    out.append(min(lit_len, 15) << 4)
+    if lit_len >= 15:
+        _emit_varlen(out, lit_len - 15)
+    out += data[anchor:]
+
+
+def _lz4_decompress_py(data: bytes, expected_len: int) -> bytes:
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                if pos >= n:
+                    raise CompressionError("truncated literal length")
+                b = data[pos]
+                pos += 1
+                lit_len += b
+                if b != 255:
+                    break
+        if pos + lit_len > n:
+            raise CompressionError("literals extend past input")
+        out += data[pos : pos + lit_len]
+        pos += lit_len
+        if pos == n:
+            break  # last sequence: literals only
+        if pos + 2 > n:
+            raise CompressionError("truncated match offset")
+        offset = int.from_bytes(data[pos : pos + 2], "little")
+        pos += 2
+        if offset == 0 or offset > len(out):
+            raise CompressionError(f"invalid match offset {offset}")
+        mlen = (token & 0xF) + _MIN_MATCH
+        if (token & 0xF) == 15:
+            while True:
+                if pos >= n:
+                    raise CompressionError("truncated match length")
+                b = data[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        # Overlapping copy must be byte-sequential.
+        start = len(out) - offset
+        for i in range(mlen):
+            out.append(out[start + i])
+        if len(out) > expected_len:
+            raise CompressionError("output exceeds expected length")
+    if len(out) != expected_len:
+        raise CompressionError(
+            f"decompressed {len(out)} bytes, expected {expected_len}"
+        )
+    return bytes(out)
+
+
+def lz4_compress(data: bytes) -> bytes:
+    native = _get_native()
+    if native is not None:
+        return native.lz4_compress(data)
+    return _lz4_compress_py(data)
+
+
+def lz4_decompress(data: bytes, expected_len: int) -> bytes:
+    native = _get_native()
+    if native is not None:
+        return native.lz4_decompress(data, expected_len)
+    return _lz4_decompress_py(data, expected_len)
+
+
+# ── Byte-grouping and bit-slicing transforms ──
+
+
+def _bg4(data: bytes) -> bytes:
+    a = np.frombuffer(data, dtype=np.uint8)
+    return b"".join(a[k::4].tobytes() for k in range(4))
+
+
+def _bg4_inverse(data: bytes) -> bytes:
+    n = len(data)
+    sizes = [(n - k + 3) // 4 for k in range(4)]
+    out = np.empty(n, dtype=np.uint8)
+    pos = 0
+    a = np.frombuffer(data, dtype=np.uint8)
+    for k in range(4):
+        out[k::4] = a[pos : pos + sizes[k]]
+        pos += sizes[k]
+    return out.tobytes()
+
+
+def _bitslice(data: bytes) -> bytes:
+    a = np.frombuffer(data, dtype=np.uint8)
+    planes = [np.packbits((a >> b) & 1) for b in range(8)]
+    return b"".join(p.tobytes() for p in planes)
+
+
+def _bitslice_inverse(data: bytes, orig_len: int) -> bytes:
+    plane_len = (orig_len + 7) // 8
+    a = np.frombuffer(data, dtype=np.uint8)
+    if len(a) != plane_len * 8:
+        raise CompressionError("bitslice payload length mismatch")
+    out = np.zeros(orig_len, dtype=np.uint8)
+    for b in range(8):
+        bits = np.unpackbits(a[b * plane_len : (b + 1) * plane_len])[:orig_len]
+        out |= bits.astype(np.uint8) << b
+    return out.tobytes()
+
+
+# ── Scheme-level API used by the xorb container ──
+
+
+def compress(data: bytes, scheme: Scheme) -> bytes:
+    if scheme == Scheme.NONE:
+        return data
+    if scheme == Scheme.LZ4:
+        return lz4_compress(data)
+    if scheme == Scheme.BG4_LZ4:
+        return lz4_compress(_bg4(data))
+    if scheme == Scheme.BITSLICE_LZ4:
+        return lz4_compress(_bitslice(data))
+    raise CompressionError(f"unknown scheme {scheme}")
+
+
+def decompress(data: bytes, scheme: Scheme, expected_len: int) -> bytes:
+    if scheme == Scheme.NONE:
+        if len(data) != expected_len:
+            raise CompressionError("stored chunk length mismatch")
+        return data
+    if scheme == Scheme.LZ4:
+        return lz4_decompress(data, expected_len)
+    if scheme == Scheme.BG4_LZ4:
+        return _bg4_inverse(lz4_decompress(data, expected_len))
+    if scheme == Scheme.BITSLICE_LZ4:
+        plane_bytes = ((expected_len + 7) // 8) * 8
+        return _bitslice_inverse(
+            lz4_decompress(data, plane_bytes), expected_len
+        )
+    raise CompressionError(f"unknown scheme {scheme}")
+
+
+def compress_auto(data: bytes) -> tuple[Scheme, bytes]:
+    """Pick the smallest encoding; None when compression doesn't pay."""
+    best_scheme, best = Scheme.NONE, data
+    for scheme in (Scheme.LZ4, Scheme.BG4_LZ4):
+        candidate = compress(data, scheme)
+        if len(candidate) < len(best):
+            best_scheme, best = scheme, candidate
+    return best_scheme, best
+
+
+def _get_native():
+    try:
+        from zest_tpu.native import lib
+
+        return lib if lib.available() and hasattr(lib, "lz4_compress") else None
+    except Exception:
+        return None

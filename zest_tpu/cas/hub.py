@@ -1,0 +1,144 @@
+"""HuggingFace Hub API client: file listing, xet detection, revision resolve.
+
+The zig-xet `model_download` equivalent (SURVEY.md §2.2): list a repo's
+files with their sizes and optional xet file hashes, resolve a ref to a
+commit SHA, and stream regular (non-xet) files. Endpoint shapes follow the
+real Hub API and are served identically by the local fixture server in
+tests (zero-egress environment):
+
+    GET  /api/models/{repo}/revision/{rev}        -> {"sha", "siblings": [...]}
+    POST /api/models/{repo}/paths-info/{rev}      -> [{"path","size","xetHash"?}]
+    GET  /{repo}/resolve/{rev}/{file}             -> raw bytes (redirects ok)
+    GET  /api/models/{repo}/xet-read-token/{rev}  -> {"accessToken","casUrl"}
+
+(reference call sites: main.zig:142-154, main.zig:638-677, main.zig:696-728,
+xet_bridge.zig:83-109)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+import requests
+
+from zest_tpu.config import Config
+
+
+class HubError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class FileEntry:
+    path: str
+    size: int
+    xet_hash: str | None = None  # 64-char hex when stored in Xet CAS
+
+    @property
+    def is_xet(self) -> bool:
+        return self.xet_hash is not None
+
+
+class HubClient:
+    def __init__(self, cfg: Config, session: requests.Session | None = None):
+        self.cfg = cfg
+        self.session = session or requests.Session()
+
+    def _headers(self) -> dict[str, str]:
+        if self.cfg.hf_token:
+            return {"Authorization": f"Bearer {self.cfg.hf_token}"}
+        return {}
+
+    def _get_json(self, url: str) -> dict | list:
+        resp = self.session.get(url, headers=self._headers(), timeout=30)
+        if resp.status_code != 200:
+            raise HubError(f"GET {url} -> {resp.status_code}")
+        return resp.json()
+
+    def resolve_revision(self, repo_id: str, revision: str = "main") -> str:
+        """Ref -> commit SHA (reference: main.zig:638-677)."""
+        doc = self._get_json(
+            f"{self.cfg.endpoint}/api/models/{repo_id}/revision/{revision}"
+        )
+        sha = doc.get("sha") if isinstance(doc, dict) else None
+        if not isinstance(sha, str) or not sha:
+            raise HubError(f"no sha in revision response for {repo_id}@{revision}")
+        return sha
+
+    def list_files(self, repo_id: str, revision: str = "main") -> list[FileEntry]:
+        """All files in the repo with sizes and xet hashes."""
+        doc = self._get_json(
+            f"{self.cfg.endpoint}/api/models/{repo_id}/revision/{revision}"
+        )
+        siblings = doc.get("siblings", []) if isinstance(doc, dict) else []
+        paths = [s["rfilename"] for s in siblings if "rfilename" in s]
+        if not paths:
+            return []
+        resp = self.session.post(
+            f"{self.cfg.endpoint}/api/models/{repo_id}/paths-info/{revision}",
+            json={"paths": paths},
+            headers=self._headers(),
+            timeout=30,
+        )
+        if resp.status_code != 200:
+            raise HubError(f"paths-info -> {resp.status_code}")
+        entries = []
+        for item in resp.json():
+            if item.get("type") == "directory":
+                continue
+            entries.append(
+                FileEntry(
+                    path=item["path"],
+                    size=int(item.get("size", 0)),
+                    xet_hash=item.get("xetHash"),
+                )
+            )
+        return entries
+
+    def download_regular_file(
+        self, repo_id: str, revision: str, filename: str, dest: Path
+    ) -> int:
+        """Stream a non-xet file to ``dest``; returns byte count.
+
+        Streams to a tmp file and renames — unlike the reference, which
+        buffers whole files in memory (quirk at main.zig:713-728).
+        """
+        url = f"{self.cfg.endpoint}/{repo_id}/resolve/{revision}/{filename}"
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_name(f".tmp-{dest.name}")
+        total = 0
+        try:
+            with self.session.get(
+                url, headers=self._headers(), timeout=60, stream=True
+            ) as resp:
+                if resp.status_code != 200:
+                    raise HubError(f"GET {url} -> {resp.status_code}")
+                with open(tmp, "wb") as f:
+                    for piece in resp.iter_content(chunk_size=1 << 20):
+                        f.write(piece)
+                        total += len(piece)
+            os.replace(tmp, dest)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return total
+
+    def xet_read_token(
+        self, repo_id: str, revision: str = "main"
+    ) -> tuple[str, str]:
+        """Exchange the HF token for (cas_url, access_token)
+        (reference: xet_bridge.zig:83-130)."""
+        doc = self._get_json(
+            f"{self.cfg.endpoint}/api/models/{repo_id}/xet-read-token/{revision}"
+        )
+        if not isinstance(doc, dict):
+            raise HubError("malformed xet-read-token response")
+        try:
+            return doc["casUrl"], doc["accessToken"]
+        except KeyError as exc:
+            raise HubError(f"xet-read-token missing {exc}") from exc

@@ -1,0 +1,240 @@
+"""Xorb container format (the zig-xet `xorb` equivalent).
+
+A xorb is a content-addressed bundle of CDC chunks — the unit of transfer
+and caching in the whole system (reference behavior: SURVEY.md §2.2 rows
+`xorb`/`chunking`; 64 MiB max matching the wire message cap,
+src/bt_wire.zig:22). The xorb's identity is the Merkle root over its chunk
+hashes (zest_tpu.cas.hashing.xorb_hash).
+
+Layout — ZXORB v2, a **self-framed chunk stream** with no container header,
+so any contiguous chunk range is a contiguous byte range. This is what makes
+the whole transfer economy work: CDN ``fetch_info.url_range`` byte ranges,
+partial cache entries (``{hash}.{range_start}``), BEP XET range responses,
+and ICI shard slices are all just frame subsequences.
+
+    per chunk frame (40 + compressed_len bytes, integers little-endian):
+        u8   scheme          (cas.compression.Scheme)
+        u24  compressed_len
+        u32  uncompressed_len
+        32B  chunk hash      (keyed BLAKE3, chunk domain)
+        ...  payload
+
+Chunk extraction is range-addressed — ``extract_chunk_range(start, end)`` —
+because reconstruction terms and BEP XET requests address *chunk index
+ranges within a xorb*, not whole xorbs (reference: src/bep_xet.zig:66-74,
+src/swarm.zig:25-31).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from zest_tpu.cas import chunking, compression, hashing
+
+FRAME_HEADER_LEN = 40
+# Cap on the SERIALIZED xorb (frames included) so a full xorb always fits
+# in one wire message (wire.MAX_MESSAGE_SIZE = 64 MiB + 1 KiB, minus BEP 10
+# and XET framing overhead).
+MAX_XORB_BYTES = 64 * 1024 * 1024 - 64
+MAX_CHUNKS = 8 * 1024
+# Largest single chunk a reader will decode. CDC chunks are <= 128 KiB
+# (chunking.MAX_CHUNK); the slack allows hand-built chunks while still
+# bounding what an untrusted frame header can make us allocate.
+MAX_CHUNK_BYTES = 4 * 1024 * 1024
+_MAX_COMPRESSED = (1 << 24) - 1
+
+
+class XorbFormatError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class ChunkEntry:
+    frame_offset: int          # byte offset of the frame within this blob
+    compressed_len: int
+    uncompressed_len: int
+    scheme: compression.Scheme
+    hash: bytes
+
+    @property
+    def frame_len(self) -> int:
+        return FRAME_HEADER_LEN + self.compressed_len
+
+
+def encode_frame(data: bytes) -> tuple[bytes, bytes]:
+    """Encode one chunk into a frame; returns (frame, chunk_hash)."""
+    if len(data) > MAX_CHUNK_BYTES:
+        raise XorbFormatError(f"chunk of {len(data)} bytes exceeds cap")
+    scheme, payload = compression.compress_auto(data)
+    if len(payload) > _MAX_COMPRESSED:
+        raise XorbFormatError("chunk payload too large")
+    h = hashing.chunk_hash(data)
+    header = struct.pack(
+        "<I", int(scheme) | (len(payload) << 8)
+    ) + struct.pack("<I", len(data)) + h
+    return header + payload, h
+
+
+class XorbBuilder:
+    """Accumulates chunks into a serialized xorb.
+
+    Compression is chosen per chunk (`compress_auto`); identity is computed
+    over the *uncompressed* chunk hashes so the same content always produces
+    the same xorb hash regardless of encoding.
+    """
+
+    def __init__(self) -> None:
+        self._frames: list[bytes] = []
+        self._hashes: list[tuple[bytes, int]] = []
+        self._uncompressed_total = 0
+        self._serialized_total = 0
+
+    def __len__(self) -> int:
+        return len(self._frames)
+
+    @property
+    def uncompressed_total(self) -> int:
+        return self._uncompressed_total
+
+    def would_overflow(self, chunk_len: int) -> bool:
+        # Worst case the new chunk stores uncompressed: header + chunk_len.
+        return (
+            self._serialized_total + FRAME_HEADER_LEN + chunk_len > MAX_XORB_BYTES
+            or len(self._frames) + 1 > MAX_CHUNKS
+        )
+
+    def add_chunk(self, data: bytes) -> bytes:
+        """Append one chunk; returns its hash."""
+        if self.would_overflow(len(data)):
+            raise XorbFormatError("xorb full")
+        frame, h = encode_frame(data)
+        self._frames.append(frame)
+        self._hashes.append((h, len(data)))
+        self._uncompressed_total += len(data)
+        self._serialized_total += len(frame)
+        return h
+
+    def add_data(self, data: bytes) -> list[bytes]:
+        """CDC-chunk ``data`` and append every chunk; returns chunk hashes."""
+        return [self.add_chunk(piece) for _, piece in chunking.chunk_stream(data)]
+
+    def chunk_hashes(self) -> list[tuple[bytes, int]]:
+        return list(self._hashes)
+
+    def xorb_hash(self) -> bytes:
+        return hashing.xorb_hash(self._hashes)
+
+    def frame_offsets(self) -> list[int]:
+        """Byte offset of each frame plus the end offset (len N+1).
+
+        ``offsets[s]:offsets[e]`` is the byte range serving chunk range
+        [s, e) — this is what populates CAS ``fetch_info.url_range``.
+        """
+        offs = [0]
+        for f in self._frames:
+            offs.append(offs[-1] + len(f))
+        return offs
+
+    def serialize(self) -> bytes:
+        return b"".join(self._frames)
+
+
+class XorbReader:
+    """Parses a frame stream and extracts verified chunk ranges.
+
+    ``data`` may be a *full* xorb or any frame subsequence (a partial cache
+    entry, a CDN byte-range response, a BEP XET chunk response); chunk
+    indices here are local to the blob — callers rebase absolute term
+    indices by the blob's ``chunk_offset``.
+    """
+
+    def __init__(self, data: bytes | memoryview):
+        data = memoryview(data)
+        self.entries: list[ChunkEntry] = []
+        pos = 0
+        n = len(data)
+        while pos < n:
+            if pos + FRAME_HEADER_LEN > n:
+                raise XorbFormatError("truncated frame header")
+            (word0,) = struct.unpack("<I", data[pos : pos + 4])
+            scheme_raw = word0 & 0xFF
+            compressed_len = word0 >> 8
+            (uncompressed_len,) = struct.unpack("<I", data[pos + 4 : pos + 8])
+            h = bytes(data[pos + 8 : pos + 40])
+            try:
+                scheme = compression.Scheme(scheme_raw)
+            except ValueError as exc:
+                raise XorbFormatError(f"unknown scheme {scheme_raw}") from exc
+            if uncompressed_len > MAX_CHUNK_BYTES:
+                # Untrusted header must not dictate our allocations.
+                raise XorbFormatError(
+                    f"chunk claims {uncompressed_len} bytes (cap "
+                    f"{MAX_CHUNK_BYTES})"
+                )
+            end = pos + FRAME_HEADER_LEN + compressed_len
+            if end > n:
+                raise XorbFormatError("frame payload extends past end")
+            if len(self.entries) >= MAX_CHUNKS:
+                raise XorbFormatError("too many chunks")
+            self.entries.append(
+                ChunkEntry(pos, compressed_len, uncompressed_len, scheme, h)
+            )
+            pos = end
+        self._data = data
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def chunk_hashes(self) -> list[tuple[bytes, int]]:
+        return [(e.hash, e.uncompressed_len) for e in self.entries]
+
+    def xorb_hash(self) -> bytes:
+        return hashing.xorb_hash(self.chunk_hashes())
+
+    def extract_chunk(self, index: int, verify: bool = True) -> bytes:
+        e = self.entries[index]
+        payload_start = e.frame_offset + FRAME_HEADER_LEN
+        payload = bytes(
+            self._data[payload_start : payload_start + e.compressed_len]
+        )
+        data = compression.decompress(payload, e.scheme, e.uncompressed_len)
+        if verify and hashing.chunk_hash(data) != e.hash:
+            raise XorbFormatError(f"chunk {index} hash mismatch")
+        return data
+
+    def extract_chunk_range(
+        self, start: int, end: int, verify: bool = True
+    ) -> bytes:
+        """Concatenated bytes of chunks [start, end) — the term-fetch shape
+        (reference: xet_bridge.zig:256-258, parallel_download.zig:65-66)."""
+        self._check_range(start, end)
+        return b"".join(
+            self.extract_chunk(i, verify=verify) for i in range(start, end)
+        )
+
+    def slice_range(self, start: int, end: int) -> bytes:
+        """Raw frame bytes for chunks [start, end) — what a seeder sends on
+        the wire and what lands in a partial cache entry."""
+        self._check_range(start, end)
+        first = self.entries[start].frame_offset
+        last = self.entries[end - 1]
+        return bytes(self._data[first : last.frame_offset + last.frame_len])
+
+    def _check_range(self, start: int, end: int) -> None:
+        if not (0 <= start < end <= len(self.entries)):
+            raise XorbFormatError(
+                f"chunk range [{start},{end}) out of bounds for "
+                f"{len(self.entries)} chunks"
+            )
+
+
+def build_from_data(data: bytes) -> tuple[bytes, bytes, list[tuple[bytes, int]]]:
+    """Convenience: CDC-chunk ``data`` into one xorb.
+
+    Returns (xorb_hash, serialized_xorb, chunk_hashes). Raises if the data
+    exceeds one xorb's capacity — callers split first.
+    """
+    builder = XorbBuilder()
+    builder.add_data(data)
+    return builder.xorb_hash(), builder.serialize(), builder.chunk_hashes()

@@ -80,8 +80,37 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_size_t, ctypes.c_char_p
         ]
+        dll.zest_gear_cut_points.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+        ]
+        dll.zest_gear_cut_points.restype = ctypes.c_size_t
+        dll.zest_lz4_bound.argtypes = [ctypes.c_size_t]
+        dll.zest_lz4_bound.restype = ctypes.c_size_t
+        dll.zest_lz4_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        dll.zest_lz4_compress.restype = ctypes.c_size_t
+        dll.zest_lz4_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        dll.zest_lz4_decompress.restype = ctypes.c_size_t
         _dll = dll
         return _dll
+
+
+_gear_array = None
+
+
+def _gear_as_array():
+    global _gear_array
+    if _gear_array is None:
+        from zest_tpu.cas.chunking import GEAR
+
+        _gear_array = (ctypes.c_uint64 * 256)(*GEAR)
+    return _gear_array
 
 
 class lib:
@@ -120,3 +149,40 @@ class lib:
         out = ctypes.create_string_buffer(32 * count)
         dll.zest_blake3_keyed_batch(key, data, count, item_len, out)
         return out.raw
+
+    @staticmethod
+    def gear_cut_points(data: bytes, min_chunk: int, max_chunk: int,
+                        mask: int) -> list[int]:
+        dll = _load()
+        cap = len(data) // min_chunk + 2 if min_chunk else len(data) + 2
+        out = (ctypes.c_uint64 * cap)()
+        n = dll.zest_gear_cut_points(
+            data, len(data), _gear_as_array(), min_chunk, max_chunk,
+            mask, out, cap,
+        )
+        return list(out[:n])
+
+    @staticmethod
+    def lz4_compress(data: bytes) -> bytes:
+        dll = _load()
+        cap = dll.zest_lz4_bound(len(data))
+        out = ctypes.create_string_buffer(cap)
+        n = dll.zest_lz4_compress(data, len(data), out, cap)
+        if n == 0 and len(data) > 0:
+            raise RuntimeError("native lz4 compress failed")
+        return out.raw[:n]
+
+    @staticmethod
+    def lz4_decompress(data: bytes, expected_len: int) -> bytes:
+        from zest_tpu.cas.compression import CompressionError, _lz4_decompress_py
+
+        if expected_len == 0:
+            # The native return code can't distinguish "decoded 0 bytes"
+            # from "malformed"; the pure path validates properly.
+            return _lz4_decompress_py(data, 0)
+        dll = _load()
+        out = ctypes.create_string_buffer(expected_len)
+        n = dll.zest_lz4_decompress(data, len(data), out, expected_len)
+        if n != expected_len:
+            raise CompressionError("native lz4: malformed input")
+        return out.raw[:expected_len]

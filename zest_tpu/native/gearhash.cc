@@ -1,0 +1,36 @@
+// Native GearHash CDC boundary scanner.
+//
+// Same algorithm and gear table as zest_tpu/cas/chunking.py (the table is
+// passed in from Python so there is exactly one source of truth).
+//
+// C ABI:
+//   zest_gear_cut_points(data, len, gear256, min, max, mask, out, out_cap)
+//     -> number of cut points written (chunk end offsets, exclusive).
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+size_t zest_gear_cut_points(const uint8_t* data, size_t len,
+                            const uint64_t* gear, size_t min_chunk,
+                            size_t max_chunk, uint64_t mask, uint64_t* out,
+                            size_t out_cap) {
+  size_t n_out = 0;
+  size_t start = 0;
+  uint64_t h = 0;
+  for (size_t i = 0; i < len;) {
+    h = (h << 1) + gear[data[i]];
+    i++;
+    size_t length = i - start;
+    if (((length >= min_chunk) && ((h & mask) == 0)) || length >= max_chunk) {
+      if (n_out < out_cap) out[n_out++] = i;
+      start = i;
+      h = 0;
+    }
+  }
+  if (start < len && n_out < out_cap) out[n_out++] = len;
+  return n_out;
+}
+
+}  // extern "C"

@@ -1,0 +1,171 @@
+"""BEP XET extension messages: chunk-level xorb transfer over BEP 10.
+
+Byte-compatible with the reference (src/bep_xet.zig) and the BEP XET spec
+it implements; all messages ride on BEP 10 extended messages (msg_id=20,
+ext name "ut_xet"):
+
+    CHUNK_REQUEST  0x01: [1][4 req_id BE][32 hash][4 range_start BE][4 range_end BE] = 45B
+    CHUNK_RESPONSE 0x02: [1][4 req_id BE][4 chunk_offset BE][4 len BE][data]
+    CHUNK_NOT_FOUND 0x03: [1][4 req_id BE][32 hash] = 37B
+    CHUNK_ERROR    0x04: [1][4 req_id BE][4 code BE][message]
+
+The response's ``chunk_offset`` rebases the blob into the xorb's absolute
+chunk index space (the range-aware partial-transfer mechanism,
+SURVEY.md §5 "long-context" analog).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from zest_tpu.p2p import bencode
+from zest_tpu.version import CLIENT_STRING
+
+EXTENSION_NAME = b"ut_xet"
+
+
+class XetMessageError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class ChunkRequest:
+    request_id: int
+    chunk_hash: bytes
+    range_start: int
+    range_end: int
+
+
+@dataclass(frozen=True)
+class ChunkResponse:
+    request_id: int
+    chunk_offset: int
+    data: bytes
+
+
+@dataclass(frozen=True)
+class ChunkNotFound:
+    request_id: int
+    chunk_hash: bytes
+
+
+@dataclass(frozen=True)
+class ChunkError:
+    request_id: int
+    error_code: int
+    message: bytes
+
+
+XetMessage = ChunkRequest | ChunkResponse | ChunkNotFound | ChunkError
+
+
+def encode_chunk_request(req: ChunkRequest) -> bytes:
+    if len(req.chunk_hash) != 32:
+        raise XetMessageError("chunk hash must be 32 bytes")
+    return (
+        b"\x01"
+        + struct.pack(">I", req.request_id)
+        + req.chunk_hash
+        + struct.pack(">II", req.range_start, req.range_end)
+    )
+
+
+def encode_chunk_response(resp: ChunkResponse) -> bytes:
+    return (
+        b"\x02"
+        + struct.pack(">III", resp.request_id, resp.chunk_offset, len(resp.data))
+        + resp.data
+    )
+
+
+def encode_chunk_not_found(msg: ChunkNotFound) -> bytes:
+    if len(msg.chunk_hash) != 32:
+        raise XetMessageError("chunk hash must be 32 bytes")
+    return b"\x03" + struct.pack(">I", msg.request_id) + msg.chunk_hash
+
+
+def encode_chunk_error(msg: ChunkError) -> bytes:
+    return (
+        b"\x04"
+        + struct.pack(">II", msg.request_id, msg.error_code)
+        + msg.message
+    )
+
+
+def encode(msg: XetMessage) -> bytes:
+    if isinstance(msg, ChunkRequest):
+        return encode_chunk_request(msg)
+    if isinstance(msg, ChunkResponse):
+        return encode_chunk_response(msg)
+    if isinstance(msg, ChunkNotFound):
+        return encode_chunk_not_found(msg)
+    if isinstance(msg, ChunkError):
+        return encode_chunk_error(msg)
+    raise XetMessageError(f"not a XET message: {type(msg).__name__}")
+
+
+def decode(payload: bytes) -> XetMessage:
+    """Decode one BEP XET sub-payload (reference: bep_xet.zig:129-175)."""
+    if not payload:
+        raise XetMessageError("empty payload")
+    kind = payload[0]
+    if kind == 0x01:
+        if len(payload) != 45:
+            raise XetMessageError(f"CHUNK_REQUEST must be 45 bytes, got {len(payload)}")
+        req_id, = struct.unpack(">I", payload[1:5])
+        start, end = struct.unpack(">II", payload[37:45])
+        return ChunkRequest(req_id, payload[5:37], start, end)
+    if kind == 0x02:
+        if len(payload) < 13:
+            raise XetMessageError("CHUNK_RESPONSE too short")
+        req_id, offset, length = struct.unpack(">III", payload[1:13])
+        data = payload[13:]
+        if len(data) != length:
+            raise XetMessageError(
+                f"CHUNK_RESPONSE length field {length} != data {len(data)}"
+            )
+        return ChunkResponse(req_id, offset, data)
+    if kind == 0x03:
+        if len(payload) != 37:
+            raise XetMessageError(f"CHUNK_NOT_FOUND must be 37 bytes, got {len(payload)}")
+        req_id, = struct.unpack(">I", payload[1:5])
+        return ChunkNotFound(req_id, payload[5:37])
+    if kind == 0x04:
+        if len(payload) < 9:
+            raise XetMessageError("CHUNK_ERROR too short")
+        req_id, code = struct.unpack(">II", payload[1:9])
+        return ChunkError(req_id, code, payload[9:])
+    raise XetMessageError(f"unknown XET message type 0x{kind:02x}")
+
+
+# ── BEP 10 extended handshake (reference: bep_xet.zig:180-236) ──
+
+
+@dataclass(frozen=True)
+class ExtCapabilities:
+    ut_xet_id: int | None
+    listen_port: int | None
+    client: bytes | None
+
+
+def make_ext_handshake(ut_xet_id: int, listen_port: int | None = None) -> bytes:
+    """``{"m":{"ut_xet":N},"p":port,"v":"zest-tpu/..."}`` bencoded."""
+    doc: dict = {b"m": {EXTENSION_NAME: ut_xet_id}, b"v": CLIENT_STRING.encode()}
+    if listen_port is not None:
+        doc[b"p"] = listen_port
+    return bencode.encode(doc)
+
+
+def parse_ext_handshake(payload: bytes) -> ExtCapabilities:
+    try:
+        doc = bencode.decode(payload)
+    except bencode.BencodeError as exc:
+        raise XetMessageError(f"bad ext handshake: {exc}") from exc
+    m = bencode.dict_get_dict(doc, b"m") or {}
+    ut_xet = m.get(EXTENSION_NAME)
+    return ExtCapabilities(
+        ut_xet_id=ut_xet if isinstance(ut_xet, int) else None,
+        listen_port=bencode.dict_get_int(doc, b"p"),
+        client=bencode.dict_get_bytes(doc, b"v"),
+    )

@@ -1,0 +1,83 @@
+"""Peer connection pool (the src/peer_pool.zig equivalent).
+
+Connections are keyed by (host, port) and reused across xorbs whose swarms
+land on the same peer. Discipline mirrors the reference (peer_pool.zig:49-95):
+connect + handshake happen *outside* the lock (slow I/O must not serialize
+the pool), with a re-check on insert — the loser of a connect race closes
+its duplicate. Broken connections are removed so the next attempt
+reconnects; at ``max_peers`` an arbitrary idle entry is evicted.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from zest_tpu.p2p.peer import BtPeer
+
+
+class PeerPool:
+    def __init__(self, max_peers: int = 50):
+        self.max_peers = max_peers
+        self._peers: dict[tuple[str, int], BtPeer] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
+    def get_or_connect(
+        self,
+        host: str,
+        port: int,
+        info_hash: bytes,
+        peer_id: bytes,
+        listen_port: int | None = None,
+    ) -> BtPeer:
+        key = (host, port)
+        with self._lock:
+            existing = self._peers.get(key)
+            if existing is not None:
+                return existing
+
+        # Slow path outside the lock.
+        peer = BtPeer.connect(host, port, info_hash, peer_id, listen_port)
+
+        with self._lock:
+            raced = self._peers.get(key)
+            if raced is not None:
+                # Lost the race; keep the established one.
+                loser = peer
+                peer = raced
+            else:
+                if len(self._peers) >= self.max_peers:
+                    self._evict_one_locked()
+                self._peers[key] = peer
+                loser = None
+        if loser is not None:
+            loser.close()
+        return peer
+
+    def remove(self, host: str, port: int) -> None:
+        with self._lock:
+            peer = self._peers.pop((host, port), None)
+        if peer is not None:
+            peer.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for p in peers:
+            p.close()
+
+    def _evict_one_locked(self) -> None:
+        # Only evict a peer whose stream lock is free — closing a socket
+        # another thread is mid-request on turns healthy transfers into
+        # spurious failures. (A thread that fetched the peer but hasn't
+        # locked yet can still lose it; that surfaces as one retried
+        # request, which the waterfall absorbs.) All busy -> soft cap:
+        # admit the newcomer and let the pool shrink on future evictions.
+        for key, peer in self._peers.items():
+            if not peer.lock.locked():
+                self._peers.pop(key).close()
+                return

@@ -1,0 +1,221 @@
+"""Host-disk storage tier: HF cache layout, refs, xorb/chunk caches, registry.
+
+The reference's L1 (src/storage.zig, plus XorbCache from src/swarm.zig:57-148).
+This is the *disk* tier; the TPU build adds an HBM tier on top
+(zest_tpu.parallel.hbm) with the same range-aware get/put semantics so the
+waterfall code is tier-agnostic.
+
+Improvement over the reference (SURVEY.md "quirks to not replicate"):
+``atomic_write`` here is actually atomic (tmp file + rename), where the
+reference's ``writeFileAtomic`` was plain create+write (storage.zig:29-41).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+from zest_tpu.config import Config
+
+
+def atomic_write(path: Path, data: bytes) -> None:
+    """Write via tmp file + rename so readers never observe partial content."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# ── HF refs (reference: storage.zig:57-86) ──
+
+
+def write_ref(cfg: Config, repo_id: str, ref: str, commit_sha: str) -> None:
+    """Record ``refs/{ref} -> commit_sha`` in the HF cache layout so
+    ``from_pretrained(revision=ref)`` resolves offline."""
+    atomic_write(cfg.model_refs_dir(repo_id) / ref, commit_sha.encode())
+
+
+def read_ref(cfg: Config, repo_id: str, ref: str) -> str | None:
+    try:
+        return (cfg.model_refs_dir(repo_id) / ref).read_text().strip()
+    except OSError:
+        return None
+
+
+# ── Chunk cache (reference: storage.zig:102-143; plain-hex keys) ──
+
+
+def write_chunk(cfg: Config, chunk_hash: bytes, data: bytes) -> None:
+    atomic_write(cfg.chunk_cache_path(chunk_hash.hex()), data)
+
+
+def read_chunk(cfg: Config, chunk_hash: bytes) -> bytes | None:
+    try:
+        return cfg.chunk_cache_path(chunk_hash.hex()).read_bytes()
+    except OSError:
+        return None
+
+
+# ── Xorb cache (reference: swarm.zig:57-148; LE-u64-hex keys) ──
+
+
+@dataclass(frozen=True)
+class CacheResult:
+    """Range-aware lookup result: ``data`` is a serialized xorb whose chunk 0
+    corresponds to absolute chunk index ``chunk_offset`` in the full xorb."""
+
+    data: bytes
+    chunk_offset: int
+
+
+class XorbCache:
+    """Full and partial xorbs on disk: ``{hash_hex}`` and
+    ``{hash_hex}.{range_start}``.
+
+    Every CDN- or peer-fetched entry is cached so this host can seed it —
+    "the package IS the seeder". Partial entries are complete ZXORB1 blobs
+    covering a chunk sub-range; ``chunk_offset`` rebases term indices.
+    """
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    def _path(self, key: str) -> Path:
+        return self.cfg.xorb_cache_path(key)
+
+    def has(self, hash_hex: str) -> bool:
+        return self._path(hash_hex).exists()
+
+    def get(self, hash_hex: str) -> bytes | None:
+        try:
+            return self._path(hash_hex).read_bytes()
+        except OSError:
+            return None
+
+    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
+        """Full xorb first (offset 0), then exact partial entry
+        ``{hash_hex}.{range_start}`` (reference: swarm.zig:81-95)."""
+        data = self.get(hash_hex)
+        if data is not None:
+            return CacheResult(data, 0)
+        data = self.get(f"{hash_hex}.{range_start}")
+        if data is not None:
+            return CacheResult(data, range_start)
+        return None
+
+    def put(self, hash_hex: str, data: bytes) -> None:
+        atomic_write(self._path(hash_hex), data)
+
+    def put_partial(self, hash_hex: str, range_start: int, data: bytes) -> None:
+        atomic_write(self._path(f"{hash_hex}.{range_start}"), data)
+
+
+def list_cached_xorbs(cfg: Config) -> list[str]:
+    """All full-xorb hex keys in the cache (reference: storage.zig:199-228).
+
+    Partial entries (``{hex}.{start}``) are excluded — seeding announces
+    only complete xorbs, matching ``cmdSeed``'s behavior.
+    """
+    root = cfg.xorb_cache_dir()
+    if not root.is_dir():
+        return []
+    out = []
+    for sub in sorted(root.iterdir()):
+        if not sub.is_dir():
+            continue
+        for f in sorted(sub.iterdir()):
+            name = f.name
+            if len(name) == 64 and "." not in name:
+                out.append(name)
+    return out
+
+
+@dataclass
+class RegistryEntry:
+    hash_hex: str
+    size: int
+    partial_starts: tuple[int, ...] = ()
+
+
+class XorbRegistry:
+    """In-memory index of locally available xorbs (reference:
+    storage.zig:148-196). The seeding server consults this instead of
+    stat()ing the disk per request; ``scan()`` rebuilds it from the cache
+    directory at startup."""
+
+    def __init__(self) -> None:
+        self._entries: dict[str, RegistryEntry] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def add(self, hash_hex: str, size: int,
+            partial_starts: tuple[int, ...] = ()) -> None:
+        with self._lock:
+            prev = self._entries.get(hash_hex)
+            if prev is not None:
+                partial_starts = tuple(
+                    sorted(set(prev.partial_starts) | set(partial_starts))
+                )
+                size = max(size, prev.size)
+            self._entries[hash_hex] = RegistryEntry(hash_hex, size, partial_starts)
+
+    def has(self, hash_hex: str) -> bool:
+        with self._lock:
+            return hash_hex in self._entries
+
+    def get(self, hash_hex: str) -> RegistryEntry | None:
+        with self._lock:
+            return self._entries.get(hash_hex)
+
+    def all_hashes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def scan(self, cfg: Config) -> int:
+        """Rebuild from the on-disk cache; returns the number of entries."""
+        root = cfg.xorb_cache_dir()
+        found: dict[str, RegistryEntry] = {}
+        if root.is_dir():
+            for sub in root.iterdir():
+                if not sub.is_dir():
+                    continue
+                for f in sub.iterdir():
+                    name = f.name
+                    if name.startswith(".tmp-"):
+                        continue
+                    try:
+                        size = f.stat().st_size
+                    except OSError:
+                        continue
+                    if len(name) == 64:
+                        e = found.setdefault(name, RegistryEntry(name, 0))
+                        found[name] = RegistryEntry(
+                            name, size, e.partial_starts
+                        )
+                    elif len(name) > 65 and name[64] == ".":
+                        hex_part, _, start = name.partition(".")
+                        if len(hex_part) == 64 and start.isdigit():
+                            e = found.setdefault(
+                                hex_part, RegistryEntry(hex_part, 0)
+                            )
+                            found[hex_part] = RegistryEntry(
+                                hex_part, e.size,
+                                tuple(sorted(set(e.partial_starts) | {int(start)})),
+                            )
+        with self._lock:
+            self._entries = found
+            return len(self._entries)

@@ -1,0 +1,85 @@
+"""Parallel term fetcher: the multi-stream download engine.
+
+The reference batches 128 terms, runs 16 concurrent fetch tasks, then
+serializes writes after a batch barrier (src/parallel_download.zig:91-204).
+This build improves on that per SURVEY.md §2.4: term output offsets are
+known up front from the reconstruction plan, so workers ``pwrite`` their
+terms straight to the right file offset — full pipelining, no
+barrier-then-serialize, bounded memory (at most ``max_concurrent`` blobs
+in flight). First error wins and cancels remaining work (the reference's
+atomic error flag, parallel_download.zig:152-153).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+from pathlib import Path
+
+from zest_tpu.cas import reconstruction as recon
+from zest_tpu.transfer.bridge import XetBridge
+
+
+class ParallelDownloader:
+    def __init__(self, bridge: XetBridge, max_concurrent: int | None = None):
+        self.bridge = bridge
+        self.max_concurrent = (
+            max_concurrent or bridge.cfg.max_concurrent_downloads
+        )
+
+    def reconstruct_to_file(self, file_hash_hex: str, out_path: Path) -> int:
+        rec = self.bridge.get_reconstruction(file_hash_hex)
+        return self.reconstruct_plan_to_file(rec, out_path)
+
+    def reconstruct_plan_to_file(
+        self, rec: recon.Reconstruction, out_path: Path
+    ) -> int:
+        total = rec.total_bytes
+        offsets = []
+        pos = 0
+        for term in rec.terms:
+            offsets.append(pos)
+            pos += term.unpacked_length
+
+        out_path = Path(out_path)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp_path = out_path.with_name(f".tmp-{out_path.name}")
+        cancel = threading.Event()
+
+        fd = os.open(tmp_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            os.ftruncate(fd, total)
+
+            def fetch_one(i: int) -> None:
+                if cancel.is_set():
+                    return
+                term = rec.terms[i]
+                data = self.bridge.fetch_term(term, rec)
+                if cancel.is_set():
+                    return
+                os.pwrite(fd, data, offsets[i])
+
+            with ThreadPoolExecutor(self.max_concurrent) as pool:
+                futures = [
+                    pool.submit(fetch_one, i) for i in range(len(rec.terms))
+                ]
+                done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+                first_error = next(
+                    (f.exception() for f in done if f.exception()), None
+                )
+                if first_error is not None:
+                    cancel.set()
+                    for f in not_done:
+                        f.cancel()
+                    raise first_error
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        os.replace(tmp_path, out_path)
+        return total

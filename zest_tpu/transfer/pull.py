@@ -1,0 +1,124 @@
+"""``pull``: download a model repo through the swarm into the HF cache.
+
+The reference's ``cmdPull`` (src/main.zig:83-305): resolve revision, list
+files, then per file run the 3-deep fallback chain — parallel reconstruct →
+sequential bridge reconstruct → plain CDN download — and finish by writing
+the refs file so ``from_pretrained()`` resolves offline. Already-cached
+files are skipped (idempotent resume; SURVEY.md §5 "checkpoint/resume").
+
+With ``device="tpu"`` the pulled checkpoint is additionally staged into
+TPU HBM via zest_tpu.parallel (the north-star path; no reference
+counterpart).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+from zest_tpu import storage
+from zest_tpu.cas.hub import HubClient
+from zest_tpu.config import Config
+from zest_tpu.transfer.bridge import XetBridge
+from zest_tpu.transfer.parallel import ParallelDownloader
+
+
+class PullResult:
+    def __init__(self, snapshot_dir: Path, stats: dict):
+        self.snapshot_dir = snapshot_dir
+        self.stats = stats
+
+    def __fspath__(self) -> str:
+        return str(self.snapshot_dir)
+
+    def __str__(self) -> str:
+        return str(self.snapshot_dir)
+
+
+def pull_model(
+    cfg: Config,
+    repo_id: str,
+    revision: str = "main",
+    device: str | None = None,
+    swarm=None,
+    no_p2p: bool = False,
+    log=print,
+) -> PullResult:
+    t0 = time.monotonic()
+    hub = HubClient(cfg)
+
+    commit_sha = hub.resolve_revision(repo_id, revision)
+    files = hub.list_files(repo_id, revision)
+    snapshot_dir = cfg.model_snapshot_dir(repo_id, commit_sha)
+
+    if swarm is None and not no_p2p:
+        swarm = _default_swarm(cfg)
+    bridge = XetBridge(cfg, swarm=swarm)
+    par = ParallelDownloader(bridge)
+    authenticated = False
+
+    downloaded = skipped = 0
+    for entry in files:
+        dest = snapshot_dir / entry.path
+        if dest.exists() and dest.stat().st_size == entry.size:
+            skipped += 1
+            continue
+        if entry.is_xet:
+            if not authenticated:
+                bridge.authenticate(repo_id, revision, hub=hub)
+                authenticated = True
+            _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
+                           entry, dest, log)
+        else:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            hub.download_regular_file(repo_id, revision, entry.path, dest)
+        downloaded += 1
+
+    storage.write_ref(cfg, repo_id, revision, commit_sha)
+
+    elapsed = time.monotonic() - t0
+    stats = {
+        "repo": repo_id,
+        "revision": commit_sha,
+        "files_downloaded": downloaded,
+        "files_skipped": skipped,
+        "elapsed_s": round(elapsed, 3),
+        "fetch": bridge.stats.summary(),
+    }
+    if swarm is not None:
+        stats["swarm"] = swarm.stats.summary()
+
+    if device == "tpu":
+        from zest_tpu.models.loader import stage_snapshot_to_hbm
+
+        stats["hbm"] = stage_snapshot_to_hbm(cfg, snapshot_dir)
+
+    return PullResult(snapshot_dir, stats)
+
+
+def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
+    """3-deep fallback chain (reference: main.zig:232-256)."""
+    try:
+        par.reconstruct_to_file(entry.xet_hash, dest)
+        return
+    except Exception as exc:  # noqa: BLE001 - any failure falls through
+        log(f"parallel fetch of {entry.path} failed ({exc}); "
+            "retrying sequentially", file=sys.stderr)
+    try:
+        bridge.reconstruct_to_file(entry.xet_hash, dest)
+        return
+    except Exception as exc:  # noqa: BLE001
+        log(f"sequential fetch of {entry.path} failed ({exc}); "
+            "falling back to plain download", file=sys.stderr)
+    hub.download_regular_file(repo_id, revision, entry.path, dest)
+
+
+def _default_swarm(cfg: Config):
+    """Construct the default swarm downloader; None when P2P can't start."""
+    try:
+        from zest_tpu.transfer.swarm import SwarmDownloader
+
+        return SwarmDownloader(cfg)
+    except Exception:
+        return None

@@ -1,0 +1,190 @@
+"""Seeding server: answers CHUNK_REQUESTs from the local caches.
+
+The src/server.zig equivalent: TCP listener, per-connection thread,
+responder-side handshake (echo the requester's info_hash — the responder
+serves all swarms, server.zig:122-139), BEP 10 negotiation, then a serve
+loop answering each CHUNK_REQUEST from the chunk cache (plain-hex keys)
+first, then the range-aware xorb cache (LE-u64-hex keys), else
+CHUNK_NOT_FOUND.
+
+Improvements over the reference:
+- responds with the *negotiated* ext id, not a hardcoded 1
+  (quirk at server.zig:194-213);
+- when a full xorb is cached but only a range was requested, slices the
+  frame stream and sends just that range (the reference ships the whole
+  cached entry).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
+from zest_tpu.config import Config
+from zest_tpu.p2p import bep_xet, peer_id as peer_id_mod, wire
+from zest_tpu.p2p.peer import LOCAL_UT_XET_ID
+from zest_tpu.storage import XorbCache, read_chunk
+
+
+@dataclass
+class ServerStats:
+    active_peers: int
+    chunks_served: int
+
+
+class BtServer:
+    def __init__(self, cfg: Config, cache: XorbCache | None = None):
+        self.cfg = cfg
+        self.cache = cache or XorbCache(cfg)
+        self.peer_id = peer_id_mod.generate()
+        self._listener: socket.socket | None = None
+        self._shutdown = threading.Event()
+        self._active_peers = 0
+        self._chunks_served = 0
+        self._stats_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    # ── Lifecycle ──
+
+    def start(self) -> int:
+        """Bind + spawn the accept loop; returns the bound port."""
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", self.cfg.listen_port))
+        listener.listen(64)
+        # Periodic timeout so shutdown() is observed promptly — closing a
+        # socket does not reliably interrupt a blocked accept().
+        listener.settimeout(0.25)
+        self._listener = listener
+        self.port = listener.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def get_stats(self) -> ServerStats:
+        with self._stats_lock:
+            return ServerStats(self._active_peers, self._chunks_served)
+
+    # ── Accept + serve (reference: server.zig:45-172) ──
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            conn.settimeout(None)
+            threading.Thread(
+                target=self._handle_peer, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_peer(self, conn: socket.socket) -> None:
+        conn.settimeout(120)
+        stream = wire.SocketStream(conn)
+        with self._stats_lock:
+            self._active_peers += 1
+        try:
+            self._handle_peer_inner(stream)
+        except (wire.WireError, OSError, bep_xet.XetMessageError):
+            pass  # peer went away or spoke garbage; drop quietly
+        finally:
+            with self._stats_lock:
+                self._active_peers -= 1
+            stream.close()
+
+    def _handle_peer_inner(self, stream: wire.SocketStream) -> None:
+        their_hs = stream.recv_handshake()
+        # Responder echoes the requester's info_hash: one server seeds
+        # every xorb swarm it has data for (server.zig:122-139).
+        stream.send_handshake(their_hs.info_hash, self.peer_id)
+        stream.send_raw(wire.encode_extended(
+            0, bep_xet.make_ext_handshake(LOCAL_UT_XET_ID, self.port)
+        ))
+        stream.send_message(wire.MessageId.UNCHOKE)
+
+        requester_ext_id = LOCAL_UT_XET_ID  # until their handshake arrives
+        while not self._shutdown.is_set():
+            msg = stream.recv_message()
+            if msg.msg_id is None:
+                continue
+            if msg.msg_id != wire.MessageId.EXTENDED:
+                continue  # interested/keepalive chatter
+            ext_id, payload = wire.parse_extended(msg.payload)
+            if ext_id == 0:
+                caps = bep_xet.parse_ext_handshake(payload)
+                if caps.ut_xet_id is not None:
+                    requester_ext_id = caps.ut_xet_id
+                continue
+            xet = bep_xet.decode(payload)
+            if isinstance(xet, bep_xet.ChunkRequest):
+                self._handle_chunk_request(stream, requester_ext_id, xet)
+
+    # ── Request service (reference: server.zig:187-215) ──
+
+    def _handle_chunk_request(
+        self,
+        stream: wire.SocketStream,
+        ext_id: int,
+        req: bep_xet.ChunkRequest,
+    ) -> None:
+        # Tier 1: chunk cache (plain byte-hex keys, storage.zig:91-99).
+        # Wrapped into a single frame so every response tier yields the
+        # same parseable frame-stream shape the bridge expects.
+        data = read_chunk(self.cfg, req.chunk_hash)
+        if data is not None:
+            frame, _h = encode_frame(data)
+            self._respond(stream, ext_id, req.request_id, 0, frame)
+            return
+
+        # Tier 2: xorb cache, range-aware (LE-u64-hex keys,
+        # server.zig:201-204).
+        hash_hex = hashing.hash_to_hex(req.chunk_hash)
+        cached = self.cache.get_with_range(hash_hex, req.range_start)
+        if cached is not None:
+            blob, offset = cached.data, cached.chunk_offset
+            try:
+                reader = XorbReader(blob)
+                local_start = req.range_start - offset
+                local_end = req.range_end - offset
+                if 0 <= local_start < local_end <= len(reader):
+                    blob = reader.slice_range(local_start, local_end)
+                    offset = req.range_start
+            except XorbFormatError:
+                pass  # serve the whole entry; requester re-slices
+            self._respond(stream, ext_id, req.request_id, offset, blob)
+            return
+
+        stream.send_raw(wire.encode_extended(
+            ext_id,
+            bep_xet.encode_chunk_not_found(
+                bep_xet.ChunkNotFound(req.request_id, req.chunk_hash)
+            ),
+        ))
+
+    def _respond(self, stream, ext_id: int, request_id: int,
+                 chunk_offset: int, data: bytes) -> None:
+        stream.send_raw(wire.encode_extended(
+            ext_id,
+            bep_xet.encode_chunk_response(
+                bep_xet.ChunkResponse(request_id, chunk_offset, data)
+            ),
+        ))
+        with self._stats_lock:
+            self._chunks_served += 1

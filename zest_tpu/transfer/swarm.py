@@ -1,0 +1,180 @@
+"""Swarm download orchestrator (the src/swarm.zig equivalent).
+
+Decides *which peers* to ask for a xorb range and manages the connection
+pool, discovery cache, and per-session stats. Discovery is pluggable
+(``PeerSource``): direct ``--peer`` addresses are tried first, then
+discovered peers — DHT and tracker sources on the interop plane
+(zest_tpu.p2p.dht / .tracker), the JAX-coordinator registry on the pod
+plane (zest_tpu.parallel.coordinator). Discovery results are cached for
+30 s per swarm under a lock (reference: swarm.zig:320-355).
+
+Failure semantics match the reference (swarm.zig:398-437): a connection
+error evicts the peer from the pool; CHUNK_NOT_FOUND keeps the connection
+(the peer is healthy, it just lacks this xorb).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from zest_tpu.config import Config
+from zest_tpu.p2p import peer_id as peer_id_mod
+from zest_tpu.p2p.peer import ChunkNotFoundError, PeerError
+from zest_tpu.p2p.pool import PeerPool
+
+DISCOVERY_TTL_S = 30.0
+
+
+class PeerSource(Protocol):
+    """Anything that can map an info_hash to peer addresses."""
+
+    def find_peers(self, info_hash: bytes) -> list[tuple[str, int]]: ...
+
+    def announce(self, info_hash: bytes, port: int) -> None: ...
+
+
+@dataclass
+class SwarmStats:
+    """(reference: swarm.zig:150-163)"""
+
+    peers_discovered: int = 0
+    peer_attempts: int = 0
+    peer_failures: int = 0
+    chunks_from_peers: int = 0
+    bytes_from_peers: int = 0
+    announces: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def bump(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + amount)
+
+    def summary(self) -> dict:
+        return {
+            "peers_discovered": self.peers_discovered,
+            "peer_attempts": self.peer_attempts,
+            "peer_failures": self.peer_failures,
+            "chunks_from_peers": self.chunks_from_peers,
+            "bytes_from_peers": self.bytes_from_peers,
+            "announces": self.announces,
+        }
+
+
+@dataclass(frozen=True)
+class PeerResult:
+    data: bytes
+    chunk_offset: int
+
+
+class SwarmDownloader:
+    def __init__(
+        self,
+        cfg: Config,
+        peer_sources: list[PeerSource] | None = None,
+        pool: PeerPool | None = None,
+    ):
+        self.cfg = cfg
+        self.peer_id = peer_id_mod.generate()
+        self.pool = pool or PeerPool(cfg.max_peers)
+        self.peer_sources = peer_sources or []
+        self.direct_peers: list[tuple[str, int]] = []
+        self.stats = SwarmStats()
+        self._discovery_cache: dict[bytes, tuple[float, list[tuple[str, int]]]] = {}
+        self._discovery_lock = threading.Lock()
+
+    def add_direct_peer(self, host: str, port: int) -> None:
+        """--peer flag path: tried before discovered peers (swarm.zig:279-314)."""
+        addr = (host, port)
+        if addr not in self.direct_peers:
+            self.direct_peers.append(addr)
+
+    def close(self) -> None:
+        self.pool.close_all()
+
+    # ── Discovery (reference: swarm.zig:320-355) ──
+
+    def discover_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
+        now = time.monotonic()
+        with self._discovery_lock:
+            cached = self._discovery_cache.get(info_hash)
+            if cached is not None and now - cached[0] < DISCOVERY_TTL_S:
+                return cached[1]
+
+        found: list[tuple[str, int]] = []
+        for source in self.peer_sources:
+            try:
+                for addr in source.find_peers(info_hash):
+                    if addr not in found:
+                        found.append(addr)
+            except Exception:
+                continue  # a dead source must not break the waterfall
+        self.stats.bump("peers_discovered", len(found))
+
+        with self._discovery_lock:
+            self._discovery_cache[info_hash] = (now, found)
+        return found
+
+    # ── Download (reference: swarm.zig:363-437) ──
+
+    def try_peer_download(
+        self,
+        xorb_hash: bytes,
+        hash_hex: str,
+        range_start: int,
+        range_end: int,
+    ) -> PeerResult | None:
+        """Fetch chunk range [range_start, range_end) of a xorb from the
+        swarm; None when no peer could serve it (bridge falls to CDN)."""
+        info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+        candidates = list(self.direct_peers)
+        for addr in self.discover_peers(info_hash):
+            if addr not in candidates:
+                candidates.append(addr)
+        if not candidates:
+            return None
+
+        for host, port in candidates:
+            self.stats.bump("peer_attempts")
+            try:
+                peer = self.pool.get_or_connect(
+                    host, port, info_hash, self.peer_id,
+                    listen_port=self.cfg.listen_port,
+                )
+                result = peer.request_chunk(xorb_hash, range_start, range_end)
+            except ChunkNotFoundError:
+                # Peer healthy, xorb absent: keep the connection
+                # (swarm.zig:406-413).
+                self.stats.bump("peer_failures")
+                continue
+            except (PeerError, OSError) as _exc:
+                self.stats.bump("peer_failures")
+                self.pool.remove(host, port)
+                continue
+            self.stats.bump("chunks_from_peers")
+            self.stats.bump("bytes_from_peers", len(result.data))
+            self.announce_available(xorb_hash, hash_hex)
+            return PeerResult(result.data, result.chunk_offset)
+        return None
+
+    # ── Seeding announcements (reference: swarm.zig:458-470) ──
+
+    def announce_available(self, xorb_hash: bytes, hash_hex: str) -> None:
+        info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+        for source in self.peer_sources:
+            try:
+                source.announce(info_hash, self.cfg.listen_port)
+            except Exception:
+                continue
+        if self.peer_sources:
+            self.stats.bump("announces")
+
+    def announce_xorbs(self, hash_hexes: list[str]) -> int:
+        """``zest seed`` path: announce every cached xorb (main.zig:307-369)."""
+        from zest_tpu.cas import hashing
+
+        for hex_key in hash_hexes:
+            self.announce_available(hashing.hex_to_hash(hex_key), hex_key)
+        return len(hash_hexes)
