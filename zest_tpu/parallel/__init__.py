@@ -1,0 +1,35 @@
+"""TPU-native distribution plane: mesh, ownership plan, HBM tier, collectives.
+
+This package is what makes the build TPU-first rather than a port
+(SURVEY.md §2.4): the reference's dynamic peer swarm becomes a static pod
+mesh (``mesh``), DHT lookup becomes a pure rendezvous-hash ownership
+function (``plan``), the on-disk xorb cache gains a device-resident tier
+(``hbm``), TCP peer wire becomes one jitted all-gather over ICI
+(``collectives``), and tracker/DHT discovery becomes the jax.distributed
+KV store (``coordinator``).
+"""
+
+from zest_tpu.parallel.collectives import (  # noqa: F401
+    GatheredPool,
+    PodDistributor,
+    PoolLayout,
+    all_gather_throughput,
+    pack_rows,
+)
+from zest_tpu.parallel.coordinator import (  # noqa: F401
+    CoordinatorRegistry,
+    InMemoryRegistry,
+)
+from zest_tpu.parallel.hbm import HbmStagingCache, TieredCache  # noqa: F401
+from zest_tpu.parallel.mesh import (  # noqa: F401
+    POD_AXIS,
+    mesh_from_config,
+    model_mesh,
+    num_slots,
+    pod_mesh,
+)
+from zest_tpu.parallel.plan import (  # noqa: F401
+    DistributionPlan,
+    FetchAssignment,
+    owner_host,
+)

@@ -1,0 +1,281 @@
+"""ICI distribution: bulk xorb movement as XLA collectives.
+
+The reference moves bulk bytes peer-to-peer over TCP (src/bt_wire.zig,
+src/bt_peer.zig). In-pod, the wire is the mesh: each host stages the blobs
+it owns (per the rendezvous plan) into rows of a pool array sharded over the
+``pod`` axis, and one jitted resharding — sharded → replicated — makes XLA
+emit the all-gather that carries every row to every device over ICI. No
+framing, no handshakes, no per-peer state machines; "seeding" is
+participating in the collective (SURVEY.md §2.1 row 15).
+
+Row protocol: each fetch unit gets one fixed-capacity row shaped
+``[u32le length][blob bytes][zero padding]``. Capacity is computed from the
+plan (identical on every host, no negotiation), rows are grouped by owner so
+shard *h* of the pool is exactly host *h*'s contribution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from zest_tpu.parallel.mesh import POD_AXIS, replicated, row_sharded
+from zest_tpu.parallel.plan import DistributionPlan, FetchAssignment
+
+_LEN_HEADER = 4
+_ROW_ALIGN = 128  # TPU lane width: keep the trailing dim MXU/VPU-friendly
+
+
+def _round_up(n: int, align: int) -> int:
+    return (n + align - 1) // align * align
+
+
+@dataclass(frozen=True)
+class PoolLayout:
+    """Deterministic row layout for a plan — computed identically everywhere.
+
+    ``rows_per_host`` rows per pod slot (padded to the max so shards are
+    equal); unit *i* of host *h* lives at row ``h * rows_per_host + i``.
+    """
+
+    num_hosts: int
+    rows_per_host: int
+    row_len: int
+    # (hash_hex, fetch range start) -> (row, chunk_offset)
+    index: dict[tuple[str, int], tuple[int, int]]
+
+    @property
+    def total_rows(self) -> int:
+        return self.num_hosts * self.rows_per_host
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.total_rows * self.row_len
+
+    @staticmethod
+    def from_plan(plan: DistributionPlan) -> "PoolLayout":
+        per_host: list[list[FetchAssignment]] = [
+            plan.for_host(h) for h in range(plan.num_hosts)
+        ]
+        rows_per_host = max((len(units) for units in per_host), default=0)
+        max_blob = max(
+            (a.est_bytes for a in plan.assignments), default=0
+        )
+        row_len = _round_up(_LEN_HEADER + max_blob, _ROW_ALIGN)
+        index: dict[tuple[str, int], tuple[int, int]] = {}
+        for h, units in enumerate(per_host):
+            for i, a in enumerate(units):
+                index[(a.hash_hex, a.fetch_info.range.start)] = (
+                    h * rows_per_host + i,
+                    a.fetch_info.range.start,
+                )
+        return PoolLayout(plan.num_hosts, rows_per_host, row_len, index)
+
+
+def pack_rows(
+    layout: PoolLayout,
+    blobs: dict[tuple[str, int], bytes],
+    host: int,
+) -> np.ndarray:
+    """Host ``host``'s shard of the pool: its owned blobs in row order."""
+    out = np.zeros((layout.rows_per_host, layout.row_len), dtype=np.uint8)
+    base = host * layout.rows_per_host
+    for key, (row, _off) in layout.index.items():
+        if not (base <= row < base + layout.rows_per_host):
+            continue
+        blob = blobs.get(key)
+        if blob is None or _LEN_HEADER + len(blob) > layout.row_len:
+            # Missing or over-capacity blob: leave a zero row so readers
+            # fall through the waterfall to CDN — one bad unit must never
+            # abort the whole round (or strand a multi-host collective).
+            continue
+        r = row - base
+        out[r, :_LEN_HEADER] = np.frombuffer(
+            len(blob).to_bytes(_LEN_HEADER, "little"), dtype=np.uint8
+        )
+        out[r, _LEN_HEADER : _LEN_HEADER + len(blob)] = np.frombuffer(
+            blob, dtype=np.uint8
+        )
+    return out
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _replicate(mesh: Mesh, pool: jax.Array) -> jax.Array:
+    """sharded-over-pod → replicated: XLA lowers this to an ICI all-gather."""
+    return jax.lax.with_sharding_constraint(pool, replicated(mesh))
+
+
+class GatheredPool:
+    """The post-all-gather pool: every device holds every row."""
+
+    def __init__(self, layout: PoolLayout, pool: jax.Array):
+        self.layout = layout
+        self.pool = pool
+        self._host_view: np.ndarray | None = None
+
+    def _rows(self) -> np.ndarray:
+        if self._host_view is None:
+            self._host_view = np.asarray(self.pool)
+        return self._host_view
+
+    def blob(self, hash_hex: str, range_start: int) -> tuple[bytes, int] | None:
+        """(blob bytes, chunk_offset) for a fetch unit, or None."""
+        loc = self.layout.index.get((hash_hex, range_start))
+        if loc is None:
+            return None
+        row, chunk_offset = loc
+        raw = self._rows()[row]
+        n = int.from_bytes(raw[:_LEN_HEADER].tobytes(), "little")
+        if n == 0 or _LEN_HEADER + n > self.layout.row_len:
+            return None
+        return raw[_LEN_HEADER : _LEN_HEADER + n].tobytes(), chunk_offset
+
+    def fill_cache(self, cache) -> int:
+        """Seed a range-aware cache (disk/HBM/tiered) with every gathered
+        blob — after this, the waterfall's tier-1 lookup hits locally and
+        the P2P byte ratio goes to 1.0 for planned units."""
+        filled = 0
+        for (hash_hex, range_start) in self.layout.index:
+            got = self.blob(hash_hex, range_start)
+            if got is None:
+                continue
+            data, chunk_offset = got
+            if chunk_offset == 0:
+                cache.put(hash_hex, data)
+            else:
+                cache.put_partial(hash_hex, chunk_offset, data)
+            filled += 1
+        return filled
+
+
+class PodDistributor:
+    """Orchestrates one distribution round: stage → all-gather → index.
+
+    ``fetch_fn(assignment) -> bytes`` is called only for units this host
+    owns; the returned blob must cover exactly the assignment's fetch-info
+    chunk range (owners with a full xorb on disk slice it first). Missing
+    units (fetch_fn raised) leave a zero-length row — readers fall through
+    the waterfall to CDN, preserving the reference's degradation semantics
+    (SURVEY.md §5 "failure detection").
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = POD_AXIS):
+        self.mesh = mesh
+        self.axis = axis
+
+    def _mesh_slots(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    def _local_slots(self) -> list[int]:
+        """Pod-axis slots backed by a device this process addresses."""
+        k = list(self.mesh.axis_names).index(self.axis)
+        by_slot = np.moveaxis(np.asarray(self.mesh.devices), k, 0)
+        pid = jax.process_index()
+        return [
+            i for i in range(by_slot.shape[0])
+            if any(d.process_index == pid for d in by_slot[i].flat)
+        ]
+
+    def distribute(
+        self,
+        plan: DistributionPlan,
+        fetch_fn,
+        host: int | None = None,
+        local_shards: dict[int, dict[tuple[str, int], bytes]] | None = None,
+    ) -> GatheredPool:
+        """Run the round. Single-process meshes simulate all pod slots
+        (``local_shards`` may pre-supply other slots' blobs in tests);
+        multi-process, each process packs only its own band.
+        """
+        if plan.num_hosts != self._mesh_slots():
+            raise ValueError(
+                f"plan built for {plan.num_hosts} hosts, mesh axis "
+                f"{self.axis!r} has {self._mesh_slots()} slots"
+            )
+        layout = PoolLayout.from_plan(plan)
+        if layout.total_rows == 0:
+            return GatheredPool(
+                layout,
+                jnp.zeros((0, layout.row_len or _ROW_ALIGN), jnp.uint8),
+            )
+
+        if jax.process_count() == 1:
+            host = 0 if host is None else host
+            bands = []
+            for h in range(plan.num_hosts):
+                if h == host:
+                    blobs = {}
+                    for a in plan.for_host(h):
+                        key = (a.hash_hex, a.fetch_info.range.start)
+                        try:
+                            blobs[key] = fetch_fn(a)
+                        except Exception:
+                            continue  # zero row → CDN fallback downstream
+                    bands.append(pack_rows(layout, blobs, h))
+                elif local_shards and h in local_shards:
+                    bands.append(pack_rows(layout, local_shards[h], h))
+                else:
+                    bands.append(
+                        np.zeros(
+                            (layout.rows_per_host, layout.row_len), np.uint8
+                        )
+                    )
+            global_rows = np.concatenate(bands, axis=0)
+            sharded = jax.device_put(
+                global_rows, row_sharded(self.mesh, self.axis)
+            )
+        else:
+            # Multi-process: a "plan host" is a pod *slot* (one device along
+            # the axis). This process fetches for every slot whose device it
+            # addresses and contributes the concatenated bands as its local
+            # shard data.
+            bands = []
+            for slot in self._local_slots():
+                blobs = {}
+                for a in plan.for_host(slot):
+                    key = (a.hash_hex, a.fetch_info.range.start)
+                    try:
+                        blobs[key] = fetch_fn(a)
+                    except Exception:
+                        continue
+                bands.append(pack_rows(layout, blobs, slot))
+            local_band = np.concatenate(bands, axis=0)
+            sharded = jax.make_array_from_process_local_data(
+                row_sharded(self.mesh, self.axis),
+                local_band,
+                (layout.total_rows, layout.row_len),
+            )
+
+        gathered = _replicate(self.mesh, sharded)
+        gathered.block_until_ready()
+        return GatheredPool(layout, gathered)
+
+
+# ── Raw all-gather microbench primitive (bench.py: ici_all_gather) ──
+
+
+def all_gather_throughput(
+    mesh: Mesh, mbytes_per_device: int = 64, iters: int = 5
+) -> float:
+    """GB/s of a pod-axis all-gather — the ICI wire-speed analog of the
+    reference's bt_wire_frame bench (src/bench.zig:167-255)."""
+    import time
+
+    n = int(mesh.shape[POD_AXIS])
+    per_dev = mbytes_per_device * 1024 * 1024
+    x = jax.device_put(
+        jnp.zeros((n, per_dev // _ROW_ALIGN, _ROW_ALIGN), jnp.uint8),
+        row_sharded(mesh),
+    )
+    _replicate(mesh, x).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _replicate(mesh, x).block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    moved = per_dev * (n - 1) * n  # bytes crossing links per gather
+    return moved / dt / 1e9

@@ -1,0 +1,105 @@
+"""Peer discovery via the JAX coordinator — the pod-native DHT replacement.
+
+The reference finds peers with a Kademlia DHT + HTTP trackers
+(src/dht.zig, src/bt_tracker.zig). Inside a pod/cluster every process
+already shares a coordination service — the ``jax.distributed`` KV store —
+so discovery is a key prefix, not a routing table:
+
+    zest/avail/{info_hash_hex}/{process_id} -> "host:port"
+
+``announce`` writes this process' DCN endpoint under each xorb it can
+serve; ``find_peers`` lists the prefix. Both satisfy the
+``SwarmDownloader.PeerSource`` protocol (zest_tpu.transfer.swarm), so the
+waterfall code cannot tell coordinator discovery from DHT discovery.
+
+An in-memory registry with the same surface backs single-process runs and
+tests (the reference's analog: direct ``--peer`` flags, main.zig:180-187).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class InMemoryRegistry:
+    """Process-local PeerSource; also the fake for loopback swarm tests."""
+
+    def __init__(self) -> None:
+        self._avail: dict[bytes, dict[str, tuple[str, int]]] = {}
+        self._lock = threading.Lock()
+        self.self_addr: tuple[str, int] | None = None
+
+    def find_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
+        with self._lock:
+            peers = list(self._avail.get(info_hash, {}).values())
+        return [p for p in peers if p != self.self_addr]
+
+    def announce(self, info_hash: bytes, port: int) -> None:
+        host = self.self_addr[0] if self.self_addr else "127.0.0.1"
+        with self._lock:
+            self._avail.setdefault(info_hash, {})["self"] = (host, port)
+
+    def add(self, info_hash: bytes, host: str, port: int,
+            peer_key: str | None = None) -> None:
+        # Key defaults to the address so adding two peers never clobbers.
+        key = peer_key if peer_key is not None else f"{host}:{port}"
+        with self._lock:
+            self._avail.setdefault(info_hash, {})[key] = (host, port)
+
+
+def _kv_client():
+    """The distributed-runtime KV client, or None when not initialized."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+class CoordinatorRegistry:
+    """PeerSource over the jax.distributed KV store.
+
+    Requires ``jax.distributed.initialize`` (every multi-host TPU job has
+    it). Announces are idempotent puts; lookups list the per-xorb prefix.
+    """
+
+    PREFIX = "zest/avail"
+
+    def __init__(self, advertise_host: str, process_id: int | None = None):
+        self.advertise_host = advertise_host
+        self.process_id = process_id
+        self._client = _kv_client()
+        if self._client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; use InMemoryRegistry "
+                "or call jax.distributed.initialize() first"
+            )
+        if self.process_id is None:
+            import jax
+
+            self.process_id = jax.process_index()
+
+    def _prefix(self, info_hash: bytes) -> str:
+        return f"{self.PREFIX}/{info_hash.hex()}"
+
+    def announce(self, info_hash: bytes, port: int) -> None:
+        self._client.key_value_set(
+            f"{self._prefix(info_hash)}/{self.process_id}",
+            f"{self.advertise_host}:{port}",
+            allow_overwrite=True,
+        )
+
+    def find_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
+        try:
+            entries = self._client.key_value_dir_get(self._prefix(info_hash))
+        except Exception:
+            return []
+        out: list[tuple[str, int]] = []
+        for key, value in entries:
+            if key.rsplit("/", 1)[-1] == str(self.process_id):
+                continue  # never hand back ourselves
+            host, _, port = value.rpartition(":")
+            if host and port.isdigit():
+                out.append((host, int(port)))
+        return out

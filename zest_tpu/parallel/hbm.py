@@ -1,0 +1,182 @@
+"""HBM staging tier: device-resident xorb cache above the disk cache.
+
+The reference's storage is two disk tiers (src/swarm.zig:57-148,
+src/storage.zig:102-143). The TPU build adds tier 0: fetched xorb blobs
+staged as ``jax.Array``s in HBM so (a) repeated extraction never re-uploads,
+(b) blobs are already device-resident for the ICI all-gather
+(zest_tpu.parallel.collectives), and (c) on-device BLAKE3
+(zest_tpu.ops.blake3) can verify without a host round-trip.
+
+Same range-aware ``get_with_range``/``put``/``put_partial`` contract as
+:class:`zest_tpu.storage.XorbCache`, so the waterfall is tier-agnostic.
+LRU eviction bounds occupancy to ``Config.hbm_staging_bytes``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zest_tpu.storage import CacheResult
+
+
+@dataclass
+class HbmEntry:
+    array: jax.Array          # uint8[length], device-resident
+    chunk_offset: int
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.array.size)
+
+
+class HbmStagingCache:
+    """LRU cache of xorb blobs in device memory.
+
+    Keys follow the disk tier: ``{hash_hex}`` for full xorbs,
+    ``{hash_hex}.{range_start}`` for partials (reference: swarm.zig:100-105).
+    """
+
+    def __init__(self, budget_bytes: int, device=None):
+        self.budget_bytes = int(budget_bytes)
+        self.device = device
+        self._entries: OrderedDict[str, HbmEntry] = OrderedDict()
+        self._used = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # ── Core ops ──
+
+    def _device_put(self, data: bytes) -> jax.Array:
+        arr = jnp.asarray(np.frombuffer(data, dtype=np.uint8))
+        if self.device is not None:
+            arr = jax.device_put(arr, self.device)
+        return arr
+
+    def _insert(self, key: str, data: bytes, chunk_offset: int) -> None:
+        if len(data) > self.budget_bytes:
+            return  # larger than the whole tier: skip, disk tier has it
+        arr = self._device_put(data)
+        with self._lock:
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self._used -= prev.nbytes
+            while self._used + len(data) > self.budget_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._used -= evicted.nbytes
+                self.evictions += 1
+            self._entries[key] = HbmEntry(arr, chunk_offset)
+            self._used += len(data)
+
+    def put(self, hash_hex: str, data: bytes) -> None:
+        self._insert(hash_hex, data, 0)
+
+    def put_partial(self, hash_hex: str, range_start: int, data: bytes) -> None:
+        self._insert(f"{hash_hex}.{range_start}", data, range_start)
+
+    def _lookup(self, key: str, count: bool = False) -> HbmEntry | None:
+        """Locked lookup; ``count=True`` also updates hit/miss counters
+        (inside the same lock — they feed concurrent-pipeline stats)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+            if count:
+                if entry is None:
+                    self.misses += 1
+                else:
+                    self.hits += 1
+            return entry
+
+    def get_device(self, hash_hex: str, range_start: int = 0) -> HbmEntry | None:
+        """Device-resident lookup — the input to collectives/ops paths."""
+        entry = self._lookup(hash_hex)
+        if entry is not None:
+            return entry
+        if range_start:
+            return self._lookup(f"{hash_hex}.{range_start}")
+        return None
+
+    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
+        """Waterfall-compatible lookup: full entry first, then the partial
+        keyed by ``range_start`` — bytes come back to host for extraction."""
+        entry = self._lookup(hash_hex)
+        if entry is None:
+            entry = self._lookup(f"{hash_hex}.{range_start}")
+        with self._lock:
+            if entry is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+        if entry is None:
+            return None
+        return CacheResult(bytes(np.asarray(entry.array)), entry.chunk_offset)
+
+    def has(self, hash_hex: str) -> bool:
+        with self._lock:
+            return hash_hex in self._entries
+
+    # ── Introspection ──
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "used_bytes": self._used,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+class TieredCache:
+    """HBM tier over the disk tier with waterfall-identical semantics.
+
+    Reads hit HBM first; disk hits are promoted into HBM. Writes go to both
+    (disk is durable truth for seeding across restarts; HBM is the fast
+    tier). Drop-in for XorbCache anywhere in the transfer pipeline.
+    """
+
+    def __init__(self, disk, hbm: HbmStagingCache):
+        self.disk = disk
+        self.hbm = hbm
+
+    def has(self, hash_hex: str) -> bool:
+        return self.hbm.has(hash_hex) or self.disk.has(hash_hex)
+
+    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
+        res = self.hbm.get_with_range(hash_hex, range_start)
+        if res is not None:
+            return res
+        res = self.disk.get_with_range(hash_hex, range_start)
+        if res is not None:
+            if res.chunk_offset == 0:
+                self.hbm.put(hash_hex, res.data)
+            else:
+                self.hbm.put_partial(hash_hex, res.chunk_offset, res.data)
+        return res
+
+    def put(self, hash_hex: str, data: bytes) -> None:
+        self.disk.put(hash_hex, data)
+        self.hbm.put(hash_hex, data)
+
+    def put_partial(self, hash_hex: str, range_start: int, data: bytes) -> None:
+        self.disk.put_partial(hash_hex, range_start, data)
+        self.hbm.put_partial(hash_hex, range_start, data)

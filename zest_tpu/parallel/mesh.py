@@ -1,0 +1,76 @@
+"""Mesh construction: the pod topology every distribution step runs over.
+
+The reference has no mesh — its "topology" is whatever peers the DHT finds
+(src/dht.zig). A TPU pod's membership is static per job, so topology here is
+explicit: a ``jax.sharding.Mesh`` built from config, with one canonical 1-D
+``pod`` axis for byte distribution (every device participates in the xorb
+all-gather) and arbitrary N-D logical axes for landing checkpoints into a
+pjit-sharded model (zest_tpu.models.loader).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zest_tpu.config import MeshConfig
+
+POD_AXIS = "pod"
+
+
+def pod_mesh(devices=None) -> Mesh:
+    """1-D mesh over all devices: the byte-distribution plane.
+
+    Bulk xorb movement is an all-gather along this axis; ICI carries it
+    in-pod, DCN between pods (slice ordering puts same-host devices
+    adjacent, so XLA's all-gather rides ICI hops first).
+    """
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (POD_AXIS,))
+
+
+def model_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """N-D logical mesh from ``MeshConfig.mesh_axes`` (e.g. data=2,model=4).
+
+    Axis order is significant: earlier axes get the slower (DCN-adjacent)
+    dimension, the last axis stays ICI-contiguous — the layout that keeps
+    tensor-parallel collectives on ICI (SURVEY.md §5 "distributed backend").
+    """
+    devices = jax.devices() if devices is None else devices
+    if not axes:
+        return pod_mesh(devices)
+    sizes = list(axes.values())
+    n = math.prod(sizes)
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(axes))
+
+
+def mesh_from_config(mesh_cfg: MeshConfig, devices=None) -> Mesh:
+    return model_mesh(mesh_cfg.mesh_axes or None, devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh, axis: str = POD_AXIS) -> NamedSharding:
+    """First-dimension sharding over ``axis`` — the pool layout."""
+    return NamedSharding(mesh, P(axis))
+
+
+def num_slots(mesh: Mesh, axis: str = POD_AXIS) -> int:
+    """Pod slots along ``axis`` — the ``num_hosts`` a DistributionPlan must
+    be built with to drive ``PodDistributor(mesh)`` (one slot per device on
+    the axis; a multi-device process fetches for all its slots)."""
+    return int(mesh.shape[axis])
+
+
+def host_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,114 @@
+"""Distribution plan: deterministic xorb → owner-host assignment.
+
+The reference answers "who has this xorb?" dynamically (DHT lookup,
+src/dht.zig:400-446). A pod inverts the question: membership is static, so
+*ownership is a pure function* — every host computes the same plan with no
+coordination, via rendezvous (highest-random-weight) hashing of
+(xorb hash, range start, host). Owners fetch their xorbs from CDN/disk;
+everyone else receives the bytes over ICI/DCN (zest_tpu.parallel.collectives)
+or pulls them from the owner via chunk RPC. HRW keeps assignment balanced
+and stable: a host joining/leaving remaps only its own share — the TPU
+equivalent of the reference's per-xorb swarm identity (src/peer_id.zig:28-33).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
+
+
+def owner_host(xorb_hash: bytes, range_start: int, num_hosts: int) -> int:
+    """Rendezvous-hash owner of one fetch unit among ``num_hosts``."""
+    if num_hosts <= 0:
+        raise ValueError("num_hosts must be positive")
+    if num_hosts == 1:
+        return 0
+    tail = struct.pack("<Q", range_start)
+    best_host, best_score = 0, b""
+    for h in range(num_hosts):
+        score = hashing.blake3_hash(
+            xorb_hash + tail + struct.pack("<Q", h)
+        )
+        if score > best_score:
+            best_host, best_score = h, score
+    return best_host
+
+
+@dataclass(frozen=True)
+class FetchAssignment:
+    """One fetch unit: a xorb's fetch_info range, owned by ``owner``."""
+
+    hash_hex: str
+    fetch_info: FetchInfo
+    owner: int
+
+    @property
+    def est_bytes(self) -> int:
+        """Compressed transfer size — the load-balance weight."""
+        return self.fetch_info.url_range_end - self.fetch_info.url_range_start
+
+
+@dataclass
+class DistributionPlan:
+    """The pod-wide fetch schedule for a set of files.
+
+    Built identically on every host from the same reconstructions (order-
+    independent: units are sorted by key before assignment), so no plan
+    needs to be exchanged — the TPU analog of the reference's emergent
+    per-peer scheduling (src/swarm.zig:279-314).
+    """
+
+    num_hosts: int
+    assignments: list[FetchAssignment] = field(default_factory=list)
+
+    @staticmethod
+    def build(recs: list[Reconstruction], num_hosts: int) -> "DistributionPlan":
+        units: dict[tuple[str, int], FetchInfo] = {}
+        for rec in recs:
+            for hash_hex, entries in rec.fetch_info.items():
+                for fi in entries:
+                    # Chunk-level dedup: a xorb range shared across files
+                    # (or repeated terms) is fetched exactly once.
+                    units.setdefault((hash_hex, fi.range.start), fi)
+        assignments = [
+            FetchAssignment(
+                hash_hex=hh,
+                fetch_info=fi,
+                owner=owner_host(
+                    hashing.hex_to_hash(hh), start, num_hosts
+                ),
+            )
+            for (hh, start), fi in sorted(units.items())
+        ]
+        return DistributionPlan(num_hosts, assignments)
+
+    def for_host(self, host: int) -> list[FetchAssignment]:
+        """The fetch units this host must source from CDN/disk."""
+        return [a for a in self.assignments if a.owner == host]
+
+    def bytes_per_host(self) -> list[int]:
+        out = [0] * self.num_hosts
+        for a in self.assignments:
+            out[a.owner] += a.est_bytes
+        return out
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(a.est_bytes for a in self.assignments)
+
+    def summary(self) -> dict:
+        per_host = self.bytes_per_host()
+        peak = max(per_host) if per_host else 0
+        mean = self.total_bytes / self.num_hosts if self.num_hosts else 0
+        return {
+            "units": len(self.assignments),
+            "hosts": self.num_hosts,
+            "total_bytes": self.total_bytes,
+            "bytes_per_host": per_host,
+            # 1.0 = perfectly balanced CDN ingress (design target for
+            # BASELINE config #5's hierarchical scheduling).
+            "balance": round(mean / peak, 4) if peak else 1.0,
+        }
