@@ -1,0 +1,94 @@
+"""On-device BLAKE3 vs the pure reference implementation (bit-exactness).
+
+The device hasher is the post-gather integrity gate (SURVEY.md §6.4 "on-
+device BLAKE3"); a single bit of drift silently corrupts every pulled
+model, so parity with cas.blake3 across tree shapes is the whole game.
+Sizes chosen to hit: empty input, sub-block, block boundaries, single-leaf
+(<=1024B), two-leaf, odd-leaf counts (promotion), and multi-level trees.
+"""
+
+import numpy as np
+import pytest
+
+from zest_tpu.cas import blake3 as ref
+from zest_tpu.ops.blake3 import DeviceHasher, verify_chunks_device
+
+_RNG = np.random.default_rng(42)
+_SIZES = [0, 1, 3, 63, 64, 65, 1023, 1024, 1025, 2048, 3000, 5000]
+
+
+@pytest.fixture(scope="module")
+def hasher():
+    return DeviceHasher()
+
+
+def test_plain_matches_reference(hasher):
+    chunks = [_RNG.bytes(n) for n in _SIZES]
+    got = hasher.hash_batch(chunks)
+    for c, g in zip(chunks, got):
+        assert g == ref.blake3(c), f"mismatch at len {len(c)}"
+
+
+def test_keyed_matches_reference():
+    key = bytes(range(32))
+    hk = DeviceHasher(key)
+    chunks = [_RNG.bytes(n) for n in _SIZES]
+    for c, g in zip(chunks, hk.hash_batch(chunks)):
+        assert g == ref.blake3_keyed(key, c), f"mismatch at len {len(c)}"
+
+
+def test_every_leaf_count_through_promotion(hasher):
+    """1..9 leaves exercises each tree shape the masked pairwise merge can
+    take at small scale (odd tails, multi-level promotion)."""
+    chunks = [_RNG.bytes(1024 * n + 17) for n in range(9)]
+    for c, g in zip(chunks, hasher.hash_batch(chunks)):
+        assert g == ref.blake3(c), f"mismatch at len {len(c)}"
+
+
+def test_device_side_masking(hasher):
+    """Garbage bytes beyond `length` must not affect the digest — gathered
+    pool rows are reused buffers."""
+    import jax.numpy as jnp
+
+    buf = np.frombuffer(_RNG.bytes(2048), dtype=np.uint8).copy()
+    words = jnp.asarray(buf.view("<u4")[None, :])
+    d = hasher.hash_device(words, jnp.asarray([1500]))
+    assert (
+        np.asarray(d)[0].astype("<u4").tobytes() == ref.blake3(buf[:1500].tobytes())
+    )
+
+
+def test_verify_chunks_device(hasher):
+    import jax.numpy as jnp
+
+    good = _RNG.bytes(1700)
+    bad = _RNG.bytes(1700)
+    buf = np.zeros((2, 2048), dtype=np.uint8)
+    buf[0, :1700] = np.frombuffer(good, dtype=np.uint8)
+    buf[1, :1700] = np.frombuffer(bad, dtype=np.uint8)
+    expected = np.stack([
+        np.frombuffer(ref.blake3(good), dtype="<u4"),
+        np.frombuffer(ref.blake3(good), dtype="<u4"),  # wrong for row 1
+    ])
+    ok = verify_chunks_device(
+        jnp.asarray(buf.view("<u4")), jnp.asarray([1700, 1700]),
+        jnp.asarray(expected),
+    )
+    assert bool(ok[0]) and not bool(ok[1])
+
+
+def test_keyed_chunk_hash_convention(hasher):
+    """Device hashing with the CHUNK_KEY matches cas.hashing.chunk_hash —
+    the convention the whole CAS layer keys on."""
+    from zest_tpu.cas import hashing
+
+    hk = DeviceHasher(hashing.CHUNK_KEY)
+    data = _RNG.bytes(3333)
+    assert hk.hash_batch([data])[0] == hashing.chunk_hash(data)
+
+
+def test_capacity_validation(hasher):
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        hasher.hash_device(jnp.zeros((1, 100), jnp.uint32), jnp.asarray([0]))

@@ -1,0 +1,11 @@
+"""On-device ops: Pallas/XLA kernels for the byte-level hot paths.
+
+The reference's hot byte work (BLAKE3 verification, chunk extraction) runs
+on host CPU in Zig; here it runs where the bytes land — TPU HBM — so the
+gathered pool is verified without a host round-trip (BASELINE north star).
+"""
+
+from zest_tpu.ops.blake3 import (  # noqa: F401
+    DeviceHasher,
+    verify_chunks_device,
+)

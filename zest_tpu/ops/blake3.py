@@ -1,0 +1,324 @@
+"""On-device BLAKE3: batched chunk verification as pure XLA ops.
+
+The reference verifies chunk hashes on the CPU via zig-xet (BASELINE
+blake3_64kb = 3.5 GB/s, README.md:309-319). Here verification runs where
+the bytes already live — HBM — so the gathered pool never round-trips to
+host: a batch of padded chunks (e.g. GatheredPool rows) is hashed entirely
+with u32 vector ops under jit. ``zest_tpu.ops.blake3_pallas`` wraps the same
+math in a Pallas kernel; this module is the lowering-agnostic version and
+the bit-exactness anchor against ``zest_tpu.cas.blake3``.
+
+Vectorization strategy (all shapes static, no data-dependent control flow):
+
+- one **leaf** = one 1024-byte BLAKE3 chunk = 16 sequential block
+  compressions → ``lax.scan`` carrying the CV, lanes masked by each leaf's
+  real block count;
+- per-chunk leaf counts vary, so the chunk tree is built as **7 fixed merge
+  levels** of pairwise parent compressions with odd-tail promotion — which
+  is exactly BLAKE3's largest-power-of-two tree shape, expressed as dense
+  masked selects instead of a CV stack (cas/blake3.py:218-226);
+- ROOT finalization selects between "last parent" (multi-leaf) and a saved
+  deferred "last block" (single-leaf) per batch element.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zest_tpu.cas.blake3 import (
+    BLOCK_LEN,
+    CHUNK_END,
+    CHUNK_LEN,
+    CHUNK_START,
+    IV,
+    KEYED_HASH,
+    MSG_PERMUTATION,
+    PARENT,
+    ROOT,
+)
+
+BLOCKS_PER_LEAF = CHUNK_LEN // BLOCK_LEN      # 16
+WORDS_PER_BLOCK = BLOCK_LEN // 4              # 16
+WORDS_PER_LEAF = CHUNK_LEN // 4               # 256
+MAX_LEAVES = 128                              # 128 KiB: xet max chunk size
+_U32 = jnp.uint32
+
+
+_PERM = np.asarray(MSG_PERMUTATION, dtype=np.int32)
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _g_vec(va, vb, vc, vd, mx, my):
+    """Four G functions at once: lane *i* of each row vector is column/
+    diagonal *i* of the state matrix — the classic SIMD formulation, which
+    is also what keeps the traced graph small enough for XLA (a fully
+    scalar-unrolled compress explodes CPU compile times)."""
+    va = va + vb + mx
+    vd = _rotr(vd ^ va, 16)
+    vc = vc + vd
+    vb = _rotr(vb ^ vc, 12)
+    va = va + vb + my
+    vd = _rotr(vd ^ va, 8)
+    vc = vc + vd
+    vb = _rotr(vb ^ vc, 7)
+    return va, vb, vc, vd
+
+
+def compress(cv, m, counter, block_len, flags):
+    """Vectorized BLAKE3 compression (cas/blake3.py:70-92).
+
+    ``cv``: (..., 8) u32; ``m``: (..., 16) u32; ``counter``/``block_len``/
+    ``flags``: (...) broadcastable u32 (counter high word is always 0 here —
+    leaf indices stay < 2^32). Returns the full (..., 16) output state.
+    """
+    shape = jnp.broadcast_shapes(
+        cv.shape[:-1], m.shape[:-1], jnp.shape(counter),
+        jnp.shape(block_len), jnp.shape(flags),
+    )
+    cv = jnp.broadcast_to(cv, shape + (8,))
+    m = jnp.broadcast_to(m, shape + (16,)).astype(_U32)
+    va, vb = cv[..., 0:4], cv[..., 4:8]
+    vc = jnp.broadcast_to(
+        jnp.asarray(IV[:4], _U32), shape + (4,)
+    )
+    vd = jnp.stack(
+        [
+            jnp.broadcast_to(counter, shape).astype(_U32),
+            jnp.zeros(shape, _U32),
+            jnp.broadcast_to(block_len, shape).astype(_U32),
+            jnp.broadcast_to(flags, shape).astype(_U32),
+        ],
+        axis=-1,
+    )
+
+    def round_fn(_i, carry):
+        va, vb, vc, vd, m = carry
+        va, vb, vc, vd = _g_vec(
+            va, vb, vc, vd, m[..., 0:8:2], m[..., 1:8:2]
+        )
+        # Diagonalize: lane i addresses (i, 4+(i+1)%4, 8+(i+2)%4, 12+(i+3)%4).
+        vb = jnp.roll(vb, -1, axis=-1)
+        vc = jnp.roll(vc, -2, axis=-1)
+        vd = jnp.roll(vd, -3, axis=-1)
+        va, vb, vc, vd = _g_vec(
+            va, vb, vc, vd, m[..., 8:16:2], m[..., 9:16:2]
+        )
+        vb = jnp.roll(vb, 1, axis=-1)
+        vc = jnp.roll(vc, 2, axis=-1)
+        vd = jnp.roll(vd, 3, axis=-1)
+        return va, vb, vc, vd, m[..., _PERM]
+
+    va, vb, vc, vd, _ = jax.lax.fori_loop(
+        0, 7, round_fn, (va, vb, vc, vd, m)
+    )
+    lo = jnp.concatenate([va, vb], axis=-1)
+    hi = jnp.concatenate([vc, vd], axis=-1)
+    return jnp.concatenate([lo ^ hi, hi ^ cv], axis=-1)
+
+
+def _leaf_cvs(words, lengths, key_words, base_flags):
+    """CVs of every leaf plus the deferred single-leaf root inputs.
+
+    ``words``: (B, MAX_LEAVES * 256) u32 — zero-padded little-endian view of
+    each chunk. ``lengths``: (B,) i32 byte lengths. Returns
+    (leaf_cv (B, L, 8), n_leaves (B,), deferred) where ``deferred`` is the
+    (cv_in, block, block_len, flags) of leaf 0's final block, needed when a
+    chunk has a single leaf and the ROOT flag belongs on that block
+    (cas/blake3.py:170-174).
+    """
+    B = words.shape[0]
+    L = words.shape[1] // WORDS_PER_LEAF
+    words = words.reshape(B, L, BLOCKS_PER_LEAF, WORDS_PER_BLOCK)
+    lengths = lengths.astype(jnp.int32)
+
+    leaf_idx = jnp.arange(L, dtype=jnp.int32)
+    # Bytes belonging to each leaf, then blocks per leaf. Leaf 0 always has
+    # one block (the empty input compresses one zero block, block_len 0).
+    leaf_bytes = jnp.clip(lengths[:, None] - leaf_idx[None, :] * CHUNK_LEN,
+                          0, CHUNK_LEN)                       # (B, L)
+    n_blocks = jnp.maximum((leaf_bytes + BLOCK_LEN - 1) // BLOCK_LEN,
+                           jnp.where(leaf_idx[None, :] == 0, 1, 0))
+    leaf_active = n_blocks > 0
+    n_leaves = jnp.maximum(jnp.sum(leaf_active, axis=1), 1)   # (B,)
+
+    # Mask padding inside the final partial word of each chunk (device
+    # buffers may hold garbage past `length`).
+    word_idx = jnp.arange(L * WORDS_PER_LEAF, dtype=jnp.int32)
+    rem = jnp.clip(lengths[:, None] - word_idx[None, :] * 4, 0, 4)
+    word_mask = jnp.where(
+        rem >= 4,
+        jnp.asarray(0xFFFFFFFF, _U32),
+        (jnp.asarray(1, _U32) << (8 * rem.astype(_U32))) - 1,
+    )
+    words = words & word_mask.reshape(B, L, BLOCKS_PER_LEAF, WORDS_PER_BLOCK)
+
+    blk = jnp.arange(BLOCKS_PER_LEAF, dtype=jnp.int32)
+    blk_active = blk[None, None, :] < n_blocks[:, :, None]     # (B, L, 16)
+    is_last = blk[None, None, :] == n_blocks[:, :, None] - 1
+    blk_len = jnp.clip(leaf_bytes[:, :, None] - blk[None, None, :] * BLOCK_LEN,
+                       0, BLOCK_LEN)
+    flags = (
+        base_flags
+        | jnp.where(blk[None, None, :] == 0, CHUNK_START, 0)
+        | jnp.where(is_last, CHUNK_END, 0)
+    ).astype(_U32)
+
+    key = jnp.broadcast_to(key_words, (B, L, 8))
+    counter = jnp.broadcast_to(leaf_idx[None, :], (B, L)).astype(_U32)
+
+    def step(carry, xs):
+        cv, dcv, dblk, dlen, dflags = carry
+        m, active, last, bl, fl = xs
+        out = compress(cv, m, counter, bl.astype(_U32), fl)
+        new_cv = jnp.where(active[..., None], out[..., :8], cv)
+        # Defer the last block's inputs for the single-leaf ROOT path.
+        dcv = jnp.where(last[..., None], cv, dcv)
+        dblk = jnp.where(last[..., None], m, dblk)
+        dlen = jnp.where(last, bl, dlen)
+        dflags = jnp.where(last, fl, dflags)
+        return (new_cv, dcv, dblk, dlen, dflags), None
+
+    xs = (
+        jnp.moveaxis(words, 2, 0),        # (16, B, L, 16)
+        jnp.moveaxis(blk_active, 2, 0),   # (16, B, L)
+        jnp.moveaxis(is_last, 2, 0),
+        jnp.moveaxis(blk_len, 2, 0),
+        jnp.moveaxis(flags, 2, 0),
+    )
+    init = (
+        key,
+        jnp.zeros((B, L, 8), _U32),
+        jnp.zeros((B, L, WORDS_PER_BLOCK), _U32),
+        jnp.zeros((B, L), jnp.int32),
+        jnp.zeros((B, L), _U32),
+    )
+    (cv, dcv, dblk, dlen, dflags), _ = jax.lax.scan(step, init, xs)
+    deferred = (dcv[:, 0], dblk[:, 0], dlen[:, 0], dflags[:, 0])
+    return cv, n_leaves, deferred
+
+
+def _merge_tree(leaf_cv, n_leaves, key_words, base_flags):
+    """Fold leaf CVs into the root state via fixed pairwise levels.
+
+    Pairwise merge with odd-tail promotion reproduces BLAKE3's
+    largest-power-of-two tree (verified exhaustively in tests). The unique
+    merge with exactly two live nodes is the root and carries ROOT.
+    """
+    B, L, _ = leaf_cv.shape
+    cv = leaf_cv
+    count = n_leaves.astype(jnp.int32)
+    root = jnp.zeros((B, 16), _U32)
+    while L > 1:
+        if L % 2:  # odd capacity: zero-pad; live odd tails promote via mask
+            cv = jnp.concatenate(
+                [cv, jnp.zeros((B, 1, 8), _U32)], axis=1
+            )
+            L += 1
+        half = L // 2
+        left = cv[:, 0::2]
+        right = cv[:, 1::2]
+        m = jnp.concatenate([left, right], axis=-1)            # (B, half, 16)
+        is_root = count == 2  # the unique two-live-node merge is the root
+        flags = (
+            jnp.full((B, half), base_flags | PARENT, _U32)
+            | jnp.where(is_root, ROOT, 0).astype(_U32)[:, None]
+        )
+        out = compress(
+            jnp.broadcast_to(key_words, (B, half, 8)),
+            m,
+            jnp.zeros((B, half), _U32),
+            jnp.full((B, half), BLOCK_LEN, _U32),
+            flags,
+        )
+        j = jnp.arange(half, dtype=jnp.int32)
+        merged = (2 * j[None, :] + 1) < count[:, None]
+        cv = jnp.where(merged[..., None], out[..., :8], left)
+        root = jnp.where(is_root[:, None], out[:, 0], root)
+        count = (count + 1) // 2
+        L = half
+    return root
+
+
+@functools.partial(jax.jit, static_argnames=("base_flags",))
+def _hash_chunks_impl(words, lengths, key_words, base_flags):
+    leaf_cv, n_leaves, deferred = _leaf_cvs(
+        words, lengths, key_words, base_flags
+    )
+    root_multi = _merge_tree(leaf_cv, n_leaves, key_words, base_flags)
+    dcv, dblk, dlen, dflags = deferred
+    root_single = compress(
+        dcv, dblk, jnp.zeros(words.shape[0], _U32),
+        dlen.astype(_U32), dflags | ROOT,
+    )
+    root = jnp.where((n_leaves == 1)[:, None], root_single, root_multi)
+    return root[:, :8]
+
+
+class DeviceHasher:
+    """Batched on-device BLAKE3 for equal-capacity chunk buffers."""
+
+    def __init__(self, key: bytes | None = None):
+        if key is not None:
+            if len(key) != 32:
+                raise ValueError("key must be 32 bytes")
+            self.key_words = jnp.asarray(
+                np.frombuffer(key, dtype="<u4"), _U32
+            )
+            self.base_flags = KEYED_HASH
+        else:
+            self.key_words = jnp.asarray(np.asarray(IV, dtype="<u4"), _U32)
+            self.base_flags = 0
+
+    def hash_device(self, words: jax.Array, lengths: jax.Array) -> jax.Array:
+        """(B, padded_words) u32 + (B,) lengths → (B, 8) u32 digests.
+
+        ``words`` stays on device — this is the path the gathered pool
+        uses. Padded capacity must be a multiple of 256 words (1 KiB) and
+        at most ``MAX_LEAVES`` KiB.
+        """
+        if words.shape[-1] % WORDS_PER_LEAF:
+            raise ValueError("padded capacity must be a 1 KiB multiple")
+        if words.shape[-1] > MAX_LEAVES * WORDS_PER_LEAF:
+            raise ValueError(f"chunks larger than {MAX_LEAVES} KiB unsupported")
+        return _hash_chunks_impl(
+            words, lengths, self.key_words, self.base_flags
+        )
+
+    def hash_batch(self, chunks: list[bytes]) -> list[bytes]:
+        """Host convenience: list of byte strings → list of 32-byte digests."""
+        if not chunks:
+            return []
+        max_len = max(len(c) for c in chunks)
+        cap = max(
+            (max_len + CHUNK_LEN - 1) // CHUNK_LEN * CHUNK_LEN, CHUNK_LEN
+        )
+        buf = np.zeros((len(chunks), cap), dtype=np.uint8)
+        lengths = np.empty(len(chunks), dtype=np.int32)
+        for i, c in enumerate(chunks):
+            buf[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lengths[i] = len(c)
+        words = jnp.asarray(buf.view("<u4"))
+        digests = np.asarray(self.hash_device(words, jnp.asarray(lengths)))
+        return [d.astype("<u4").tobytes() for d in digests]
+
+
+def verify_chunks_device(
+    words: jax.Array,
+    lengths: jax.Array,
+    expected: jax.Array,
+    key: bytes | None = None,
+) -> jax.Array:
+    """(B,) bool: does each padded chunk hash to ``expected`` (B, 8) u32?
+
+    The post-gather integrity gate: runs entirely in HBM, one scalar per
+    chunk comes back to host.
+    """
+    got = DeviceHasher(key).hash_device(words, lengths)
+    return jnp.all(got == expected, axis=-1)

@@ -48,6 +48,10 @@ class PoolLayout:
     row_len: int
     # (hash_hex, fetch range start) -> (row, chunk_offset)
     index: dict[tuple[str, int], tuple[int, int]]
+    # per host: its unit keys in row order (so packing is O(own units))
+    host_keys: tuple[tuple[tuple[str, int], ...], ...]
+    # hashes whose single unit at start 0 is provably the whole xorb
+    full_xorbs: frozenset[str]
 
     @property
     def total_rows(self) -> int:
@@ -59,8 +63,9 @@ class PoolLayout:
 
     @staticmethod
     def from_plan(plan: DistributionPlan) -> "PoolLayout":
+        by_owner = plan.by_owner()
         per_host: list[list[FetchAssignment]] = [
-            plan.for_host(h) for h in range(plan.num_hosts)
+            by_owner.get(h, []) for h in range(plan.num_hosts)
         ]
         rows_per_host = max((len(units) for units in per_host), default=0)
         max_blob = max(
@@ -68,13 +73,29 @@ class PoolLayout:
         )
         row_len = _round_up(_LEN_HEADER + max_blob, _ROW_ALIGN)
         index: dict[tuple[str, int], tuple[int, int]] = {}
+        host_keys: list[tuple[tuple[str, int], ...]] = []
+        starts_by_hash: dict[str, list[int]] = {}
         for h, units in enumerate(per_host):
+            keys = []
             for i, a in enumerate(units):
-                index[(a.hash_hex, a.fetch_info.range.start)] = (
-                    h * rows_per_host + i,
-                    a.fetch_info.range.start,
+                key = (a.hash_hex, a.fetch_info.range.start)
+                index[key] = (h * rows_per_host + i, a.fetch_info.range.start)
+                keys.append(key)
+                starts_by_hash.setdefault(a.hash_hex, []).append(
+                    a.fetch_info.range.start
                 )
-        return PoolLayout(plan.num_hosts, rows_per_host, row_len, index)
+            host_keys.append(tuple(keys))
+        # Same evidence rule as XetBridge._cache_fetched: a blob is the
+        # whole xorb only when its hash has exactly one planned range and
+        # that range starts at chunk 0.
+        full = frozenset(
+            hh for hh, starts in starts_by_hash.items()
+            if starts == [0]
+        )
+        return PoolLayout(
+            plan.num_hosts, rows_per_host, row_len, index,
+            tuple(host_keys), full,
+        )
 
 
 def pack_rows(
@@ -85,9 +106,8 @@ def pack_rows(
     """Host ``host``'s shard of the pool: its owned blobs in row order."""
     out = np.zeros((layout.rows_per_host, layout.row_len), dtype=np.uint8)
     base = host * layout.rows_per_host
-    for key, (row, _off) in layout.index.items():
-        if not (base <= row < base + layout.rows_per_host):
-            continue
+    for key in layout.host_keys[host]:
+        row, _off = layout.index[key]
         blob = blobs.get(key)
         if blob is None or _LEN_HEADER + len(blob) > layout.row_len:
             # Missing or over-capacity blob: leave a zero row so readers
@@ -145,7 +165,10 @@ class GatheredPool:
             if got is None:
                 continue
             data, chunk_offset = got
-            if chunk_offset == 0:
+            # Full-key writes need proof the blob is the whole xorb
+            # (layout.full_xorbs); an offset-0 slice cached as full would
+            # poison later range reads (same rule as bridge._cache_fetched).
+            if chunk_offset == 0 and hash_hex in self.layout.full_xorbs:
                 cache.put(hash_hex, data)
             else:
                 cache.put_partial(hash_hex, chunk_offset, data)

@@ -31,8 +31,13 @@ class InMemoryRegistry:
 
     def find_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
         with self._lock:
-            peers = list(self._avail.get(info_hash, {}).values())
-        return [p for p in peers if p != self.self_addr]
+            items = list(self._avail.get(info_hash, {}).items())
+        # Never hand back our own announce ("self" key), whatever self_addr
+        # says — dialing ourselves would fake P2P stats.
+        return [
+            addr for key, addr in items
+            if key != "self" and addr != self.self_addr
+        ]
 
     def announce(self, info_hash: bytes, port: int) -> None:
         host = self.self_addr[0] if self.self_addr else "127.0.0.1"

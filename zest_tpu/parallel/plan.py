@@ -63,6 +63,9 @@ class DistributionPlan:
 
     num_hosts: int
     assignments: list[FetchAssignment] = field(default_factory=list)
+    _by_owner: dict[int, list[FetchAssignment]] | None = field(
+        default=None, repr=False, compare=False
+    )
 
     @staticmethod
     def build(recs: list[Reconstruction], num_hosts: int) -> "DistributionPlan":
@@ -71,8 +74,13 @@ class DistributionPlan:
             for hash_hex, entries in rec.fetch_info.items():
                 for fi in entries:
                     # Chunk-level dedup: a xorb range shared across files
-                    # (or repeated terms) is fetched exactly once.
-                    units.setdefault((hash_hex, fi.range.start), fi)
+                    # (or repeated terms) is fetched exactly once. Keep the
+                    # widest entry for a start — a narrower duplicate would
+                    # leave later readers short of chunks.
+                    key = (hash_hex, fi.range.start)
+                    prev = units.get(key)
+                    if prev is None or fi.range.end > prev.range.end:
+                        units[key] = fi
         assignments = [
             FetchAssignment(
                 hash_hex=hh,
@@ -85,9 +93,18 @@ class DistributionPlan:
         ]
         return DistributionPlan(num_hosts, assignments)
 
+    def by_owner(self) -> dict[int, list[FetchAssignment]]:
+        """Assignments grouped by owner — built once, O(units)."""
+        if self._by_owner is None:
+            grouped: dict[int, list[FetchAssignment]] = {}
+            for a in self.assignments:
+                grouped.setdefault(a.owner, []).append(a)
+            self._by_owner = grouped
+        return self._by_owner
+
     def for_host(self, host: int) -> list[FetchAssignment]:
         """The fetch units this host must source from CDN/disk."""
-        return [a for a in self.assignments if a.owner == host]
+        return self.by_owner().get(host, [])
 
     def bytes_per_host(self) -> list[int]:
         out = [0] * self.num_hosts
