@@ -1,0 +1,259 @@
+"""Models layer: safetensors format, checkpoint landing, flagship GPT-2.
+
+The correctness anchor mirrors the reference's verify-model.sh (load pulled
+weights with transformers and check behavior, test/local/verify-model.sh:
+90-147) — but cross-implementation: the same random checkpoint must produce
+the same logits from torch/transformers' GPT2 and from our pure-JAX
+forward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zest_tpu.models import gpt2
+from zest_tpu.models.loader import infer_spec, load_checkpoint, spec_for
+from zest_tpu.models.safetensors_io import (
+    SafetensorsFile,
+    parse_header,
+    write_safetensors,
+)
+
+
+# ── safetensors_io ──
+
+
+def test_safetensors_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    tensors = {
+        "a": rng.standard_normal((3, 5)).astype(np.float32),
+        "b": rng.integers(0, 255, size=(7,), dtype=np.uint8),
+        "c.nested.name": rng.standard_normal((2, 2, 2)).astype(np.float16),
+    }
+    path = tmp_path / "m.safetensors"
+    write_safetensors(path, tensors, metadata={"format": "pt"})
+    with SafetensorsFile(path) as sf:
+        assert sorted(sf.names()) == sorted(tensors)
+        assert sf.header.metadata == {"format": "pt"}
+        for name, want in tensors.items():
+            np.testing.assert_array_equal(sf.tensor(name), want)
+
+
+def test_safetensors_bf16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    arr = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    path = tmp_path / "m.safetensors"
+    write_safetensors(path, {"x": arr})
+    with SafetensorsFile(path) as sf:
+        assert sf.info("x").dtype == "BF16"
+        np.testing.assert_array_equal(sf.tensor("x"), arr)
+
+
+def test_safetensors_upstream_compat(tmp_path):
+    """Our writer's files parse with the upstream safetensors package and
+    vice versa."""
+    st = pytest.importorskip("safetensors.numpy")
+    ours = tmp_path / "ours.safetensors"
+    theirs = tmp_path / "theirs.safetensors"
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    write_safetensors(ours, {"x": x})
+    np.testing.assert_array_equal(st.load_file(str(ours))["x"], x)
+    st.save_file({"x": x}, str(theirs))
+    with SafetensorsFile(theirs) as sf:
+        np.testing.assert_array_equal(sf.tensor("x"), x)
+
+
+def test_safetensors_rejects_bad_header():
+    with pytest.raises(ValueError):
+        parse_header(b"\x00" * 4)
+    huge = (10**12).to_bytes(8, "little") + b"{}"
+    with pytest.raises(ValueError):
+        parse_header(huge)
+
+
+def test_safetensors_rejects_offset_shape_mismatch(tmp_path):
+    import json
+    import struct
+
+    hdr = json.dumps({
+        "x": {"dtype": "F32", "shape": [4], "data_offsets": [0, 8]}
+    }).encode()
+    with pytest.raises(ValueError, match="span"):
+        parse_header(struct.pack("<Q", len(hdr)) + hdr + b"\x00" * 8)
+
+
+# ── loader ──
+
+
+def _mesh8():
+    return Mesh(np.asarray(jax.devices()[:8]), ("model",))
+
+
+def test_infer_spec_picks_largest_divisible_axis():
+    mesh = _mesh8()
+    assert infer_spec((16, 6), mesh, "model") == P("model", None)
+    assert infer_spec((6, 32), mesh, "model") == P(None, "model")
+    assert infer_spec((3, 5), mesh, "model") == P()  # indivisible
+    assert infer_spec((), mesh, "model") == P()
+
+
+def test_spec_rules_first_match_wins():
+    mesh = _mesh8()
+    rules = [(r"bias$", P()), (r"weight$", P("model", None))]
+    assert spec_for("h.0.weight", (16, 16), mesh, rules) == P("model", None)
+    assert spec_for("h.0.bias", (16,), mesh, rules) == P()
+    # no rule match → inferred
+    assert spec_for("other", (16, 4), mesh, rules) == P("model", None)
+
+
+def test_load_checkpoint_sharded(tmp_path):
+    rng = np.random.default_rng(1)
+    tensors = {
+        "w": rng.standard_normal((16, 4)).astype(np.float32),
+        "b": rng.standard_normal((5,)).astype(np.float32),
+    }
+    write_safetensors(tmp_path / "model.safetensors", tensors)
+    mesh = _mesh8()
+    params = load_checkpoint(tmp_path, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(params["w"]), tensors["w"])
+    np.testing.assert_array_equal(np.asarray(params["b"]), tensors["b"])
+    w_spec = params["w"].sharding.spec
+    assert w_spec == P("model", None)       # 16 divisible by 8
+    assert params["b"].sharding.spec == P()  # 5 indivisible → replicated
+
+
+def test_stage_snapshot_to_hbm_stats(tmp_path, tmp_config):
+    tensors = {"w": np.ones((8, 8), np.float32)}
+    write_safetensors(tmp_path / "model.safetensors", tensors)
+    from zest_tpu.models.loader import stage_snapshot_to_hbm
+
+    stats = stage_snapshot_to_hbm(tmp_config, tmp_path)
+    assert stats["tensors"] == 1
+    assert stats["bytes"] == 8 * 8 * 4
+    assert "w" in tmp_config.staged_params
+
+
+# ── gpt2 flagship ──
+
+
+def test_gpt2_forward_shapes_and_jit():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    ids = jnp.zeros((2, 9), jnp.int32)
+    logits = jax.jit(lambda p, x: gpt2.forward(p, x, cfg))(params, ids)
+    assert logits.shape == (2, 9, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_gpt2_matches_transformers():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=48, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu_new",
+    )
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    state = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    cfg = gpt2.GPT2Config(vocab_size=96, n_ctx=32, n_embd=48,
+                          n_layer=2, n_head=4)
+    params = gpt2.params_from_hf(state, cfg)
+
+    ids = np.array([[5, 17, 2, 90, 41, 7, 0, 33]], dtype=np.int64)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(gpt2.forward(params, jnp.asarray(ids, jnp.int32), cfg))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_train_step_reduces_loss():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(jax.random.key(1), cfg)
+    batch = jax.random.randint(jax.random.key(2), (4, 17), 0,
+                               cfg.vocab_size, jnp.int32)
+    import functools
+    step = jax.jit(functools.partial(gpt2.train_step, cfg=cfg, lr=1e-2))
+    params, loss0 = step(params, batch)
+    for _ in range(5):
+        params, loss = step(params, batch)
+    assert float(loss) < float(loss0)
+
+
+def test_gpt2_sharded_train_step():
+    """The dryrun path: params sharded per param_specs over data×model."""
+    cfg = gpt2.GPT2Config.tiny()
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("data", "model"))
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, gpt2.param_specs(cfg),
+    )
+    batch = jax.device_put(
+        jnp.zeros((4, 17), jnp.int32),
+        NamedSharding(mesh, P("data", None)),
+    )
+    import functools
+    step = jax.jit(functools.partial(gpt2.train_step, cfg=cfg))
+    new_params, loss = step(params, batch)
+    jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
+    # sharding survived the step
+    qkv = new_params["blocks"]["attn"]["qkv_w"]
+    assert qkv.sharding.spec == P(None, None, "model")
+
+
+def test_gpt2_generate_greedy_is_causal_consistent():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(jax.random.key(3), cfg)
+    out = gpt2.generate_greedy(params, cfg, [1, 2, 3], steps=4)
+    assert out.shape == (7,)
+    assert list(np.asarray(out[:3])) == [1, 2, 3]
+    # determinism
+    out2 = gpt2.generate_greedy(params, cfg, [1, 2, 3], steps=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# ── driver entry points ──
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == args[1].shape[0]
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+def test_gpt2_generate_rejects_context_overflow():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(jax.random.key(3), cfg)
+    with pytest.raises(ValueError, match="n_ctx"):
+        gpt2.generate_greedy(params, cfg, list(range(60)), steps=10)
+
+
+def test_real_gpt2_vocab_lands_on_mesh(tmp_path):
+    """Regression: vocab 50257 divides no axis — wte must land replicated
+    or embedding-dim-sharded, never raise."""
+    mesh = _mesh8()
+    spec = spec_for("wte.weight", (50257, 768), mesh,
+                    gpt2.checkpoint_shard_rules())
+    assert spec in (P(), P(None, "model"))
+    arr = np.zeros((50257, 16), np.float32)
+    landed = jax.device_put(arr, NamedSharding(mesh, spec))
+    assert landed.shape == arr.shape
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+    ge.dryrun_multichip(4)  # subset of local devices must also work

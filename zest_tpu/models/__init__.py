@@ -1,0 +1,30 @@
+"""Model landing + flagship consumers of pulled checkpoints.
+
+- safetensors_io: the checkpoint byte format (header → tensor byte ranges)
+- loader: safetensors → (pjit-sharded) jax.Arrays in HBM
+- gpt2: pure-JAX flagship model proving the pulled bytes run on the MXU
+"""
+
+from zest_tpu.models.loader import (
+    infer_spec,
+    land_tensor,
+    load_checkpoint,
+    spec_for,
+    stage_snapshot_to_hbm,
+)
+from zest_tpu.models.safetensors_io import (
+    SafetensorsFile,
+    parse_header,
+    write_safetensors,
+)
+
+__all__ = [
+    "SafetensorsFile",
+    "parse_header",
+    "write_safetensors",
+    "infer_spec",
+    "land_tensor",
+    "load_checkpoint",
+    "spec_for",
+    "stage_snapshot_to_hbm",
+]

@@ -1,0 +1,304 @@
+"""Flagship model: pure-JAX GPT-2 that consumes pulled HF checkpoints.
+
+The reference's end-to-end proof is "pull gpt2, load with transformers,
+generate" (test/local/verify-model.sh:90-147). The TPU build closes the
+same loop natively: the pulled safetensors map onto this module's param
+tree, the forward runs under jit on the MXU (bf16 matmuls, static shapes,
+``lax`` control flow only), and the train step shards over a
+``{data, model}`` mesh so the checkpoint landed by zest_tpu.models.loader
+is consumed in place.
+
+Design notes (TPU-first, not a torch translation):
+- params are a flat pytree of arrays; blocks are stacked along a leading
+  layer axis and the transformer body is one ``lax.scan`` over layers —
+  one compiled block regardless of depth, the idiomatic XLA layout.
+- tensor-parallel sharding follows the Megatron pattern expressed as
+  PartitionSpecs: qkv/fc shard the output feature dim, proj shards the
+  input feature dim, so each block needs exactly one reduce per sublayer,
+  which GSPMD inserts automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_ctx: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_eps: float = 1e-5
+
+    @staticmethod
+    def tiny(**over) -> "GPT2Config":
+        """Test/dryrun-sized config (divisible by 8-wide model axes)."""
+        base = dict(vocab_size=256, n_ctx=64, n_embd=64,
+                    n_layer=2, n_head=4)
+        base.update(over)
+        return GPT2Config(**base)
+
+    @staticmethod
+    def from_hf(cfg_json: dict) -> "GPT2Config":
+        return GPT2Config(
+            vocab_size=cfg_json["vocab_size"],
+            n_ctx=cfg_json.get("n_ctx", cfg_json.get("n_positions", 1024)),
+            n_embd=cfg_json["n_embd"],
+            n_layer=cfg_json["n_layer"],
+            n_head=cfg_json["n_head"],
+            layer_norm_eps=cfg_json.get("layer_norm_epsilon", 1e-5),
+        )
+
+
+# ── Parameters ──
+
+
+def init_params(rng: jax.Array, cfg: GPT2Config, dtype=jnp.float32) -> dict:
+    """Random-init param tree with stacked per-layer leaves (L leading)."""
+    E, L = cfg.n_embd, cfg.n_layer
+    k = iter(jax.random.split(rng, 8))
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+    return {
+        "wte": dense(next(k), (cfg.vocab_size, E)),
+        "wpe": dense(next(k), (cfg.n_ctx, E), 0.01),
+        "ln_f": {"g": jnp.ones((E,), dtype), "b": jnp.zeros((E,), dtype)},
+        "blocks": {
+            "ln_1": {"g": jnp.ones((L, E), dtype),
+                     "b": jnp.zeros((L, E), dtype)},
+            "ln_2": {"g": jnp.ones((L, E), dtype),
+                     "b": jnp.zeros((L, E), dtype)},
+            "attn": {
+                "qkv_w": dense(next(k), (L, E, 3 * E)),
+                "qkv_b": jnp.zeros((L, 3 * E), dtype),
+                "proj_w": dense(next(k), (L, E, E),
+                                0.02 / math.sqrt(2 * L)),
+                "proj_b": jnp.zeros((L, E), dtype),
+            },
+            "mlp": {
+                "fc_w": dense(next(k), (L, E, 4 * E)),
+                "fc_b": jnp.zeros((L, 4 * E), dtype),
+                "proj_w": dense(next(k), (L, 4 * E, E),
+                                0.02 / math.sqrt(2 * L)),
+                "proj_b": jnp.zeros((L, E), dtype),
+            },
+        },
+    }
+
+
+_HF_BLOCK = re.compile(r"^h\.(\d+)\.(.+)$")
+
+# HF tensor name (within a block) -> (group, leaf). GPT-2 uses Conv1D, whose
+# weight is stored (in_features, out_features) — already the x @ W layout,
+# no transpose.
+_HF_LEAF = {
+    "ln_1.weight": ("ln_1", "g"), "ln_1.bias": ("ln_1", "b"),
+    "ln_2.weight": ("ln_2", "g"), "ln_2.bias": ("ln_2", "b"),
+    "attn.c_attn.weight": ("attn", "qkv_w"),
+    "attn.c_attn.bias": ("attn", "qkv_b"),
+    "attn.c_proj.weight": ("attn", "proj_w"),
+    "attn.c_proj.bias": ("attn", "proj_b"),
+    "mlp.c_fc.weight": ("mlp", "fc_w"), "mlp.c_fc.bias": ("mlp", "fc_b"),
+    "mlp.c_proj.weight": ("mlp", "proj_w"),
+    "mlp.c_proj.bias": ("mlp", "proj_b"),
+}
+
+
+def params_from_hf(
+    tensors: dict[str, np.ndarray], cfg: GPT2Config, dtype=jnp.float32
+) -> dict:
+    """Map an HF gpt2 checkpoint (flat name→array) onto the param tree.
+
+    Accepts either bare names (``h.0.attn.c_attn.weight``) or the
+    ``transformer.``-prefixed variant; skips the tied ``lm_head.weight``
+    and the non-parameter causal-mask buffers (``attn.bias``).
+    """
+    flat: dict[str, np.ndarray] = {}
+    for name, arr in tensors.items():
+        if name.startswith("transformer."):
+            name = name[len("transformer."):]
+        flat[name] = np.asarray(arr)
+
+    L = cfg.n_layer
+    stacks: dict[tuple[str, str], list] = {
+        key: [None] * L for key in set(_HF_LEAF.values())
+    }
+    out = {
+        "wte": jnp.asarray(flat["wte.weight"], dtype),
+        "wpe": jnp.asarray(flat["wpe.weight"], dtype),
+        "ln_f": {"g": jnp.asarray(flat["ln_f.weight"], dtype),
+                 "b": jnp.asarray(flat["ln_f.bias"], dtype)},
+    }
+    for name, arr in flat.items():
+        m = _HF_BLOCK.match(name)
+        if not m:
+            continue
+        layer, leaf = int(m.group(1)), m.group(2)
+        if leaf not in _HF_LEAF:
+            continue  # attn.bias / attn.masked_bias buffers
+        stacks[_HF_LEAF[leaf]][layer] = arr
+    blocks: dict[str, dict[str, jax.Array]] = {}
+    for (group, leaf), layers in stacks.items():
+        missing = [i for i, a in enumerate(layers) if a is None]
+        if missing:
+            raise ValueError(f"checkpoint missing {group}.{leaf} "
+                             f"for layers {missing}")
+        blocks.setdefault(group, {})[leaf] = jnp.asarray(
+            np.stack(layers), dtype
+        )
+    out["blocks"] = blocks
+    return out
+
+
+# ── Sharding rules (data+tensor parallel) ──
+
+
+def param_specs(cfg: GPT2Config) -> dict:
+    """PartitionSpec tree matching ``init_params`` (Megatron-style TP)."""
+    rep1 = {"g": P(), "b": P()}
+    return {
+        # wte stays replicated: GPT-2's vocab (50257) divides no mesh axis,
+        # and a divisibility-dependent spec would make the tree shape a
+        # function of the mesh. Landing raw checkpoints still shards the
+        # embedding dim when divisible (checkpoint_shard_rules fallback).
+        "wte": P(),
+        "wpe": P(),
+        "ln_f": dict(rep1),
+        "blocks": {
+            "ln_1": dict(rep1),
+            "ln_2": dict(rep1),
+            "attn": {
+                "qkv_w": P(None, None, MODEL_AXIS),
+                "qkv_b": P(None, MODEL_AXIS),
+                "proj_w": P(None, MODEL_AXIS, None),
+                "proj_b": P(),
+            },
+            "mlp": {
+                "fc_w": P(None, None, MODEL_AXIS),
+                "fc_b": P(None, MODEL_AXIS),
+                "proj_w": P(None, MODEL_AXIS, None),
+                "proj_b": P(),
+            },
+        },
+    }
+
+
+def checkpoint_shard_rules() -> list[tuple[str, P]]:
+    """Name-pattern rules for landing raw HF gpt2 safetensors via
+    zest_tpu.models.loader (same layout as ``param_specs``)."""
+    return [
+        (r"attn\.c_attn\.weight$", P(None, MODEL_AXIS)),
+        (r"attn\.c_attn\.bias$", P(MODEL_AXIS)),
+        (r"attn\.c_proj\.weight$", P(MODEL_AXIS, None)),
+        (r"mlp\.c_fc\.weight$", P(None, MODEL_AXIS)),
+        (r"mlp\.c_fc\.bias$", P(MODEL_AXIS)),
+        (r"mlp\.c_proj\.weight$", P(MODEL_AXIS, None)),
+        # No rule for wte/wpe/ln: the loader's infer_spec fallback shards
+        # only evenly divisible dims (vocab 50257 divides nothing → the
+        # embedding dim shards instead) and replicates the rest.
+    ]
+
+
+# ── Forward ──
+
+
+def _layer_norm(x, g, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _block(x, p, cfg: GPT2Config):
+    """One transformer block; ``p`` holds this layer's slice of the stack."""
+    B, T, E = x.shape
+    H = cfg.n_head
+    h = _layer_norm(x, p["ln_1"]["g"], p["ln_1"]["b"], cfg.layer_norm_eps)
+    qkv = h @ p["attn"]["qkv_w"] + p["attn"]["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+    scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(E // H)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores, axis=-1)
+    out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, E)
+    x = x + out @ p["attn"]["proj_w"] + p["attn"]["proj_b"]
+
+    h = _layer_norm(x, p["ln_2"]["g"], p["ln_2"]["b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(h @ p["mlp"]["fc_w"] + p["mlp"]["fc_b"], approximate=True)
+    return x + h @ p["mlp"]["proj_w"] + p["mlp"]["proj_b"]
+
+
+def forward(params: dict, input_ids: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """(B, T) int32 token ids → (B, T, vocab) logits. Jittable."""
+    B, T = input_ids.shape
+    x = params["wte"][input_ids] + params["wpe"][:T]
+
+    def body(x, layer_params):
+        return _block(x, layer_params, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"],
+                    cfg.layer_norm_eps)
+    return x @ params["wte"].T
+
+
+def loss_fn(params, batch, cfg: GPT2Config):
+    """Next-token cross entropy over ``batch`` (B, T+1) ids."""
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def train_step(params, batch, cfg: GPT2Config, lr: float = 1e-3):
+    """One SGD step — the full step jitted over the mesh in dryruns.
+
+    Inputs arrive sharded (params per ``param_specs``, batch over the data
+    axis); GSPMD propagates the shardings and inserts the TP reduces and
+    the DP gradient psum.
+    """
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                          params, grads)
+    return params, loss
+
+
+def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int):
+    """Greedy decode via ``lax.scan`` over a fixed-size buffer (static
+    shapes; no Python loop under jit). Returns (len(prompt)+steps,) ids."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    n0 = prompt_ids.shape[0]
+    total = n0 + steps
+    if total > cfg.n_ctx:
+        raise ValueError(
+            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"n_ctx {cfg.n_ctx}"
+        )
+    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+
+    def step(carry, _):
+        buf, pos = carry
+        logits = forward(params, buf[None, :], cfg)[0]
+        nxt = jnp.argmax(logits[pos - 1]).astype(jnp.int32)
+        buf = buf.at[pos].set(nxt)
+        return (buf, pos + 1), nxt
+
+    (buf, _), _ = jax.lax.scan(step, (buf, jnp.int32(n0)), None, length=steps)
+    return buf

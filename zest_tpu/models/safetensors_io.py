@@ -1,0 +1,195 @@
+"""Minimal safetensors reader/writer — the checkpoint byte format.
+
+The reference never parses model files; it reassembles opaque bytes and lets
+transformers read them (src/xet_bridge.zig:231-264). The TPU build needs the
+format itself, because the north-star path lands tensors *directly* into
+sharded device buffers without a disk round-trip: the header maps tensor
+names to byte ranges, and those ranges compose with reconstruction terms so
+a chunk range can be scattered straight to the tensor slices it feeds.
+
+Self-contained on purpose (no ``safetensors`` dependency): the framework
+must know byte offsets, which the upstream package hides.
+
+Format (https spec, stable): ``[u64le header_len][JSON header][data]`` where
+header maps ``name -> {"dtype", "shape", "data_offsets": [begin, end)}``
+with offsets relative to the end of the header; optional ``__metadata__``.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+import ml_dtypes
+
+# safetensors dtype tag -> numpy dtype (little-endian where sized)
+DTYPES: dict[str, np.dtype] = {
+    "F64": np.dtype("<f8"),
+    "F32": np.dtype("<f4"),
+    "F16": np.dtype("<f2"),
+    "BF16": np.dtype(ml_dtypes.bfloat16),
+    "I64": np.dtype("<i8"),
+    "I32": np.dtype("<i4"),
+    "I16": np.dtype("<i2"),
+    "I8": np.dtype("i1"),
+    "U8": np.dtype("u1"),
+    "BOOL": np.dtype("?"),
+    "U16": np.dtype("<u2"),
+    "U32": np.dtype("<u4"),
+    "U64": np.dtype("<u8"),
+    "F8_E4M3": np.dtype(ml_dtypes.float8_e4m3fn),
+    "F8_E5M2": np.dtype(ml_dtypes.float8_e5m2),
+}
+_TAGS = {v: k for k, v in DTYPES.items()}
+
+_MAX_HEADER = 100 * 1024 * 1024  # upstream parser's sanity cap
+
+
+@dataclass(frozen=True)
+class TensorInfo:
+    name: str
+    dtype: str                 # safetensors tag, e.g. "F32"
+    shape: tuple[int, ...]
+    data_offsets: tuple[int, int]   # relative to data section start
+
+    @property
+    def nbytes(self) -> int:
+        return self.data_offsets[1] - self.data_offsets[0]
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return DTYPES[self.dtype]
+
+    def file_range(self, data_start: int) -> tuple[int, int]:
+        """Absolute byte range of this tensor within the file — the hook
+        that lets reconstruction terms scatter directly into tensors."""
+        return (data_start + self.data_offsets[0],
+                data_start + self.data_offsets[1])
+
+
+@dataclass(frozen=True)
+class SafetensorsHeader:
+    tensors: dict[str, TensorInfo]
+    metadata: dict[str, str]
+    data_start: int            # file offset where the data section begins
+
+    def names(self) -> list[str]:
+        return list(self.tensors)
+
+
+def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
+    if len(buf) < 8:
+        raise ValueError("truncated safetensors: missing header length")
+    (hlen,) = struct.unpack_from("<Q", buf, 0)
+    if hlen > _MAX_HEADER or 8 + hlen > len(buf):
+        raise ValueError(f"safetensors header length {hlen} out of bounds")
+    header = json.loads(bytes(buf[8 : 8 + hlen]).decode("utf-8"))
+    metadata = header.pop("__metadata__", {})
+    tensors: dict[str, TensorInfo] = {}
+    for name, spec in header.items():
+        if spec["dtype"] not in DTYPES:
+            raise ValueError(f"unsupported dtype {spec['dtype']} for {name}")
+        begin, end = spec["data_offsets"]
+        shape = tuple(int(d) for d in spec["shape"])
+        info = TensorInfo(name, spec["dtype"], shape, (int(begin), int(end)))
+        expect = int(np.prod(shape, dtype=np.int64)) * info.np_dtype.itemsize
+        if info.nbytes != expect:
+            raise ValueError(
+                f"{name}: data_offsets span {info.nbytes} bytes, "
+                f"shape/dtype need {expect}"
+            )
+        tensors[name] = info
+    return SafetensorsHeader(tensors, metadata, 8 + hlen)
+
+
+class SafetensorsFile:
+    """mmap-backed lazy reader: header up front, tensor bytes on demand."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._f = open(self.path, "rb")
+        try:
+            self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # zero-length file
+            self._f.close()
+            raise ValueError(f"{path}: empty file is not safetensors")
+        try:
+            self.header = parse_header(memoryview(self._mm))
+        except Exception:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except BufferError:
+            pass  # zero-copy views still alive; the map unmaps on GC
+        self._f.close()
+
+    def __enter__(self) -> "SafetensorsFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def names(self) -> list[str]:
+        return self.header.names()
+
+    def info(self, name: str) -> TensorInfo:
+        return self.header.tensors[name]
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Zero-copy view (mmap-backed) of one tensor."""
+        info = self.header.tensors[name]
+        lo, hi = info.file_range(self.header.data_start)
+        count = (hi - lo) // info.np_dtype.itemsize
+        return np.frombuffer(
+            self._mm, dtype=info.np_dtype, count=count, offset=lo
+        ).reshape(info.shape)
+
+    def items(self):
+        for name in self.header.tensors:
+            yield name, self.tensor(name)
+
+
+def write_safetensors(
+    path: str | Path,
+    tensors: dict[str, np.ndarray],
+    metadata: dict[str, str] | None = None,
+) -> None:
+    """Writer — used by tests and by checkpoint re-export."""
+    header: dict[str, object] = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    offset = 0
+    arrays: list[np.ndarray] = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = np.dtype(arr.dtype)
+        if dt.byteorder == ">":
+            arr = arr.astype(dt.newbyteorder("<"))
+            dt = arr.dtype
+        tag = _TAGS.get(dt) or _TAGS.get(np.dtype(dt.str.lstrip(">=")))
+        if tag is None:
+            raise ValueError(f"{name}: dtype {arr.dtype} not representable")
+        header[name] = {
+            "dtype": tag,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + arr.nbytes],
+        }
+        offset += arr.nbytes
+        arrays.append(arr)
+    blob = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    # Upstream aligns the data section to 8 bytes by padding the JSON.
+    pad = (8 - (8 + len(blob)) % 8) % 8
+    blob += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for arr in arrays:
+            f.write(arr.tobytes())
