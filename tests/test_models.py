@@ -7,6 +7,8 @@ the same logits from torch/transformers' GPT2 and from our pure-JAX
 forward.
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -73,6 +75,30 @@ def test_safetensors_rejects_bad_header():
     huge = (10**12).to_bytes(8, "little") + b"{}"
     with pytest.raises(ValueError):
         parse_header(huge)
+
+
+def test_safetensors_rejects_overlapping_and_oob_offsets():
+    import struct
+
+    def hdr(doc, data: bytes) -> bytes:
+        raw = json.dumps(doc).encode()
+        return struct.pack("<Q", len(raw)) + raw + data
+
+    # overlapping ranges: two tensors aliasing the same bytes
+    with pytest.raises(ValueError, match="overlap"):
+        parse_header(hdr({
+            "a": {"dtype": "F32", "shape": [2], "data_offsets": [0, 8]},
+            "b": {"dtype": "F32", "shape": [2], "data_offsets": [4, 12]},
+        }, b"\x00" * 12))
+    # out of bounds / reversed
+    with pytest.raises(ValueError, match="out of bounds"):
+        parse_header(hdr({
+            "a": {"dtype": "U8", "shape": [4], "data_offsets": [0, 4]},
+        }, b"\x00" * 2))
+    with pytest.raises(ValueError, match="out of bounds"):
+        parse_header(hdr({
+            "a": {"dtype": "U8", "shape": [0], "data_offsets": [4, 0]},
+        }, b"\x00" * 8))
 
 
 def test_safetensors_rejects_offset_shape_mismatch(tmp_path):

@@ -1,0 +1,206 @@
+"""Tracker client + HTTP control plane + CLI surface.
+
+Tracker tests follow the reference's built-then-parsed style
+(bt_tracker.zig:208-242) plus a live loopback announce against a canned
+HTTP server. API tests drive the real ThreadingHTTPServer over loopback —
+including the SSE ``/v1/pull`` the reference never implemented.
+"""
+
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import requests
+
+from zest_tpu.p2p import bencode
+from zest_tpu.p2p.tracker import (
+    AnnounceResponse,
+    Event,
+    TrackerClient,
+    TrackerError,
+    build_announce_url,
+    parse_announce_response,
+)
+
+
+# ── Tracker ──
+
+
+def _compact(peers):
+    return b"".join(
+        socket.inet_aton(ip) + struct.pack(">H", port) for ip, port in peers
+    )
+
+
+def test_parse_announce_response_roundtrip():
+    body = bencode.encode({
+        b"interval": 900,
+        b"peers": _compact([("10.0.0.1", 6881), ("10.0.0.2", 6882)]),
+    })
+    resp = parse_announce_response(body)
+    assert resp == AnnounceResponse(
+        900, [("10.0.0.1", 6881), ("10.0.0.2", 6882)]
+    )
+
+
+def test_parse_announce_failure_reason():
+    body = bencode.encode({b"failure reason": b"unregistered torrent"})
+    with pytest.raises(TrackerError, match="unregistered"):
+        parse_announce_response(body)
+
+
+def test_parse_announce_rejects_misaligned_peers():
+    body = bencode.encode({b"interval": 1, b"peers": b"x" * 7})
+    with pytest.raises(TrackerError, match="6-aligned"):
+        parse_announce_response(body)
+
+
+def test_build_announce_url_percent_encodes_binary():
+    url = build_announce_url(
+        "http://t.example/announce", bytes(range(20)),
+        b"-ZE0200-abcdefghijkl", 6881, event=Event.STARTED,
+    )
+    assert "info_hash=%00%01%02" in url
+    assert "event=started" in url and "compact=1" in url
+    # '?' already present → '&' separator
+    url2 = build_announce_url("http://t.example/a?k=1", b"\xff" * 20,
+                              b"p" * 20, 1)
+    assert "?k=1&info_hash=%FF" in url2
+
+
+@pytest.fixture
+def fake_tracker():
+    """Canned tracker that records request paths."""
+    seen = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen.append(self.path)
+            body = bencode.encode({
+                b"interval": 60,
+                b"peers": _compact([("127.0.0.1", 7777)]),
+            })
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/announce", seen
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_tracker_client_live_announce(fake_tracker):
+    url, seen = fake_tracker
+    client = TrackerClient(url, b"-ZE0200-abcdefghijkl")
+    resp = client.announce_event(b"\xab" * 20, 6881, Event.STARTED)
+    assert resp.peers == [("127.0.0.1", 7777)]
+    assert client.last_interval == 60
+    assert "info_hash=%AB" in seen[0]
+    # PeerSource protocol surface
+    assert client.find_peers(b"\xab" * 20) == [("127.0.0.1", 7777)]
+    client.announce(b"\xab" * 20, 6881)
+    assert len(seen) == 3
+
+
+def test_tracker_client_swallows_network_errors():
+    client = TrackerClient("http://127.0.0.1:1/announce", b"p" * 20,
+                           timeout=0.2)
+    assert client.find_peers(b"\x01" * 20) == []
+    client.announce(b"\x01" * 20, 1)  # must not raise
+
+
+# ── HTTP control plane ──
+
+
+@pytest.fixture
+def api(tmp_config):
+    from zest_tpu.api.http_api import HttpApi
+
+    tmp_config.http_port = 0
+    a = HttpApi(tmp_config)
+    port = a.start()
+    yield a, f"http://127.0.0.1:{port}"
+    a.close()
+
+
+def test_health_status_models_routes(api, tmp_config):
+    a, base = api
+    assert requests.get(f"{base}/v1/health", timeout=5).json() == {
+        "status": "ok"
+    }
+    status = requests.get(f"{base}/v1/status", timeout=5).json()
+    assert status["bt_peers"] == 0 and status["xorbs_cached"] == 0
+    assert status["http_requests"] >= 1
+
+    # Seed a fake cached model and see it in /v1/models.
+    snap = (tmp_config.hf_home / "hub" / "models--org--name" /
+            "snapshots" / "abc123")
+    snap.mkdir(parents=True)
+    (snap / "config.json").write_text("{}")
+    models = requests.get(f"{base}/v1/models", timeout=5).json()
+    assert models["models"] == [
+        {"repo_id": "org/name", "revision": "abc123", "files": 1}
+    ]
+
+    assert requests.get(f"{base}/nope", timeout=5).status_code == 404
+    assert "zest-tpu" in requests.get(f"{base}/", timeout=5).text
+
+
+def test_stop_route_triggers_shutdown(api):
+    a, base = api
+    assert not a.shutdown_event.is_set()
+    requests.post(f"{base}/v1/stop", timeout=5)
+    assert a.shutdown_event.wait(timeout=2)
+
+
+def test_pull_route_streams_sse_errors(api, monkeypatch):
+    """A bad repo id must stream start → error, not 500 or hang."""
+    a, base = api
+    r = requests.post(f"{base}/v1/pull", json={"repo_id": "nosuch/repo"},
+                      stream=True, timeout=30)
+    assert r.status_code == 200
+    events = []
+    for line in r.iter_lines():
+        if line.startswith(b"data: "):
+            events.append(json.loads(line[6:]))
+    assert events[0]["event"] == "start"
+    assert events[-1]["event"] == "error"
+
+
+def test_pull_route_rejects_bad_body(api):
+    _a, base = api
+    r = requests.post(f"{base}/v1/pull", data=b"not json", timeout=5)
+    assert r.status_code == 400
+
+
+# ── CLI ──
+
+
+def test_cli_version_and_help(capsys):
+    from zest_tpu.cli import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "zest-tpu" in out
+    assert main([]) == 0
+    assert "pull" in capsys.readouterr().out
+
+
+def test_cli_bench_host_only(capsys):
+    from zest_tpu.cli import main
+
+    assert main(["bench", "--no-device", "--json"]) == 0
+    results = json.loads(capsys.readouterr().out)
+    names = {r["name"] for r in results}
+    assert {"bencode_encode", "bencode_decode", "blake3_64kb",
+            "sha1_info_hash", "bt_wire_frame"} <= names
+    assert all(r["mb_per_s"] > 0 for r in results)

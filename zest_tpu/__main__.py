@@ -1,0 +1,5 @@
+"""``python -m zest_tpu`` — the CLI shim (reference: python/zest/cli.py)."""
+
+from zest_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,289 @@
+"""Localhost REST control plane + dashboard.
+
+The reference's http_api.zig: loopback-bound HTTP server routing
+``GET /v1/health``, ``GET /v1/status``, ``POST /v1/pull``, ``POST /v1/stop``,
+``GET /v1/models`` and an embedded single-page dashboard polling status every
+2 s (src/http_api.zig:96-114, 235-351). Differences by design:
+
+- ``POST /v1/pull`` is implemented for real (the reference shipped a stub,
+  src/http_api.zig:138-142): it streams SSE progress events while the pull
+  runs, per DESIGN.md's intended contract.
+- ``/v1/status`` additionally reports pod-level fields (HBM staging
+  occupancy, mesh axes) — the TPU build's control plane surfaces the
+  device tier too (SURVEY.md §2.1 row 16).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from zest_tpu import storage
+from zest_tpu.config import Config
+from zest_tpu.version import __version__
+
+
+class HttpApi:
+    """Control-plane server. ``run()`` blocks until ``/v1/stop``."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        bt_server=None,
+        registry=None,
+        hbm_cache=None,
+        swarm=None,
+    ):
+        self.cfg = cfg
+        self.bt_server = bt_server
+        self.registry = registry
+        self.hbm_cache = hbm_cache
+        self.swarm = swarm
+        self.http_requests = 0
+        self.shutdown_event = threading.Event()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._lock = threading.Lock()
+
+    # ── Lifecycle ──
+
+    def start(self) -> int:
+        """Bind loopback (reference binds 127.0.0.1 only, http_api.zig:49)
+        and serve in a background thread; returns the bound port."""
+        api = self
+
+        class Handler(_Handler):
+            pass
+
+        Handler.api = api
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.cfg.http_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def run(self) -> None:
+        """Blocking serve-until-stopped (reference main.zig:458-467)."""
+        self.start()
+        self.shutdown_event.wait()
+        self.close()
+
+    def trigger_shutdown(self) -> None:
+        self.shutdown_event.set()
+        if self.bt_server is not None:
+            self.bt_server.shutdown()
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def port(self) -> int:
+        return (
+            self._httpd.server_address[1]
+            if self._httpd
+            else self.cfg.http_port
+        )
+
+    # ── Payloads ──
+
+    def status_payload(self) -> dict:
+        bt = self.bt_server.get_stats() if self.bt_server else None
+        payload = {
+            "version": __version__,
+            "bt_peers": bt.active_peers if bt else 0,
+            "chunks_served": bt.chunks_served if bt else 0,
+            "xorbs_cached": len(self.registry) if self.registry is not None
+            else len(storage.list_cached_xorbs(self.cfg)),
+            "http_requests": self.http_requests,
+            "listen_port": self.cfg.listen_port,
+            "http_port": self.port,
+        }
+        if self.hbm_cache is not None:
+            payload["hbm"] = self.hbm_cache.summary()
+        if self.cfg.mesh.mesh_axes:
+            payload["mesh_axes"] = self.cfg.mesh.mesh_axes
+        if self.swarm is not None:
+            payload["swarm"] = self.swarm.stats.summary()
+        return payload
+
+    def models_payload(self) -> dict:
+        """Scan the HF hub cache for models--*/ dirs (http_api.zig:152-210)."""
+        models = []
+        hub = self.cfg.hf_home / "hub"
+        if hub.is_dir():
+            for d in sorted(hub.iterdir()):
+                if not d.name.startswith("models--") or not d.is_dir():
+                    continue
+                repo_id = d.name[len("models--"):].replace("--", "/", 1)
+                snapshots = d / "snapshots"
+                n_files = 0
+                revision = None
+                if snapshots.is_dir():
+                    revs = sorted(
+                        snapshots.iterdir(),
+                        key=lambda p: p.stat().st_mtime,
+                    )
+                    if revs:
+                        revision = revs[-1].name
+                        n_files = sum(
+                            1 for f in revs[-1].rglob("*") if f.is_file()
+                        )
+                models.append({
+                    "repo_id": repo_id,
+                    "revision": revision,
+                    "files": n_files,
+                })
+        return {"models": models}
+
+    def pull_events(self, repo_id: str, revision: str, device: str | None):
+        """Generator of SSE progress events for one pull."""
+        from zest_tpu.transfer.pull import pull_model
+
+        done = threading.Event()
+        events: list[dict] = []
+        cond = threading.Condition()
+
+        def log(*args, **_kw):
+            with cond:
+                events.append({"event": "log",
+                               "message": " ".join(str(a) for a in args)})
+                cond.notify()
+
+        result: dict = {}
+
+        def work():
+            try:
+                res = pull_model(self.cfg, repo_id, revision=revision,
+                                 device=device, swarm=self.swarm, log=log)
+                result["ok"] = {"snapshot_dir": str(res.snapshot_dir),
+                                "stats": res.stats}
+            except Exception as exc:  # noqa: BLE001 - reported to client
+                result["error"] = str(exc)
+            finally:
+                done.set()
+                with cond:
+                    cond.notify()
+
+        threading.Thread(target=work, daemon=True).start()
+        yield {"event": "start", "repo_id": repo_id, "revision": revision}
+        sent = 0
+        while True:
+            with cond:
+                cond.wait(timeout=1.0)
+                new = events[sent:]
+                sent = len(events)
+            yield from new
+            if done.is_set():
+                with cond:
+                    yield from events[sent:]
+                break
+        if "ok" in result:
+            yield {"event": "done", **result["ok"]}
+        else:
+            yield {"event": "error", "message": result.get("error", "?")}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: HttpApi
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet; reference logs nothing per-request
+        pass
+
+    def _json(self, payload: dict, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        self.api.http_requests += 1
+        if self.path == "/v1/health":
+            self._json({"status": "ok"})
+        elif self.path == "/v1/status":
+            self._json(self.api.status_payload())
+        elif self.path == "/v1/models":
+            self._json(self.api.models_payload())
+        elif self.path == "/":
+            body = DASHBOARD_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self) -> None:  # noqa: N802
+        self.api.http_requests += 1
+        if self.path == "/v1/stop":
+            self._json({"status": "stopping"})
+            self.api.trigger_shutdown()
+        elif self.path == "/v1/pull":
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                repo_id = req["repo_id"]
+            except (json.JSONDecodeError, KeyError):
+                self._json({"error": "body must be JSON with repo_id"}, 400)
+                return
+            revision = req.get("revision", "main")
+            device = req.get("device")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for ev in self.api.pull_events(repo_id, revision, device):
+                    data = f"data: {json.dumps(ev)}\n\n".encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-pull; the pull thread finishes
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>zest-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#101418;color:#e6e6e6}
+ h1{font-size:1.3rem} .k{color:#8ab4f8} table{border-collapse:collapse}
+ td,th{padding:.3rem .8rem;border-bottom:1px solid #333;text-align:left}
+ .card{background:#1a2027;border-radius:8px;padding:1rem 1.4rem;margin:1rem 0;
+       max-width:42rem}
+ code{color:#7ee787}
+</style></head><body>
+<h1>zest-tpu <span id="ver" class="k"></span></h1>
+<div class="card"><table id="status"></table></div>
+<div class="card"><h2 style="font-size:1.05rem">Cached models</h2>
+<table id="models"><thead><tr><th>repo</th><th>revision</th><th>files</th>
+</tr></thead><tbody></tbody></table></div>
+<script>
+async function tick(){
+ try{
+  const s=await (await fetch('/v1/status')).json();
+  document.getElementById('ver').textContent='v'+s.version;
+  const rows=Object.entries(s).filter(([k])=>k!=='version')
+   .map(([k,v])=>`<tr><td class="k">${k}</td><td><code>${
+     typeof v==='object'?JSON.stringify(v):v}</code></td></tr>`).join('');
+  document.getElementById('status').innerHTML=rows;
+  const m=await (await fetch('/v1/models')).json();
+  document.querySelector('#models tbody').innerHTML=m.models.map(x=>
+   `<tr><td>${x.repo_id}</td><td><code>${(x.revision||'').slice(0,12)}</code>
+    </td><td>${x.files}</td></tr>`).join('');
+ }catch(e){}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""

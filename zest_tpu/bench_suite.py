@@ -1,0 +1,169 @@
+"""Synthetic benchmark harness (reference: src/bench.zig).
+
+Same shape as the reference's ``zest bench --synthetic [--json]``: per-bench
+iteration loops over a monotonic clock, median-of-runs reporting, text or
+JSON output consumed by CI (bench.zig:150-165, 273-287). The suite covers
+the reference's benches (bencode encode/decode, BLAKE3 64 KiB, SHA-1
+info-hash, wire framing) plus the TPU-native stages: on-device BLAKE3 and
+the pod-axis ICI all-gather (GB/s) that replaces the TCP wire.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from dataclasses import dataclass
+
+CHUNK_64K = 64 * 1024
+
+
+@dataclass
+class BenchResult:
+    name: str
+    iters: int
+    median_ns: float
+    bytes_per_iter: int
+
+    @property
+    def mb_per_s(self) -> float:
+        if self.median_ns <= 0:
+            return float("inf")
+        return self.bytes_per_iter / (self.median_ns / 1e9) / 1e6
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "iters": self.iters,
+            "median_ns": round(self.median_ns, 1),
+            "mb_per_s": round(self.mb_per_s, 1),
+        }
+
+
+def _time_fn(name: str, fn, bytes_per_iter: int, iters: int,
+             repeats: int = 5) -> BenchResult:
+    fn()  # warm (compile caches, branch predictors, JIT)
+    medians = []
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            fn()
+        medians.append((time.perf_counter_ns() - t0) / iters)
+    return BenchResult(name, iters, statistics.median(medians), bytes_per_iter)
+
+
+# ── Host benches (reference parity, bench.zig:167-255) ──
+
+
+def bench_bencode(iters: int = 2000) -> list[BenchResult]:
+    from zest_tpu.p2p import bencode
+
+    doc = {
+        b"m": {b"ut_xet": 3},
+        b"p": 6881,
+        b"v": b"zest-tpu/" + b"0.1.0",
+        b"payload": b"x" * 512,
+    }
+    encoded = bencode.encode(doc)
+    return [
+        _time_fn("bencode_encode", lambda: bencode.encode(doc),
+                 len(encoded), iters),
+        _time_fn("bencode_decode", lambda: bencode.decode(encoded),
+                 len(encoded), iters),
+    ]
+
+
+def bench_blake3_host(iters: int = 200) -> BenchResult:
+    from zest_tpu.cas import hashing
+
+    data = bytes(range(256)) * (CHUNK_64K // 256)
+    return _time_fn("blake3_64kb", lambda: hashing.blake3_hash(data),
+                    CHUNK_64K, iters)
+
+
+def bench_sha1_info_hash(iters: int = 5000) -> BenchResult:
+    from zest_tpu.p2p import peer_id
+
+    xorb = bytes(32)
+    return _time_fn("sha1_info_hash",
+                    lambda: peer_id.compute_info_hash(xorb), 32 + 12, iters)
+
+
+def bench_wire_frame(iters: int = 5000) -> BenchResult:
+    from zest_tpu.p2p import wire
+
+    payload = b"y" * 1024
+    def roundtrip():
+        framed = wire.encode_message(wire.MessageId.EXTENDED, payload)
+        wire.decode_message_header(framed[:4])
+    return _time_fn("bt_wire_frame", roundtrip, 1024 + 5, iters)
+
+
+# ── Device benches (TPU-native; no reference counterpart) ──
+
+
+def bench_blake3_device(batch: int = 256, iters: int = 8) -> BenchResult:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from zest_tpu.ops.blake3 import DeviceHasher
+
+    rng = np.random.default_rng(0)
+    host = rng.integers(0, 256, size=(batch, CHUNK_64K), dtype=np.uint8)
+    words = jnp.asarray(host.view("<u4"))
+    lengths = jnp.full((batch,), CHUNK_64K, jnp.int32)
+    hasher = DeviceHasher()
+    hasher.hash_device(words, lengths).block_until_ready()
+
+    def window():
+        outs = [hasher.hash_device(words, lengths) for _ in range(iters)]
+        jax.block_until_ready(outs)
+
+    medians = []
+    for _ in range(3):
+        t0 = time.perf_counter_ns()
+        window()
+        medians.append((time.perf_counter_ns() - t0) / iters)
+    return BenchResult("blake3_64kb_device", iters,
+                       statistics.median(medians), batch * CHUNK_64K)
+
+
+def bench_ici_all_gather(mbytes_per_device: int = 16) -> BenchResult:
+    import jax
+
+    from zest_tpu.parallel.collectives import all_gather_throughput
+    from zest_tpu.parallel.mesh import pod_mesh
+
+    mesh = pod_mesh()
+    n = len(jax.devices())
+    gbps = all_gather_throughput(mesh, mbytes_per_device=mbytes_per_device)
+    moved = mbytes_per_device * 1024 * 1024 * n * max(n - 1, 1)
+    # Express as one "iteration" moving `moved` bytes at the measured rate.
+    ns = moved / (gbps * 1e9) * 1e9 if gbps > 0 else 0.0
+    return BenchResult("ici_all_gather", 1, ns, moved)
+
+
+def run_synthetic(device: bool = True) -> list[BenchResult]:
+    results = bench_bencode()
+    results += [bench_blake3_host(), bench_sha1_info_hash(),
+                bench_wire_frame()]
+    if device:
+        try:
+            results.append(bench_blake3_device())
+            results.append(bench_ici_all_gather())
+        except Exception:  # no usable accelerator: host suite still valid
+            pass
+    return results
+
+
+def format_results(results: list[BenchResult], as_json: bool) -> str:
+    if as_json:
+        return json.dumps([r.as_dict() for r in results], indent=2)
+    lines = [f"{'bench':24} {'iters':>7} {'median':>14} {'MB/s':>12}"]
+    for r in results:
+        lines.append(
+            f"{r.name:24} {r.iters:>7} {r.median_ns:>12.0f}ns "
+            f"{r.mb_per_s:>12.1f}"
+        )
+    return "\n".join(lines)

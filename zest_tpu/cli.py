@@ -1,0 +1,329 @@
+"""CLI entry: ``zest <command>`` (reference: src/main.zig:33-81).
+
+Commands: pull | seed | serve | start | stop | bench | version | help —
+the reference's full surface, plus ``--device=tpu`` on pull (the north-star
+flag) and ``models`` for cache introspection. Daemon lifecycle uses a PID
+file under the cache dir exactly like cmdServe/cmdStop
+(src/main.zig:436,550-590,592-636).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from zest_tpu.config import Config
+from zest_tpu.version import __version__
+
+
+def _pid_file(cfg: Config) -> Path:
+    return cfg.cache_dir / "zest.pid"
+
+
+def _write_pid_file(cfg: Config) -> None:
+    cfg.cache_dir.mkdir(parents=True, exist_ok=True)
+    _pid_file(cfg).write_text(str(os.getpid()))
+
+
+def _remove_pid_file(cfg: Config) -> None:
+    try:
+        _pid_file(cfg).unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _server_running(cfg: Config) -> bool:
+    """Health-check the daemon (reference isServerRunning, main.zig:532-548)."""
+    import requests
+
+    try:
+        r = requests.get(
+            f"http://127.0.0.1:{cfg.http_port}/v1/health", timeout=1
+        )
+        return r.status_code == 200
+    except requests.RequestException:
+        return False
+
+
+def auto_start_server(cfg: Config) -> bool:
+    """Detached ``serve`` spawn after a pull so the node seeds what it just
+    cached — "the package IS the seeder" (reference main.zig:485-508)."""
+    if _server_running(cfg):
+        return False
+    subprocess.Popen(
+        [sys.executable, "-m", "zest_tpu", "serve",
+         "--http-port", str(cfg.http_port),
+         "--listen-port", str(cfg.listen_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    return True
+
+
+def _build_swarm(cfg: Config, tracker: str | None = None, dht: bool = True):
+    """SwarmDownloader with the configured discovery sources: DHT (UDP on
+    the listen port, reference swarm.zig:221) and/or an HTTP tracker."""
+    from zest_tpu.p2p import peer_id as peer_id_mod
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    sources = []
+    if dht:
+        try:
+            from zest_tpu.p2p.dht import Dht
+
+            sources.append(Dht(bind=("0.0.0.0", cfg.listen_port)))
+        except OSError:  # port busy (daemon already owns it): client-only
+            try:
+                from zest_tpu.p2p.dht import Dht
+
+                sources.append(Dht(bind=("0.0.0.0", 0)))
+            except OSError:
+                pass
+    if tracker:
+        from zest_tpu.p2p.tracker import TrackerClient
+
+        sources.append(TrackerClient(tracker, peer_id_mod.generate(),
+                                     listen_port=cfg.listen_port))
+    return SwarmDownloader(cfg, peer_sources=sources)
+
+
+# ── Commands ──
+
+
+def cmd_pull(args) -> int:
+    cfg = Config.load()
+    if args.http_port:
+        cfg.http_port = args.http_port
+    swarm = None
+    if not args.no_p2p:
+        try:
+            swarm = _build_swarm(cfg, tracker=args.tracker,
+                                 dht=not args.no_dht)
+            for spec in args.peer or []:
+                host, _, port = spec.rpartition(":")
+                swarm.add_direct_peer(host, int(port))
+        except Exception as exc:  # noqa: BLE001 - degrade to CDN-only
+            print(f"p2p unavailable ({exc}); continuing CDN-only",
+                  file=sys.stderr)
+    from zest_tpu.transfer.pull import pull_model
+
+    res = pull_model(cfg, args.repo, revision=args.revision,
+                     device=args.device, swarm=swarm, no_p2p=args.no_p2p)
+    print(f"✓ {args.repo} -> {res.snapshot_dir}")
+    _print_pull_stats(res.stats)
+    if not args.no_seed:
+        if auto_start_server(cfg):
+            print("seeding daemon started in the background")
+    return 0
+
+
+def _print_pull_stats(stats: dict) -> None:
+    fetch = stats.get("fetch") or {}
+    if fetch:
+        nbytes = fetch.get("bytes", {})
+        print(f"  From cache: {nbytes.get('cache', 0)} bytes")
+        print(f"  From peers: {nbytes.get('peer', 0)} bytes")
+        print(f"  From CDN:   {nbytes.get('cdn', 0)} bytes")
+        print(f"  P2P ratio:  {fetch.get('p2p_ratio', 0.0):.1%}")
+    print(f"  Elapsed:    {stats.get('elapsed_s', 0)}s")
+    if "hbm" in stats:
+        h = stats["hbm"]
+        print(f"  HBM commit: {h['tensors']} tensors, {h['bytes']} bytes "
+              f"({h['gbps']} GB/s)")
+
+
+def cmd_seed(args) -> int:
+    """Announce every cached xorb to the swarm (reference main.zig:307-369)."""
+    cfg = Config.load()
+    from zest_tpu import storage
+
+    hashes = storage.list_cached_xorbs(cfg)
+    if not hashes:
+        print("nothing cached to seed")
+        return 0
+    swarm = _build_swarm(cfg, tracker=args.tracker)
+    n = swarm.announce_xorbs(hashes)
+    print(f"announced {n}/{len(hashes)} xorbs to the swarm")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Foreground seeding server + REST API (reference main.zig:403-469)."""
+    cfg = Config.load()
+    if args.http_port:
+        cfg.http_port = args.http_port
+    if args.listen_port:
+        cfg.listen_port = args.listen_port
+
+    from zest_tpu import storage
+    from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.transfer.server import BtServer
+
+    registry = storage.XorbRegistry()
+    n = registry.scan(cfg)
+    print(f"indexed {n} cached xorbs")
+
+    bt = BtServer(cfg)
+    port = bt.start()
+    print(f"seeding on :{port}")
+
+    _write_pid_file(cfg)
+    api = HttpApi(cfg, bt_server=bt, registry=registry)
+    api.start()
+    print(f"dashboard: http://127.0.0.1:{api.port}/")
+
+    def on_signal(_sig, _frm):
+        api.trigger_shutdown()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        api.shutdown_event.wait()
+    finally:
+        api.close()
+        bt.shutdown()
+        _remove_pid_file(cfg)
+    return 0
+
+
+def cmd_start(_args) -> int:
+    cfg = Config.load()
+    if _server_running(cfg):
+        print("already running")
+        return 0
+    auto_start_server(cfg)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if _server_running(cfg):
+            print(f"started (http :{cfg.http_port})")
+            return 0
+        time.sleep(0.1)
+    print("daemon failed to become healthy", file=sys.stderr)
+    return 1
+
+
+def cmd_stop(_args) -> int:
+    """REST stop with PID-file kill fallback (reference main.zig:550-590)."""
+    cfg = Config.load()
+    import requests
+
+    try:
+        requests.post(
+            f"http://127.0.0.1:{cfg.http_port}/v1/stop", timeout=5
+        )
+        print("stopped")
+        return 0
+    except requests.RequestException:
+        pass
+    pid_file = _pid_file(cfg)
+    if pid_file.exists():
+        try:
+            pid = int(pid_file.read_text().strip())
+            os.kill(pid, signal.SIGTERM)
+            print(f"sent SIGTERM to pid {pid}")
+        except (ValueError, ProcessLookupError):
+            print("stale pid file removed")
+        _remove_pid_file(cfg)
+        return 0
+    print("not running")
+    return 0
+
+
+def cmd_status(_args) -> int:
+    cfg = Config.load()
+    import requests
+
+    try:
+        r = requests.get(
+            f"http://127.0.0.1:{cfg.http_port}/v1/status", timeout=2
+        )
+        print(json.dumps(r.json(), indent=2))
+        return 0
+    except requests.RequestException:
+        print("daemon not running")
+        return 1
+
+
+def cmd_bench(args) -> int:
+    from zest_tpu import bench_suite
+
+    results = bench_suite.run_synthetic(device=not args.no_device)
+    print(bench_suite.format_results(results, as_json=args.json))
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(f"zest-tpu {__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="zest",
+        description="TPU-native P2P model distribution",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    pull = sub.add_parser("pull", help="download a model through the swarm")
+    pull.add_argument("repo")
+    pull.add_argument("--revision", default="main")
+    pull.add_argument("--device", choices=["tpu"], default=None)
+    pull.add_argument("--peer", action="append",
+                      help="direct peer host:port (repeatable)")
+    pull.add_argument("--tracker", default=None, help="tracker announce URL")
+    pull.add_argument("--no-p2p", action="store_true")
+    pull.add_argument("--no-dht", action="store_true",
+                      help="skip DHT discovery (direct peers/tracker only)")
+    pull.add_argument("--no-seed", action="store_true",
+                      help="don't auto-start the seeding daemon after pull")
+    pull.add_argument("--http-port", type=int, default=None)
+    pull.set_defaults(fn=cmd_pull)
+
+    seed = sub.add_parser("seed", help="announce cached xorbs to the swarm")
+    seed.add_argument("--tracker", default=None)
+    seed.set_defaults(fn=cmd_seed)
+
+    serve = sub.add_parser("serve", help="run the seeding server (foreground)")
+    serve.add_argument("--http-port", type=int, default=None)
+    serve.add_argument("--listen-port", type=int, default=None)
+    serve.set_defaults(fn=cmd_serve)
+
+    sub.add_parser("start", help="start the daemon in the background") \
+        .set_defaults(fn=cmd_start)
+    sub.add_parser("stop", help="stop the daemon").set_defaults(fn=cmd_stop)
+    sub.add_parser("status", help="print daemon status") \
+        .set_defaults(fn=cmd_status)
+
+    bench = sub.add_parser("bench", help="run the synthetic benchmark suite")
+    bench.add_argument("--json", action="store_true")
+    bench.add_argument("--no-device", action="store_true",
+                       help="host-only benches (skip TPU)")
+    bench.add_argument("--synthetic", action="store_true",
+                       help="accepted for reference CLI parity (default)")
+    bench.set_defaults(fn=cmd_bench)
+
+    sub.add_parser("version", help="print version") \
+        .set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 0
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -90,13 +90,19 @@ def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
         raise ValueError(f"safetensors header length {hlen} out of bounds")
     header = json.loads(bytes(buf[8 : 8 + hlen]).decode("utf-8"))
     metadata = header.pop("__metadata__", {})
+    data_len = len(buf) - (8 + hlen)
     tensors: dict[str, TensorInfo] = {}
     for name, spec in header.items():
         if spec["dtype"] not in DTYPES:
             raise ValueError(f"unsupported dtype {spec['dtype']} for {name}")
-        begin, end = spec["data_offsets"]
+        begin, end = (int(v) for v in spec["data_offsets"])
+        if begin < 0 or end < begin or end > data_len:
+            raise ValueError(
+                f"{name}: data_offsets [{begin}, {end}) out of bounds "
+                f"for {data_len}-byte data section"
+            )
         shape = tuple(int(d) for d in spec["shape"])
-        info = TensorInfo(name, spec["dtype"], shape, (int(begin), int(end)))
+        info = TensorInfo(name, spec["dtype"], shape, (begin, end))
         expect = int(np.prod(shape, dtype=np.int64)) * info.np_dtype.itemsize
         if info.nbytes != expect:
             raise ValueError(
@@ -104,6 +110,16 @@ def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
                 f"shape/dtype need {expect}"
             )
         tensors[name] = info
+    # Ranges must not overlap — aliased tensors would silently share bytes,
+    # defeating byte-level integrity (upstream enforces the same).
+    spans = sorted(
+        (i.data_offsets for i in tensors.values() if i.nbytes),
+    )
+    for (b0, e0), (b1, _e1) in zip(spans, spans[1:]):
+        if b1 < e0:
+            raise ValueError(
+                f"overlapping tensor data ranges [{b0},{e0}) and [{b1},…)"
+            )
     return SafetensorsHeader(tensors, metadata, 8 + hlen)
 
 

@@ -92,7 +92,12 @@ def pull_model(
     if device == "tpu":
         from zest_tpu.models.loader import stage_snapshot_to_hbm
 
-        stats["hbm"] = stage_snapshot_to_hbm(cfg, snapshot_dir)
+        mesh = None
+        if cfg.mesh.mesh_axes:
+            from zest_tpu.parallel.mesh import mesh_from_config
+
+            mesh = mesh_from_config(cfg.mesh)
+        stats["hbm"] = stage_snapshot_to_hbm(cfg, snapshot_dir, mesh=mesh)
 
     return PullResult(snapshot_dir, stats)
 
