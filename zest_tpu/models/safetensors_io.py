@@ -82,24 +82,33 @@ class SafetensorsHeader:
         return list(self.tensors)
 
 
-def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
+def _parse_tensors(
+    buf: bytes | memoryview, bounded: bool
+) -> SafetensorsHeader:
+    """Shared header parse; ``bounded=False`` skips the data-section end
+    bound (prefix mode — everything else, including overlap and shape/size
+    consistency, is validated in both modes)."""
     if len(buf) < 8:
         raise ValueError("truncated safetensors: missing header length")
     (hlen,) = struct.unpack_from("<Q", buf, 0)
     if hlen > _MAX_HEADER or 8 + hlen > len(buf):
-        raise ValueError(f"safetensors header length {hlen} out of bounds")
+        raise ValueError(
+            f"safetensors header length {hlen} out of bounds for "
+            f"{len(buf)}-byte buffer"
+        )
+    data_len = len(buf) - 8 - hlen if bounded else None
     header = json.loads(bytes(buf[8 : 8 + hlen]).decode("utf-8"))
     metadata = header.pop("__metadata__", {})
-    data_len = len(buf) - (8 + hlen)
     tensors: dict[str, TensorInfo] = {}
     for name, spec in header.items():
         if spec["dtype"] not in DTYPES:
             raise ValueError(f"unsupported dtype {spec['dtype']} for {name}")
         begin, end = (int(v) for v in spec["data_offsets"])
-        if begin < 0 or end < begin or end > data_len:
+        if begin < 0 or end < begin or (
+            data_len is not None and end > data_len
+        ):
             raise ValueError(
-                f"{name}: data_offsets [{begin}, {end}) out of bounds "
-                f"for {data_len}-byte data section"
+                f"{name}: data_offsets [{begin}, {end}) out of bounds"
             )
         shape = tuple(int(d) for d in spec["shape"])
         info = TensorInfo(name, spec["dtype"], shape, (begin, end))
@@ -121,6 +130,23 @@ def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
                 f"overlapping tensor data ranges [{b0},{e0}) and [{b1},…)"
             )
     return SafetensorsHeader(tensors, metadata, 8 + hlen)
+
+
+def parse_header(buf: bytes | memoryview) -> SafetensorsHeader:
+    return _parse_tensors(buf, bounded=True)
+
+
+def parse_header_prefix(buf: bytes | memoryview) -> SafetensorsHeader:
+    """Parse a header from the *head bytes only* (data section absent).
+
+    The expert-routing planner (zest_tpu.parallel.expert) must know tensor
+    byte ranges before any data bytes are fetched — it pulls just the file
+    head, reads the name→range map, and routes the rest of the file's
+    chunks to the hosts that need them. Same validation as
+    ``parse_header`` minus the data-section end bound (the data length is
+    unknown here; that check reruns on reassembly).
+    """
+    return _parse_tensors(buf, bounded=False)
 
 
 class SafetensorsFile:
