@@ -20,7 +20,18 @@ from zest_tpu.parallel.coordinator import (  # noqa: F401
     CoordinatorRegistry,
     InMemoryRegistry,
 )
+from zest_tpu.parallel.expert import (  # noqa: F401
+    ExpertPlacement,
+    ExpertRoutedPlan,
+    classify_file,
+)
 from zest_tpu.parallel.hbm import HbmStagingCache, TieredCache  # noqa: F401
+from zest_tpu.parallel.hierarchy import (  # noqa: F401
+    HierarchicalDistributor,
+    HierarchicalPlan,
+    hier_mesh,
+    owner_pod_host,
+)
 from zest_tpu.parallel.mesh import (  # noqa: F401
     POD_AXIS,
     mesh_from_config,

@@ -59,9 +59,6 @@ class ExpertPlacement:
     def host_of_expert(self, expert: int) -> int:
         if not 0 <= expert < self.n_experts:
             raise ValueError(f"expert {expert} out of range")
-        if self.num_hosts >= self.n_experts:
-            # more hosts than experts: each expert's block leader owns it
-            return expert * (self.num_hosts // self.n_experts)
         return expert * self.num_hosts // self.n_experts
 
     def experts_of_host(self, host: int) -> list[int]:

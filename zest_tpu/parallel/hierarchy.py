@@ -1,0 +1,275 @@
+"""Hierarchical DCN+ICI distribution for multi-pod pulls.
+
+BASELINE config #5 ("Llama-405B v5p-256 hierarchical DCN+ICI"): at
+multi-pod scale the network is two-tier — fast ICI inside each pod, slower
+DCN between pods — and a flat rendezvous plan (zest_tpu.parallel.plan)
+wastes the tiering: it balances CDN ingress over *global* hosts but says
+nothing about how bytes cross DCN. This module adds the two-level story
+(SURVEY.md §7 "hard parts" #3):
+
+  - **two-level ownership**: a fetch unit is HRW-hashed first to an owning
+    *pod* (balances CDN/DCN ingress per pod), then to an owning *host
+    within that pod* (balances intra-pod fetch work). Every process
+    computes the same (pod, host) pair with no coordination.
+  - **two-stage gather**: the pool array lives on a 2-D ``(pods, hosts)``
+    mesh. Stage 1 un-shards the ``pods`` axis — XLA emits the cross-pod
+    all-gather that rides DCN, moving each unit (n_pods - 1)× across the
+    slow tier, exactly once per destination pod. Stage 2 un-shards the
+    ``hosts`` axis — the in-pod ICI all-gather. Staging them as two
+    jitted reshardings (instead of one replicate) gives the per-stage
+    DCN/ICI timing the BASELINE metrics require; fused or staged, the
+    bytes moved are identical.
+
+The reference's closest analog is "100 WAN peers" (DESIGN.md:563-574):
+its WAN/LAN split is emergent from peer RTTs; ours is explicit in the
+mesh axes.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.reconstruction import Reconstruction
+from zest_tpu.parallel.collectives import (
+    GatheredPool,
+    PoolLayout,
+    pack_rows,
+)
+from zest_tpu.parallel.plan import (
+    DistributionPlan,
+    FetchAssignment,
+    owner_host,
+)
+
+PODS_AXIS = "pods"
+HOSTS_AXIS = "hosts"
+
+# Domain-separation salts so pod-level and host-level rendezvous draws are
+# independent (same convention as hashing's keyed domains).
+_POD_SALT = b"zest-hier-pod"
+_HOST_SALT = b"zest-hier-host"
+
+
+def hier_mesh(n_pods: int, hosts_per_pod: int, devices=None) -> Mesh:
+    """2-D ``(pods, hosts)`` mesh. Device order matters: consecutive
+    devices share a pod (the ICI-contiguous trailing axis), so the
+    ``hosts`` all-gather stays on ICI and only the leading axis crosses
+    DCN — the layout rule from zest_tpu.parallel.mesh.model_mesh."""
+    devices = jax.devices() if devices is None else devices
+    if n_pods * hosts_per_pod != len(devices):
+        raise ValueError(
+            f"{n_pods}×{hosts_per_pod} mesh needs {n_pods * hosts_per_pod} "
+            f"devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(n_pods, hosts_per_pod)
+    return Mesh(arr, (PODS_AXIS, HOSTS_AXIS))
+
+
+def owner_pod_host(
+    xorb_hash: bytes, range_start: int, n_pods: int, hosts_per_pod: int
+) -> tuple[int, int]:
+    """Two independent rendezvous draws: owning pod, then host in pod."""
+    pod = owner_host(_POD_SALT + xorb_hash, range_start, n_pods)
+    host = owner_host(_HOST_SALT + xorb_hash, range_start, hosts_per_pod)
+    return pod, host
+
+
+@dataclass
+class HierarchicalPlan:
+    """A DistributionPlan whose owner slots encode (pod, host) pod-major.
+
+    ``flat`` is a plain DistributionPlan over n_pods × hosts_per_pod
+    global slots (slot = pod * hosts_per_pod + host), so the pool layout,
+    packing, and registry machinery from collectives/plan are reused
+    unchanged — only the owner assignment differs.
+    """
+
+    n_pods: int
+    hosts_per_pod: int
+    flat: DistributionPlan
+
+    @staticmethod
+    def build(
+        recs: list[Reconstruction], n_pods: int, hosts_per_pod: int
+    ) -> "HierarchicalPlan":
+        base = DistributionPlan.build(recs, n_pods * hosts_per_pod)
+        assignments = []
+        for a in base.assignments:
+            pod, host = owner_pod_host(
+                hashing.hex_to_hash(a.hash_hex),
+                a.fetch_info.range.start,
+                n_pods,
+                hosts_per_pod,
+            )
+            assignments.append(FetchAssignment(
+                hash_hex=a.hash_hex,
+                fetch_info=a.fetch_info,
+                owner=pod * hosts_per_pod + host,
+            ))
+        return HierarchicalPlan(
+            n_pods, hosts_per_pod,
+            DistributionPlan(n_pods * hosts_per_pod, assignments),
+        )
+
+    def bytes_per_pod(self) -> list[int]:
+        """CDN/DCN ingress per pod — the balance target of level 1."""
+        out = [0] * self.n_pods
+        for a in self.flat.assignments:
+            out[a.owner // self.hosts_per_pod] += a.est_bytes
+        return out
+
+    def summary(self) -> dict:
+        per_pod = self.bytes_per_pod()
+        peak = max(per_pod) if per_pod else 0
+        mean = sum(per_pod) / self.n_pods if self.n_pods else 0
+        s = self.flat.summary()
+        s["pods"] = self.n_pods
+        s["bytes_per_pod"] = per_pod
+        s["pod_balance"] = round(mean / peak, 4) if peak else 1.0
+        return s
+
+
+def _stage_shardings(mesh: Mesh):
+    """Shardings over the 3-D pool view [pods, hosts·rows_per_host, len].
+
+    The pool is kept 3-D (pod dim explicit) so each stage is a single-axis
+    resharding: owner → after_dcn un-shards only ``pods`` (an all-gather
+    between same-host-index devices of different pods — the DCN tier);
+    after_dcn → replicated un-shards ``hosts`` (in-pod ICI). A flat 2-D
+    pool sharded P((pods, hosts)) would NOT decompose this way — its
+    contiguous blocks interleave host indices, so the "DCN" stage would
+    move bytes between in-pod hosts too.
+    """
+    owner = NamedSharding(mesh, P(PODS_AXIS, HOSTS_AXIS, None))
+    after_dcn = NamedSharding(mesh, P(None, HOSTS_AXIS, None))
+    replicated = NamedSharding(mesh, P())
+    return owner, after_dcn, replicated
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _to(sharding: NamedSharding, pool: jax.Array) -> jax.Array:
+    return jax.lax.with_sharding_constraint(pool, sharding)
+
+
+class HierarchicalDistributor:
+    """One multi-pod distribution round: pack → DCN gather → ICI gather.
+
+    Single-process only simulates the topology (the driver's virtual-mesh
+    dryrun); multi-process packing reuses the same slot convention, where
+    this process contributes bands for every slot whose device it owns.
+    """
+
+    def __init__(self, mesh: Mesh):
+        if tuple(mesh.axis_names) != (PODS_AXIS, HOSTS_AXIS):
+            raise ValueError(
+                f"expected a (pods, hosts) mesh, got {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.n_pods = int(mesh.shape[PODS_AXIS])
+        self.hosts_per_pod = int(mesh.shape[HOSTS_AXIS])
+        # Filled by distribute(): wall-clock of the two collective stages
+        # and the pool layout they moved.
+        self.stage_seconds: dict[str, float] = {}
+        self._layout: PoolLayout | None = None
+
+    def distribute(
+        self,
+        plan: HierarchicalPlan,
+        fetch_fn,
+        slot: int | None = None,
+        local_shards: dict[int, dict[tuple[str, int], bytes]] | None = None,
+    ) -> GatheredPool:
+        if (plan.n_pods, plan.hosts_per_pod) != (
+            self.n_pods, self.hosts_per_pod
+        ):
+            raise ValueError(
+                f"plan is {plan.n_pods}×{plan.hosts_per_pod}, mesh is "
+                f"{self.n_pods}×{self.hosts_per_pod}"
+            )
+        flat = plan.flat
+        layout = PoolLayout.from_plan(flat)
+        self._layout = layout
+        if layout.total_rows == 0:
+            return GatheredPool(
+                layout, jnp.zeros((0, layout.row_len or 128), jnp.uint8)
+            )
+
+        slot = 0 if slot is None else slot
+        bands = []
+        for s in range(flat.num_hosts):
+            if s == slot:
+                blobs = {}
+                for a in flat.for_host(s):
+                    key = (a.hash_hex, a.fetch_info.range.start)
+                    try:
+                        blobs[key] = fetch_fn(a)
+                    except Exception:
+                        continue  # zero row → CDN fallback downstream
+                bands.append(pack_rows(layout, blobs, s))
+            elif local_shards and s in local_shards:
+                bands.append(pack_rows(layout, local_shards[s], s))
+            else:
+                bands.append(np.zeros(
+                    (layout.rows_per_host, layout.row_len), np.uint8
+                ))
+        global_rows = np.concatenate(bands, axis=0)
+        # 3-D pod-major view: [pods, hosts_per_pod·rows_per_host, row_len].
+        # Slot s = pod·H + host, so this reshape keeps every band in place.
+        pod_rows = global_rows.reshape(
+            self.n_pods,
+            self.hosts_per_pod * layout.rows_per_host,
+            layout.row_len,
+        )
+
+        owner_sh, after_dcn_sh, repl_sh = _stage_shardings(self.mesh)
+        pool = jax.device_put(pod_rows, owner_sh)
+        pool.block_until_ready()
+
+        t0 = time.perf_counter()
+        pool = _to(after_dcn_sh, pool)   # stage 1: cross-pod (DCN)
+        pool.block_until_ready()
+        t1 = time.perf_counter()
+        pool = _to(repl_sh, pool)        # stage 2: in-pod (ICI)
+        pool.block_until_ready()
+        t2 = time.perf_counter()
+        self.stage_seconds = {"dcn": t1 - t0, "ici": t2 - t1}
+        return GatheredPool(
+            layout, pool.reshape(layout.total_rows, layout.row_len)
+        )
+
+    def stage_stats(self) -> dict:
+        """Bytes each stage moved + measured wall-clock (per-stage timing,
+        SURVEY.md §5 'tracing/profiling' requirement).
+
+        The basis is ``layout.pool_bytes`` — what the collectives actually
+        carry (fixed-capacity rows, padded), not the plan's compressed
+        est_bytes sum. Per device the owner shard is pool/(P·H); stage 1
+        delivers it to the other P-1 pods, stage 2 fans each pod's
+        pool/H band out to its other H-1 hosts.
+        """
+        if self._layout is None:
+            raise RuntimeError("stage_stats before distribute()")
+        pool = self._layout.pool_bytes
+        # Totals are bytes *received* summed over devices: stage 1 — each
+        # of P·H devices receives (P-1) owner shards of pool/(P·H); stage
+        # 2 — each receives (H-1) bands of pool/H.
+        out = {
+            "pool_bytes": pool,
+            "dcn_bytes": pool * (self.n_pods - 1),
+            "ici_bytes": pool * self.n_pods * (self.hosts_per_pod - 1),
+        }
+        for name, secs in self.stage_seconds.items():
+            out[f"{name}_seconds"] = round(secs, 6)
+            moved = out[f"{name}_bytes"]
+            out[f"{name}_gbps"] = (
+                round(moved / secs / 1e9, 3) if secs > 0 else 0.0
+            )
+        return out
