@@ -132,6 +132,25 @@ def test_distribute_failed_fetch_leaves_zero_row(tmp_config):
         assert pool.blob(a.hash_hex, a.fetch_info.range.start) is None
 
 
+def test_multiprocess_branch_builds_per_device_shards(monkeypatch):
+    """Drive the multi-process packing path: every device is addressable
+    in a single-process run, so faking process_count exercises the
+    per-device shard construction end-to-end and must produce the same
+    pool as the global path."""
+    import zest_tpu.parallel.hierarchy as hier
+
+    repo = _repo(n_files=2)
+    plan = _plan(repo)
+    fetch = _fetch_fn(repo)
+    mesh = hier_mesh(2, 4)
+
+    monkeypatch.setattr(hier.jax, "process_count", lambda: 2)
+    pool = HierarchicalDistributor(mesh).distribute(plan, fetch)
+    for a in plan.flat.assignments:
+        got = pool.blob(a.hash_hex, a.fetch_info.range.start)
+        assert got is not None and got[0] == fetch(a)
+
+
 def test_plan_mesh_mismatch_raises():
     plan = _plan(_repo(n_files=1), n_pods=4, hosts_per_pod=2)
     dist = HierarchicalDistributor(hier_mesh(2, 4))

@@ -1,0 +1,87 @@
+"""Pallas BLAKE3 kernel vs the pure reference (bit-exactness).
+
+Runs in interpreter mode on the CPU test mesh; the same kernel lowers to
+Mosaic on TPU (verified on hardware by bench.py's correctness gate). The
+grouped-grid design (leaf groups sequential, CVs in scratch) must be
+bit-exact across group boundaries, so sizes straddle the 16-leaf group
+width as well as all the tree shapes the XLA test covers.
+"""
+
+import numpy as np
+import pytest
+
+from zest_tpu.cas import blake3 as ref
+from zest_tpu.ops.blake3_pallas import _LEAVES_PER_GROUP, PallasHasher
+
+_RNG = np.random.default_rng(7)
+_GROUP_BYTES = _LEAVES_PER_GROUP * 1024
+_SIZES = [
+    0, 1, 63, 64, 65, 1023, 1024, 1025, 3000,           # leaf shapes
+    _GROUP_BYTES - 1, _GROUP_BYTES, _GROUP_BYTES + 1,   # group boundary
+    2 * _GROUP_BYTES + 7, 40_000,                       # multi-group
+]
+# (the 64–128 KiB shapes run on hardware via bench.py's correctness gate;
+# in the interpreter they cost minutes for no extra tree coverage)
+
+
+@pytest.fixture(scope="module")
+def hasher():
+    return PallasHasher(interpret=True)
+
+
+def test_plain_matches_reference(hasher):
+    """All tree shapes plus a mixed-length tail in ONE kernel call —
+    interpret-mode execution is lane-parallel, so batching every case
+    into a single 128-lane invocation costs the same ~60 s as one case.
+    The tail models the gathered-pool shape (fixed capacity, variable
+    fill per row)."""
+    mixed = (5, 33_000, 1024, 0, 17_000, 7, 99, 512, 2048, 4097,
+             9000, 12_345, 20_000, 31_999)
+    chunks = [_RNG.bytes(n) for n in (*_SIZES, *mixed)]
+    got = hasher.hash_batch(chunks)
+    for c, g in zip(chunks, got):
+        assert g == ref.blake3(c), f"mismatch at len {len(c)}"
+
+
+def test_keyed_matches_reference():
+    # Small capacity on purpose: the key only changes per-compress flags,
+    # orthogonal to tree shape, and each new capacity is a fresh ~60 s
+    # interpret compile.
+    key = bytes(range(32))
+    hasher = PallasHasher(key=key, interpret=True)
+    chunks = [_RNG.bytes(n) for n in (0, 100, 1024, 2000)]
+    got = hasher.hash_batch(chunks)
+    for c, g in zip(chunks, got):
+        assert g == ref.blake3_keyed(key, c), f"mismatch at len {len(c)}"
+
+
+def test_batch_not_a_tile_multiple(hasher):
+    # B=5 forces lane padding to 128; padded rows must not leak out
+    chunks = [_RNG.bytes(100 + i) for i in range(5)]
+    got = hasher.hash_batch(chunks)
+    assert len(got) == 5
+    for c, g in zip(chunks, got):
+        assert g == ref.blake3(c)
+
+
+def test_capacity_validation(hasher):
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError, match="1 KiB multiple"):
+        hasher.hash_device(
+            jnp.zeros((1, 100), jnp.uint32), jnp.zeros((1,), jnp.int32)
+        )
+    with pytest.raises(ValueError, match="128 KiB"):
+        hasher.hash_device(
+            jnp.zeros((1, 129 * 256), jnp.uint32),
+            jnp.zeros((1,), jnp.int32),
+        )
+
+
+def test_bad_key_length():
+    with pytest.raises(ValueError, match="32 bytes"):
+        PallasHasher(key=b"short")
+
+
+def test_empty_batch(hasher):
+    assert hasher.hash_batch([]) == []

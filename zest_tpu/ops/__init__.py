@@ -9,3 +9,15 @@ from zest_tpu.ops.blake3 import (  # noqa: F401
     DeviceHasher,
     verify_chunks_device,
 )
+from zest_tpu.ops.blake3_pallas import PallasHasher  # noqa: F401
+
+
+def best_hasher(key: bytes | None = None):
+    """The fastest verifier for the current backend: the Pallas kernel on
+    TPU (~13% over the XLA lowering, measured v5e), XLA elsewhere (the
+    Pallas interpreter is for tests, not production CPU hashing)."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return PallasHasher(key)
+    return DeviceHasher(key)

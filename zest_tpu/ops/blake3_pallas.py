@@ -1,0 +1,397 @@
+"""BLAKE3 as a Pallas TPU kernel — the north-star on-device verifier.
+
+Same math as zest_tpu.ops.blake3 (the lowering-agnostic XLA version, which
+remains the bit-exactness anchor), reformulated for the TPU VPU:
+
+- **chunks ride the lane dimension**: all state is shaped (..., TILE) with
+  one hashed chunk per lane, so every compression step is an (8×128)-wide
+  vector op. The XLA version's (..., 4) lane layout wastes 31/32 lanes on
+  TPU; here utilization is TILE/128.
+- **block-major word layout**: the host view is pre-arranged as
+  ``A[block, leaf·16 + word, chunk]`` so the per-block message load inside
+  the 16-iteration compression loop is one contiguous ref slice
+  (``a_ref[b]``) — no strided gathers in VMEM.
+- **word masking runs outside the kernel** (cheap XLA elementwise on the
+  way in), so the kernel sees zero-padded words and only needs per-leaf
+  block counts.
+- the chunk merge tree unrolls into log2(MAX_LEAVES) static pairwise
+  levels with odd-tail promotion, exactly like the XLA version
+  (ops/blake3.py:207-246) but transposed.
+
+VMEM is bounded by the **leaf-group grid**, not a smaller batch tile
+(Mosaic requires the lane dim to be a multiple of 128): the second grid
+dimension walks the chunk capacity ``_LEAVES_PER_GROUP`` KiB at a time,
+accumulating per-leaf CVs in scratch, and the last step folds the merge
+tree — so the input block stays at ``_LEAVES_PER_GROUP·1 KiB × 128 lanes``
+(2 MiB) regardless of chunk size. ``_LEAVES_PER_GROUP`` is the VMEM knob.
+
+On non-TPU backends the kernel runs in interpreter mode (tests); the XLA
+version stays the production path for CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zest_tpu.cas.blake3 import (
+    BLOCK_LEN,
+    CHUNK_END,
+    CHUNK_LEN,
+    CHUNK_START,
+    IV,
+    KEYED_HASH,
+    MSG_PERMUTATION,
+    PARENT,
+    ROOT,
+)
+from zest_tpu.ops.blake3 import (
+    BLOCKS_PER_LEAF,
+    MAX_LEAVES,
+    WORDS_PER_BLOCK,
+    WORDS_PER_LEAF,
+)
+
+_U32 = jnp.uint32
+
+# Static per-round message schedules (word index per G-function input):
+# round r reads the identity permutation advanced r times. Baking the
+# schedule in lets the kernel index message words with *static* slices —
+# no in-kernel gather, which Mosaic lowers poorly.
+_SCHEDULES: list[tuple[int, ...]] = []
+_s = list(range(16))
+for _ in range(7):
+    _SCHEDULES.append(tuple(_s))
+    _s = [_s[i] for i in MSG_PERMUTATION]
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _g(va, vb, vc, vd, mx, my):
+    va = va + vb + mx
+    vd = _rotr(vd ^ va, 16)
+    vc = vc + vd
+    vb = _rotr(vb ^ vc, 12)
+    va = va + vb + my
+    vd = _rotr(vd ^ va, 8)
+    vc = vc + vd
+    vb = _rotr(vb ^ vc, 7)
+    return va, vb, vc, vd
+
+
+def _roll1(x, k: int):
+    """Rotate axis 1 (the 4-row state group) by k via static slices —
+    jnp.roll on a middle axis does not lower well in Mosaic."""
+    k %= x.shape[1]
+    if k == 0:
+        return x
+    return jnp.concatenate([x[:, k:], x[:, :k]], axis=1)
+
+
+def _cols(m, idxs):
+    """Stack static message columns: (L, 16, T)[idxs] → (L, len, T)."""
+    return jnp.stack([m[:, i] for i in idxs], axis=1)
+
+
+def _compress_t(cv, m, counter, block_len, flags, key4):
+    """Transposed compression: cv (L, 8, T), m (L, 16, T); counter /
+    block_len / flags (L, T). Lane axis T is the chunk batch. Rounds are
+    statically unrolled with baked message schedules."""
+    L, _, T = cv.shape
+    va, vb = cv[:, 0:4], cv[:, 4:8]
+    vc = jnp.broadcast_to(key4, (L, 4, T))
+    vd = jnp.stack(
+        [
+            counter.astype(_U32),
+            jnp.zeros_like(counter, _U32),
+            block_len.astype(_U32),
+            flags.astype(_U32),
+        ],
+        axis=1,
+    )
+
+    for sched in _SCHEDULES:
+        va, vb, vc, vd = _g(
+            va, vb, vc, vd,
+            _cols(m, sched[0:8:2]), _cols(m, sched[1:8:2]),
+        )
+        vb = _roll1(vb, 1)
+        vc = _roll1(vc, 2)
+        vd = _roll1(vd, 3)
+        va, vb, vc, vd = _g(
+            va, vb, vc, vd,
+            _cols(m, sched[8:16:2]), _cols(m, sched[9:16:2]),
+        )
+        vb = _roll1(vb, 3)
+        vc = _roll1(vc, 2)
+        vd = _roll1(vd, 1)
+    lo = jnp.concatenate([va, vb], axis=1)
+    hi = jnp.concatenate([vc, vd], axis=1)
+    return jnp.concatenate([lo ^ hi, hi ^ cv], axis=1)
+
+
+_TILE = 128           # lane width: Mosaic requires last block dim % 128
+_LEAVES_PER_GROUP = 16  # 16 leaves × 1 KiB × 128 lanes = 2 MiB VMEM/block
+
+
+def _make_kernel(n_leaves_cap: int, leaves_per_group: int, n_groups: int,
+                 key_words: tuple[int, ...], base_flags: int):
+    """Kernel over grid (batch_tile, leaf_group). The leaf-group axis is
+    sequential: each step compresses its group's leaves into the CV
+    scratch; the last step folds the merge tree and writes digests. This
+    keeps the VMEM block at ``leaves_per_group`` KiB × 128 lanes no matter
+    how large the chunk capacity is."""
+    L, G = n_leaves_cap, leaves_per_group
+    Lp = n_groups * G  # scratch rows (≥ L; tail rows never go live)
+    key8 = tuple(int(w) for w in key_words)
+    iv4 = tuple(int(w) for w in IV[:4])
+
+    def kernel(a_ref, len_ref, out_ref,
+               cv_ref, dcv_ref, dblk_ref, dmeta_ref):
+        g = pl.program_id(1)
+        T = out_ref.shape[1]
+        key4 = jnp.stack(
+            [jnp.full((T,), w, _U32) for w in iv4], axis=0
+        )[None]                                               # (1, 4, T)
+        key_row = jnp.stack(
+            [jnp.full((T,), w, _U32) for w in key8], axis=0
+        )                                                     # (8, T)
+        lengths = len_ref[0, :]                               # (T,) i32
+
+        # ── group phase: compress this group's G leaves ──
+        leaf_l = jax.lax.broadcasted_iota(jnp.int32, (G, T), 0)
+        leaf = leaf_l + g * G                                  # global idx
+        leaf_bytes = jnp.clip(
+            lengths[None, :] - leaf * CHUNK_LEN, 0, CHUNK_LEN
+        )
+        n_blocks = jnp.maximum(
+            (leaf_bytes + BLOCK_LEN - 1) // BLOCK_LEN,
+            jnp.where(leaf == 0, 1, 0),
+        )
+
+        def body(b, carry):
+            cv, dcv, dblk, dlen, dfl = carry
+            m = a_ref[pl.ds(b, 1)].reshape(G, WORDS_PER_BLOCK, T)
+            active = b < n_blocks
+            is_last = b == n_blocks - 1
+            bl = jnp.clip(leaf_bytes - b * BLOCK_LEN, 0, BLOCK_LEN)
+            fl = (
+                jnp.full((G, T), base_flags, _U32)
+                | jnp.where(b == 0, CHUNK_START, 0).astype(_U32)
+                | jnp.where(is_last, CHUNK_END, 0).astype(_U32)
+            )
+            out = _compress_t(cv, m, leaf, bl, fl, key4)
+            new_cv = jnp.where(active[:, None, :], out[:, :8], cv)
+            # Defer leaf 0's final-block inputs for the single-leaf ROOT
+            # (leaf 0 lives in group 0 only).
+            last0 = is_last[0][None, :]
+            dcv = jnp.where(last0, cv[0], dcv)
+            dblk = jnp.where(last0, m[0], dblk)
+            dlen = jnp.where(is_last[0], bl[0], dlen)
+            dfl = jnp.where(is_last[0], fl[0], dfl)
+            return new_cv, dcv, dblk, dlen, dfl
+
+        init_cv = jnp.broadcast_to(key_row[None], (G, 8, T))
+        init = (
+            init_cv,
+            jnp.zeros((8, T), _U32),
+            jnp.zeros((WORDS_PER_BLOCK, T), _U32),
+            jnp.zeros((T,), jnp.int32),
+            jnp.zeros((T,), _U32),
+        )
+        cv_g, dcv, dblk, dlen, dfl = jax.lax.fori_loop(
+            0, BLOCKS_PER_LEAF, body, init
+        )
+        cv_ref[pl.ds(g * G, G)] = cv_g
+
+        @pl.when(g == 0)
+        def _():
+            dcv_ref[:] = dcv
+            dblk_ref[:] = dblk
+            dmeta_ref[0, :] = dlen
+            dmeta_ref[1, :] = dfl.astype(jnp.int32)
+
+        # ── final phase: fold the tree and emit digests ──
+        @pl.when(g == n_groups - 1)
+        def _():
+            full_leaf = jax.lax.broadcasted_iota(jnp.int32, (Lp, T), 0)
+            live = (
+                jnp.clip(lengths[None, :] - full_leaf * CHUNK_LEN,
+                         0, CHUNK_LEN) > 0
+            ) | (full_leaf == 0)
+            n_leaves = jnp.maximum(
+                jnp.sum(live.astype(jnp.int32), axis=0), 1
+            )
+            cv = cv_ref[:]                                    # (Lp, 8, T)
+            count = n_leaves
+            root = jnp.zeros((16, T), _U32)
+            lvl = Lp
+            while lvl > 1:
+                if lvl % 2:
+                    cv = jnp.concatenate(
+                        [cv, jnp.zeros((1, 8, T), _U32)], axis=0
+                    )
+                    lvl += 1
+                half = lvl // 2
+                # Adjacent rows pair up, so the parent message is just a
+                # reshape: (2h, 8, T) → (h, 16, T) puts left in cols 0:8,
+                # right in 8:16. (Strided slices like cv[0::2] lower to
+                # gathers, which Mosaic rejects beyond 2-D.)
+                m = cv.reshape(half, 16, T)
+                left = m[:, :8]
+                is_root = count == 2
+                fl = (
+                    jnp.full((half, T), base_flags | PARENT, _U32)
+                    | jnp.where(is_root, ROOT, 0).astype(_U32)[None, :]
+                )
+                out = _compress_t(
+                    jnp.broadcast_to(key_row[None], (half, 8, T)),
+                    m,
+                    jnp.zeros((half, T), _U32),
+                    jnp.full((half, T), BLOCK_LEN, _U32),
+                    fl,
+                    key4,
+                )
+                j = jax.lax.broadcasted_iota(jnp.int32, (half, T), 0)
+                merged = (2 * j + 1) < count[None, :]
+                cv = jnp.where(merged[:, None, :], out[:, :8], left)
+                root = jnp.where(is_root[None, :], out[0], root)
+                count = (count + 1) // 2
+                lvl = half
+
+            single = _compress_t(
+                dcv_ref[:][None],
+                dblk_ref[:][None],
+                jnp.zeros((1, T), _U32),
+                dmeta_ref[0, :][None].astype(_U32),
+                (dmeta_ref[1, :][None].astype(_U32) | ROOT),
+                key4,
+            )[0]
+            root = jnp.where((n_leaves == 1)[None, :], single, root)
+            out_ref[:] = root[:8]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("key_words", "base_flags", "interpret"),
+)
+def _hash_pallas(words, lengths, key_words, base_flags, interpret):
+    B, W = words.shape
+    L = W // WORDS_PER_LEAF
+
+    # Mask garbage bytes past each chunk's length (XLA elementwise).
+    widx = jnp.arange(W, dtype=jnp.int32)
+    rem = jnp.clip(lengths[:, None] - widx[None, :] * 4, 0, 4)
+    mask = jnp.where(
+        rem >= 4,
+        jnp.asarray(0xFFFFFFFF, _U32),
+        (jnp.asarray(1, _U32) << (8 * rem.astype(_U32))) - 1,
+    )
+    words = words & mask
+
+    pad = (-B) % _TILE
+    if pad:
+        words = jnp.pad(words, ((0, pad), (0, 0)))
+        lengths = jnp.pad(lengths, (0, pad))
+    Bp = B + pad
+
+    G = min(_LEAVES_PER_GROUP, L)
+    n_groups = pl.cdiv(L, G)
+    Lp = n_groups * G
+    if Lp != L:  # pad capacity so every group is full
+        words = jnp.pad(words, ((0, 0), (0, (Lp - L) * WORDS_PER_LEAF)))
+        L = Lp
+
+    # Block-major transposed view: A[block, leaf*16 + word, chunk].
+    a = (
+        words.reshape(Bp, L, BLOCKS_PER_LEAF, WORDS_PER_BLOCK)
+        .transpose(2, 1, 3, 0)
+        .reshape(BLOCKS_PER_LEAF, L * WORDS_PER_BLOCK, Bp)
+    )
+    len2d = lengths.astype(jnp.int32).reshape(1, Bp)
+
+    kernel = _make_kernel(L, G, n_groups, key_words, base_flags)
+    digests_t = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, Bp), _U32),
+        grid=(Bp // _TILE, n_groups),
+        in_specs=[
+            pl.BlockSpec(
+                (BLOCKS_PER_LEAF, G * WORDS_PER_BLOCK, _TILE),
+                lambda i, g: (0, g, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, _TILE), lambda i, g: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, _TILE), lambda i, g: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((L, 8, _TILE), _U32),       # per-leaf CVs
+            pltpu.VMEM((8, _TILE), _U32),          # deferred cv
+            pltpu.VMEM((WORDS_PER_BLOCK, _TILE), _U32),  # deferred block
+            pltpu.VMEM((2, _TILE), jnp.int32),     # deferred len/flags
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(a, len2d)
+    return digests_t[:, :B].T
+
+
+class PallasHasher:
+    """Drop-in sibling of ops.blake3.DeviceHasher lowering via Pallas."""
+
+    def __init__(self, key: bytes | None = None, interpret: bool | None = None):
+        if key is not None:
+            if len(key) != 32:
+                raise ValueError("key must be 32 bytes")
+            self.key_words = tuple(
+                int(w) for w in np.frombuffer(key, dtype="<u4")
+            )
+            self.base_flags = int(KEYED_HASH)
+        else:
+            self.key_words = tuple(int(w) for w in IV)
+            self.base_flags = 0
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+
+    def hash_device(self, words: jax.Array, lengths: jax.Array) -> jax.Array:
+        """(B, padded_words) u32 + (B,) lengths → (B, 8) u32 digests."""
+        if words.shape[-1] % WORDS_PER_LEAF:
+            raise ValueError("padded capacity must be a 1 KiB multiple")
+        if words.shape[-1] > MAX_LEAVES * WORDS_PER_LEAF:
+            raise ValueError(
+                f"chunks larger than {MAX_LEAVES} KiB unsupported"
+            )
+        return _hash_pallas(
+            words, lengths.astype(jnp.int32),
+            self.key_words, self.base_flags, self.interpret,
+        )
+
+    def hash_batch(self, chunks: list[bytes]) -> list[bytes]:
+        if not chunks:
+            return []
+        max_len = max(len(c) for c in chunks)
+        cap = max(
+            (max_len + CHUNK_LEN - 1) // CHUNK_LEN * CHUNK_LEN, CHUNK_LEN
+        )
+        buf = np.zeros((len(chunks), cap), dtype=np.uint8)
+        lengths = np.empty(len(chunks), dtype=np.int32)
+        for i, c in enumerate(chunks):
+            buf[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lengths[i] = len(c)
+        words = jnp.asarray(buf.view("<u4"))
+        digests = np.asarray(self.hash_device(words, jnp.asarray(lengths)))
+        return [d.astype("<u4").tobytes() for d in digests]

@@ -124,6 +124,47 @@ def pack_rows(
     return out
 
 
+def fetch_owned_blobs(
+    plan: DistributionPlan, fetch_fn, slot: int
+) -> dict[tuple[str, int], bytes]:
+    """Fetch every unit ``slot`` owns; a failed fetch leaves its key out
+    (→ zero row → CDN fallback downstream). One bad unit must never abort
+    a round or strand a multi-host collective."""
+    blobs: dict[tuple[str, int], bytes] = {}
+    for a in plan.for_host(slot):
+        key = (a.hash_hex, a.fetch_info.range.start)
+        try:
+            blobs[key] = fetch_fn(a)
+        except Exception:
+            continue
+    return blobs
+
+
+def pack_global_rows(
+    layout: PoolLayout,
+    plan: DistributionPlan,
+    fetch_fn,
+    slot: int,
+    local_shards: dict[int, dict[tuple[str, int], bytes]] | None = None,
+) -> np.ndarray:
+    """Single-process pool assembly: fetch ``slot``'s own band, take other
+    slots' bands from ``local_shards`` (tests / simulation), zero-fill the
+    rest. Shared by the flat and hierarchical distributors."""
+    bands = []
+    for h in range(plan.num_hosts):
+        if h == slot:
+            bands.append(
+                pack_rows(layout, fetch_owned_blobs(plan, fetch_fn, h), h)
+            )
+        elif local_shards and h in local_shards:
+            bands.append(pack_rows(layout, local_shards[h], h))
+        else:
+            bands.append(
+                np.zeros((layout.rows_per_host, layout.row_len), np.uint8)
+            )
+    return np.concatenate(bands, axis=0)
+
+
 @partial(jax.jit, static_argnames=("mesh",))
 def _replicate(mesh: Mesh, pool: jax.Array) -> jax.Array:
     """sharded-over-pod → replicated: XLA lowers this to an ICI all-gather."""
@@ -228,27 +269,10 @@ class PodDistributor:
             )
 
         if jax.process_count() == 1:
-            host = 0 if host is None else host
-            bands = []
-            for h in range(plan.num_hosts):
-                if h == host:
-                    blobs = {}
-                    for a in plan.for_host(h):
-                        key = (a.hash_hex, a.fetch_info.range.start)
-                        try:
-                            blobs[key] = fetch_fn(a)
-                        except Exception:
-                            continue  # zero row → CDN fallback downstream
-                    bands.append(pack_rows(layout, blobs, h))
-                elif local_shards and h in local_shards:
-                    bands.append(pack_rows(layout, local_shards[h], h))
-                else:
-                    bands.append(
-                        np.zeros(
-                            (layout.rows_per_host, layout.row_len), np.uint8
-                        )
-                    )
-            global_rows = np.concatenate(bands, axis=0)
+            global_rows = pack_global_rows(
+                layout, plan, fetch_fn,
+                0 if host is None else host, local_shards,
+            )
             sharded = jax.device_put(
                 global_rows, row_sharded(self.mesh, self.axis)
             )
@@ -257,16 +281,12 @@ class PodDistributor:
             # the axis). This process fetches for every slot whose device it
             # addresses and contributes the concatenated bands as its local
             # shard data.
-            bands = []
-            for slot in self._local_slots():
-                blobs = {}
-                for a in plan.for_host(slot):
-                    key = (a.hash_hex, a.fetch_info.range.start)
-                    try:
-                        blobs[key] = fetch_fn(a)
-                    except Exception:
-                        continue
-                bands.append(pack_rows(layout, blobs, slot))
+            bands = [
+                pack_rows(
+                    layout, fetch_owned_blobs(plan, fetch_fn, slot), slot
+                )
+                for slot in self._local_slots()
+            ]
             local_band = np.concatenate(bands, axis=0)
             sharded = jax.make_array_from_process_local_data(
                 row_sharded(self.mesh, self.axis),

@@ -41,11 +41,14 @@ from zest_tpu.cas.reconstruction import Reconstruction
 from zest_tpu.parallel.collectives import (
     GatheredPool,
     PoolLayout,
+    fetch_owned_blobs,
+    pack_global_rows,
     pack_rows,
 )
 from zest_tpu.parallel.plan import (
     DistributionPlan,
     FetchAssignment,
+    collect_units,
     owner_host,
 )
 
@@ -100,18 +103,13 @@ class HierarchicalPlan:
     def build(
         recs: list[Reconstruction], n_pods: int, hosts_per_pod: int
     ) -> "HierarchicalPlan":
-        base = DistributionPlan.build(recs, n_pods * hosts_per_pod)
         assignments = []
-        for a in base.assignments:
+        for (hh, start), fi in collect_units(recs):
             pod, host = owner_pod_host(
-                hashing.hex_to_hash(a.hash_hex),
-                a.fetch_info.range.start,
-                n_pods,
-                hosts_per_pod,
+                hashing.hex_to_hash(hh), start, n_pods, hosts_per_pod
             )
             assignments.append(FetchAssignment(
-                hash_hex=a.hash_hex,
-                fetch_info=a.fetch_info,
+                hash_hex=hh, fetch_info=fi,
                 owner=pod * hosts_per_pod + host,
             ))
         return HierarchicalPlan(
@@ -162,9 +160,10 @@ def _to(sharding: NamedSharding, pool: jax.Array) -> jax.Array:
 class HierarchicalDistributor:
     """One multi-pod distribution round: pack → DCN gather → ICI gather.
 
-    Single-process only simulates the topology (the driver's virtual-mesh
-    dryrun); multi-process packing reuses the same slot convention, where
-    this process contributes bands for every slot whose device it owns.
+    Single-process simulates the full topology (the driver's virtual-mesh
+    dryrun, with ``local_shards`` pre-supplying other slots' blobs);
+    multi-process, each process fetches for every (pod, host) slot whose
+    device it addresses and contributes those bands as per-device shards.
     """
 
     def __init__(self, mesh: Mesh):
@@ -202,35 +201,40 @@ class HierarchicalDistributor:
                 layout, jnp.zeros((0, layout.row_len or 128), jnp.uint8)
             )
 
-        slot = 0 if slot is None else slot
-        bands = []
-        for s in range(flat.num_hosts):
-            if s == slot:
-                blobs = {}
-                for a in flat.for_host(s):
-                    key = (a.hash_hex, a.fetch_info.range.start)
-                    try:
-                        blobs[key] = fetch_fn(a)
-                    except Exception:
-                        continue  # zero row → CDN fallback downstream
-                bands.append(pack_rows(layout, blobs, s))
-            elif local_shards and s in local_shards:
-                bands.append(pack_rows(layout, local_shards[s], s))
-            else:
-                bands.append(np.zeros(
-                    (layout.rows_per_host, layout.row_len), np.uint8
-                ))
-        global_rows = np.concatenate(bands, axis=0)
-        # 3-D pod-major view: [pods, hosts_per_pod·rows_per_host, row_len].
-        # Slot s = pod·H + host, so this reshape keeps every band in place.
-        pod_rows = global_rows.reshape(
+        owner_sh, after_dcn_sh, repl_sh = _stage_shardings(self.mesh)
+        pool_shape = (
             self.n_pods,
             self.hosts_per_pod * layout.rows_per_host,
             layout.row_len,
         )
-
-        owner_sh, after_dcn_sh, repl_sh = _stage_shardings(self.mesh)
-        pool = jax.device_put(pod_rows, owner_sh)
+        if jax.process_count() == 1:
+            global_rows = pack_global_rows(
+                layout, flat, fetch_fn, 0 if slot is None else slot,
+                local_shards,
+            )
+            # 3-D pod-major view: slot s = pod·H + host, so the reshape
+            # keeps every band in place.
+            pool = jax.device_put(global_rows.reshape(pool_shape), owner_sh)
+        else:
+            # Multi-process: device (p, h)'s shard of the owner-sharded
+            # pool is exactly slot (p·H + h)'s band — build each
+            # addressable device's shard locally, no global assembly.
+            R = layout.rows_per_host
+            mesh_devs = np.asarray(self.mesh.devices)
+            shards = []
+            for p in range(self.n_pods):
+                for h in range(self.hosts_per_pod):
+                    dev = mesh_devs[p, h]
+                    if dev.process_index != jax.process_index():
+                        continue
+                    s = p * self.hosts_per_pod + h
+                    band = pack_rows(
+                        layout, fetch_owned_blobs(flat, fetch_fn, s), s
+                    )
+                    shards.append(jax.device_put(band[None], dev))
+            pool = jax.make_array_from_single_device_arrays(
+                pool_shape, owner_sh, shards
+            )
         pool.block_until_ready()
 
         t0 = time.perf_counter()

@@ -51,6 +51,28 @@ class FetchAssignment:
         return self.fetch_info.url_range_end - self.fetch_info.url_range_start
 
 
+def collect_units(
+    recs: list[Reconstruction],
+) -> list[tuple[tuple[str, int], FetchInfo]]:
+    """Deduplicated, sorted fetch units for a set of reconstructions.
+
+    Chunk-level dedup: a xorb range shared across files (or repeated
+    terms) is fetched exactly once. Keeps the widest entry per start — a
+    narrower duplicate would leave later readers short of chunks. Shared
+    by every planner (flat, hierarchical, expert-routed) so ownership
+    policies differ without re-collecting.
+    """
+    units: dict[tuple[str, int], FetchInfo] = {}
+    for rec in recs:
+        for hash_hex, entries in rec.fetch_info.items():
+            for fi in entries:
+                key = (hash_hex, fi.range.start)
+                prev = units.get(key)
+                if prev is None or fi.range.end > prev.range.end:
+                    units[key] = fi
+    return sorted(units.items())
+
+
 @dataclass
 class DistributionPlan:
     """The pod-wide fetch schedule for a set of files.
@@ -69,18 +91,6 @@ class DistributionPlan:
 
     @staticmethod
     def build(recs: list[Reconstruction], num_hosts: int) -> "DistributionPlan":
-        units: dict[tuple[str, int], FetchInfo] = {}
-        for rec in recs:
-            for hash_hex, entries in rec.fetch_info.items():
-                for fi in entries:
-                    # Chunk-level dedup: a xorb range shared across files
-                    # (or repeated terms) is fetched exactly once. Keep the
-                    # widest entry for a start — a narrower duplicate would
-                    # leave later readers short of chunks.
-                    key = (hash_hex, fi.range.start)
-                    prev = units.get(key)
-                    if prev is None or fi.range.end > prev.range.end:
-                        units[key] = fi
         assignments = [
             FetchAssignment(
                 hash_hex=hh,
@@ -89,7 +99,7 @@ class DistributionPlan:
                     hashing.hex_to_hash(hh), start, num_hosts
                 ),
             )
-            for (hh, start), fi in sorted(units.items())
+            for (hh, start), fi in collect_units(recs)
         ]
         return DistributionPlan(num_hosts, assignments)
 
