@@ -129,3 +129,82 @@ class TestExtHandshake:
     def test_garbage_rejected(self):
         with pytest.raises(bep_xet.XetMessageError):
             bep_xet.parse_ext_handshake(b"not bencode at all \xff")
+
+
+# ── native one-pass framer parity (zest_tpu/native/wire.cc) ──
+
+
+def test_encode_framed_matches_pure_concat():
+    """The native framer must be byte-identical to the pure chain
+    wire.encode_extended(ext, bep_xet.encode(msg)) for every message kind
+    it accelerates — and decode back to the original message."""
+    from zest_tpu.native import lib
+    from zest_tpu.p2p import bep_xet, wire
+
+    h = bytes(range(32))
+    msgs = [
+        bep_xet.ChunkRequest(0xABCDEF01, h, 3, 900),
+        bep_xet.ChunkResponse(7, 12, b"\x00\x01" * 40_000),
+        bep_xet.ChunkResponse(8, 0, b""),
+        bep_xet.ChunkNotFound(0xFFFFFFFF, h),
+    ]
+    for m in msgs:
+        pure = wire.encode_extended(9, bep_xet.encode(m))
+        framed = bep_xet.encode_framed(9, m)
+        assert framed == pure, type(m).__name__
+        # roundtrip through the decoders
+        length = wire.decode_message_header(framed[:4])
+        assert length == len(framed) - 4
+        ext_id, sub = wire.parse_extended(framed[5:])
+        assert ext_id == 9
+        assert bep_xet.decode(sub) == m
+    assert lib.available(), "native lib should compile in this image"
+
+
+def test_encode_framed_error_falls_back_to_pure():
+    from zest_tpu.p2p import bep_xet, wire
+
+    m = bep_xet.ChunkError(3, 42, b"boom")
+    assert bep_xet.encode_framed(5, m) == wire.encode_extended(
+        5, bep_xet.encode(m)
+    )
+
+
+def test_encode_framed_validates_hash_length():
+    import pytest
+
+    from zest_tpu.p2p import bep_xet
+
+    with pytest.raises(bep_xet.XetMessageError):
+        bep_xet.encode_framed(1, bep_xet.ChunkRequest(1, b"short", 0, 1))
+
+
+def test_encode_framed_rejects_out_of_range_fields():
+    """ctypes would silently truncate (c_uint8(300) → 44) where the pure
+    path raises — the framed encoder must fail loudly first."""
+    import pytest
+
+    from zest_tpu.p2p import bep_xet, wire
+
+    h = bytes(32)
+    with pytest.raises(bep_xet.XetMessageError, match="ext_id"):
+        bep_xet.encode_framed(300, bep_xet.ChunkNotFound(1, h))
+    with pytest.raises(bep_xet.XetMessageError, match="request_id"):
+        bep_xet.encode_framed(1, bep_xet.ChunkNotFound(-1, h))
+    with pytest.raises(bep_xet.XetMessageError, match="request_id"):
+        bep_xet.encode_framed(1, bep_xet.ChunkNotFound(1 << 32, h))
+    with pytest.raises(bep_xet.XetMessageError, match="chunk_offset"):
+        bep_xet.encode_framed(1, bep_xet.ChunkResponse(1, -5, b"x"))
+    with pytest.raises(bep_xet.XetMessageError, match="range"):
+        bep_xet.encode_framed(1, bep_xet.ChunkRequest(1, h, 0, 1 << 33))
+
+
+def test_encode_framed_enforces_message_cap(monkeypatch):
+    import pytest
+
+    from zest_tpu.p2p import bep_xet, wire
+
+    # Shrink the cap rather than allocating 64 MiB in a unit test.
+    monkeypatch.setattr(wire, "MAX_MESSAGE_SIZE", 1024)
+    with pytest.raises(wire.WireError, match="too large"):
+        bep_xet.encode_framed(1, bep_xet.ChunkResponse(1, 0, b"x" * 2048))

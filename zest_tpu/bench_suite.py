@@ -99,6 +99,27 @@ def bench_wire_frame(iters: int = 5000) -> BenchResult:
     return _time_fn("bt_wire_frame", roundtrip, 1024 + 5, iters)
 
 
+def bench_wire_frame_native(iters: int = 2000) -> BenchResult:
+    """One-pass CHUNK_RESPONSE framing (native/wire.cc) on a 64 KiB blob —
+    the serving hot loop's actual workload (reference bt_wire_frame is a
+    1 KiB header roundtrip; this measures data-bearing frames). Requires
+    the native lib: reporting the pure fallback under this label would be
+    a silently wrong comparison."""
+    from zest_tpu.native import lib
+    from zest_tpu.p2p import bep_xet
+
+    if not lib.available():
+        raise RuntimeError("native lib unavailable; xet_frame_64kb skipped")
+    data = b"z" * 65536
+    msg = bep_xet.ChunkResponse(1, 0, data)
+    return _time_fn(
+        "xet_frame_64kb",
+        lambda: bep_xet.encode_framed(3, msg),
+        65536 + 19,
+        iters,
+    )
+
+
 # ── Device benches (TPU-native; no reference counterpart) ──
 
 
@@ -148,6 +169,10 @@ def run_synthetic(device: bool = True) -> list[BenchResult]:
     results = bench_bencode()
     results += [bench_blake3_host(), bench_sha1_info_hash(),
                 bench_wire_frame()]
+    try:
+        results.append(bench_wire_frame_native())
+    except RuntimeError:
+        pass  # no native lib: the pure benches above still stand
     if device:
         try:
             results.append(bench_blake3_device())

@@ -19,7 +19,7 @@ from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
 _BUILD_DIR = _HERE / "build"
-_SOURCES = ["blake3.cc", "gearhash.cc", "lz4.cc"]
+_SOURCES = ["blake3.cc", "gearhash.cc", "lz4.cc", "wire.cc"]
 
 _lock = threading.Lock()
 _dll: ctypes.CDLL | None = None
@@ -67,6 +67,17 @@ def _load() -> ctypes.CDLL | None:
             dll = ctypes.CDLL(str(so))
         except OSError:
             return None
+        try:
+            _bind(dll)
+        except AttributeError:
+            # A stale .so missing newer symbols must degrade to the pure
+            # path, not crash every native caller through available().
+            return None
+        _dll = dll
+        return _dll
+
+
+def _bind(dll: ctypes.CDLL) -> None:
         dll.zest_blake3.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
         ]
@@ -97,8 +108,22 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t
         ]
         dll.zest_lz4_decompress.restype = ctypes.c_size_t
-        _dll = dll
-        return _dll
+        dll.zest_wire_response_size.argtypes = [ctypes.c_size_t]
+        dll.zest_wire_response_size.restype = ctypes.c_size_t
+        dll.zest_wire_frame_chunk_response.argtypes = [
+            ctypes.c_uint8, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        dll.zest_wire_frame_chunk_response.restype = ctypes.c_size_t
+        dll.zest_wire_frame_chunk_request.argtypes = [
+            ctypes.c_uint8, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p,
+        ]
+        dll.zest_wire_frame_chunk_request.restype = ctypes.c_size_t
+        dll.zest_wire_frame_chunk_not_found.argtypes = [
+            ctypes.c_uint8, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        dll.zest_wire_frame_chunk_not_found.restype = ctypes.c_size_t
 
 
 _gear_array = None
@@ -170,6 +195,39 @@ class lib:
         n = dll.zest_lz4_compress(data, len(data), out, cap)
         if n == 0 and len(data) > 0:
             raise RuntimeError("native lz4 compress failed")
+        return out.raw[:n]
+
+    @staticmethod
+    def frame_chunk_response(ext_id: int, req_id: int, chunk_offset: int,
+                             data: bytes) -> bytes:
+        """Complete framed BEP10+XET CHUNK_RESPONSE in one pass."""
+        dll = _load()
+        out = ctypes.create_string_buffer(
+            dll.zest_wire_response_size(len(data))
+        )
+        n = dll.zest_wire_frame_chunk_response(
+            ext_id, req_id, chunk_offset, data, len(data), out
+        )
+        return out.raw[:n]
+
+    @staticmethod
+    def frame_chunk_request(ext_id: int, req_id: int, chunk_hash: bytes,
+                            range_start: int, range_end: int) -> bytes:
+        dll = _load()
+        out = ctypes.create_string_buffer(51)
+        n = dll.zest_wire_frame_chunk_request(
+            ext_id, req_id, chunk_hash, range_start, range_end, out
+        )
+        return out.raw[:n]
+
+    @staticmethod
+    def frame_chunk_not_found(ext_id: int, req_id: int,
+                              chunk_hash: bytes) -> bytes:
+        dll = _load()
+        out = ctypes.create_string_buffer(43)
+        n = dll.zest_wire_frame_chunk_not_found(
+            ext_id, req_id, chunk_hash, out
+        )
         return out.raw[:n]
 
     @staticmethod

@@ -150,12 +150,10 @@ class BtPeer:
 
     def _send_request(self, rid: int, chunk_hash: bytes,
                       range_start: int, range_end: int) -> None:
-        payload = bep_xet.encode_chunk_request(
-            bep_xet.ChunkRequest(rid, chunk_hash, range_start, range_end)
-        )
-        self.stream.send_raw(
-            wire.encode_extended(self.peer_ut_xet_id, payload)
-        )
+        self.stream.send_raw(bep_xet.encode_framed(
+            self.peer_ut_xet_id,
+            bep_xet.ChunkRequest(rid, chunk_hash, range_start, range_end),
+        ))
 
     def _recv_response(self, expect_rid: int) -> ChunkResult:
         while True:

@@ -171,20 +171,19 @@ class BtServer:
             self._respond(stream, ext_id, req.request_id, offset, blob)
             return
 
-        stream.send_raw(wire.encode_extended(
+        stream.send_raw(bep_xet.encode_framed(
             ext_id,
-            bep_xet.encode_chunk_not_found(
-                bep_xet.ChunkNotFound(req.request_id, req.chunk_hash)
-            ),
+            bep_xet.ChunkNotFound(req.request_id, req.chunk_hash),
         ))
 
     def _respond(self, stream, ext_id: int, request_id: int,
                  chunk_offset: int, data: bytes) -> None:
-        stream.send_raw(wire.encode_extended(
+        # encode_framed copies the chunk data once (native framer) instead
+        # of three times through the pure concat chain — the serving hot
+        # loop's analog of the reference's bt_wire fast path.
+        stream.send_raw(bep_xet.encode_framed(
             ext_id,
-            bep_xet.encode_chunk_response(
-                bep_xet.ChunkResponse(request_id, chunk_offset, data)
-            ),
+            bep_xet.ChunkResponse(request_id, chunk_offset, data),
         ))
         with self._stats_lock:
             self._chunks_served += 1
