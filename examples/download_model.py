@@ -1,0 +1,47 @@
+"""Pull a model through the swarm, then verify it loads.
+
+The reference's example (examples/download_model.py) pulls gpt2 via
+zest.pull() and checks it with transformers; this is the same flow on
+zest-tpu. Run against the real Hub (needs network + HF_TOKEN for Xet
+repos), or against the loopback fixture hub for an offline demo:
+
+    python scripts/fixture_hub.py --url-file /tmp/hub.url &
+    HF_ENDPOINT=$(cat /tmp/hub.url) HF_TOKEN=hf_test \
+        python examples/download_model.py acme/loopback-model
+"""
+
+import sys
+
+import zest_tpu as zest
+
+
+def main() -> int:
+    repo = sys.argv[1] if len(sys.argv) > 1 else "openai-community/gpt2"
+    path = zest.pull(repo)
+    print(f"pulled {repo} -> {path}")
+
+    import json
+    from pathlib import Path
+
+    cfg = json.loads((Path(path) / "config.json").read_text())
+    if cfg.get("model_type") == "loopback":
+        print("fixture repo pulled OK (synthetic weights; skipping load)")
+        return 0
+    try:
+        from transformers import AutoModelForCausalLM, AutoTokenizer
+    except ImportError:
+        print("transformers not installed; skipping load check")
+        return 0
+    model = AutoModelForCausalLM.from_pretrained(path)
+    tok = AutoTokenizer.from_pretrained(path)
+    n_params = sum(p.numel() for p in model.parameters())
+    print(f"loaded: {n_params / 1e6:.1f}M parameters")
+    out = model.generate(
+        **tok("The quick brown", return_tensors="pt"), max_new_tokens=8
+    )
+    print("generate:", tok.decode(out[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

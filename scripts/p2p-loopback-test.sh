@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# Two-process P2P test on one machine — the reference's Docker 2-node
+# harness (test/local/p2p-docker-test.sh) without Docker: a seeder pulls
+# CDN-only from a loopback fixture hub and serves its cache; a leecher
+# with a separate cache pulls with --peer pointed at the seeder. PASS
+# requires >0 bytes from peers (the reference's gate, p2p-docker-test.sh:
+# 204-218); the fixture CDN stays reachable so the waterfall's fallback
+# is honest.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+ROOT=$(mktemp -d)
+REPO_ID="acme/loopback-model"
+LISTEN_PORT=${LISTEN_PORT:-16881}
+PIDS=()
+
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+  rm -rf "$ROOT"
+}
+trap cleanup EXIT
+
+say() { printf '\n=== %s ===\n' "$*"; }
+
+say "start fixture hub"
+python scripts/fixture_hub.py --url-file "$ROOT/hub.url" &
+PIDS+=($!)
+for _ in $(seq 1 50); do [ -s "$ROOT/hub.url" ] && break; sleep 0.2; done
+[ -s "$ROOT/hub.url" ] || { echo "hub did not start"; exit 1; }
+HUB_URL=$(cat "$ROOT/hub.url")
+echo "hub: $HUB_URL"
+
+common_env=(HF_ENDPOINT="$HUB_URL" HF_TOKEN=hf_test ZEST_NATIVE="${ZEST_NATIVE:-1}")
+
+say "seeder: CDN-only pull"
+env "${common_env[@]}" \
+    HF_HOME="$ROOT/seeder/hf" ZEST_CACHE_DIR="$ROOT/seeder/zest" \
+    python -m zest_tpu pull "$REPO_ID" --no-p2p --no-seed
+
+say "seeder: serve"
+env "${common_env[@]}" \
+    HF_HOME="$ROOT/seeder/hf" ZEST_CACHE_DIR="$ROOT/seeder/zest" \
+    ZEST_LISTEN_PORT="$LISTEN_PORT" ZEST_HTTP_PORT=19847 \
+    python -m zest_tpu serve --listen-port "$LISTEN_PORT" --http-port 19847 &
+PIDS+=($!)
+for _ in $(seq 1 50); do
+  python - "$LISTEN_PORT" <<'EOF' && break
+import socket, sys
+s = socket.socket()
+s.settimeout(0.3)
+try:
+    s.connect(("127.0.0.1", int(sys.argv[1])))
+except OSError:
+    raise SystemExit(1)
+finally:
+    s.close()
+EOF
+  sleep 0.2
+done
+
+say "leecher: pull with --peer"
+env "${common_env[@]}" \
+    HF_HOME="$ROOT/leecher/hf" ZEST_CACHE_DIR="$ROOT/leecher/zest" \
+    python -m zest_tpu pull "$REPO_ID" \
+      --peer "127.0.0.1:$LISTEN_PORT" --no-dht --no-seed \
+  | tee "$ROOT/leecher.out"
+
+say "verify"
+PEER_BYTES=$(sed -n 's/.*From peers: \([0-9]*\) bytes.*/\1/p' "$ROOT/leecher.out")
+CDN_BYTES=$(sed -n 's/.*From CDN: *\([0-9]*\) bytes.*/\1/p' "$ROOT/leecher.out")
+echo "peer bytes: ${PEER_BYTES:-0}, cdn bytes: ${CDN_BYTES:-0}"
+if [ -z "${PEER_BYTES:-}" ] || [ "$PEER_BYTES" -eq 0 ]; then
+  echo "FAIL: no bytes served by the peer"
+  exit 1
+fi
+# byte-identical files on both sides
+python - "$ROOT" <<'EOF'
+import sys
+from pathlib import Path
+
+root = Path(sys.argv[1])
+def snapshot_file(side):
+    hits = sorted((root / side / "hf").rglob("model.safetensors"))
+    assert hits, f"no snapshot for {side}"
+    return hits[0].read_bytes()
+
+assert snapshot_file("seeder") == snapshot_file("leecher"), "payload mismatch"
+print("payloads byte-identical")
+EOF
+echo "PASS: leecher fetched ${PEER_BYTES} bytes from the peer"
