@@ -237,7 +237,7 @@ def test_distribute_fill_cache_feeds_waterfall(tmp_config):
         plan, fetch, host=0, local_shards=shards
     )
     cache = XorbCache(tmp_config)
-    assert pool.fill_cache(cache) == len(plan.assignments)
+    assert pool.fill_cache(cache) == (len(plan.assignments), 0)
     for a in plan.assignments:
         got = cache.get_with_range(a.hash_hex, a.fetch_info.range.start)
         assert got is not None and got.data == repo.xorbs[a.hash_hex].blob

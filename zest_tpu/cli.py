@@ -113,8 +113,10 @@ def cmd_pull(args) -> int:
                   file=sys.stderr)
     from zest_tpu.transfer.pull import pull_model
 
+    pod = True if args.pod else (False if args.no_pod else None)
     res = pull_model(cfg, args.repo, revision=args.revision,
-                     device=args.device, swarm=swarm, no_p2p=args.no_p2p)
+                     device=args.device, swarm=swarm, no_p2p=args.no_p2p,
+                     pod=pod)
     print(f"✓ {args.repo} -> {res.snapshot_dir}")
     _print_pull_stats(res.stats)
     if not args.no_seed:
@@ -132,6 +134,10 @@ def _print_pull_stats(stats: dict) -> None:
         print(f"  From CDN:   {nbytes.get('cdn', 0)} bytes")
         print(f"  P2P ratio:  {fetch.get('p2p_ratio', 0.0):.1%}")
     print(f"  Elapsed:    {stats.get('elapsed_s', 0)}s")
+    if "pod" in stats and not stats["pod"].get("skipped"):
+        p = stats["pod"]
+        print(f"  Pod round:  {p['filled']}/{p['units']} units over "
+              f"{p['slots']} slots, gather {p['gather_s']}s")
     if "hbm" in stats:
         h = stats["hbm"]
         print(f"  HBM commit: {h['tensors']} tensors, {h['bytes']} bytes "
@@ -282,6 +288,13 @@ def build_parser() -> argparse.ArgumentParser:
                       help="skip DHT discovery (direct peers/tracker only)")
     pull.add_argument("--no-seed", action="store_true",
                       help="don't auto-start the seeding daemon after pull")
+    pod_group = pull.add_mutually_exclusive_group()
+    pod_group.add_argument("--pod", action="store_true",
+                           help="run the pod distribution round (default "
+                                "with --device=tpu; one collective fetch "
+                                "per mesh)")
+    pod_group.add_argument("--no-pod", action="store_true",
+                           help="skip the pod round even with --device=tpu")
     pull.add_argument("--http-port", type=int, default=None)
     pull.set_defaults(fn=cmd_pull)
 

@@ -124,19 +124,39 @@ def pack_rows(
     return out
 
 
+FETCH_WORKERS = 16  # matches the reference's 16-way concurrent fetcher
+                    # (default_max_concurrent_downloads, config.zig:13)
+
+
 def fetch_owned_blobs(
-    plan: DistributionPlan, fetch_fn, slot: int
+    plan: DistributionPlan, fetch_fn, slot: int,
+    workers: int = FETCH_WORKERS,
 ) -> dict[tuple[str, int], bytes]:
-    """Fetch every unit ``slot`` owns; a failed fetch leaves its key out
-    (→ zero row → CDN fallback downstream). One bad unit must never abort
-    a round or strand a multi-host collective."""
+    """Fetch every unit ``slot`` owns, ``workers``-way concurrent (the
+    units are CDN/disk reads — I/O bound). A failed fetch leaves its key
+    out (→ zero row → CDN fallback downstream): one bad unit must never
+    abort a round or strand a multi-host collective."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    owned = plan.for_host(slot)
     blobs: dict[tuple[str, int], bytes] = {}
-    for a in plan.for_host(slot):
-        key = (a.hash_hex, a.fetch_info.range.start)
+    if not owned:
+        return blobs
+
+    def one(a):
         try:
-            blobs[key] = fetch_fn(a)
+            return (a.hash_hex, a.fetch_info.range.start), fetch_fn(a)
         except Exception:
-            continue
+            return None
+
+    if len(owned) == 1 or workers <= 1:
+        results = map(one, owned)
+    else:
+        with ThreadPoolExecutor(min(workers, len(owned))) as pool:
+            results = list(pool.map(one, owned))
+    for r in results:
+        if r is not None:
+            blobs[r[0]] = r[1]
     return blobs
 
 
@@ -144,20 +164,25 @@ def pack_global_rows(
     layout: PoolLayout,
     plan: DistributionPlan,
     fetch_fn,
-    slot: int,
+    slot: int | None,
     local_shards: dict[int, dict[tuple[str, int], bytes]] | None = None,
 ) -> np.ndarray:
-    """Single-process pool assembly: fetch ``slot``'s own band, take other
-    slots' bands from ``local_shards`` (tests / simulation), zero-fill the
-    rest. Shared by the flat and hierarchical distributors."""
+    """Single-process pool assembly, shared by the flat and hierarchical
+    distributors.
+
+    ``slot=None`` means this process is the sole controller of every mesh
+    slot (one host driving N chips) and fetches every slot's band itself.
+    An explicit ``slot`` simulates one host of a multi-host pod: only that
+    band is fetched, other slots come from ``local_shards`` (tests) or
+    stay zero (→ waterfall fallback downstream)."""
     bands = []
     for h in range(plan.num_hosts):
-        if h == slot:
+        if local_shards and h in local_shards:
+            bands.append(pack_rows(layout, local_shards[h], h))
+        elif slot is None or h == slot:
             bands.append(
                 pack_rows(layout, fetch_owned_blobs(plan, fetch_fn, h), h)
             )
-        elif local_shards and h in local_shards:
-            bands.append(pack_rows(layout, local_shards[h], h))
         else:
             bands.append(
                 np.zeros((layout.rows_per_host, layout.row_len), np.uint8)
@@ -196,11 +221,16 @@ class GatheredPool:
             return None
         return raw[_LEN_HEADER : _LEN_HEADER + n].tobytes(), chunk_offset
 
-    def fill_cache(self, cache) -> int:
+    def fill_cache(self, cache, verify=None) -> tuple[int, int]:
         """Seed a range-aware cache (disk/HBM/tiered) with every gathered
         blob — after this, the waterfall's tier-1 lookup hits locally and
-        the P2P byte ratio goes to 1.0 for planned units."""
-        filled = 0
+        the P2P byte ratio goes to 1.0 for planned units.
+
+        ``verify(hash_hex, data)`` optionally gates *full-xorb* writes
+        (partial blobs carry per-chunk hashes in their frames, checked at
+        extraction). Returns (filled, rejected).
+        """
+        filled = rejected = 0
         for (hash_hex, range_start) in self.layout.index:
             got = self.blob(hash_hex, range_start)
             if got is None:
@@ -210,11 +240,14 @@ class GatheredPool:
             # (layout.full_xorbs); an offset-0 slice cached as full would
             # poison later range reads (same rule as bridge._cache_fetched).
             if chunk_offset == 0 and hash_hex in self.layout.full_xorbs:
+                if verify is not None and not verify(hash_hex, data):
+                    rejected += 1
+                    continue
                 cache.put(hash_hex, data)
             else:
                 cache.put_partial(hash_hex, chunk_offset, data)
             filled += 1
-        return filled
+        return filled, rejected
 
 
 class PodDistributor:
@@ -252,9 +285,14 @@ class PodDistributor:
         host: int | None = None,
         local_shards: dict[int, dict[tuple[str, int], bytes]] | None = None,
     ) -> GatheredPool:
-        """Run the round. Single-process meshes simulate all pod slots
-        (``local_shards`` may pre-supply other slots' blobs in tests);
-        multi-process, each process packs only its own band.
+        """Run the round.
+
+        Single-process: ``host=None`` (default) fetches every slot's band
+        — the sole-controller case (one host, N chips); an explicit
+        ``host`` simulates one host of a multi-host pod, with
+        ``local_shards`` optionally pre-supplying other slots (tests).
+        Multi-process: each process packs only the bands of slots whose
+        devices it addresses.
         """
         if plan.num_hosts != self._mesh_slots():
             raise ValueError(
@@ -271,7 +309,7 @@ class PodDistributor:
         if jax.process_count() == 1:
             global_rows = pack_global_rows(
                 layout, plan, fetch_fn,
-                0 if host is None else host, local_shards,
+                host, local_shards,
             )
             sharded = jax.device_put(
                 global_rows, row_sharded(self.mesh, self.axis)

@@ -209,7 +209,7 @@ class HierarchicalDistributor:
         )
         if jax.process_count() == 1:
             global_rows = pack_global_rows(
-                layout, flat, fetch_fn, 0 if slot is None else slot,
+                layout, flat, fetch_fn, slot,
                 local_shards,
             )
             # 3-D pod-major view: slot s = pod·H + host, so the reshape

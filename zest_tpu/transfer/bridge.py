@@ -115,6 +115,7 @@ class XetBridge:
         self.swarm = swarm
         self.cas: CasClient | None = None
         self.stats = FetchStats()
+        self._recons: dict[str, recon.Reconstruction] = {}
 
     # ── Auth (reference: xet_bridge.zig:76-130) ──
 
@@ -125,9 +126,17 @@ class XetBridge:
         self.cas = CasClient(cas_url, access_token)
 
     def get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
+        """Memoized per bridge: the pod pre-pass plans from the same
+        reconstructions the per-file loop consumes moments later, and a
+        pull session's reconstructions are immutable (content-addressed),
+        so each file costs one CAS round-trip total."""
         if self.cas is None:
             raise NotAuthenticated("call authenticate() first")
-        return self.cas.get_reconstruction(file_hash_hex)
+        cached = self._recons.get(file_hash_hex)
+        if cached is None:
+            cached = self.cas.get_reconstruction(file_hash_hex)
+            self._recons[file_hash_hex] = cached
+        return cached
 
     # ── The waterfall (reference: xet_bridge.zig:149-218) ──
 
@@ -193,6 +202,51 @@ class XetBridge:
             term.range.start - fi.range.start,
             term.range.end - fi.range.start,
         )
+
+    def fetch_unit(self, hash_hex: str, fi: recon.FetchInfo) -> bytes:
+        """Raw blob for one fetch unit (a fetch_info chunk range) through
+        the same waterfall tiers, without term rebasing — the fetch_fn the
+        pod distribution round hands to PodDistributor (owners source
+        their assigned units here, then the ICI all-gather carries them
+        to everyone)."""
+        cached = self.cache.get_with_range(hash_hex, fi.range.start)
+        if cached is not None and cached.chunk_offset <= fi.range.start:
+            lo = fi.range.start - cached.chunk_offset
+            hi = fi.range.end - cached.chunk_offset
+            if _blob_covers(cached.data, lo, hi):
+                self.stats.record("cache", len(cached.data))
+                if lo == 0:
+                    return cached.data
+                # Covering entry at a lower offset (e.g. the full xorb
+                # from an earlier pull): re-frame just the unit's range so
+                # the gathered row starts exactly at fi.range.start.
+                return XorbReader(cached.data).slice_range(lo, hi)
+
+        if self.swarm is not None:
+            xorb_hash = None
+            try:
+                from zest_tpu.cas import hashing
+                xorb_hash = hashing.hex_to_hash(hash_hex)
+            except ValueError:
+                pass
+            if xorb_hash is not None:
+                peer_result = self.swarm.try_peer_download(
+                    xorb_hash, hash_hex, fi.range.start, fi.range.end
+                )
+                if peer_result is not None \
+                        and peer_result.chunk_offset == fi.range.start \
+                        and _blob_covers(peer_result.data, 0,
+                                         fi.range.end - fi.range.start):
+                    self.stats.record("peer", len(peer_result.data))
+                    return peer_result.data
+
+        if self.cas is None:
+            raise NotAuthenticated("no CAS client and no peers had the xorb")
+        data = self.cas.fetch_xorb_from_url(
+            self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
+        )
+        self.stats.record("cdn", len(data))
+        return data
 
     def _cache_fetched(self, rec: recon.Reconstruction, hash_hex: str,
                        chunk_offset: int, data: bytes) -> None:

@@ -43,6 +43,7 @@ def pull_model(
     device: str | None = None,
     swarm=None,
     no_p2p: bool = False,
+    pod: bool | None = None,
     log=print,
 ) -> PullResult:
     t0 = time.monotonic()
@@ -57,6 +58,40 @@ def pull_model(
     bridge = XetBridge(cfg, swarm=swarm)
     par = ParallelDownloader(bridge)
     authenticated = False
+
+    # Pod pre-pass (BASELINE config #3): one collective round fills the
+    # cache so the per-file loop below hits tier 1 for planned bytes.
+    # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
+    if pod is None:
+        import os
+
+        env = os.environ.get("ZEST_TPU_POD")
+        pod = env == "1" if env in ("0", "1") else device == "tpu"
+    pod_stats = None
+    if pod:
+        pending = [
+            e for e in files
+            if e.is_xet and not (
+                (snapshot_dir / e.path).exists()
+                and (snapshot_dir / e.path).stat().st_size == e.size
+            )
+        ]
+        if pending:
+            try:
+                bridge.authenticate(repo_id, revision, hub=hub)
+                authenticated = True
+                recs = [bridge.get_reconstruction(e.xet_hash)
+                        for e in pending]
+                from zest_tpu.transfer.pod import pod_round
+
+                # Byte distribution always runs over the 1-D pod mesh
+                # (pod_round's default) — the N-D model mesh from config
+                # is for checkpoint *landing*, not for moving bytes.
+                pod_stats = pod_round(bridge, recs, log=lambda m: log(m))
+            except Exception as exc:  # noqa: BLE001 - round is an accelerator
+                log(f"pod round unavailable ({exc}); "
+                    "continuing with the per-host waterfall",
+                    file=sys.stderr)
 
     downloaded = skipped = 0
     for entry in files:
@@ -86,6 +121,8 @@ def pull_model(
         "elapsed_s": round(elapsed, 3),
         "fetch": bridge.stats.summary(),
     }
+    if pod_stats is not None:
+        stats["pod"] = pod_stats
     if swarm is not None:
         stats["swarm"] = swarm.stats.summary()
 
