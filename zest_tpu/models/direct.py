@@ -1,0 +1,164 @@
+"""Direct-to-HBM landing: cached xorb units → device arrays, no file.
+
+The reference always reassembles files on disk and lets torch read them
+back (SURVEY.md §3.1) — a full extra write+read of every checkpoint byte.
+The north-star path skips it (SURVEY.md §7 hard part #2): the safetensors
+header maps tensor names to file byte ranges, reconstruction terms map
+file ranges to cached chunk ranges, so a tensor's bytes can be decoded
+straight out of the (gathered, verified) xorb cache into a host buffer
+and committed to its pjit layout — the only disk artifacts are the
+content-addressed cache entries the host was seeding anyway.
+
+This is also what makes expert-sharded landing (BASELINE config #4) pay
+off: a host lands *only* the tensors its shards consume; nothing forces
+it to materialize other experts' bytes just to write a complete file.
+"""
+
+from __future__ import annotations
+
+from zest_tpu.cas import reconstruction as recon
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.models.safetensors_io import SafetensorsHeader
+
+
+class DirectLandingError(RuntimeError):
+    pass
+
+
+class CachedFileReader:
+    """Random-access byte reads over a file that exists only as cached
+    xorb units + a reconstruction.
+
+    Decoded terms are memoized (most tensors span few terms, and adjacent
+    tensors share boundary terms — without memoization every boundary
+    chunk would be decompressed twice).
+    """
+
+    def __init__(self, cache, rec: recon.Reconstruction):
+        self.cache = cache
+        self.rec = rec
+        self._spans: list[tuple[int, int, recon.Term]] = []
+        off = 0
+        for t in rec.terms:
+            self._spans.append((off, off + t.unpacked_length, t))
+            off += t.unpacked_length
+        self.size = off
+        self._term_bytes: dict[int, bytes] = {}
+
+    def _decode_term(self, i: int) -> bytes:
+        data = self._term_bytes.get(i)
+        if data is not None:
+            return data
+        _lo, _hi, term = self._spans[i]
+        fi = self.rec.find_fetch_info(term)
+        if fi is None:
+            raise DirectLandingError(
+                f"no fetch_info covers term {term.hash_hex}"
+            )
+        entry = self.cache.get_with_range(term.hash_hex, fi.range.start)
+        if entry is None:
+            raise DirectLandingError(
+                f"unit {term.hash_hex}[{fi.range.start},{fi.range.end}) "
+                "not in cache — run the distribution round first"
+            )
+        local_start = term.range.start - entry.chunk_offset
+        local_end = term.range.end - entry.chunk_offset
+        data = XorbReader(entry.data).extract_chunk_range(
+            local_start, local_end
+        )
+        if len(data) != term.unpacked_length:
+            raise DirectLandingError(
+                f"term decoded to {len(data)} bytes, expected "
+                f"{term.unpacked_length}"
+            )
+        self._term_bytes[i] = data
+        return data
+
+    def read(self, lo: int, hi: int) -> bytes:
+        """Bytes [lo, hi) of the reconstructed file."""
+        if not 0 <= lo <= hi <= self.size:
+            raise DirectLandingError(
+                f"read [{lo},{hi}) outside file of {self.size} bytes"
+            )
+        parts: list[bytes] = []
+        for i, (t_lo, t_hi, _term) in enumerate(self._spans):
+            if t_hi <= lo:
+                continue
+            if t_lo >= hi:
+                break
+            data = self._decode_term(i)
+            parts.append(data[max(lo, t_lo) - t_lo : min(hi, t_hi) - t_lo])
+        return b"".join(parts)
+
+    def drop_memo(self) -> None:
+        self._term_bytes.clear()
+
+
+def land_tensors(
+    cache,
+    rec: recon.Reconstruction,
+    header: SafetensorsHeader,
+    predicate=None,
+):
+    """Decode selected tensors of one safetensors file from the cache.
+
+    Returns name → np.ndarray (host buffers, zero file I/O beyond the
+    cache). ``predicate(name)`` filters — the expert-sharded landing
+    passes "is this tensor shared or one of my experts?". Callers commit
+    the arrays with models.loader.land_tensor / jax.device_put.
+    """
+    import numpy as np
+
+    reader = CachedFileReader(cache, rec)
+    out: dict[str, np.ndarray] = {}
+    for name, info in header.tensors.items():
+        if predicate is not None and not predicate(name):
+            continue
+        lo, hi = info.file_range(header.data_start)
+        raw = reader.read(lo, hi)
+        out[name] = np.frombuffer(raw, dtype=info.np_dtype).reshape(
+            info.shape
+        )
+    reader.drop_memo()
+    return out
+
+
+def land_moe_expert_sharded(
+    cache,
+    recs_with_headers: list[tuple[recon.Reconstruction, SafetensorsHeader]],
+    moe_cfg,
+    mesh,
+    placement,
+    dtype=None,
+):
+    """Land a Mixtral-family checkpoint expert-sharded into HBM.
+
+    Single-controller form (one process drives the mesh): all tensors are
+    decoded from the cache, stacked into the models.moe param tree, and
+    committed under ``param_specs`` — GSPMD slices the stacked expert
+    leaves over the ``expert`` axis in exactly the blocks
+    ``ExpertPlacement`` routed bytes for, so every expert's weights land
+    on the host that fetched them. No reassembled file touches disk.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from zest_tpu.models import moe as moe_mod
+
+    if placement.n_experts != moe_cfg.n_experts:
+        raise DirectLandingError(
+            f"placement has {placement.n_experts} experts, "
+            f"config has {moe_cfg.n_experts}"
+        )
+    tensors: dict = {}
+    for rec, header in recs_with_headers:
+        tensors.update(land_tensors(cache, rec, header))
+    params = moe_mod.params_from_hf(
+        tensors, moe_cfg, dtype=dtype or jnp.float32
+    )
+    specs = moe_mod.param_specs(moe_cfg)
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda v: isinstance(v, P),
+    )

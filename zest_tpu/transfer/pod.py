@@ -44,7 +44,98 @@ def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher) -> bool:
     return hashing.hash_to_hex(hashing.xorb_hash(leaves)) == hash_hex
 
 
-def pod_round(bridge, recs, mesh=None, log=None) -> dict:
+def fetch_file_header(bridge, rec):
+    """Parse a safetensors header by fetching only the file's head terms.
+
+    Expert routing must know tensor byte ranges *before* the bulk fetch
+    (zest_tpu.parallel.expert); the header lives in the first few KB, so
+    this pulls terms through the waterfall until ``8 + header_len`` bytes
+    are decoded (each fetched blob lands in the cache and is reused by
+    the bulk round). Raises for files that are not safetensors.
+    """
+    import struct as _struct
+
+    from zest_tpu.models.safetensors_io import parse_header_prefix
+
+    buf = bytearray()
+    for term in rec.terms:
+        buf += bridge.fetch_term(term, rec)
+        if len(buf) >= 8:
+            (hlen,) = _struct.unpack_from("<Q", buf, 0)
+            if len(buf) >= 8 + hlen:
+                break
+    return parse_header_prefix(bytes(buf))
+
+
+def expert_pod_round(
+    bridge, file_maps, placement, mesh=None, log=None
+) -> dict:
+    """Expert-sharded distribution round (BASELINE config #4).
+
+    Shared (dense) units go through the normal all-gather round; units
+    feeding exactly one expert's tensors are fetched *only* by the
+    process that owns that expert's shard — never gathered, saving
+    (X-1)/X of expert-weight ICI traffic. Under a single controller that
+    means all expert units are fetched locally (it owns every shard);
+    multi-process, each process fetches its hosts' expert units.
+    """
+    from zest_tpu.parallel.expert import ExpertRoutedPlan
+
+    mesh = pod_mesh() if mesh is None else mesh
+    routed = ExpertRoutedPlan.build(file_maps, placement)
+
+    t0 = time.monotonic()
+    shared_stats = pod_round(bridge, [], mesh=mesh, log=None,
+                             _plan=routed.shared)
+
+    import jax
+
+    if jax.process_count() == 1:
+        my_hosts = range(placement.num_hosts)
+    else:
+        my_hosts = [
+            h for h in range(placement.num_hosts)
+            if h == jax.process_index()
+        ]
+    fetched = failed = expert_bytes = 0
+    for h in my_hosts:
+        for a in routed.expert_units.get(h, []):
+            try:
+                data = bridge.fetch_unit(a.hash_hex, a.fetch_info)
+            except Exception:
+                failed += 1
+                continue
+            fi = a.fetch_info
+            if fi.range.start == 0 and \
+                    _is_whole_xorb(file_maps, a.hash_hex, fi):
+                bridge.cache.put(a.hash_hex, data)
+            else:
+                bridge.cache.put_partial(a.hash_hex, fi.range.start, data)
+            fetched += 1
+            expert_bytes += len(data)
+
+    s = routed.summary()
+    return {
+        "shared": shared_stats,
+        "expert_units_fetched": fetched,
+        "expert_units_failed": failed,
+        "expert_bytes": expert_bytes,
+        "ici_bytes_saved": s["ici_bytes_saved"],
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+def _is_whole_xorb(file_maps, hash_hex: str, fi) -> bool:
+    """Full-cache-key evidence: the hash has exactly one fetch_info entry
+    across the files and it starts at chunk 0 (same rule as
+    bridge._cache_fetched)."""
+    entries = []
+    for fm in file_maps:
+        entries.extend(fm.rec.fetch_info.get(hash_hex, []))
+    return len(entries) == 1 and entries[0].range.start == 0
+
+
+def pod_round(bridge, recs, mesh=None, log=None, _plan=None) -> dict:
     """Run one distribution round for ``recs`` over ``mesh``.
 
     Single-slot meshes skip the collective entirely — the waterfall alone
@@ -53,7 +144,7 @@ def pod_round(bridge, recs, mesh=None, log=None) -> dict:
     """
     mesh = pod_mesh() if mesh is None else mesh
     n = num_slots(mesh)
-    plan = DistributionPlan.build(recs, n)
+    plan = _plan if _plan is not None else DistributionPlan.build(recs, n)
     if not plan.assignments or n <= 1:
         return {"slots": n, "units": len(plan.assignments), "skipped": True}
 
