@@ -250,6 +250,78 @@ def test_plan_mesh_size_mismatch_raises():
         PodDistributor(pod_mesh()).distribute(plan, lambda a: b"")
 
 
+# ── windowed waves: HBM-budgeted rounds ──
+
+
+def _unit(i, size, owner):
+    from zest_tpu.cas.reconstruction import ChunkRange, FetchInfo
+    from zest_tpu.parallel.plan import FetchAssignment
+
+    return FetchAssignment(
+        hash_hex=f"{i:064x}",
+        fetch_info=FetchInfo("/u", 0, size, ChunkRange(0, 1)),
+        owner=owner,
+    )
+
+
+def test_split_waves_bounds_pool_to_budget():
+    from zest_tpu.parallel import split_waves
+
+    plan = DistributionPlan(8, [_unit(i, 100_000, i % 8) for i in range(64)])
+    budget = 2 << 20
+    assert PoolLayout.from_plan(plan).pool_bytes > budget
+    waves = split_waves(plan, budget)
+    assert len(waves) > 1
+    got = []
+    for w in waves:
+        assert PoolLayout.from_plan(w).pool_bytes <= budget
+        got += [(a.hash_hex, a.fetch_info.range.start) for a in w.assignments]
+    # every unit appears in exactly one wave
+    want = [(a.hash_hex, a.fetch_info.range.start) for a in plan.assignments]
+    assert sorted(got) == sorted(want)
+
+
+def test_split_waves_buckets_mixed_sizes():
+    """One big unit among many small ones must not set the row capacity
+    for all of them (the ~600x pool inflation failure mode)."""
+    from zest_tpu.parallel import split_waves
+
+    units = [_unit(0, 8 << 20, 0)] + [
+        _unit(i + 1, 4096, i % 8) for i in range(80)
+    ]
+    plan = DistributionPlan(8, units)
+    waves = split_waves(plan, budget_bytes=64 << 20)
+    assert len(waves) == 2  # big unit isolated, small ones together
+    total = sum(PoolLayout.from_plan(w).pool_bytes for w in waves)
+    assert total < PoolLayout.from_plan(plan).pool_bytes / 10
+
+
+def test_split_waves_budget_zero_disables_windowing():
+    from zest_tpu.parallel import split_waves
+
+    plan = DistributionPlan(8, [_unit(i, 1000, i % 8) for i in range(10)])
+    assert split_waves(plan, 0) == [plan]
+
+
+def test_split_waves_oversized_unit_gets_own_wave():
+    from zest_tpu.parallel import split_waves
+
+    plan = DistributionPlan(8, [_unit(i, 1 << 20, i % 8) for i in range(4)])
+    waves = split_waves(plan, budget_bytes=1024)
+    assert len(waves) == 4
+    assert all(len(w.assignments) == 1 for w in waves)
+
+
+def test_split_waves_deterministic():
+    from zest_tpu.parallel import split_waves
+
+    units = [_unit(i, 1000 + 97 * (i % 7), i % 8) for i in range(40)]
+    a = split_waves(DistributionPlan(8, units), 1 << 20)
+    b = split_waves(DistributionPlan(8, list(reversed(units))), 1 << 20)
+    key = lambda w: [(x.hash_hex, x.owner) for x in w.assignments]  # noqa: E731
+    assert [key(w) for w in a] == [key(w) for w in b]
+
+
 # ── coordinator discovery ──
 
 

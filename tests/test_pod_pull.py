@@ -116,6 +116,77 @@ def test_device_verify_rejects_corrupt_xorb(hub, tmp_path):
     assert not _device_verify_full_xorb(b"garbage", hash_hex, hasher)
 
 
+def test_pod_round_windowed_waves_match_single_gather(hub, tmp_path):
+    """A budget far below the plan's pool forces multiple waves; each
+    wave's pool stays within budget and the cache ends up identical to
+    the single-gather round (the reference's bounded 128-term batching,
+    parallel_download.zig:117-131, as a collective)."""
+    from zest_tpu.parallel.collectives import PoolLayout
+    from zest_tpu.parallel.plan import DistributionPlan
+
+    cfg = _cfg(hub, tmp_path / "win")
+    bridge = _authed_bridge(hub, cfg)
+    recs = _recs(hub, bridge)
+    plan = DistributionPlan.build(recs, 8)
+    full_pool = PoolLayout.from_plan(plan).pool_bytes
+    biggest = max(
+        PoolLayout.from_plan(DistributionPlan(8, [a])).pool_bytes
+        for a in plan.assignments
+    )
+    budget = max(biggest, full_pool // 3)
+    assert budget < full_pool
+    stats = pod_round(bridge, recs, budget_bytes=budget)
+    assert stats["waves"] > 1
+    assert stats["pool_bytes"] <= budget
+    assert stats["filled"] == stats["units"]
+    assert stats["budget_bytes"] == budget
+
+    ref = _authed_bridge(hub, _cfg(hub, tmp_path / "one"))
+    ref_stats = pod_round(ref, _recs(hub, ref), budget_bytes=0)
+    assert ref_stats["waves"] == 1
+    for a in plan.assignments:
+        x = bridge.cache.get_with_range(a.hash_hex, a.fetch_info.range.start)
+        y = ref.cache.get_with_range(a.hash_hex, a.fetch_info.range.start)
+        assert x is not None and y is not None and x.data == y.data
+
+
+def test_device_verify_oversized_chunk_rejected_not_raised(hub):
+    """A peer-supplied blob with a chunk above the device hasher's leaf
+    cap (128 KiB) must count as a verify failure, not abort the round."""
+    from zest_tpu.cas import hashing
+    from zest_tpu.cas.xorb import XorbBuilder
+    from zest_tpu.ops import best_hasher
+
+    b = XorbBuilder()
+    b.add_chunk(bytes(200 * 1024))  # XorbReader-legal, hasher-illegal
+    blob = b.serialize()
+    hh = hashing.hash_to_hex(b.xorb_hash())
+    hasher = best_hasher(hashing.CHUNK_KEY)
+    assert _device_verify_full_xorb(blob, hh, hasher) is False
+
+
+def test_fetch_unit_slices_overwide_cached_blob(hub, tmp_path):
+    """A cached full xorb wider than a prefix unit is re-framed to the
+    unit's exact range — a wider blob would overflow its pool row and be
+    zero-rowed (refetching from CDN despite the local hit)."""
+    from zest_tpu.cas.reconstruction import ChunkRange, FetchInfo
+    from zest_tpu.cas.xorb import XorbReader
+
+    cfg = _cfg(hub, tmp_path)
+    bridge = XetBridge(cfg)  # no CAS auth: a CDN fallthrough would raise
+    repo = hub.repos["acme/pod-model"]
+    hash_hex, xf = next(
+        (h, x) for h, x in repo.xorbs.items()
+        if len(XorbReader(x.blob)) >= 2
+    )
+    bridge.cache.put(hash_hex, xf.blob)
+    fi = FetchInfo("/unused", 0, len(xf.blob), ChunkRange(0, 1))
+    got = bridge.fetch_unit(hash_hex, fi)
+    assert got == XorbReader(xf.blob).slice_range(0, 1)
+    assert len(got) < len(xf.blob)
+    assert bridge.stats.bytes_from_cache == len(got)
+
+
 def test_pod_round_failed_fetch_degrades(hub, tmp_path):
     """An owner whose fetch fails leaves a zero row; the following
     reconstruction falls through to CDN — no aborts."""

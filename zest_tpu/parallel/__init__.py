@@ -15,6 +15,7 @@ from zest_tpu.parallel.collectives import (  # noqa: F401
     PoolLayout,
     all_gather_throughput,
     pack_rows,
+    split_waves,
 )
 from zest_tpu.parallel.coordinator import (  # noqa: F401
     CoordinatorRegistry,

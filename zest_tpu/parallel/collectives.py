@@ -98,6 +98,56 @@ class PoolLayout:
         )
 
 
+def split_waves(
+    plan: DistributionPlan,
+    budget_bytes: int,
+    pad_factor: int = 4,
+) -> list[DistributionPlan]:
+    """Split a plan into waves whose staged pool fits an HBM budget.
+
+    The reference bounds in-flight memory by batching 128 terms at a time
+    (src/parallel_download.zig:117-131); the collective analog is bounding
+    each all-gather's pool. Two concerns, one mechanism: units are sorted
+    by descending size, so each wave's ``row_len`` is set by its first
+    unit and (a) the wave is closed before ``pool_bytes`` would exceed
+    ``budget_bytes``, (b) a unit needing less than ``row_len/pad_factor``
+    opens a fresh wave instead of paying >pad_factor× row padding (one
+    64 MiB xorb among thousands of 100 KB ranges would otherwise inflate
+    the pool ~600×). Deterministic: every host computes the same split
+    from the same plan, no negotiation. A single unit larger than the
+    budget still gets its own wave — it cannot be subdivided here.
+
+    ``budget_bytes <= 0`` disables windowing (one wave).
+    """
+    if budget_bytes <= 0 or len(plan.assignments) <= 1:
+        return [plan]
+    units = sorted(
+        plan.assignments,
+        key=lambda a: (-a.est_bytes, a.hash_hex, a.fetch_info.range.start),
+    )
+    waves: list[DistributionPlan] = []
+    cur: list[FetchAssignment] = []
+    counts: dict[int, int] = {}
+    rows_per_host = 0
+    row_len = 0
+    for a in units:
+        need = _round_up(_LEN_HEADER + a.est_bytes, _ROW_ALIGN)
+        if cur:
+            new_rows = max(rows_per_host, counts.get(a.owner, 0) + 1)
+            if (plan.num_hosts * new_rows * row_len > budget_bytes
+                    or need * pad_factor < row_len):
+                waves.append(DistributionPlan(plan.num_hosts, cur))
+                cur, counts, rows_per_host = [], {}, 0
+        if not cur:
+            row_len = need
+        cur.append(a)
+        counts[a.owner] = counts.get(a.owner, 0) + 1
+        rows_per_host = max(rows_per_host, counts[a.owner])
+    if cur:
+        waves.append(DistributionPlan(plan.num_hosts, cur))
+    return waves
+
+
 def pack_rows(
     layout: PoolLayout,
     blobs: dict[tuple[str, int], bytes],
@@ -268,14 +318,15 @@ class PodDistributor:
     def _mesh_slots(self) -> int:
         return int(self.mesh.shape[self.axis])
 
-    def _local_slots(self) -> list[int]:
+    def local_slots(self) -> list[int]:
         """Pod-axis slots backed by a device this process addresses."""
         k = list(self.mesh.axis_names).index(self.axis)
         by_slot = np.moveaxis(np.asarray(self.mesh.devices), k, 0)
+        by_slot = by_slot.reshape(by_slot.shape[0], -1)  # 1-axis mesh safe
         pid = jax.process_index()
         return [
             i for i in range(by_slot.shape[0])
-            if any(d.process_index == pid for d in by_slot[i].flat)
+            if any(d.process_index == pid for d in by_slot[i])
         ]
 
     def distribute(
@@ -323,7 +374,7 @@ class PodDistributor:
                 pack_rows(
                     layout, fetch_owned_blobs(plan, fetch_fn, slot), slot
                 )
-                for slot in self._local_slots()
+                for slot in self.local_slots()
             ]
             local_band = np.concatenate(bands, axis=0)
             sharded = jax.make_array_from_process_local_data(

@@ -37,11 +37,15 @@ def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher) -> bool:
         chunks = [
             reader.extract_chunk(i, verify=False) for i in range(len(reader))
         ]
-    except XorbFormatError:
+        digests = hasher.hash_batch(chunks)
+        leaves = [(d, len(c)) for d, c in zip(digests, chunks)]
+        return hashing.hash_to_hex(hashing.xorb_hash(leaves)) == hash_hex
+    except Exception:
+        # Any malformed peer-supplied blob — bad framing (XorbFormatError)
+        # or chunks exceeding the hasher's leaf cap (ValueError from
+        # hash_batch) — is a verification failure, never a round abort:
+        # one bad unit must not kill the fill phase.
         return False
-    digests = hasher.hash_batch(chunks)
-    leaves = [(d, len(c)) for d, c in zip(digests, chunks)]
-    return hashing.hash_to_hex(hashing.xorb_hash(leaves)) == hash_hex
 
 
 def fetch_file_header(bridge, rec):
@@ -93,9 +97,14 @@ def expert_pod_round(
     if jax.process_count() == 1:
         my_hosts = range(placement.num_hosts)
     else:
+        # Placement hosts are mesh slots along the pod axis, not process
+        # indices: with several local devices per process (the normal TPU
+        # topology) one process covers several slots. Derive the slots this
+        # process's addressable devices occupy — the same mapping
+        # PodDistributor uses for its shard bands.
         my_hosts = [
-            h for h in range(placement.num_hosts)
-            if h == jax.process_index()
+            s for s in PodDistributor(mesh).local_slots()
+            if s < placement.num_hosts
         ]
     fetched = failed = expert_bytes = 0
     for h in my_hosts:
@@ -135,12 +144,19 @@ def _is_whole_xorb(file_maps, hash_hex: str, fi) -> bool:
     return len(entries) == 1 and entries[0].range.start == 0
 
 
-def pod_round(bridge, recs, mesh=None, log=None, _plan=None) -> dict:
+def pod_round(
+    bridge, recs, mesh=None, log=None, _plan=None, budget_bytes=None,
+) -> dict:
     """Run one distribution round for ``recs`` over ``mesh``.
 
     Single-slot meshes skip the collective entirely — the waterfall alone
-    is optimal there. Returns the stats block recorded under
-    ``stats["pod"]`` in PullResult.
+    is optimal there. The round is windowed: the plan is split into waves
+    whose staged pool fits ``budget_bytes`` (default
+    ``Config.hbm_staging_bytes``; the reference's analog is its 128-term
+    batches, src/parallel_download.zig:117-131), each wave gathered,
+    verified, and drained into the cache before the next is staged —
+    per-device HBM cost is bounded by the budget, not the model size.
+    Returns the stats block recorded under ``stats["pod"]`` in PullResult.
     """
     mesh = pod_mesh() if mesh is None else mesh
     n = num_slots(mesh)
@@ -149,38 +165,56 @@ def pod_round(bridge, recs, mesh=None, log=None, _plan=None) -> dict:
         return {"slots": n, "units": len(plan.assignments), "skipped": True}
 
     from zest_tpu.ops import best_hasher
+    from zest_tpu.parallel.collectives import split_waves
 
-    t0 = time.monotonic()
+    if budget_bytes is None:
+        budget_bytes = bridge.cfg.hbm_staging_bytes
+    waves = split_waves(plan, budget_bytes)
+
     dist = PodDistributor(mesh)
-    pool = dist.distribute(
-        plan,
-        lambda a: bridge.fetch_unit(a.hash_hex, a.fetch_info),
-    )
-    t_gather = time.monotonic()
     # Full xorbs are device-verified before caching; partial-range blobs
     # carry per-chunk hashes in their frames, checked at extraction
     # (XorbReader) — same trust boundary as the reference's cache writes
     # (swarm.zig:416-420).
     hasher = best_hasher(hashing.CHUNK_KEY)
-    filled, rejected = pool.fill_cache(
-        bridge.cache,
-        verify=lambda hh, data: _device_verify_full_xorb(data, hh, hasher),
-    )
-    t_fill = time.monotonic()
+    filled = rejected = 0
+    gather_s = fill_s = 0.0
+    peak_pool = 0
+    for wave in waves:
+        tw = time.monotonic()
+        pool = dist.distribute(
+            wave,
+            lambda a: bridge.fetch_unit(a.hash_hex, a.fetch_info),
+        )
+        t_gather = time.monotonic()
+        f, r = pool.fill_cache(
+            bridge.cache,
+            verify=lambda hh, data: _device_verify_full_xorb(
+                data, hh, hasher
+            ),
+        )
+        filled += f
+        rejected += r
+        peak_pool = max(peak_pool, pool.layout.pool_bytes)
+        gather_s += t_gather - tw
+        fill_s += time.monotonic() - t_gather
+        del pool  # drop the gathered buffers before staging the next wave
 
     stats = {
         "slots": n,
         "units": len(plan.assignments),
         "planned_bytes": plan.total_bytes,
-        "pool_bytes": pool.layout.pool_bytes,
+        "waves": len(waves),
+        "pool_bytes": peak_pool,
+        "budget_bytes": budget_bytes,
         "balance": plan.summary()["balance"],
         "filled": filled,
         "verify_rejected": rejected,
-        "gather_s": round(t_gather - t0, 3),
-        "fill_s": round(t_fill - t_gather, 3),
+        "gather_s": round(gather_s, 3),
+        "fill_s": round(fill_s, 3),
     }
     if log is not None:
         log(f"pod round: {filled}/{stats['units']} units cached over "
-            f"{n} slots ({stats['planned_bytes']} bytes, "
-            f"gather {stats['gather_s']}s)")
+            f"{n} slots in {len(waves)} wave(s) "
+            f"({stats['planned_bytes']} bytes, gather {stats['gather_s']}s)")
     return stats
