@@ -152,15 +152,16 @@ def test_load_checkpoint_sharded(tmp_path):
     assert params["b"].sharding.spec == P()  # 5 indivisible → replicated
 
 
-def test_stage_snapshot_to_hbm_stats(tmp_path, tmp_config):
+def test_stage_snapshot_to_hbm_stats(tmp_path):
     tensors = {"w": np.ones((8, 8), np.float32)}
     write_safetensors(tmp_path / "model.safetensors", tensors)
     from zest_tpu.models.loader import stage_snapshot_to_hbm
 
-    stats = stage_snapshot_to_hbm(tmp_config, tmp_path)
+    params, stats = stage_snapshot_to_hbm(tmp_path)
     assert stats["tensors"] == 1
     assert stats["bytes"] == 8 * 8 * 4
-    assert "w" in tmp_config.staged_params
+    assert stats["direct"] is False
+    assert "w" in params  # the caller owns the staged tree
 
 
 # ── gpt2 flagship ──

@@ -140,8 +140,12 @@ def _print_pull_stats(stats: dict) -> None:
               f"{p['slots']} slots, gather {p['gather_s']}s")
     if "hbm" in stats:
         h = stats["hbm"]
-        print(f"  HBM commit: {h['tensors']} tensors, {h['bytes']} bytes "
-              f"({h['gbps']} GB/s)")
+        if "error" in h:
+            print(f"  HBM commit: FAILED ({h['error']})")
+        else:
+            print(f"  HBM commit: {h['tensors']} tensors, {h['bytes']} "
+                  f"bytes ({h['gbps']} GB/s)"
+                  + (" [direct]" if h.get("direct") else ""))
 
 
 def cmd_seed(args) -> int:

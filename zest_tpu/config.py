@@ -90,12 +90,6 @@ class Config:
     hbm_staging_bytes: int = DEFAULT_HBM_STAGING_BYTES
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
-    # Filled by models.loader.stage_snapshot_to_hbm: the last staged
-    # checkpoint's name→jax.Array tree. Held here so the device buffers
-    # outlive the pull call; set to None to release the HBM.
-    staged_params: dict | None = dataclasses.field(
-        default=None, repr=False, compare=False
-    )
 
     # ── Construction ──
 

@@ -34,9 +34,10 @@ class CachedFileReader:
     chunk would be decompressed twice).
     """
 
-    def __init__(self, cache, rec: recon.Reconstruction):
+    def __init__(self, cache, rec: recon.Reconstruction, bridge=None):
         self.cache = cache
         self.rec = rec
+        self.bridge = bridge
         self._spans: list[tuple[int, int, recon.Term]] = []
         off = 0
         for t in rec.terms:
@@ -56,16 +57,32 @@ class CachedFileReader:
                 f"no fetch_info covers term {term.hash_hex}"
             )
         entry = self.cache.get_with_range(term.hash_hex, fi.range.start)
-        if entry is None:
-            raise DirectLandingError(
-                f"unit {term.hash_hex}[{fi.range.start},{fi.range.end}) "
-                "not in cache — run the distribution round first"
-            )
-        local_start = term.range.start - entry.chunk_offset
-        local_end = term.range.end - entry.chunk_offset
-        data = XorbReader(entry.data).extract_chunk_range(
-            local_start, local_end
-        )
+        data = None
+        if entry is not None:
+            try:
+                local_start = term.range.start - entry.chunk_offset
+                local_end = term.range.end - entry.chunk_offset
+                data = XorbReader(entry.data).extract_chunk_range(
+                    local_start, local_end
+                )
+            except Exception:
+                # Corrupt/short cached entry: with a bridge it costs one
+                # term refetch (which overwrites the bad cache key — the
+                # same self-heal as fetch_xorb_for_term), never the whole
+                # landing. Without one, fail below.
+                data = None
+        if data is None:
+            if self.bridge is None:
+                raise DirectLandingError(
+                    f"unit {term.hash_hex}[{fi.range.start},{fi.range.end})"
+                    " not in cache — run the distribution round first"
+                )
+            # Unit not cached (no distribution round ran, or it missed
+            # this unit): pull the term through the full waterfall —
+            # peers, then CDN — which also caches the blob for seeding.
+            # Direct landing then works even single-host with a cold
+            # cache: bytes stream origin → cache → device, no file.
+            data = self.bridge.fetch_term(term, self.rec)
         if len(data) != term.unpacked_length:
             raise DirectLandingError(
                 f"term decoded to {len(data)} bytes, expected "
@@ -99,17 +116,20 @@ def land_tensors(
     rec: recon.Reconstruction,
     header: SafetensorsHeader,
     predicate=None,
+    bridge=None,
 ):
     """Decode selected tensors of one safetensors file from the cache.
 
     Returns name → np.ndarray (host buffers, zero file I/O beyond the
     cache). ``predicate(name)`` filters — the expert-sharded landing
-    passes "is this tensor shared or one of my experts?". Callers commit
-    the arrays with models.loader.land_tensor / jax.device_put.
+    passes "is this tensor shared or one of my experts?". With a
+    ``bridge``, units missing from the cache are pulled through the
+    waterfall instead of failing. Callers commit the arrays with
+    models.loader.land_tensor / jax.device_put.
     """
     import numpy as np
 
-    reader = CachedFileReader(cache, rec)
+    reader = CachedFileReader(cache, rec, bridge=bridge)
     out: dict[str, np.ndarray] = {}
     for name, info in header.tensors.items():
         if predicate is not None and not predicate(name):

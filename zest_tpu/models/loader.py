@@ -105,31 +105,74 @@ def load_checkpoint(
     return out
 
 
-def stage_snapshot_to_hbm(
-    cfg,
-    snapshot_dir: str | Path,
-    mesh: Mesh | None = None,
-    rules: ShardRules | None = None,
+def _commit_stats(
+    params: dict, dt: float, mesh: Mesh | None, direct: bool
 ) -> dict:
-    """The ``pull --device=tpu`` tail: commit a pulled snapshot into HBM.
-
-    Returns the stats block reported in PullResult (tensors, bytes, wall
-    time, effective host→HBM GB/s — the "HBM commit" stage of the BASELINE
-    per-stage timing).
-    """
-    t0 = time.monotonic()
-    params = load_checkpoint(snapshot_dir, mesh=mesh, rules=rules)
-    for arr in params.values():
-        arr.block_until_ready()
-    dt = time.monotonic() - t0
     total = sum(int(a.nbytes) for a in params.values())
-    # Config.staged_params (a declared field) keeps the tree alive so the
-    # buffers we just committed outlive this call.
-    cfg.staged_params = params
     return {
         "tensors": len(params),
         "bytes": total,
         "elapsed_s": round(dt, 3),
         "gbps": round(total / dt / 1e9, 3) if dt > 0 else 0.0,
         "sharded": mesh is not None,
+        "direct": direct,
     }
+
+
+def stage_snapshot_to_hbm(
+    snapshot_dir: str | Path,
+    mesh: Mesh | None = None,
+    rules: ShardRules | None = None,
+) -> tuple[dict[str, jax.Array], dict]:
+    """Disk-path HBM commit: read a pulled snapshot's files into device
+    arrays.
+
+    Returns ``(params, stats)`` — the caller (normally ``PullResult``)
+    owns the param tree and with it the HBM lifetime; drop the result to
+    release the buffers. ``stats`` is the block reported under
+    ``stats["hbm"]`` (tensors, bytes, wall time, effective host→HBM GB/s
+    — the "HBM commit" stage of the BASELINE per-stage timing).
+    """
+    t0 = time.monotonic()
+    params = load_checkpoint(snapshot_dir, mesh=mesh, rules=rules)
+    for arr in params.values():
+        arr.block_until_ready()
+    dt = time.monotonic() - t0
+    return params, _commit_stats(params, dt, mesh, direct=False)
+
+
+def stage_cached_to_hbm(
+    bridge,
+    recs_with_headers,
+    mesh: Mesh | None = None,
+    rules: ShardRules | None = None,
+) -> tuple[dict[str, jax.Array], dict]:
+    """Direct-path HBM commit: land tensors straight from cached xorb
+    units — zero file reads on the landing path (SURVEY.md §7 hard part
+    #2; the reference always round-trips disk, SURVEY.md §3.1).
+
+    ``recs_with_headers`` is ``[(Reconstruction, SafetensorsHeader)]``,
+    one per safetensors file (headers via transfer.pod.fetch_file_header).
+    Units the distribution round missed are pulled through the bridge's
+    waterfall. Returns ``(params, stats)`` like stage_snapshot_to_hbm,
+    with ``stats["direct"] = True``.
+    """
+    from zest_tpu.models.direct import land_tensors
+
+    t0 = time.monotonic()
+    params: dict[str, jax.Array] = {}
+    for rec, header in recs_with_headers:
+        tensors = land_tensors(
+            bridge.cache, rec, header, bridge=bridge
+        )
+        for name, arr in tensors.items():
+            if mesh is None:
+                params[name] = jax.device_put(arr)
+            else:
+                params[name] = land_tensor(
+                    arr, mesh, spec_for(name, arr.shape, mesh, rules)
+                )
+    for arr in params.values():
+        arr.block_until_ready()
+    dt = time.monotonic() - t0
+    return params, _commit_stats(params, dt, mesh, direct=True)

@@ -213,14 +213,24 @@ class XetBridge:
         if cached is not None and cached.chunk_offset <= fi.range.start:
             lo = fi.range.start - cached.chunk_offset
             hi = fi.range.end - cached.chunk_offset
-            if _blob_covers(cached.data, lo, hi):
-                self.stats.record("cache", len(cached.data))
-                if lo == 0:
-                    return cached.data
-                # Covering entry at a lower offset (e.g. the full xorb
-                # from an earlier pull): re-frame just the unit's range so
-                # the gathered row starts exactly at fi.range.start.
-                return XorbReader(cached.data).slice_range(lo, hi)
+            try:
+                reader = XorbReader(cached.data)  # one parse per hit
+            except Exception:
+                reader = None  # corrupt entry: fall through, CDN self-heals
+            if reader is not None and lo >= 0 and lo < hi <= len(reader):
+                # A covering entry wider than the unit (offset below
+                # fi.range.start, or more chunks than fi.range.end — e.g.
+                # a full xorb cached by an earlier pull while this plan's
+                # unit covers a prefix) is re-framed to exactly the unit's
+                # range: a wider blob would overflow its pool row capacity
+                # and be zero-rowed, refetching from CDN despite the local
+                # hit. Stats count the bytes actually served.
+                if lo == 0 and len(reader) == hi:
+                    data = cached.data
+                else:
+                    data = reader.slice_range(lo, hi)
+                self.stats.record("cache", len(data))
+                return data
 
         if self.swarm is not None:
             xorb_hash = None

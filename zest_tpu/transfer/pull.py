@@ -25,15 +25,28 @@ from zest_tpu.transfer.parallel import ParallelDownloader
 
 
 class PullResult:
-    def __init__(self, snapshot_dir: Path, stats: dict):
+    """What a pull produced: the snapshot path, stats, and — for
+    ``device="tpu"`` — the staged param tree. The result *owns* the HBM
+    buffers: drop it (or set ``params = None``) to release them."""
+
+    def __init__(self, snapshot_dir: Path, stats: dict, params=None):
         self.snapshot_dir = snapshot_dir
         self.stats = stats
+        self.params = params  # name → jax.Array, or None
 
     def __fspath__(self) -> str:
         return str(self.snapshot_dir)
 
     def __str__(self) -> str:
         return str(self.snapshot_dir)
+
+
+def _is_complete(snapshot_dir: Path, entry) -> bool:
+    """One definition of "this file is already pulled" — shared by the
+    pod pre-pass, the download loop's skip, and the direct-landing
+    eligibility check, so the three never disagree about resume state."""
+    dest = snapshot_dir / entry.path
+    return dest.exists() and dest.stat().st_size == entry.size
 
 
 def pull_model(
@@ -71,10 +84,7 @@ def pull_model(
     if pod:
         pending = [
             e for e in files
-            if e.is_xet and not (
-                (snapshot_dir / e.path).exists()
-                and (snapshot_dir / e.path).stat().st_size == e.size
-            )
+            if e.is_xet and not _is_complete(snapshot_dir, e)
         ]
         if pending:
             try:
@@ -93,10 +103,27 @@ def pull_model(
                     "continuing with the per-host waterfall",
                     file=sys.stderr)
 
+    # Direct-to-HBM landing (SURVEY.md §7 hard part #2, the north star):
+    # land tensors straight from cached units BEFORE any file is written,
+    # so the landing path never reads a reassembled file. The HF-cache
+    # files are still written by the loop below — served from the
+    # now-warm cache, not refetched.
+    hbm_params = hbm_stats = None
+    mesh = None
+    if device == "tpu":
+        if cfg.mesh.mesh_axes:
+            from zest_tpu.parallel.mesh import mesh_from_config
+
+            mesh = mesh_from_config(cfg.mesh)
+        hbm_params, hbm_stats = _try_direct_stage(
+            bridge, hub, repo_id, revision, files, snapshot_dir, mesh, log
+        )
+        authenticated = authenticated or bridge.cas is not None
+
     downloaded = skipped = 0
     for entry in files:
         dest = snapshot_dir / entry.path
-        if dest.exists() and dest.stat().st_size == entry.size:
+        if _is_complete(snapshot_dir, entry):
             skipped += 1
             continue
         if entry.is_xet:
@@ -126,17 +153,56 @@ def pull_model(
     if swarm is not None:
         stats["swarm"] = swarm.stats.summary()
 
-    if device == "tpu":
+    if device == "tpu" and hbm_stats is None:
+        # Disk fallback: direct landing was ineligible or failed; the
+        # files are on disk now, stage them the reference's way. A
+        # staging failure (e.g. a repo whose .safetensors doesn't parse)
+        # must not lose the completed download — report it and return.
         from zest_tpu.models.loader import stage_snapshot_to_hbm
 
-        mesh = None
-        if cfg.mesh.mesh_axes:
-            from zest_tpu.parallel.mesh import mesh_from_config
+        try:
+            hbm_params, hbm_stats = stage_snapshot_to_hbm(
+                snapshot_dir, mesh=mesh
+            )
+        except Exception as exc:  # noqa: BLE001
+            log(f"HBM staging failed ({exc}); files remain in "
+                f"{snapshot_dir}", file=sys.stderr)
+            hbm_stats = {"error": str(exc), "direct": False}
+    if hbm_stats is not None:
+        stats["hbm"] = hbm_stats
 
-            mesh = mesh_from_config(cfg.mesh)
-        stats["hbm"] = stage_snapshot_to_hbm(cfg, snapshot_dir, mesh=mesh)
+    return PullResult(snapshot_dir, stats, params=hbm_params)
 
-    return PullResult(snapshot_dir, stats)
+
+def _try_direct_stage(
+    bridge, hub, repo_id, revision, files, snapshot_dir, mesh, log
+):
+    """Direct cache→HBM landing for every safetensors file, before any
+    file write. Returns ``(None, None)`` when ineligible — non-xet
+    safetensors (no reconstruction to land from) or files already on
+    disk (the resume case: reading local disk beats refetching) — or on
+    any failure, in which case the disk fallback runs after the file
+    loop."""
+    st = [e for e in files if e.path.endswith(".safetensors")]
+    if not st or not all(e.is_xet for e in st):
+        return None, None
+    if any(_is_complete(snapshot_dir, e) for e in st):
+        return None, None
+    try:
+        from zest_tpu.models.loader import stage_cached_to_hbm
+        from zest_tpu.transfer.pod import fetch_file_header
+
+        if bridge.cas is None:
+            bridge.authenticate(repo_id, revision, hub=hub)
+        recs_with_headers = []
+        for e in st:
+            rec = bridge.get_reconstruction(e.xet_hash)
+            recs_with_headers.append((rec, fetch_file_header(bridge, rec)))
+        return stage_cached_to_hbm(bridge, recs_with_headers, mesh=mesh)
+    except Exception as exc:  # noqa: BLE001 - landing is an accelerator
+        log(f"direct HBM landing unavailable ({exc}); "
+            "will stage from disk after download", file=sys.stderr)
+        return None, None
 
 
 def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
