@@ -27,8 +27,9 @@ from zest_tpu.cas import chunking
 @dataclass
 class _XorbFixture:
     hash_hex: str
-    blob: bytes
+    blob: bytes               # frame stream (the in-pipeline blob shape)
     frame_offsets: list[int]  # len = num_chunks + 1
+    full: bytes = b""         # frames + XETBLOB footer (the S3 artifact)
 
 
 @dataclass
@@ -84,7 +85,9 @@ class FixtureRepo:
             xh_hex = hashing.hash_to_hex(xh)
             offs = builder.frame_offsets()
             self.xorbs.setdefault(
-                xh_hex, _XorbFixture(xh_hex, builder.serialize(), offs)
+                xh_hex,
+                _XorbFixture(xh_hex, builder.serialize(), offs,
+                             builder.serialize_full()),
             )
             n = len(group)
             terms.append(
@@ -206,7 +209,13 @@ class FixtureHub:
             for repo in self.repos.values():
                 rec = repo.reconstructions.get(file_hex)
                 if rec is not None:
-                    handler._send_json(recon.to_json(rec))
+                    doc = self._reconstruction_doc(
+                        rec, handler.headers.get("Range")
+                    )
+                    if doc is None:  # range starts past EOF
+                        handler._send_json({"error": "range"}, 416)
+                        return
+                    handler._send_json(doc)
                     return
             handler._send_json({"error": "not found"}, 404)
             return
@@ -216,7 +225,10 @@ class FixtureHub:
             for repo in self.repos.values():
                 xf = repo.xorbs.get(xh_hex)
                 if xf is not None:
-                    self._send_ranged(handler, xf.blob)
+                    # Serve the full XETBLOB artifact (frames + footer),
+                    # as S3 does; fetch_info url_ranges only ever address
+                    # the frame region.
+                    self._send_ranged(handler, xf.full or xf.blob)
                     return
             handler._send(404, b"not found")
             return
@@ -257,6 +269,48 @@ class FixtureHub:
             handler._send_json(out)
             return
         handler._send(404, b"unknown path")
+
+    def _reconstruction_doc(self, rec, range_header):
+        """Production reconstruction semantics: an optional HTTP ``Range``
+        header selects a byte window of the *file*; the response holds only
+        the terms overlapping it plus ``offset_into_first_range`` (bytes to
+        skip inside the first term). A window starting past EOF is 416 —
+        this is how the real client paginates huge files (it walks 256 MB
+        windows until the server says 416)."""
+        total = sum(t.unpacked_length for t in rec.terms)
+        lo, hi = 0, total
+        if range_header:
+            spec = range_header.split("=", 1)[-1]
+            start_s, _, end_s = spec.partition("-")
+            lo = int(start_s or 0)
+            hi = min(int(end_s) + 1 if end_s else total, total)
+            if lo >= total and total > 0:
+                return None
+        doc = recon.to_json(rec)
+        if lo > 0 or hi < total:
+            terms, off = [], 0
+            offset_into_first = 0
+            for t, tj in zip(rec.terms, doc["terms"]):
+                t_lo, t_hi = off, off + t.unpacked_length
+                if t_hi > lo and t_lo < hi:
+                    if not terms:
+                        offset_into_first = lo - t_lo
+                    terms.append(tj)
+                off = t_hi
+            doc["terms"] = terms
+            doc["offset_into_first_range"] = offset_into_first
+            keep = {t["hash"] for t in terms}
+            doc["fetch_info"] = {
+                h: v for h, v in doc["fetch_info"].items() if h in keep
+            }
+        # Production fetch_info URLs are absolute presigned links;
+        # absolutize at serve time (the port isn't known when the repo
+        # fixture is built).
+        for entries in doc["fetch_info"].values():
+            for fi in entries:
+                if fi["url"].startswith("/"):
+                    fi["url"] = self.url + fi["url"]
+        return doc
 
     @staticmethod
     def _send_ranged(handler, blob: bytes) -> None:

@@ -143,13 +143,34 @@ class TestXetConventions:
         b = (hashing.chunk_hash(b"b"), 1)
         assert hashing.merkle_root([a, b]) != hashing.merkle_root([b, a])
 
-    def test_merkle_odd_promotion(self):
-        leaves = [(hashing.chunk_hash(bytes([i])), 1) for i in range(3)]
+    def test_merkle_matches_documented_grouping(self):
+        """Independent re-derivation of the production tree rule (group
+        closes at child k>=3 when last u64 LE % 4 == 0, or at k == 9;
+        parent = node_hash of the group)."""
+        import struct as _struct
+
+        leaves = [(hashing.chunk_hash(bytes([i])), 1) for i in range(23)]
+
+        def ref_root(nodes):
+            if len(nodes) == 1:
+                return nodes[0]
+            groups, cur = [], []
+            for nd in nodes:
+                cur.append(nd)
+                last = _struct.unpack("<Q", nd[0][24:32])[0]
+                if (len(cur) >= 3 and last % 4 == 0) or len(cur) == 9:
+                    groups.append(cur)
+                    cur = []
+            if cur:
+                groups.append(cur)
+            return ref_root([
+                (hashing.node_hash(g), sum(s for _, s in g)) for g in groups
+            ])
         root, total = hashing.merkle_root(leaves)
-        # parent(l0,l1) then parent(that, l2)
-        p01 = hashing.node_hash(leaves[:2])
-        expected = hashing.node_hash([(p01, 2), leaves[2]])
-        assert root == expected and total == 3
+        assert (root, total) == ref_root(leaves)
+        assert total == 23
+        # single leaf is its own root
+        assert hashing.merkle_root(leaves[:1]) == leaves[0]
 
     def test_chunk_domain_separation(self):
         data = b"same bytes"

@@ -113,7 +113,7 @@ class TestCasClient:
         xh_hex = next(iter(hub.repos["test-org/tiny-model"].xorbs))
         xf = hub.repos["test-org/tiny-model"].xorbs[xh_hex]
         full = cas.fetch_xorb_from_url(hub.url + f"/xorbs/{xh_hex}")
-        assert full == xf.blob
+        assert full == xf.full  # unranged GET returns the footered artifact
         part = cas.fetch_xorb_from_url(
             hub.url + f"/xorbs/{xh_hex}", (0, xf.frame_offsets[1])
         )

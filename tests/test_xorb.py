@@ -86,9 +86,11 @@ class TestHostileInput:
             XorbReader(bytes(blob))
 
     def test_corrupted_chunk_fails_verification(self):
+        # Full artifact (footer carries hashes): payload corruption is
+        # caught at extraction.
         chunks = [os.urandom(5000)]
-        blob = bytearray(_build(chunks).serialize())
-        blob[-1] ^= 0xFF
+        blob = bytearray(_build(chunks).serialize_full())
+        blob[100] ^= 0xFF  # inside the single chunk's payload
         r = XorbReader(bytes(blob))
         with pytest.raises(Exception):  # hash mismatch or decode error
             r.extract_chunk(0)
@@ -99,18 +101,21 @@ class TestHostileInput:
         assert r.extract_chunk(0, verify=False) == chunks[0]
 
     def test_tampered_hash_detected(self):
-        blob = bytearray(_build([b"q" * 3000]).serialize())
-        blob[8] ^= 0x01  # first hash byte
+        b = _build([b"q" * 3000])
+        blob = bytearray(b.serialize_full())
+        # Flip a byte of the chunk hash inside the footer's XBLBHSH section:
+        # frames end at serialize() length; hash 0 starts 52 bytes into the
+        # footer (ident+version+xorb hash+section ident+count).
+        blob[len(b.serialize()) + 52] ^= 0x01
         r = XorbReader(bytes(blob))
         with pytest.raises(XorbFormatError, match="hash mismatch"):
             r.extract_chunk(0)
 
     def test_absurd_uncompressed_len_rejected(self):
-        # Untrusted frame header must not dictate allocations: claim 4 GiB.
-        import struct as _struct
-
+        # Untrusted frame header must not dictate allocations: claim the
+        # u24 max (16 MiB), over the 4 MiB decode cap.
         frame = bytearray(_build([b"x" * 100]).serialize())
-        _struct.pack_into("<I", frame, 4, 0xFFFFFFFF)
+        frame[5:8] = b"\xff\xff\xff"
         with pytest.raises(XorbFormatError, match="claims"):
             XorbReader(bytes(frame))
 

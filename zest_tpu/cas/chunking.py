@@ -5,11 +5,13 @@ max 128KB — the Xet parameters, reference DESIGN.md:265-273) so identical
 content produces identical chunk boundaries regardless of surrounding bytes;
 this is what makes chunk-level dedup work across model revisions.
 
-Algorithm: GearHash rolling hash — ``h = (h << 1) + GEAR[byte]`` — with a cut
-when the top ``log2(target - min)`` bits of ``h`` are all zero. The gear
-table is deterministic (derived from BLAKE3 of the table index under a
-documented context) and is a compatibility seam: substitute the production
-Xet table for boundary-level interop with HF's CAS.
+Algorithm: GearHash rolling hash — ``h = (h << 1) + GEAR[byte]`` — with a
+cut when the top 16 bits of ``h`` are all zero (expected gap 2^16 = 64 KiB;
+the MIN_CHUNK skip shifts the mean to ~MIN + 64 KiB and MAX_CHUNK truncates
+the geometric tail). Table, mask, and limits are the PRODUCTION Xet
+constants (zest_tpu.cas.xet_constants), so chunk boundaries — and therefore
+every content address downstream — match HF's CAS exactly (verified against
+the official client, tests/test_xet_interop.py).
 
 Hot path dispatches to the native C++ scanner (zest_tpu/native/gearhash.cc)
 when available; the pure-Python implementation is the correctness anchor.
@@ -17,34 +19,16 @@ when available; the pure-Python implementation is the correctness anchor.
 
 from __future__ import annotations
 
-import struct
 from dataclasses import dataclass
 from typing import Iterator
 
-from zest_tpu.cas import blake3 as _b3
+from zest_tpu.cas import xet_constants as _xc
 
-MIN_CHUNK = 8 * 1024
-TARGET_CHUNK = 64 * 1024
-MAX_CHUNK = 128 * 1024
-
-# Cut when the top bits of the rolling hash are zero. With 16 mask bits the
-# expected gap between qualifying positions is 2^16 = 64 KiB; the MIN_CHUNK
-# skip shifts the mean to ~MIN + 64 KiB and MAX_CHUNK truncates the
-# geometric tail, landing the realized average near the 64 KiB Xet target.
-_MASK_BITS = TARGET_CHUNK.bit_length() - 1  # 16
-MASK = ((1 << _MASK_BITS) - 1) << (64 - _MASK_BITS)
-
-_GEAR_CONTEXT = "zest-tpu gearhash table v1"
-
-
-def _make_gear_table() -> tuple[int, ...]:
-    # 256 pseudorandom u64s, deterministically derived so every
-    # implementation (Python, C++, tests) agrees byte-for-byte.
-    material = _b3.blake3_derive_key(_GEAR_CONTEXT, b"gear", 256 * 8)
-    return struct.unpack("<256Q", material)
-
-
-GEAR = _make_gear_table()
+MIN_CHUNK = _xc.MIN_CHUNK
+TARGET_CHUNK = _xc.TARGET_CHUNK
+MAX_CHUNK = _xc.MAX_CHUNK
+MASK = _xc.MASK
+GEAR = _xc.GEAR_TABLE
 
 _U64 = (1 << 64) - 1
 

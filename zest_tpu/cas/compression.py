@@ -1,13 +1,17 @@
 """Chunk compression schemes (the zig-xet `compression` equivalent).
 
-Four schemes, matching the reference's set (SURVEY.md §2.2, row
+Four schemes, matching the production Xet set (SURVEY.md §2.2, row
 `compression`): None, LZ4, ByteGrouping4LZ4, FullBitsliceLZ4.
 
-- **LZ4** is the standard LZ4 block format, implemented from the public
-  spec (no frame header — the xorb chunk header carries lengths).
+- **LZ4** payloads are the standard **LZ4 frame** format (magic
+  ``0x184D2204``, independent blocks, 256 KiB block max — a chunk is
+  always a single block) wrapping LZ4 block data, exactly as the
+  production client writes them (verified frame-for-frame against real
+  xorbs, tests/test_xet_interop.py).
 - **ByteGrouping4LZ4** regroups bytes into 4 planes (byte k of every 4-byte
   group) before LZ4 — fp32/bf16 tensor bytes compress far better planar,
-  because exponent bytes are highly repetitive.
+  because exponent bytes are highly repetitive. Plane layout matches
+  production bit-for-bit.
 - **FullBitsliceLZ4** slices each byte into 8 bit-planes first; best for
   quantized weights, costliest to (de)code.
 
@@ -19,6 +23,7 @@ codec (zest_tpu/native/lz4.cc) when available.
 from __future__ import annotations
 
 import enum
+import struct
 
 import numpy as np
 
@@ -167,6 +172,123 @@ def lz4_decompress(data: bytes, expected_len: int) -> bytes:
     return _lz4_decompress_py(data, expected_len)
 
 
+# ── LZ4 frame format (what production xorb payloads actually hold) ──
+
+_LZ4F_MAGIC = b"\x04\x22\x4d\x18"
+# FLG 0x60: version 01, independent blocks, no checksums/content-size.
+# BD 0x50: 256 KiB block max — every CDC chunk (<= 128 KiB) is one block.
+_LZ4F_DESCRIPTOR = b"\x60\x50"
+
+_XXH_P1, _XXH_P2, _XXH_P3, _XXH_P4, _XXH_P5 = (
+    2654435761, 2246822519, 3266489917, 668265263, 374761393
+)
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    """XXH32 (needed for the LZ4 frame header checksum byte)."""
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + _XXH_P1 + _XXH_P2) & _M32
+        v2 = (seed + _XXH_P2) & _M32
+        v3 = seed & _M32
+        v4 = (seed - _XXH_P1) & _M32
+        while i <= n - 16:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * k : i + 4 * k + 4], "little")
+                v = (v + lane * _XXH_P2) & _M32
+                v = (_rotl32(v, 13) * _XXH_P1) & _M32
+                if k == 0: v1 = v
+                elif k == 1: v2 = v
+                elif k == 2: v3 = v
+                else: v4 = v
+            i += 16
+        h = (_rotl32(v1, 1) + _rotl32(v2, 7) + _rotl32(v3, 12)
+             + _rotl32(v4, 18)) & _M32
+    else:
+        h = (seed + _XXH_P5) & _M32
+    h = (h + n) & _M32
+    while i + 4 <= n:
+        h = (h + int.from_bytes(data[i:i+4], "little") * _XXH_P3) & _M32
+        h = (_rotl32(h, 17) * _XXH_P4) & _M32
+        i += 4
+    while i < n:
+        h = (h + data[i] * _XXH_P5) & _M32
+        h = (_rotl32(h, 11) * _XXH_P1) & _M32
+        i += 1
+    h ^= h >> 15
+    h = (h * _XXH_P2) & _M32
+    h ^= h >> 13
+    h = (h * _XXH_P3) & _M32
+    h ^= h >> 16
+    return h
+
+
+def lz4_frame_compress(data: bytes) -> bytes:
+    """One-block LZ4 frame (the production chunk-payload shape)."""
+    hc = (xxh32(_LZ4F_DESCRIPTOR) >> 8) & 0xFF
+    out = bytearray(_LZ4F_MAGIC + _LZ4F_DESCRIPTOR + bytes([hc]))
+    if data:
+        block = lz4_compress(data)
+        if len(block) < len(data):
+            out += struct.pack("<I", len(block)) + block
+        else:
+            out += struct.pack("<I", 0x80000000 | len(data)) + data
+    out += b"\x00\x00\x00\x00"  # end mark
+    return bytes(out)
+
+
+def lz4_frame_decompress(data: bytes, expected_len: int) -> bytes:
+    """Decode an LZ4 frame to exactly ``expected_len`` bytes."""
+    if data[:4] != _LZ4F_MAGIC:
+        raise CompressionError("not an LZ4 frame")
+    if len(data) < 7:
+        raise CompressionError("truncated LZ4 frame header")
+    flg, bd = data[4], data[5]
+    if flg >> 6 != 1:
+        raise CompressionError("unsupported LZ4 frame version")
+    block_max = 1 << (8 + 2 * ((bd >> 4) & 0x7))
+    pos = 6
+    if flg & 0x08:
+        pos += 8  # content size (unused; the chunk header is authoritative)
+    if flg & 0x01:
+        pass  # content checksum present after the end mark; ignored
+    pos += 1  # header checksum byte
+    out = bytearray()
+    while True:
+        if pos + 4 > len(data):
+            raise CompressionError("truncated LZ4 frame block")
+        (bsz,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        if bsz == 0:
+            break
+        stored = bool(bsz & 0x80000000)
+        bsz &= 0x7FFFFFFF
+        if pos + bsz > len(data):
+            raise CompressionError("LZ4 frame block extends past input")
+        block = data[pos : pos + bsz]
+        pos += bsz
+        if flg & 0x10:
+            pos += 4  # block checksum; ignored
+        if stored:
+            out += block
+        else:
+            # Encoders fill blocks to block_max; only the final block is
+            # short, and its size is pinned by expected_len.
+            remaining = expected_len - len(out)
+            out += lz4_decompress(block, min(block_max, remaining))
+    if len(out) != expected_len:
+        raise CompressionError(
+            f"LZ4 frame decoded {len(out)} bytes, expected {expected_len}"
+        )
+    return bytes(out)
+
+
 # ── Byte-grouping and bit-slicing transforms ──
 
 
@@ -212,11 +334,11 @@ def compress(data: bytes, scheme: Scheme) -> bytes:
     if scheme == Scheme.NONE:
         return data
     if scheme == Scheme.LZ4:
-        return lz4_compress(data)
+        return lz4_frame_compress(data)
     if scheme == Scheme.BG4_LZ4:
-        return lz4_compress(_bg4(data))
+        return lz4_frame_compress(_bg4(data))
     if scheme == Scheme.BITSLICE_LZ4:
-        return lz4_compress(_bitslice(data))
+        return lz4_frame_compress(_bitslice(data))
     raise CompressionError(f"unknown scheme {scheme}")
 
 
@@ -226,13 +348,13 @@ def decompress(data: bytes, scheme: Scheme, expected_len: int) -> bytes:
             raise CompressionError("stored chunk length mismatch")
         return data
     if scheme == Scheme.LZ4:
-        return lz4_decompress(data, expected_len)
+        return lz4_frame_decompress(data, expected_len)
     if scheme == Scheme.BG4_LZ4:
-        return _bg4_inverse(lz4_decompress(data, expected_len))
+        return _bg4_inverse(lz4_frame_decompress(data, expected_len))
     if scheme == Scheme.BITSLICE_LZ4:
         plane_bytes = ((expected_len + 7) // 8) * 8
         return _bitslice_inverse(
-            lz4_decompress(data, plane_bytes), expected_len
+            lz4_frame_decompress(data, plane_bytes), expected_len
         )
     raise CompressionError(f"unknown scheme {scheme}")
 

@@ -16,9 +16,10 @@ Three things live here, mirroring what the reference gets from zig-xet
 
 3. **Domain-separated chunk/node keys** — chunk hashes and Merkle interior
    nodes use distinct BLAKE3 keyed modes so a chunk can never collide with
-   a subtree (xet-core convention). The concrete 32-byte keys are derived
-   from documented context strings; they are a compatibility seam — wire
-   them to the production Xet constants to interoperate with HF's CAS.
+   a subtree. The keys, merkle grouping, and file-salt step are the
+   PRODUCTION Xet constants (zest_tpu.cas.xet_constants), verified
+   bit-for-bit against the official client — hashes computed here are
+   real HF CAS addresses.
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ from __future__ import annotations
 import struct
 
 from zest_tpu.cas import blake3 as _py_blake3
+from zest_tpu.cas import xet_constants as _xc
 
 # Native backend is optional; loaded lazily to keep import cheap.
 _native = None
@@ -47,9 +49,10 @@ def _get_native():
 
 HASH_LEN = 32
 
-# ── Domain-separation keys (compatibility seam; see module docstring) ──
-CHUNK_KEY = _py_blake3.blake3_derive_key("zest-tpu xet chunk hash v1", b"zest")
-NODE_KEY = _py_blake3.blake3_derive_key("zest-tpu xet merkle node v1", b"zest")
+# ── Domain-separation keys (production Xet constants) ──
+CHUNK_KEY = _xc.CHUNK_KEY
+NODE_KEY = _xc.NODE_KEY
+FILE_SALT = _xc.FILE_SALT
 
 
 def blake3_hash(data: bytes) -> bytes:
@@ -72,40 +75,50 @@ def chunk_hash(data: bytes) -> bytes:
     return blake3_keyed(CHUNK_KEY, data)
 
 
-# ── Merkle aggregation ──
+# ── Merkle aggregation (production Xet tree) ──
 #
-# Leaves are (chunk_hash, byte_length); interior nodes hash the concatenation
-# of each child's ``hash || u64le(length)`` under the node key and carry the
-# summed length. Xorb hashes and file hashes use the same tree so dedup is
-# consistent at every level.
+# Leaves are (chunk_hash, byte_length). Children group left-to-right:
+# a group closes at its k-th child (k >= GROUP_MIN) when the child hash's
+# last u64 (LE) % GROUP_MOD == 0, or unconditionally at k == GROUP_MAX.
+# Each parent is the keyed BLAKE3 (node domain) of the text
+# ``"{hash_hex} : {size}\n"`` per child, carrying the summed length.
+# Iterate to a single root; one leaf is its own root. Verified bit-for-bit
+# against the official client (tests/test_xet_interop.py).
 
 
 def node_hash(children: list[tuple[bytes, int]]) -> bytes:
-    buf = bytearray()
+    buf = []
     for h, length in children:
         if len(h) != HASH_LEN:
             raise ValueError("child hash must be 32 bytes")
-        buf += h
-        buf += struct.pack("<Q", length)
-    return blake3_keyed(NODE_KEY, bytes(buf))
+        buf.append(f"{hash_to_hex(h)} : {length}\n")
+    return blake3_keyed(NODE_KEY, "".join(buf).encode())
+
+
+def _closes_group(child_hash: bytes, k: int) -> bool:
+    if k >= _xc.GROUP_MAX:
+        return True
+    if k < _xc.GROUP_MIN:
+        return False
+    last = struct.unpack("<Q", child_hash[24:32])[0]
+    return last % _xc.GROUP_MOD == 0
 
 
 def merkle_root(leaves: list[tuple[bytes, int]]) -> tuple[bytes, int]:
-    """Binary Merkle root over (hash, length) leaves.
-
-    Pairs children level by level; an odd tail node is promoted unchanged
-    (so a single chunk's xorb hash is that chunk's hash).
-    """
+    """Production Xet merkle root over (hash, length) leaves."""
     if not leaves:
         return chunk_hash(b""), 0
     level = list(leaves)
     while len(level) > 1:
         nxt: list[tuple[bytes, int]] = []
-        for i in range(0, len(level) - 1, 2):
-            pair = [level[i], level[i + 1]]
-            nxt.append((node_hash(pair), pair[0][1] + pair[1][1]))
-        if len(level) % 2:
-            nxt.append(level[-1])
+        group: list[tuple[bytes, int]] = []
+        for child in level:
+            group.append(child)
+            if _closes_group(child[0], len(group)):
+                nxt.append((node_hash(group), sum(s for _, s in group)))
+                group = []
+        if group:
+            nxt.append((node_hash(group), sum(s for _, s in group)))
         level = nxt
     return level[0]
 
@@ -116,8 +129,10 @@ def xorb_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
 
 
 def file_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
-    """Content address of a file = Merkle root over its chunk sequence."""
-    return merkle_root(chunk_hashes)[0]
+    """Content address of a file: the merkle root over the file's chunk
+    sequence, salted — ``blake3_keyed(FILE_SALT, root)`` — so file
+    addresses never collide with xorb addresses. HF uses the zero salt."""
+    return blake3_keyed(FILE_SALT, merkle_root(chunk_hashes)[0])
 
 
 # ── Hex conventions ──

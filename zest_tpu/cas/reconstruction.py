@@ -110,7 +110,11 @@ def from_json(file_hash_hex: str, doc: dict) -> Reconstruction:
                 FetchInfo(
                     url=fi["url"],
                     url_range_start=int(fi["url_range"]["start"]),
-                    url_range_end=int(fi["url_range"]["end"]),
+                    # The wire "url_range.end" is INCLUSIVE (production
+                    # semantics: the client requests exactly
+                    # ``Range: bytes={start}-{end}``); internally we keep
+                    # half-open [start, end).
+                    url_range_end=int(fi["url_range"]["end"]) + 1,
                     range=ChunkRange(fi["range"]["start"], fi["range"]["end"]),
                 )
                 for fi in entries
@@ -127,8 +131,14 @@ def from_json(file_hash_hex: str, doc: dict) -> Reconstruction:
 
 
 def to_json(rec: Reconstruction) -> dict:
-    """Serialize (used by the fixture CAS server and the pod-local CAS)."""
+    """Serialize (used by the fixture CAS server and the pod-local CAS).
+
+    ``offset_into_first_range`` is part of the production response schema
+    (cas_types ``QueryReconstructionResponse``) — nonzero only for ranged
+    file queries, which we don't issue; the real client requires the field.
+    """
     return {
+        "offset_into_first_range": 0,
         "terms": [
             {
                 "hash": t.hash_hex,
@@ -141,9 +151,10 @@ def to_json(rec: Reconstruction) -> dict:
             h: [
                 {
                     "url": fi.url,
+                    # Inclusive end on the wire (see from_json).
                     "url_range": {
                         "start": fi.url_range_start,
-                        "end": fi.url_range_end,
+                        "end": fi.url_range_end - 1,
                     },
                     "range": {"start": fi.range.start, "end": fi.range.end},
                 }

@@ -1,4 +1,4 @@
-"""Xorb container format (the zig-xet `xorb` equivalent).
+"""Xorb container format — production XETBLOB (the zig-xet `xorb` equivalent).
 
 A xorb is a content-addressed bundle of CDC chunks — the unit of transfer
 and caching in the whole system (reference behavior: SURVEY.md §2.2 rows
@@ -6,18 +6,32 @@ and caching in the whole system (reference behavior: SURVEY.md §2.2 rows
 src/bt_wire.zig:22). The xorb's identity is the Merkle root over its chunk
 hashes (zest_tpu.cas.hashing.xorb_hash).
 
-Layout — ZXORB v2, a **self-framed chunk stream** with no container header,
-so any contiguous chunk range is a contiguous byte range. This is what makes
-the whole transfer economy work: CDN ``fetch_info.url_range`` byte ranges,
-partial cache entries (``{hash}.{range_start}``), BEP XET range responses,
-and ICI shard slices are all just frame subsequences.
+This module implements the PRODUCTION XETBLOB layout byte-for-byte
+(verified against real xorbs written by the official client,
+tests/test_xet_interop.py):
 
-    per chunk frame (40 + compressed_len bytes, integers little-endian):
-        u8   scheme          (cas.compression.Scheme)
+    per chunk frame (8 + compressed_len bytes, integers little-endian):
+        u8   version          (0)
         u24  compressed_len
-        u32  uncompressed_len
-        32B  chunk hash      (keyed BLAKE3, chunk domain)
+        u8   scheme           (cas.compression.Scheme)
+        u24  uncompressed_len
         ...  payload
+
+    full-xorb footer (40*n + 96 bytes):
+        "XETBLOB" u8(1)                     ident + version
+        32B xorb hash
+        "XBLBHSH" u8(0) u32 n  n×32B        chunk hashes
+        "XBLBBND" u8(1) u32 n  n×u32 n×u32  serialized / uncompressed
+                                            cumulative end offsets
+        u32 n, u32 footer_len-40, u32 8n+40, 4×u32 0, u32 footer_len
+
+The chunk frames are **self-framed**: any contiguous chunk range is a
+contiguous byte range, which is what makes the whole transfer economy work —
+CDN ``fetch_info.url_range`` byte ranges, partial cache entries
+(``{hash}.{range_start}``), BEP XET range responses, and ICI shard slices
+are all frame subsequences. The footer travels only with *full* xorbs
+(CDN storage artifacts, full-xorb cache entries); range reads never touch
+it, exactly as HF's CAS serves S3 byte ranges of the frame region.
 
 Chunk extraction is range-addressed — ``extract_chunk_range(start, end)`` —
 because reconstruction terms and BEP XET requests address *chunk index
@@ -32,7 +46,10 @@ from dataclasses import dataclass
 
 from zest_tpu.cas import chunking, compression, hashing
 
-FRAME_HEADER_LEN = 40
+FRAME_HEADER_LEN = 8
+FOOTER_IDENT = b"XETBLOB"
+_HSH_IDENT = b"XBLBHSH"
+_BND_IDENT = b"XBLBBND"
 # Cap on the SERIALIZED xorb (frames included) so a full xorb always fits
 # in one wire message (wire.MAX_MESSAGE_SIZE = 64 MiB + 1 KiB, minus BEP 10
 # and XET framing overhead).
@@ -42,7 +59,7 @@ MAX_CHUNKS = 8 * 1024
 # (chunking.MAX_CHUNK); the slack allows hand-built chunks while still
 # bounding what an untrusted frame header can make us allocate.
 MAX_CHUNK_BYTES = 4 * 1024 * 1024
-_MAX_COMPRESSED = (1 << 24) - 1
+_MAX_U24 = (1 << 24) - 1
 
 
 class XorbFormatError(ValueError):
@@ -55,7 +72,7 @@ class ChunkEntry:
     compressed_len: int
     uncompressed_len: int
     scheme: compression.Scheme
-    hash: bytes
+    hash: bytes | None         # known only when a footer was present
 
     @property
     def frame_len(self) -> int:
@@ -67,13 +84,40 @@ def encode_frame(data: bytes) -> tuple[bytes, bytes]:
     if len(data) > MAX_CHUNK_BYTES:
         raise XorbFormatError(f"chunk of {len(data)} bytes exceeds cap")
     scheme, payload = compression.compress_auto(data)
-    if len(payload) > _MAX_COMPRESSED:
+    if len(payload) > _MAX_U24:
         raise XorbFormatError("chunk payload too large")
     h = hashing.chunk_hash(data)
-    header = struct.pack(
-        "<I", int(scheme) | (len(payload) << 8)
-    ) + struct.pack("<I", len(data)) + h
+    header = (
+        bytes([0])
+        + len(payload).to_bytes(3, "little")
+        + bytes([int(scheme)])
+        + len(data).to_bytes(3, "little")
+    )
     return header + payload, h
+
+
+def _encode_footer(
+    xorb_hash: bytes,
+    hashes: list[tuple[bytes, int]],
+    ser_ends: list[int],
+) -> bytes:
+    n = len(hashes)
+    unc_ends, total = [], 0
+    for _, size in hashes:
+        total += size
+        unc_ends.append(total)
+    out = bytearray()
+    out += FOOTER_IDENT + bytes([1]) + xorb_hash
+    out += _HSH_IDENT + bytes([0]) + struct.pack("<I", n)
+    for h, _ in hashes:
+        out += h
+    out += _BND_IDENT + bytes([1]) + struct.pack("<I", n)
+    out += struct.pack(f"<{n}I", *ser_ends)
+    out += struct.pack(f"<{n}I", *unc_ends)
+    footer_len = 40 * n + 92
+    out += struct.pack("<8I", n, footer_len - 40, 8 * n + 40, 0, 0, 0, 0,
+                       footer_len)
+    return bytes(out)
 
 
 class XorbBuilder:
@@ -137,31 +181,84 @@ class XorbBuilder:
         return offs
 
     def serialize(self) -> bytes:
+        """Frame stream only — the in-pipeline blob shape."""
         return b"".join(self._frames)
+
+    def serialize_full(self) -> bytes:
+        """Frames + XETBLOB footer — the storage/CDN artifact, byte-
+        identical to what the production client writes (modulo per-chunk
+        compression choices)."""
+        return self.serialize() + _encode_footer(
+            self.xorb_hash(), self._hashes, self.frame_offsets()[1:]
+        )
+
+
+def parse_footer(data: bytes | memoryview) -> tuple[int, bytes, list[bytes]]:
+    """If ``data`` ends with a XETBLOB footer, return
+    (frames_end, xorb_hash, chunk_hashes); raise XorbFormatError otherwise.
+    """
+    data = memoryview(data)
+    if len(data) < 96 + 4:
+        raise XorbFormatError("too short for a XETBLOB footer")
+    (footer_len,) = struct.unpack("<I", data[-4:])
+    start = len(data) - 4 - footer_len
+    if footer_len < 92 or start < 0:
+        raise XorbFormatError("bad footer length")
+    foot = bytes(data[start : len(data) - 4])
+    if foot[:7] != FOOTER_IDENT:
+        raise XorbFormatError("missing XETBLOB ident")
+    xorb_hash = foot[8:40]
+    if foot[40:47] != _HSH_IDENT:
+        raise XorbFormatError("missing hash section")
+    (n,) = struct.unpack_from("<I", foot, 48)
+    if footer_len != 40 * n + 92 or n > MAX_CHUNKS:
+        raise XorbFormatError("footer length inconsistent with chunk count")
+    off = 52
+    hashes = [foot[off + 32 * i : off + 32 * (i + 1)] for i in range(n)]
+    off += 32 * n
+    if foot[off : off + 7] != _BND_IDENT:
+        raise XorbFormatError("missing boundary section")
+    return start, xorb_hash, hashes
 
 
 class XorbReader:
-    """Parses a frame stream and extracts verified chunk ranges.
+    """Parses a frame stream and extracts chunk ranges.
 
-    ``data`` may be a *full* xorb or any frame subsequence (a partial cache
-    entry, a CDN byte-range response, a BEP XET chunk response); chunk
-    indices here are local to the blob — callers rebase absolute term
-    indices by the blob's ``chunk_offset``.
+    ``data`` may be a *full* XETBLOB (frames + footer — a CDN storage
+    artifact or full-xorb cache entry) or any frame subsequence (a partial
+    cache entry, a CDN byte-range response, a BEP XET chunk response).
+    Chunk indices here are local to the blob — callers rebase absolute
+    term indices by the blob's ``chunk_offset``. With a footer, per-chunk
+    hashes are known and extraction verifies them; bare frame streams are
+    verified downstream (device BLAKE3 before full-xorb cache writes,
+    file-level hashes after reassembly) — the same trust model as the
+    production CDN path, whose range responses carry no hashes either.
     """
 
     def __init__(self, data: bytes | memoryview):
         data = memoryview(data)
+        self.xorb_hash_footer: bytes | None = None
+        frames_end = len(data)
+        footer_hashes: list[bytes] | None = None
+        try:
+            frames_end, self.xorb_hash_footer, footer_hashes = \
+                parse_footer(data)
+        except XorbFormatError:
+            pass
         self.entries: list[ChunkEntry] = []
         pos = 0
-        n = len(data)
-        while pos < n:
-            if pos + FRAME_HEADER_LEN > n:
+        while pos < frames_end:
+            if pos + FRAME_HEADER_LEN > frames_end:
                 raise XorbFormatError("truncated frame header")
-            (word0,) = struct.unpack("<I", data[pos : pos + 4])
-            scheme_raw = word0 & 0xFF
-            compressed_len = word0 >> 8
-            (uncompressed_len,) = struct.unpack("<I", data[pos + 4 : pos + 8])
-            h = bytes(data[pos + 8 : pos + 40])
+            if data[pos] != 0:
+                raise XorbFormatError(
+                    f"unknown chunk frame version {data[pos]}"
+                )
+            compressed_len = int.from_bytes(data[pos + 1 : pos + 4], "little")
+            scheme_raw = data[pos + 4]
+            uncompressed_len = int.from_bytes(
+                data[pos + 5 : pos + 8], "little"
+            )
             try:
                 scheme = compression.Scheme(scheme_raw)
             except ValueError as exc:
@@ -173,21 +270,37 @@ class XorbReader:
                     f"{MAX_CHUNK_BYTES})"
                 )
             end = pos + FRAME_HEADER_LEN + compressed_len
-            if end > n:
+            if end > frames_end:
                 raise XorbFormatError("frame payload extends past end")
             if len(self.entries) >= MAX_CHUNKS:
                 raise XorbFormatError("too many chunks")
+            i = len(self.entries)
+            h = footer_hashes[i] if footer_hashes and i < len(footer_hashes) \
+                else None
             self.entries.append(
                 ChunkEntry(pos, compressed_len, uncompressed_len, scheme, h)
             )
             pos = end
+        if footer_hashes is not None and len(footer_hashes) != len(self.entries):
+            raise XorbFormatError(
+                f"footer lists {len(footer_hashes)} chunks, "
+                f"frames hold {len(self.entries)}"
+            )
         self._data = data
 
     def __len__(self) -> int:
         return len(self.entries)
 
     def chunk_hashes(self) -> list[tuple[bytes, int]]:
-        return [(e.hash, e.uncompressed_len) for e in self.entries]
+        """(hash, uncompressed length) per chunk — from the footer when
+        present, else computed by decoding (the authoritative source)."""
+        out = []
+        for i, e in enumerate(self.entries):
+            h = e.hash if e.hash is not None else hashing.chunk_hash(
+                self.extract_chunk(i, verify=False)
+            )
+            out.append((h, e.uncompressed_len))
+        return out
 
     def xorb_hash(self) -> bytes:
         return hashing.xorb_hash(self.chunk_hashes())
@@ -199,7 +312,7 @@ class XorbReader:
             self._data[payload_start : payload_start + e.compressed_len]
         )
         data = compression.decompress(payload, e.scheme, e.uncompressed_len)
-        if verify and hashing.chunk_hash(data) != e.hash:
+        if verify and e.hash is not None and hashing.chunk_hash(data) != e.hash:
             raise XorbFormatError(f"chunk {index} hash mismatch")
         return data
 
@@ -232,8 +345,8 @@ class XorbReader:
 def build_from_data(data: bytes) -> tuple[bytes, bytes, list[tuple[bytes, int]]]:
     """Convenience: CDC-chunk ``data`` into one xorb.
 
-    Returns (xorb_hash, serialized_xorb, chunk_hashes). Raises if the data
-    exceeds one xorb's capacity — callers split first.
+    Returns (xorb_hash, serialized frame stream, chunk_hashes). Raises if
+    the data exceeds one xorb's capacity — callers split first.
     """
     builder = XorbBuilder()
     builder.add_data(data)
