@@ -99,8 +99,10 @@ class TestCasClient:
         for term in rec.terms:
             fi = rec.find_fetch_info(term)
             assert fi is not None, "every term must have covering fetch info"
+            # fetch_info URLs are served absolute (production behavior);
+            # pass through untouched, mirroring bridge._absolute_url.
             blob = cas.fetch_xorb_from_url(
-                hub.url + fi.url, (fi.url_range_start, fi.url_range_end)
+                fi.url, (fi.url_range_start, fi.url_range_end)
             )
             reader = XorbReader(blob)
             local_start = term.range.start - fi.range.start

@@ -94,6 +94,26 @@ def test_reader_requires_cached_units(hub, tmp_path):
         reader.read(0, 100)
 
 
+def test_reader_reports_corrupt_cache_with_cause(hub, tmp_path):
+    """A corrupt cached unit + no bridge must surface the decode failure
+    (with the underlying exception chained), not claim a cache miss."""
+    import os
+
+    bridge = _bridge(hub, tmp_path)
+    rec = _rec(hub)
+    pod_round(bridge, [rec])
+    for root, _dirs, files in os.walk(tmp_path / "zest"):
+        for name in files:
+            path = os.path.join(root, name)
+            blob = bytearray(open(path, "rb").read())
+            blob[8 : min(len(blob), 64)] = b"\xff" * (min(len(blob), 64) - 8)
+            open(path, "wb").write(bytes(blob))
+    reader = CachedFileReader(bridge.cache, rec)  # no bridge
+    with pytest.raises(DirectLandingError, match="failed to decode") as ei:
+        reader.read(0, 100)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
 def test_land_tensors_bit_exact(hub, tmp_path, ckpt):
     bridge = _bridge(hub, tmp_path)
     rec = _rec(hub)

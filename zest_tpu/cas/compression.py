@@ -257,7 +257,9 @@ def lz4_frame_decompress(data: bytes, expected_len: int) -> bytes:
     if flg & 0x08:
         pos += 8  # content size (unused; the chunk header is authoritative)
     if flg & 0x01:
-        pass  # content checksum present after the end mark; ignored
+        pos += 4  # DictID (FLG bit 0): 4-byte dictionary ID before HC
+    # FLG bit 2 (0x04) = content checksum after the end mark; the block
+    # loop stops at the end mark, so it needs no skip here.
     pos += 1  # header checksum byte
     out = bytearray()
     while True:

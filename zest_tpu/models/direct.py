@@ -58,6 +58,7 @@ class CachedFileReader:
             )
         entry = self.cache.get_with_range(term.hash_hex, fi.range.start)
         data = None
+        decode_err: ValueError | None = None
         if entry is not None:
             try:
                 local_start = term.range.start - entry.chunk_offset
@@ -65,14 +66,21 @@ class CachedFileReader:
                 data = XorbReader(entry.data).extract_chunk_range(
                     local_start, local_end
                 )
-            except Exception:
+            except ValueError as exc:  # XorbFormatError / CompressionError
                 # Corrupt/short cached entry: with a bridge it costs one
                 # term refetch (which overwrites the bad cache key — the
                 # same self-heal as fetch_xorb_for_term), never the whole
                 # landing. Without one, fail below.
                 data = None
+                decode_err = exc
         if data is None:
             if self.bridge is None:
+                if decode_err is not None:
+                    raise DirectLandingError(
+                        f"cached unit {term.hash_hex}"
+                        f"[{fi.range.start},{fi.range.end}) failed to "
+                        f"decode: {decode_err}"
+                    ) from decode_err
                 raise DirectLandingError(
                     f"unit {term.hash_hex}[{fi.range.start},{fi.range.end})"
                     " not in cache — run the distribution round first"
