@@ -1,0 +1,110 @@
+"""Generate the frozen golden fixtures under tests/golden/.
+
+The fixtures pin the XETBLOB xorb layout, the LZ4 frame encoder output,
+and the BG4/bitslice transforms against regression: once generated they
+are CHECKED IN and must never be regenerated casually — a diff in the
+frozen bytes means the on-disk/on-wire format changed, which breaks
+interop with every previously-cached xorb (and, for the layouts shared
+with production Xet, with HF's CAS). Regenerate only on a deliberate,
+versioned format change.
+
+Provenance: chunk payloads are deterministic (numpy PCG64 seed 42 +
+fixed literals), so reviewers can confirm the .bin is exactly what
+XorbBuilder emits for reproducible inputs — no opaque blobs.
+
+Run: python scripts/gen_golden_fixtures.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from zest_tpu.cas import compression as comp
+from zest_tpu.cas.hashing import chunk_hash, file_hash, hash_to_hex
+from zest_tpu.cas.xorb import XorbBuilder, parse_footer
+
+GOLDEN = pathlib.Path(__file__).resolve().parent.parent / "tests" / "golden"
+
+
+def golden_chunk_payloads() -> list[bytes]:
+    """Deterministic chunk payloads covering every auto-selected scheme:
+    incompressible (NONE), repetitive text (LZ4), smooth fp32 tensor
+    bytes (BG4_LZ4), plus a second incompressible and a structured ramp."""
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, 256, 12 * 1024, dtype=np.uint8).tobytes(),
+        (b"the quick brown fox jumps over the lazy dog. " * 512)[: 20 * 1024],
+        np.sin(np.linspace(0, 20, 4096)).astype(np.float32).tobytes(),
+        rng.integers(0, 256, 9 * 1024, dtype=np.uint8).tobytes(),
+        bytes(bytearray((i // 64) % 256 for i in range(16 * 1024))),
+    ]
+
+
+def gen_xorb() -> None:
+    payloads = golden_chunk_payloads()
+    builder = XorbBuilder()
+    for p in payloads:
+        builder.add_chunk(p)
+    full = builder.serialize_full()
+    (GOLDEN / "xorb_mixed.bin").write_bytes(full)
+
+    frames_end, _xh, footer_hashes = parse_footer(full)
+    assert frames_end == len(builder.serialize())
+    n = len(footer_hashes)
+    chunks = []
+    for p, frame_off in zip(payloads, builder.frame_offsets()):
+        scheme = comp.compress_auto(p)[0]
+        chunks.append(
+            {
+                "chunk_hash": hash_to_hex(chunk_hash(p)),
+                "scheme": int(scheme),
+                "scheme_name": comp.Scheme(scheme).name,
+                "uncompressed_len": len(p),
+                "frame_offset": frame_off,
+            }
+        )
+    meta = {
+        "comment": "frozen XETBLOB layout fixture; see gen_golden_fixtures.py",
+        "n_chunks": n,
+        "xorb_hash": hash_to_hex(builder.xorb_hash()),
+        "file_hash": hash_to_hex(file_hash(builder.chunk_hashes())),
+        "frames_len": len(builder.serialize()),
+        "full_len": len(full),
+        "chunks": chunks,
+    }
+    (GOLDEN / "xorb_mixed.json").write_text(json.dumps(meta, indent=1))
+
+
+def gen_lz4() -> None:
+    cases = {
+        "empty": b"",
+        "hello": b"hello world, golden frame",
+        "run": b"A" * 1000,
+        "text": (b"the quick brown fox jumps over the lazy dog. " * 40),
+        "ramp256": bytes(range(256)) * 8,
+    }
+    out = {}
+    for name, payload in cases.items():
+        frame = comp.lz4_frame_compress(payload)
+        assert comp.lz4_frame_decompress(frame, len(payload)) == payload
+        out[name] = {"payload_len": len(payload), "frame_hex": frame.hex()}
+    fixed = bytes(range(32))
+    out["_transforms"] = {
+        "input_hex": fixed.hex(),
+        "bg4_hex": comp._bg4(fixed).hex(),
+        "bitslice_hex": comp._bitslice(fixed).hex(),
+    }
+    (GOLDEN / "lz4_frames.json").write_text(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    GOLDEN.mkdir(parents=True, exist_ok=True)
+    gen_xorb()
+    gen_lz4()
+    print("golden fixtures written to", GOLDEN)

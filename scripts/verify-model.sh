@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Real-network end-to-end integrity gate — the reference's
+# test/local/verify-model.sh analog (reference: :90-147): pull a real
+# xet-backed repo from huggingface.co through the full CAS client into an
+# isolated HF_HOME, then load it with transformers OFFLINE and assert
+# parameter count + greedy generation. Records wall-clock and per-source
+# byte stats to a JSON report.
+#
+# Requires network egress to huggingface.co — this is exactly the check
+# that CAN'T run against loopback fixtures: it proves the chunking/
+# hashing/xorb/reconstruction stack speaks to the production CAS. Run it
+# wherever egress exists:
+#
+#   scripts/verify-model.sh [repo_id] [report.json]
+#
+# Defaults: openai-community/gpt2 → E2E_REAL.json. HF_TOKEN is optional
+# (gpt2 is public). The pytest twin is tests/test_real_e2e.py
+# (ZEST_E2E_REAL=1).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+REPO_ID="${1:-openai-community/gpt2}"
+REPORT="${2:-E2E_REAL.json}"
+ROOT=$(mktemp -d)
+trap 'rm -rf "$ROOT"' EXIT
+
+say() { printf '\n=== %s ===\n' "$*"; }
+
+say "preflight: egress to huggingface.co"
+python - <<'EOF' || { echo "NO NETWORK EGRESS — cannot run the real-e2e gate"; exit 2; }
+import urllib.request
+urllib.request.urlopen("https://huggingface.co/api/models/gpt2", timeout=10)
+EOF
+
+say "pull $REPO_ID (CDN waterfall tier; P2P off — single node)"
+START=$(python -c 'import time; print(time.monotonic())')
+env HF_HOME="$ROOT/hf" ZEST_CACHE_DIR="$ROOT/zest" \
+    python -m zest_tpu pull "$REPO_ID" --no-p2p --no-seed | tee "$ROOT/pull.log"
+END=$(python -c 'import time; print(time.monotonic())')
+
+say "verify: offline transformers load + generation"
+env HF_HOME="$ROOT/hf" HF_HUB_OFFLINE=1 TRANSFORMERS_OFFLINE=1 \
+    REPO_ID="$REPO_ID" PULL_SECONDS="$(python -c "print($END-$START)")" \
+    PULL_LOG="$ROOT/pull.log" REPORT="$REPORT" \
+    python - <<'EOF'
+import json, os, re, sys
+
+from transformers import AutoModelForCausalLM, AutoTokenizer
+
+repo = os.environ["REPO_ID"]
+model = AutoModelForCausalLM.from_pretrained(repo)
+tok = AutoTokenizer.from_pretrained(repo)
+n_params = sum(p.numel() for p in model.parameters())
+assert n_params > 100_000_000, f"only {n_params} params"
+prompt = "The quick brown fox"
+ids = tok(prompt, return_tensors="pt").input_ids
+out = model.generate(ids, max_new_tokens=8, do_sample=False)
+text = tok.decode(out[0], skip_special_tokens=True)
+assert text.startswith(prompt), text
+print(f"OK: {n_params:,} params; generated: {text!r}")
+
+log = open(os.environ["PULL_LOG"]).read()
+def grab(pat):
+    m = re.search(pat, log)
+    return int(m.group(1)) if m else None
+report = {
+    "repo": repo,
+    "wall_clock_seconds": float(os.environ["PULL_SECONDS"]),
+    "n_params": n_params,
+    "generated": text,
+    "bytes_from_peers": grab(r"From peers:\s*(\d+)"),
+    "bytes_from_cdn": grab(r"From CDN:\s*(\d+)"),
+}
+json.dump(report, open(os.environ["REPORT"], "w"), indent=1)
+print("report ->", os.environ["REPORT"])
+EOF
+
+say "PASS"

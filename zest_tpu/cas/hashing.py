@@ -131,7 +131,13 @@ def xorb_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
 def file_hash(chunk_hashes: list[tuple[bytes, int]]) -> bytes:
     """Content address of a file: the merkle root over the file's chunk
     sequence, salted — ``blake3_keyed(FILE_SALT, root)`` — so file
-    addresses never collide with xorb addresses. HF uses the zero salt."""
+    addresses never collide with xorb addresses. HF uses the zero salt.
+
+    An empty file's address is the all-zero hash (official-client
+    behavior, cross-checked in tests/test_xet_interop.py), not a salted
+    empty root."""
+    if not chunk_hashes:
+        return bytes(HASH_LEN)
     return blake3_keyed(FILE_SALT, merkle_root(chunk_hashes)[0])
 
 

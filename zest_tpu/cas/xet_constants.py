@@ -1,9 +1,10 @@
 """Production Xet content-addressing constants (interop-critical).
 
-These are the public constants of the HF Xet stack, recovered and verified
-bit-for-bit against the official ``hf_xet`` client (golden tests in
-tests/test_xet_interop.py reproduce its file hashes, chunk boundaries, and
-xorb bytes exactly):
+These are the public constants of the HF Xet stack, verified bit-for-bit
+against the installed official ``hf_xet`` client: the golden tests in
+tests/test_xet_interop.py reproduce its file hashes on inputs from empty
+through 70 MiB, which pins every constant below (a single wrong bit in
+the table, mask, keys, grouping rule, or salt changes the final hex):
 
 - ``GEAR_TABLE``: the 256-entry u64 table of the public ``gearhash`` crate
   (MIT) used by xet-core's content-defined chunker. Boundary rule: roll

@@ -6,9 +6,12 @@ and caching in the whole system (reference behavior: SURVEY.md §2.2 rows
 src/bt_wire.zig:22). The xorb's identity is the Merkle root over its chunk
 hashes (zest_tpu.cas.hashing.xorb_hash).
 
-This module implements the PRODUCTION XETBLOB layout byte-for-byte
-(verified against real xorbs written by the official client,
-tests/test_xet_interop.py):
+This module implements the XETBLOB layout. The chunk/xorb/file content
+addresses it computes ARE production HF CAS addresses (pinned against the
+official hf_xet client in tests/test_xet_interop.py); the container
+byte layout itself is pinned by a frozen golden fixture in the same
+suite — no production xorb can be captured offline, so layout compat
+with the official writer rests on the format description below:
 
     per chunk frame (8 + compressed_len bytes, integers little-endian):
         u8   version          (0)
@@ -185,9 +188,8 @@ class XorbBuilder:
         return b"".join(self._frames)
 
     def serialize_full(self) -> bytes:
-        """Frames + XETBLOB footer — the storage/CDN artifact, byte-
-        identical to what the production client writes (modulo per-chunk
-        compression choices)."""
+        """Frames + XETBLOB footer — the storage/CDN artifact shape
+        (layout frozen by tests/test_xet_interop.py golden fixtures)."""
         return self.serialize() + _encode_footer(
             self.xorb_hash(), self._hashes, self.frame_offsets()[1:]
         )
