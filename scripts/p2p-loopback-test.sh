@@ -41,7 +41,8 @@ say "seeder: serve"
 env "${common_env[@]}" \
     HF_HOME="$ROOT/seeder/hf" ZEST_CACHE_DIR="$ROOT/seeder/zest" \
     ZEST_LISTEN_PORT="$LISTEN_PORT" ZEST_HTTP_PORT=19847 \
-    python -m zest_tpu serve --listen-port "$LISTEN_PORT" --http-port 19847 &
+    python -m zest_tpu serve --listen-port "$LISTEN_PORT" --http-port 19847 \
+        --dcn-port 0 &
 PIDS+=($!)
 for _ in $(seq 1 50); do
   python - "$LISTEN_PORT" <<'EOF' && break

@@ -1,0 +1,66 @@
+"""Child process for the two-process federated-round test.
+
+Runs as a REAL separate process (no monkeypatched process indices): pod 0
+fetches its owned units from the fixture hub's CDN, serves them on an
+ephemeral DCN port, and stays up until the parent signals done. The
+parent process (pytest) is pod 1 and pulls pod-0-owned units over the DCN
+chunk RPC — real bytes over a real socket between two OS processes.
+
+Usage: python tests/_federated_child.py HUB_URL ROOT_DIR REPO_ID
+Writes: ROOT_DIR/port       (the DCN port, once serving)
+        ROOT_DIR/stats.json (pod 0's federated_round stats)
+Exits when ROOT_DIR/done appears (rc 0) or after 60s (rc 3).
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+
+def main() -> int:
+    hub_url, root, repo_id = sys.argv[1], pathlib.Path(sys.argv[2]), sys.argv[3]
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.federated import federated_round
+
+    cfg = Config(
+        hf_home=root / "hf",
+        cache_dir=root / "zest",
+        hf_token="hf_test",
+        endpoint=hub_url,
+        dcn_port=0,  # ephemeral
+    )
+    bridge = XetBridge(cfg)
+    bridge.authenticate(repo_id)
+    recs = [
+        bridge.get_reconstruction(e.xet_hash)
+        for e in HubClient(cfg).list_files(repo_id)
+        if e.is_xet
+    ]
+
+    # Pod 0 of 2, no peers: fetch own units from CDN, CDN-degrade nothing
+    # (foreign units are pod 1's business).
+    stats = federated_round(bridge, recs, 0, 2, pod_addrs={})
+    (root / "stats.json").write_text(json.dumps(stats))
+
+    server = DcnServer(cfg, bridge.cache)
+    port = server.start()
+    (root / "port").write_text(str(port))
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if (root / "done").exists():
+            server.shutdown()
+            return 0
+        time.sleep(0.1)
+    server.shutdown()
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

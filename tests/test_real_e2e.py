@@ -37,7 +37,7 @@ def cfg(tmp_path_factory):
     )
 
 
-def test_real_pull_hashes_and_loads(cfg):
+def test_real_pull_hashes_and_loads(cfg, monkeypatch):
     from zest_tpu.cas.chunking import chunk_stream
     from zest_tpu.cas.hashing import chunk_hash, file_hash, hash_to_hex
     from zest_tpu.cas.hub import HubClient
@@ -61,22 +61,19 @@ def test_real_pull_hashes_and_loads(cfg):
     assert n_xet > 0, "expected at least one xet-backed file"
 
     # The reference's bar: transformers loads it offline, >100M params,
-    # greedy generation echoes the prompt.
-    os.environ["HF_HUB_OFFLINE"] = "1"
-    os.environ["TRANSFORMERS_OFFLINE"] = "1"
-    try:
-        from transformers import AutoModelForCausalLM, AutoTokenizer
+    # greedy generation echoes the prompt. monkeypatch restores whatever
+    # offline-mode values the environment already had.
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    monkeypatch.setenv("TRANSFORMERS_OFFLINE", "1")
+    from transformers import AutoModelForCausalLM, AutoTokenizer
 
-        model = AutoModelForCausalLM.from_pretrained(
-            REPO, cache_dir=cfg.hf_home / "hub"
-        )
-        tok = AutoTokenizer.from_pretrained(REPO, cache_dir=cfg.hf_home / "hub")
-        assert sum(p.numel() for p in model.parameters()) > 100_000_000
-        ids = tok("The quick brown fox", return_tensors="pt").input_ids
-        out = model.generate(ids, max_new_tokens=8, do_sample=False)
-        assert tok.decode(out[0], skip_special_tokens=True).startswith(
-            "The quick brown fox"
-        )
-    finally:
-        os.environ.pop("HF_HUB_OFFLINE", None)
-        os.environ.pop("TRANSFORMERS_OFFLINE", None)
+    model = AutoModelForCausalLM.from_pretrained(
+        REPO, cache_dir=cfg.hf_home / "hub"
+    )
+    tok = AutoTokenizer.from_pretrained(REPO, cache_dir=cfg.hf_home / "hub")
+    assert sum(p.numel() for p in model.parameters()) > 100_000_000
+    ids = tok("The quick brown fox", return_tensors="pt").input_ids
+    out = model.generate(ids, max_new_tokens=8, do_sample=False)
+    assert tok.decode(out[0], skip_special_tokens=True).startswith(
+        "The quick brown fox"
+    )

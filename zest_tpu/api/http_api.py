@@ -34,12 +34,14 @@ class HttpApi:
         registry=None,
         hbm_cache=None,
         swarm=None,
+        dcn_server=None,
     ):
         self.cfg = cfg
         self.bt_server = bt_server
         self.registry = registry
         self.hbm_cache = hbm_cache
         self.swarm = swarm
+        self.dcn_server = dcn_server
         self.http_requests = 0
         self.shutdown_event = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
@@ -103,6 +105,15 @@ class HttpApi:
             "listen_port": self.cfg.listen_port,
             "http_port": self.port,
         }
+        if self.dcn_server is not None and self.dcn_server.port is not None:
+            d = self.dcn_server.stats
+            payload["dcn"] = {
+                "port": self.dcn_server.port,
+                "connections": d.connections,
+                "chunks_served": d.chunks_served,
+                "bytes_served": d.bytes_served,
+                "not_found": d.not_found,
+            }
         if self.hbm_cache is not None:
             payload["hbm"] = self.hbm_cache.summary()
         if self.cfg.mesh.mesh_axes:

@@ -171,8 +171,12 @@ def cmd_serve(args) -> int:
     if args.listen_port:
         cfg.listen_port = args.listen_port
 
+    if args.dcn_port is not None:
+        cfg.dcn_port = args.dcn_port
+
     from zest_tpu import storage
     from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.transfer.dcn import DcnServer
     from zest_tpu.transfer.server import BtServer
 
     registry = storage.XorbRegistry()
@@ -181,10 +185,21 @@ def cmd_serve(args) -> int:
 
     bt = BtServer(cfg)
     port = bt.start()
-    print(f"seeding on :{port}")
+    print(f"seeding on :{port} (BT wire)")
+
+    # Same cache, second transport: the lean chunk RPC other zest hosts
+    # use across DCN (foreign BT clients keep the wire protocol above).
+    # A taken port degrades to BT-only serving, not a dead daemon.
+    dcn_server = DcnServer(cfg, bt.cache)
+    try:
+        dcn_port = dcn_server.start()
+        print(f"seeding on :{dcn_port} (DCN chunk RPC)")
+    except OSError as exc:
+        print(f"DCN listener disabled (port {cfg.dcn_port}: {exc})")
 
     _write_pid_file(cfg)
-    api = HttpApi(cfg, bt_server=bt, registry=registry)
+    api = HttpApi(cfg, bt_server=bt, registry=registry,
+                  dcn_server=dcn_server)
     api.start()
     print(f"dashboard: http://127.0.0.1:{api.port}/")
 
@@ -197,6 +212,7 @@ def cmd_serve(args) -> int:
         api.shutdown_event.wait()
     finally:
         api.close()
+        dcn_server.shutdown()
         bt.shutdown()
         _remove_pid_file(cfg)
     return 0
@@ -309,6 +325,8 @@ def build_parser() -> argparse.ArgumentParser:
     serve = sub.add_parser("serve", help="run the seeding server (foreground)")
     serve.add_argument("--http-port", type=int, default=None)
     serve.add_argument("--listen-port", type=int, default=None)
+    serve.add_argument("--dcn-port", type=int, default=None,
+                       help="DCN chunk-RPC port (0 = ephemeral)")
     serve.set_defaults(fn=cmd_serve)
 
     sub.add_parser("start", help="start the daemon in the background") \

@@ -1,0 +1,497 @@
+"""DCN host-to-host chunk RPC — the cross-pod transport tier.
+
+In-pod, bulk bytes ride ICI as XLA collectives (zest_tpu.transfer.pod);
+off-pod BitTorrent peers ride the full BT interop stack (zest_tpu.p2p).
+Between *our own* hosts across DCN neither fits: collectives need one
+jax.distributed mesh spanning every host, and the BT stack pays for a
+handshake dance + bencoded extension negotiation that exists only for
+interop with foreign clients. This module is the third transport: a lean,
+pipelined request/response protocol between zest hosts, bound to
+``Config.dcn_port``, with exactly the reference's BEP XET semantics —
+CHUNK_REQUEST / CHUNK_RESPONSE / CHUNK_NOT_FOUND / CHUNK_ERROR over one
+long-lived TCP stream with request-ID matching (reference:
+src/bep_xet.zig:66-124, pipelining: src/bt_peer.zig:188-248) — minus the
+BT framing it doesn't need.
+
+Wire format (version 1, all integers little-endian; both sides send an
+8-byte hello on connect, then messages flow in either direction):
+
+    hello:   "ZDCN" u8 version  u8 flags(0)  u16 reserved(0)
+    message: u8 type  u8 flags(0)  u16 reserved(0)  u32 req_id  u32 len
+             + len payload bytes
+    REQUEST   (1): 32B xorb hash + u64 chunk_start + u64 chunk_end
+    RESPONSE  (2): u64 chunk_offset + frame bytes
+    NOT_FOUND (3): 32B xorb hash
+    ERROR     (4): utf-8 message
+
+Ranges are chunk-index ranges within a xorb and responses carry the
+``chunk_offset`` their frames start at — identical coordinate frames to
+BEP XET, so cache rebasing logic is shared. The 64 MiB+1KB payload cap
+matches the BT wire cap (src/bt_wire.zig:22): a full xorb always fits.
+
+Serving reads the same two cache tiers as the BT seeding server — the
+lookup is factored into :func:`lookup_chunk_range` and shared by both —
+so a host answers identically whether asked over DCN or BT wire.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
+from zest_tpu.config import Config
+from zest_tpu.p2p.wire import MAX_MESSAGE_SIZE
+from zest_tpu.storage import XorbCache, read_chunk
+
+MAGIC = b"ZDCN"
+VERSION = 1
+_HELLO = MAGIC + bytes([VERSION, 0, 0, 0])
+_HEADER = struct.Struct("<BBHII")
+
+MSG_REQUEST = 1
+MSG_RESPONSE = 2
+MSG_NOT_FOUND = 3
+MSG_ERROR = 4
+
+# A silent peer (half-open connection, port scanner that said hello)
+# releases its serving thread after this long; clients hold channels
+# with in-flight traffic, and an expired channel just reconnects.
+IDLE_TIMEOUT_S = 300.0
+
+_REQ_BODY = struct.Struct("<32sQQ")
+
+
+class DcnProtocolError(ConnectionError):
+    pass
+
+
+@dataclass(frozen=True)
+class DcnRequest:
+    request_id: int
+    chunk_hash: bytes
+    range_start: int
+    range_end: int
+
+
+@dataclass(frozen=True)
+class DcnResponse:
+    request_id: int
+    chunk_offset: int
+    data: bytes
+
+
+@dataclass(frozen=True)
+class DcnNotFound:
+    request_id: int
+    chunk_hash: bytes
+
+
+@dataclass(frozen=True)
+class DcnError:
+    request_id: int
+    message: str
+
+
+DcnMessage = DcnRequest | DcnResponse | DcnNotFound | DcnError
+
+
+# ── Codec (fixed-buffer roundtrip-testable, no sockets) ──
+
+
+def encode_message(msg: DcnMessage) -> bytes:
+    if isinstance(msg, DcnRequest):
+        body = _REQ_BODY.pack(msg.chunk_hash, msg.range_start, msg.range_end)
+        mtype = MSG_REQUEST
+    elif isinstance(msg, DcnResponse):
+        body = struct.pack("<Q", msg.chunk_offset) + msg.data
+        mtype = MSG_RESPONSE
+    elif isinstance(msg, DcnNotFound):
+        body = msg.chunk_hash
+        mtype = MSG_NOT_FOUND
+    elif isinstance(msg, DcnError):
+        body = msg.message.encode()
+        mtype = MSG_ERROR
+    else:  # pragma: no cover - type system guards this
+        raise DcnProtocolError(f"unencodable message {msg!r}")
+    if len(body) > MAX_MESSAGE_SIZE:
+        raise DcnProtocolError(f"payload of {len(body)} bytes over cap")
+    return _HEADER.pack(mtype, 0, 0, msg.request_id, len(body)) + body
+
+
+def decode_message(header: bytes, body: bytes) -> DcnMessage:
+    mtype, _flags, _rsvd, req_id, length = _HEADER.unpack(header)
+    if length != len(body):
+        raise DcnProtocolError("body length disagrees with header")
+    if mtype == MSG_REQUEST:
+        if len(body) != _REQ_BODY.size:
+            raise DcnProtocolError("bad REQUEST body")
+        h, start, end = _REQ_BODY.unpack(body)
+        return DcnRequest(req_id, h, start, end)
+    if mtype == MSG_RESPONSE:
+        if len(body) < 8:
+            raise DcnProtocolError("bad RESPONSE body")
+        (offset,) = struct.unpack_from("<Q", body)
+        return DcnResponse(req_id, offset, body[8:])
+    if mtype == MSG_NOT_FOUND:
+        if len(body) != hashing.HASH_LEN:
+            raise DcnProtocolError("bad NOT_FOUND body")
+        return DcnNotFound(req_id, body)
+    if mtype == MSG_ERROR:
+        return DcnError(req_id, body.decode(errors="replace"))
+    raise DcnProtocolError(f"unknown message type {mtype}")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("DCN peer closed the stream")
+        buf += part
+    return bytes(buf)
+
+
+def _recv_message(sock: socket.socket) -> DcnMessage:
+    header = _recv_exact(sock, _HEADER.size)
+    length = struct.unpack_from("<I", header, 8)[0]
+    if length > MAX_MESSAGE_SIZE:
+        raise DcnProtocolError(f"message of {length} bytes over cap")
+    return decode_message(header, _recv_exact(sock, length))
+
+
+def _exchange_hello(sock: socket.socket) -> None:
+    sock.sendall(_HELLO)
+    theirs = _recv_exact(sock, len(_HELLO))
+    if theirs[:4] != MAGIC:
+        raise DcnProtocolError("peer is not a zest DCN endpoint")
+    if theirs[4] != VERSION:
+        raise DcnProtocolError(f"unsupported DCN version {theirs[4]}")
+
+
+# ── Shared cache lookup (BT server and DCN server answer identically) ──
+
+
+def lookup_chunk_range(
+    cfg: Config,
+    cache: XorbCache,
+    chunk_hash: bytes,
+    range_start: int,
+    range_end: int,
+) -> tuple[int, bytes] | None:
+    """Two-tier cache read for a chunk-range request: the chunk cache
+    (single chunk, wrapped into one frame), then the xorb cache with
+    range rebasing (reference: src/server.zig:187-215). Returns
+    (chunk_offset, frame bytes) or None."""
+    data = read_chunk(cfg, chunk_hash)
+    if data is not None:
+        frame, _h = encode_frame(data)
+        return 0, frame
+
+    hash_hex = hashing.hash_to_hex(chunk_hash)
+    cached = cache.get_with_range(hash_hex, range_start)
+    if cached is None:
+        return None
+    blob, offset = cached.data, cached.chunk_offset
+    try:
+        reader = XorbReader(blob)
+        local_start = range_start - offset
+        local_end = range_end - offset
+        if 0 <= local_start < local_end <= len(reader):
+            blob = reader.slice_range(local_start, local_end)
+            offset = range_start
+    except XorbFormatError:
+        pass  # serve the whole entry; requester re-slices
+    return offset, blob
+
+
+# ── Server ──
+
+
+@dataclass
+class DcnServerStats:
+    connections: int = 0
+    chunks_served: int = 0
+    bytes_served: int = 0
+    not_found: int = 0
+
+
+class DcnServer:
+    """Chunk-RPC listener bound to ``cfg.dcn_port`` (0 = ephemeral).
+
+    One thread per connection, sequential request service per stream —
+    responses go back in request order, and clients pipeline by tagging
+    request IDs (the reference's model: one serve loop per peer,
+    src/server.zig:158-172).
+    """
+
+    def __init__(self, cfg: Config, cache: XorbCache | None = None):
+        self.cfg = cfg
+        self.cache = cache or XorbCache(cfg)
+        self.port: int | None = None
+        self.stats = DcnServerStats()
+        self._stats_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._sock: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("0.0.0.0", self.cfg.dcn_port))
+            sock.listen(64)
+        except OSError:
+            sock.close()
+            raise
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="dcn-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._stats_lock:
+                self.stats.connections += 1
+            # Daemon threads, deliberately not tracked: each exits when
+            # its peer disconnects, idles past IDLE_TIMEOUT_S, or the
+            # listener shuts down — holding references would only grow a
+            # list for the daemon's lifetime.
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn.settimeout(IDLE_TIMEOUT_S)
+                _exchange_hello(conn)
+                while not self._shutdown.is_set():
+                    msg = _recv_message(conn)
+                    if not isinstance(msg, DcnRequest):
+                        conn.sendall(encode_message(DcnError(
+                            msg.request_id, "server accepts only REQUEST"
+                        )))
+                        continue
+                    self._serve_request(conn, msg)
+        except (ConnectionError, DcnProtocolError, OSError):
+            return  # peer went away / spoke garbage: drop the connection
+
+    def _serve_request(self, conn: socket.socket, req: DcnRequest) -> None:
+        if not req.range_start < req.range_end:
+            conn.sendall(encode_message(DcnError(
+                req.request_id,
+                f"invalid range [{req.range_start},{req.range_end})",
+            )))
+            return
+        found = lookup_chunk_range(
+            self.cfg, self.cache, req.chunk_hash,
+            req.range_start, req.range_end,
+        )
+        if found is None:
+            with self._stats_lock:
+                self.stats.not_found += 1
+            conn.sendall(encode_message(
+                DcnNotFound(req.request_id, req.chunk_hash)
+            ))
+            return
+        offset, blob = found
+        # Count before sending: a client that got the last response must
+        # observe the stats it implies (the send is the visibility edge).
+        with self._stats_lock:
+            self.stats.chunks_served += 1
+            self.stats.bytes_served += len(blob)
+        conn.sendall(encode_message(
+            DcnResponse(req.request_id, offset, blob)
+        ))
+
+
+# ── Client ──
+
+
+class DcnChannel:
+    """One pipelined stream to a remote host's DcnServer.
+
+    Thread-safe: senders tag monotonically increasing request IDs; a
+    single reader thread matches responses back to waiting callers, so
+    any number of threads can have requests in flight on one TCP
+    connection (queue-depth management per SURVEY.md §2.4 row "request
+    pipelining")."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.address = (host, port)
+        self.timeout = timeout
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _exchange_hello(self._sock)
+        # The connect/hello timeout must not linger: the reader thread
+        # blocks between requests indefinitely (idle ≠ dead); per-request
+        # deadlines live in _Waiter.wait, not on the socket.
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._next_id = 0
+        self._pending: dict[int, _Waiter] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self.dead = False  # reader saw EOF/error; pool must reconnect
+        self._reader = threading.Thread(
+            target=self._read_loop, name="dcn-reader", daemon=True
+        )
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        self.dead = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._fail_all(ConnectionError("channel closed"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._pending_lock:
+            waiters = list(self._pending.values())
+            self._pending.clear()
+        for w in waiters:
+            w.error = exc
+            w.event.set()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _recv_message(self._sock)
+                with self._pending_lock:
+                    waiter = self._pending.pop(msg.request_id, None)
+                if waiter is not None:
+                    waiter.result = msg
+                    waiter.event.set()
+        except (ConnectionError, DcnProtocolError, OSError) as exc:
+            self.dead = True
+            if not self._closed:
+                self._fail_all(exc)
+
+    def send_request(
+        self, chunk_hash: bytes, range_start: int, range_end: int
+    ) -> "_Waiter":
+        """Fire one request; returns a waiter to collect later — callers
+        batch N sends then collect N waits to pipeline."""
+        if self.dead:
+            raise ConnectionError("DCN channel is dead")
+        with self._send_lock:
+            req_id = self._next_id
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            waiter = _Waiter(req_id)
+            with self._pending_lock:
+                self._pending[req_id] = waiter
+            try:
+                self._sock.sendall(encode_message(
+                    DcnRequest(req_id, chunk_hash, range_start, range_end)
+                ))
+            except OSError as exc:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                raise ConnectionError(f"DCN send failed: {exc}") from exc
+        return waiter
+
+    def request(
+        self, chunk_hash: bytes, range_start: int, range_end: int
+    ) -> DcnMessage:
+        return self.send_request(
+            chunk_hash, range_start, range_end
+        ).wait(self.timeout)
+
+    def request_many(
+        self, wants: list[tuple[bytes, int, int]]
+    ) -> list[DcnMessage]:
+        """Pipelined batch: all requests go out before any response is
+        awaited; results come back in ``wants`` order."""
+        waiters = [self.send_request(*w) for w in wants]
+        return [w.wait(self.timeout) for w in waiters]
+
+
+class _Waiter:
+    __slots__ = ("request_id", "event", "result", "error")
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self.event = threading.Event()
+        self.result: DcnMessage | None = None
+        self.error: Exception | None = None
+
+    def wait(self, timeout: float) -> DcnMessage:
+        if not self.event.wait(timeout):
+            raise TimeoutError(
+                f"DCN request {self.request_id} timed out after {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        assert self.result is not None
+        return self.result
+
+
+class DcnPool:
+    """Long-lived channels keyed by (host, port). Pod topology is static,
+    so channels persist for the process lifetime — the reference's
+    LRU-evicting PeerPool degenerates to a plain dict here (SURVEY.md
+    §2.1 row 8: "mostly subsumed by persistent pod topology")."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self._channels: dict[tuple[str, int], DcnChannel] = {}
+        self._lock = threading.Lock()
+
+    def channel(self, host: str, port: int) -> DcnChannel:
+        key = (host, port)
+        with self._lock:
+            ch = self._channels.get(key)
+            if ch is not None and ch.dead:
+                # Server-side idle close (IDLE_TIMEOUT_S) or a dropped
+                # link killed the reader; an expired channel reconnects
+                # instead of poisoning every later round.
+                del self._channels[key]
+                ch.close()
+                ch = None
+        if ch is not None:
+            return ch
+        ch = DcnChannel(host, port, timeout=self.timeout)
+        with self._lock:
+            # connect raced: keep the first live one, close ours
+            existing = self._channels.get(key)
+            if existing is not None and not existing.dead:
+                ch.close()
+                return existing
+            self._channels[key] = ch
+            return ch
+
+    def drop(self, host: str, port: int) -> None:
+        with self._lock:
+            ch = self._channels.pop((host, port), None)
+        if ch is not None:
+            ch.close()
+
+    def close(self) -> None:
+        with self._lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
+            ch.close()

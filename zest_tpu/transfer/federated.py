@@ -1,0 +1,255 @@
+"""Federated multi-pod rounds: cross-pod bytes over the DCN chunk RPC.
+
+zest_tpu.parallel.hierarchy covers multi-pod distribution when every host
+joins ONE jax.distributed mesh — the cross-pod stage is then an XLA
+all-gather that XLA routes over DCN. This module covers the other
+deployment shape, the one the reference's WAN swarm actually serves
+(SURVEY.md §2.4 "peer-to-peer transport" row): pods that are *separate
+processes/jobs* with no shared mesh — separate trainers, a warm pod
+seeding a cold one, staggered pod startup. Between such pods no
+collective exists; bytes move over zest_tpu.transfer.dcn instead.
+
+The round keeps the reference's waterfall contract per unit
+(xet_bridge.zig:149-218), with the DCN pod tier slotted between the local
+cache and the CDN:
+
+    local cache  →  owner pod over DCN  →  (BT peers)  →  CDN
+
+Ownership is the same HRW pod draw as the hierarchical plan
+(hierarchy.owner_pod_host), so every pod independently computes the same
+owner map with no coordination, CDN ingress stays balanced across pods
+(each unit leaves the CDN once, through its owning pod), and DCN carries
+each unit at most (n_pods - 1) times. A failed/missing owner degrades the
+unit to CDN — the waterfall's safety net (SURVEY.md §5 failure
+detection).
+
+After the cross-pod stage, every unit is in the local cache and an
+ordinary in-pod pod_round distributes it over ICI; the two stages
+compose exactly like the hierarchical distributor's dcn/ici stages, but
+across process boundaries.
+"""
+
+from __future__ import annotations
+
+import time
+
+from zest_tpu.cas import hashing
+from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.parallel.hierarchy import owner_pod_host
+from zest_tpu.parallel.plan import collect_units
+from zest_tpu.transfer.dcn import DcnPool, DcnResponse
+
+
+def pod_owned_units(
+    recs: list[Reconstruction], pod_index: int, n_pods: int
+) -> tuple[list[tuple[str, FetchInfo]], dict[int, list[tuple[str, FetchInfo]]]]:
+    """Split the deduplicated fetch units into (mine, theirs-by-pod).
+
+    Host-level fan-out inside the pod is the in-pod round's business;
+    here only the pod draw matters, so hosts_per_pod is pinned to 1 in
+    the HRW call (the pod draw is independent of it by construction).
+    """
+    mine: list[tuple[str, FetchInfo]] = []
+    theirs: dict[int, list[tuple[str, FetchInfo]]] = {}
+    for (hash_hex, start), fi in collect_units(recs):
+        pod, _host = owner_pod_host(
+            hashing.hex_to_hash(hash_hex), start, n_pods, 1
+        )
+        if pod == pod_index:
+            mine.append((hash_hex, fi))
+        else:
+            theirs.setdefault(pod, []).append((hash_hex, fi))
+    return mine, theirs
+
+
+def _blob_covers(data: bytes, n_chunks: int) -> bool:
+    """Structural gate before caching a DCN blob (same rule as the BT
+    peer tier, bridge._blob_covers): parses and holds >= n_chunks frames.
+    BLAKE3 content verification happens at extraction, as everywhere."""
+    try:
+        return len(XorbReader(data)) >= n_chunks
+    except Exception:
+        return False
+
+
+def _already_cached(bridge, hash_hex: str, fi: FetchInfo) -> bool:
+    """True when the local cache already serves [fi.range) — both to skip
+    the fetch and, critically, to never *write*: a blob that round-tripped
+    through a fetch_unit cache hit can be a narrower slice of the cached
+    entry (e.g. a full xorb answering a [0,3) unit), and re-putting it
+    would evict chunks already local."""
+    entry = bridge.cache.get_with_range(hash_hex, fi.range.start)
+    if entry is None or entry.chunk_offset > fi.range.start:
+        return False
+    return _blob_covers(entry.data, fi.range.end - entry.chunk_offset)
+
+
+def _entries_by_hash(recs: list[Reconstruction]) -> dict[str, list[FetchInfo]]:
+    out: dict[str, list[FetchInfo]] = {}
+    for rec in recs:
+        for hash_hex, entries in rec.fetch_info.items():
+            out.setdefault(hash_hex, []).extend(entries)
+    return out
+
+
+def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
+                chunk_offset: int, data: bytes) -> None:
+    """Cache a fetched unit under the same full-vs-partial rule as the
+    bridge (_cache_fetched): full key only with whole-xorb evidence."""
+    entries = entries_map.get(hash_hex, [])
+    if chunk_offset == 0 and len(entries) == 1 \
+            and entries[0].range.start == 0:
+        bridge.cache.put(hash_hex, data)
+    else:
+        bridge.cache.put_partial(hash_hex, chunk_offset, data)
+
+
+def federated_round(
+    bridge,
+    recs: list[Reconstruction],
+    pod_index: int,
+    n_pods: int,
+    pod_addrs: dict[int, tuple[str, int]],
+    dcn_pool: DcnPool | None = None,
+    pipeline_depth: int = 16,
+    log=None,
+) -> dict:
+    """One cross-pod stage: fetch owned units via the waterfall, pull
+    foreign-owned units from their owner pods over DCN (pipelined,
+    ``pipeline_depth`` in flight per channel — the reference's
+    max_concurrent analog, config.zig:13), CDN-fallback anything the
+    owner can't serve. Afterwards every unit is locally cached; run
+    pod_round(mesh) to spread them in-pod over ICI.
+
+    ``pod_addrs`` maps pod index → (host, dcn_port). Missing pods are
+    treated as unreachable (their units degrade to CDN).
+    """
+    t0 = time.monotonic()
+    pool = dcn_pool or DcnPool()
+    own_pool = dcn_pool is None
+    mine, theirs = pod_owned_units(recs, pod_index, n_pods)
+    entries_map = _entries_by_hash(recs)
+
+    stats = {
+        "pod": pod_index,
+        "pods": n_pods,
+        "own_units": 0,
+        "own_bytes": 0,
+        "cached_units": 0,
+        "dcn_units": 0,
+        "dcn_bytes": 0,
+        "fallback_units": 0,
+        "fallback_bytes": 0,
+        "failed_units": 0,
+    }
+
+    # Stage 1: own units through the regular waterfall (cache/peers/CDN),
+    # persisted so this pod can serve them to the others.
+    for hash_hex, fi in mine:
+        if _already_cached(bridge, hash_hex, fi):
+            stats["own_units"] += 1
+            continue
+        try:
+            data = bridge.fetch_unit(hash_hex, fi)
+        except Exception:
+            stats["failed_units"] += 1
+            continue
+        _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)
+        stats["own_units"] += 1
+        stats["own_bytes"] += len(data)
+
+    # Stage 2: foreign units from their owner pod, pipelined per channel.
+    def fallback(units):
+        for hash_hex, fi in units:
+            if _already_cached(bridge, hash_hex, fi):
+                stats["fallback_units"] += 1
+                continue
+            try:
+                data = bridge.fetch_unit(hash_hex, fi)
+            except Exception:
+                stats["failed_units"] += 1
+                continue
+            _cache_unit(bridge, entries_map, hash_hex, fi,
+                        fi.range.start, data)
+            stats["fallback_units"] += 1
+            stats["fallback_bytes"] += len(data)
+
+    for pod, all_units in sorted(theirs.items()):
+        units = []
+        for hash_hex, fi in all_units:
+            if _already_cached(bridge, hash_hex, fi):
+                stats["cached_units"] += 1
+            else:
+                units.append((hash_hex, fi))
+        if not units:
+            continue
+        addr = pod_addrs.get(pod)
+
+        def connect():
+            if addr is None:
+                return None
+            try:
+                return pool.channel(*addr)
+            except (OSError, ConnectionError):
+                return None
+
+        channel = connect()
+        if channel is None:
+            fallback(units)
+            continue
+        i = 0
+        retried = False
+        while i < len(units):
+            window = units[i : i + pipeline_depth]
+            missed = []
+            try:
+                replies = channel.request_many([
+                    (hashing.hex_to_hash(hh), fi.range.start, fi.range.end)
+                    for hh, fi in window
+                ])
+            except (ConnectionError, TimeoutError, OSError):
+                # One reconnect per pod: a transient failure (stale
+                # channel after a long idle gap, a blip mid-transfer)
+                # shouldn't push the pod's remaining gigabytes to CDN.
+                pool.drop(*addr)
+                if not retried:
+                    retried = True
+                    channel = connect()
+                    if channel is not None:
+                        continue  # retry the same window
+                fallback(units[i:])
+                break
+            for (hash_hex, fi), reply in zip(window, replies):
+                if (
+                    isinstance(reply, DcnResponse)
+                    and reply.chunk_offset <= fi.range.start
+                    and _blob_covers(
+                        reply.data,
+                        fi.range.end - reply.chunk_offset,
+                    )
+                ):
+                    _cache_unit(
+                        bridge, entries_map, hash_hex, fi,
+                        reply.chunk_offset, reply.data,
+                    )
+                    bridge.stats.record("peer", len(reply.data))
+                    stats["dcn_units"] += 1
+                    stats["dcn_bytes"] += len(reply.data)
+                else:
+                    missed.append((hash_hex, fi))
+            fallback(missed)
+            i += pipeline_depth
+
+    if own_pool:
+        pool.close()
+    stats["units"] = len(mine) + sum(len(u) for u in theirs.values())
+    stats["elapsed_s"] = round(time.monotonic() - t0, 3)
+    if log is not None:
+        log(
+            f"federated round pod {pod_index}/{n_pods}: "
+            f"{stats['own_units']} own, {stats['dcn_units']} over DCN "
+            f"({stats['dcn_bytes']} bytes), {stats['fallback_units']} "
+            f"CDN-fallback, {stats['failed_units']} failed"
+        )
+    return stats

@@ -21,12 +21,11 @@ import socket
 import threading
 from dataclasses import dataclass
 
-from zest_tpu.cas import hashing
-from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
 from zest_tpu.config import Config
 from zest_tpu.p2p import bep_xet, peer_id as peer_id_mod, wire
 from zest_tpu.p2p.peer import LOCAL_UT_XET_ID
-from zest_tpu.storage import XorbCache, read_chunk
+from zest_tpu.storage import XorbCache
+from zest_tpu.transfer.dcn import lookup_chunk_range
 
 
 @dataclass
@@ -144,30 +143,14 @@ class BtServer:
         ext_id: int,
         req: bep_xet.ChunkRequest,
     ) -> None:
-        # Tier 1: chunk cache (plain byte-hex keys, storage.zig:91-99).
-        # Wrapped into a single frame so every response tier yields the
-        # same parseable frame-stream shape the bridge expects.
-        data = read_chunk(self.cfg, req.chunk_hash)
-        if data is not None:
-            frame, _h = encode_frame(data)
-            self._respond(stream, ext_id, req.request_id, 0, frame)
-            return
-
-        # Tier 2: xorb cache, range-aware (LE-u64-hex keys,
-        # server.zig:201-204).
-        hash_hex = hashing.hash_to_hex(req.chunk_hash)
-        cached = self.cache.get_with_range(hash_hex, req.range_start)
-        if cached is not None:
-            blob, offset = cached.data, cached.chunk_offset
-            try:
-                reader = XorbReader(blob)
-                local_start = req.range_start - offset
-                local_end = req.range_end - offset
-                if 0 <= local_start < local_end <= len(reader):
-                    blob = reader.slice_range(local_start, local_end)
-                    offset = req.range_start
-            except XorbFormatError:
-                pass  # serve the whole entry; requester re-slices
+        # Shared two-tier lookup (chunk cache, then range-aware xorb
+        # cache) — identical answers over BT wire and DCN RPC.
+        found = lookup_chunk_range(
+            self.cfg, self.cache, req.chunk_hash,
+            req.range_start, req.range_end,
+        )
+        if found is not None:
+            offset, blob = found
             self._respond(stream, ext_id, req.request_id, offset, blob)
             return
 
