@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# True multi-process distribution gate — the reference's Docker 2-node
+# harness (test/local/p2p-docker-test.sh) upgraded to jax.distributed:
+# two real jax processes on one machine (CPU backend, 4 virtual devices
+# each) form one 8-device mesh, discover each other through the
+# coordinator KV store (CoordinatorRegistry), move bytes over BT wire,
+# and run a distributed pod_round with cross-process collectives.
+#
+# The heavy lifting lives in tests/test_multiprocess.py (launcher) +
+# tests/_mp_pod_worker.py (per-process worker); this wrapper is the CI
+# entry point, mirroring `zig build p2p-test` (build.zig:69-72).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/test_multiprocess.py -q -m slow "$@"

@@ -1,0 +1,170 @@
+"""One process of the true multi-process distribution test.
+
+Run N of these (process_id 0..N-1) against one jax.distributed
+coordinator: each is a REAL separate jax process — no monkeypatched
+process counts — with its own cache, BT seeding server, and 4 virtual
+CPU devices, forming one global 4N-device mesh.
+
+Three phases, KV-barriered:
+
+  A. process 0 fetches every unit from the fixture CDN and announces
+     each xorb on the CoordinatorRegistry (the jax.distributed KV store).
+  B. every other process pulls ALL units through the waterfall with the
+     registry as its only peer source: discovery must come from the KV
+     prefix, bytes must come from process 0 over BT wire, CDN must see
+     nothing. Process 0 meanwhile asserts find_peers never returns
+     itself.
+  C. all processes run one pod_round over the global mesh — the
+     multi-process make_array_from_process_local_data branch + the
+     cross-process all-gather — then verify every file reassembles
+     bit-identically (hash re-derived through the CAS stack).
+
+Usage: _mp_pod_worker.py PROCESS_ID NUM_PROCS COORD_ADDR HUB_URL ROOT REPO_ID
+Writes ROOT/stats_{pid}.json on success.
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+
+def main() -> int:
+    pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    coord, hub_url = sys.argv[3], sys.argv[4]
+    root, repo_id = pathlib.Path(sys.argv[5]), sys.argv[6]
+    devices_per_proc = 4
+
+    # CPU backend with 4 virtual devices. The launcher already exports
+    # JAX_PLATFORMS/XLA_FLAGS, but sitecustomize may have imported jax
+    # before this line with the ambient (TPU) platform — set both env
+    # and jax.config, exactly like tests/conftest.py.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid
+    )
+    assert jax.process_count() == nprocs
+    assert jax.device_count() == devices_per_proc * nprocs
+
+    from zest_tpu.cas.chunking import chunk_stream
+    from zest_tpu.cas.hashing import chunk_hash, file_hash, hash_to_hex
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.parallel.coordinator import CoordinatorRegistry
+    from zest_tpu.parallel.mesh import pod_mesh
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.pod import pod_round
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    cfg = Config(
+        hf_home=root / f"p{pid}" / "hf",
+        cache_dir=root / f"p{pid}" / "zest",
+        hf_token="hf_test",
+        endpoint=hub_url,
+        listen_port=0,
+    )
+    registry = CoordinatorRegistry("127.0.0.1", process_id=pid)
+    swarm = SwarmDownloader(cfg, peer_sources=[registry])
+    bridge = XetBridge(cfg, swarm=swarm)
+    bridge.authenticate(repo_id)
+    recs = [
+        bridge.get_reconstruction(e.xet_hash)
+        for e in HubClient(cfg).list_files(repo_id)
+        if e.is_xet
+    ]
+    from zest_tpu.parallel.plan import collect_units
+
+    units = collect_units(recs)
+    assert units, "fixture repo must have xet units"
+
+    stats = {"pid": pid, "phase_b_peer_bytes": 0, "phase_b_cdn_bytes": 0}
+
+    from zest_tpu.transfer.federated import (
+        _already_cached,
+        _cache_unit,
+        _entries_by_hash,
+    )
+
+    entries_map = _entries_by_hash(recs)
+
+    def warm(units):
+        """fetch_unit + persist under the bridge's full-vs-partial cache
+        rule (fetch_unit leaves caching to its callers)."""
+        for (hash_hex, _start), fi in units:
+            if _already_cached(bridge, hash_hex, fi):
+                continue
+            data = bridge.fetch_unit(hash_hex, fi)
+            _cache_unit(bridge, entries_map, hash_hex, fi,
+                        fi.range.start, data)
+
+    # Phase A: process 0 warms its cache from CDN and announces.
+    server = BtServer(cfg, bridge.cache)
+    bt_port = server.start()
+    if pid == 0:
+        warm(units)
+        from zest_tpu.cas import hashing as _h
+        from zest_tpu.p2p import peer_id as peer_id_mod
+
+        for (hash_hex, _start), _fi in units:
+            registry.announce(
+                peer_id_mod.compute_info_hash(_h.hex_to_hash(hash_hex)),
+                bt_port,
+            )
+            # self-exclusion: our own announce must be invisible to us
+            assert registry.find_peers(
+                peer_id_mod.compute_info_hash(_h.hex_to_hash(hash_hex))
+            ) == []
+        stats["announced"] = len(units)
+    registry.barrier("phase-a", 120)
+
+    # Phase B: other processes pull through KV-discovered BT peers only.
+    if pid != 0:
+        cdn_before = bridge.stats.bytes_from_cdn
+        warm(units)
+        stats["phase_b_peer_bytes"] = bridge.stats.bytes_from_peer
+        stats["phase_b_cdn_bytes"] = bridge.stats.bytes_from_cdn - cdn_before
+        assert stats["phase_b_peer_bytes"] > 0, "no bytes over BT wire"
+        assert stats["phase_b_cdn_bytes"] == 0, "waterfall leaked to CDN"
+    registry.barrier("phase-b", 120)
+
+    # Phase C: the distributed pod round over the global mesh. Caches are
+    # warm, so owners serve their slots from cache and the cross-process
+    # all-gather replicates every band.
+    mesh = pod_mesh()  # 1-D axis over all 4N global devices
+    pod_stats = pod_round(bridge, recs, mesh=mesh)
+    assert pod_stats["slots"] == devices_per_proc * nprocs
+    assert pod_stats["filled"] > 0 or pod_stats["units"] == 0
+    stats["pod"] = {
+        k: pod_stats[k] for k in ("slots", "units", "filled", "waves")
+    }
+
+    # Integrity: every file reassembles to its advertised CAS address.
+    for e in HubClient(cfg).list_files(repo_id):
+        if not e.is_xet:
+            continue
+        out = root / f"p{pid}" / f"out-{e.path.replace('/', '_')}"
+        bridge.reconstruct_to_file(e.xet_hash, out)
+        data = out.read_bytes()
+        leaves = [(chunk_hash(c), len(c)) for _m, c in chunk_stream(data)]
+        assert hash_to_hex(file_hash(leaves)) == e.xet_hash, e.path
+    stats["verified_files"] = sum(
+        1 for e in HubClient(cfg).list_files(repo_id) if e.is_xet
+    )
+
+    registry.barrier("phase-c", 120)
+    server.shutdown()
+    (root / f"stats_{pid}.json").write_text(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,3 +108,9 @@ class CoordinatorRegistry:
             if host and port.isdigit():
                 out.append((host, int(port)))
         return out
+
+    def barrier(self, name: str, timeout_s: float = 60.0) -> None:
+        """Coordination-service barrier — staged rounds (seed-then-leech,
+        per-wave sync) without inventing a side channel. Every process
+        must call with the same ``name``."""
+        self._client.wait_at_barrier(name, int(timeout_s * 1000))

@@ -342,8 +342,12 @@ class DcnChannel:
         self.address = (host, port)
         self.timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _exchange_hello(self._sock)
+        try:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _exchange_hello(self._sock)
+        except Exception:
+            self._sock.close()  # not a zest endpoint / hello timeout
+            raise
         # The connect/hello timeout must not linger: the reader thread
         # blocks between requests indefinitely (idle ≠ dead); per-request
         # deadlines live in _Waiter.wait, not on the socket.
