@@ -56,11 +56,15 @@ def test_two_process_distribution(hub, tmp_path):
     nprocs = 2
     coord = f"127.0.0.1:{_free_port()}"
     script = pathlib.Path(__file__).parent / "_mp_pod_worker.py"
+    # Per-worker log files, not PIPEs: the workers are barrier-coupled,
+    # so an unread pipe filling up in one would deadlock the other.
+    logs = [open(tmp_path / f"worker_{pid}.log", "w+") for pid in
+            range(nprocs)]
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), str(nprocs), coord,
              hub.url, str(tmp_path), REPO_ID],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
             # sitecustomize imports jax at interpreter start, so the CPU
             # platform + virtual device count must already be in the env
             # when the worker is spawned.
@@ -72,17 +76,27 @@ def test_two_process_distribution(hub, tmp_path):
         )
         for pid in range(nprocs)
     ]
-    outputs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process workers timed out")
-        outputs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+
+    def read_log(pid):
+        logs[pid].flush()
+        logs[pid].seek(0)
+        return logs[pid].read()
+
+    try:
+        for p in procs:
+            try:
+                p.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multi-process workers timed out:\n"
+                            + "\n".join(read_log(i) for i in range(nprocs)))
+        for pid, p in enumerate(procs):
+            assert p.returncode == 0, \
+                f"worker {pid} failed:\n{read_log(pid)}"
+    finally:
+        for f in logs:
+            f.close()
 
     s0 = json.loads((tmp_path / "stats_0.json").read_text())
     s1 = json.loads((tmp_path / "stats_1.json").read_text())
