@@ -25,49 +25,10 @@ from tests.fixtures import FixtureHub, FixtureRepo  # noqa: E402
 
 
 def _gpt2_files() -> dict[str, bytes]:
-    """A tiny but *valid* GPT-2 checkpoint (HF tensor names + config), so
-    examples/pull_to_tpu_mesh.py can land and run it after pulling."""
-    import io
-    import json
+    """Tiny valid GPT-2 checkpoint (shared generator in tests/fixtures)."""
+    from tests.fixtures import gpt2_checkpoint_files
 
-    import numpy as np
-
-    from zest_tpu.models import gpt2
-    from zest_tpu.models.safetensors_io import write_safetensors
-
-    cfg = dict(model_type="gpt2", vocab_size=256, n_positions=64, n_ctx=64,
-               n_embd=64, n_layer=2, n_head=4, layer_norm_epsilon=1e-5)
-    rng = np.random.default_rng(0)
-    E, L = cfg["n_embd"], cfg["n_layer"]
-    t = {
-        "wte.weight": rng.normal(0, 0.02, (cfg["vocab_size"], E)),
-        "wpe.weight": rng.normal(0, 0.01, (cfg["n_ctx"], E)),
-        "ln_f.weight": np.ones(E), "ln_f.bias": np.zeros(E),
-    }
-    shapes = {
-        "ln_1.weight": (E,), "ln_1.bias": (E,),
-        "ln_2.weight": (E,), "ln_2.bias": (E,),
-        "attn.c_attn.weight": (E, 3 * E), "attn.c_attn.bias": (3 * E,),
-        "attn.c_proj.weight": (E, E), "attn.c_proj.bias": (E,),
-        "mlp.c_fc.weight": (E, 4 * E), "mlp.c_fc.bias": (4 * E,),
-        "mlp.c_proj.weight": (4 * E, E), "mlp.c_proj.bias": (E,),
-    }
-    for layer in range(L):
-        for leaf, shape in shapes.items():
-            init = (np.ones if leaf.endswith("ln_1.weight")
-                    or leaf.endswith("ln_2.weight") else
-                    lambda s: rng.normal(0, 0.02, s))
-            t[f"h.{layer}.{leaf}"] = np.asarray(init(shape))
-    tensors = {k: v.astype(np.float32) for k, v in t.items()}
-    import tempfile
-
-    with tempfile.NamedTemporaryFile(suffix=".safetensors") as f:
-        write_safetensors(f.name, tensors)
-        blob = Path(f.name).read_bytes()
-    return {
-        "config.json": json.dumps(cfg).encode(),
-        "model.safetensors": blob,
-    }
+    return gpt2_checkpoint_files()
 
 
 def main() -> int:

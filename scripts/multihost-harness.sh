@@ -72,18 +72,8 @@ start_serve() {  # start_serve <i>
         > "$WORK/node$i/serve.log" 2>&1 &
     echo $! >> "$WORK/serve.pids"
     local port=$((BASE_PORT + i))
-    for _ in $(seq 1 50); do
-        python - "$port" <<'EOF' && return 0
-import socket, sys
-s = socket.socket(); s.settimeout(0.3)
-try:
-    s.connect(("127.0.0.1", int(sys.argv[1])))
-except OSError:
-    raise SystemExit(1)
-EOF
-        sleep 0.2
-    done
-    die "node $i serve did not come up on :$port"
+    python scripts/wait_for_port.py "$port" 10 \
+        || die "node $i serve did not come up on :$port"
 }
 
 timed_pull() {  # timed_pull <node> <outfile> [extra pull args...]

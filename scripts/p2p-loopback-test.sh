@@ -44,20 +44,8 @@ env "${common_env[@]}" \
     python -m zest_tpu serve --listen-port "$LISTEN_PORT" --http-port 19847 \
         --dcn-port 0 &
 PIDS+=($!)
-for _ in $(seq 1 50); do
-  python - "$LISTEN_PORT" <<'EOF' && break
-import socket, sys
-s = socket.socket()
-s.settimeout(0.3)
-try:
-    s.connect(("127.0.0.1", int(sys.argv[1])))
-except OSError:
-    raise SystemExit(1)
-finally:
-    s.close()
-EOF
-  sleep 0.2
-done
+python scripts/wait_for_port.py "$LISTEN_PORT" 10 \
+    || { echo "seeder serve did not come up"; exit 1; }
 
 say "leecher: pull with --peer"
 env "${common_env[@]}" \

@@ -1,0 +1,33 @@
+"""Exit 0 once 127.0.0.1:PORT accepts a TCP connect, 1 after a timeout.
+
+Shared readiness probe for the shell harnesses (p2p-loopback-test.sh,
+multihost-harness.sh) — one implementation instead of per-script
+heredocs that drift apart.
+
+Usage: python scripts/wait_for_port.py PORT [TIMEOUT_SECONDS]
+"""
+
+import socket
+import sys
+import time
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    timeout = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = socket.socket()
+        s.settimeout(0.3)
+        try:
+            s.connect(("127.0.0.1", port))
+            return 0
+        except OSError:
+            time.sleep(0.2)
+        finally:
+            s.close()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -335,3 +335,56 @@ class FixtureHub:
             handler.wfile.write(piece)
         else:
             handler._send(200, blob)
+
+
+def gpt2_checkpoint_files(
+    n_embd: int = 64,
+    n_layer: int = 2,
+    vocab_size: int = 256,
+    n_ctx: int = 64,
+    seed: int = 0,
+) -> dict[str, bytes]:
+    """A small but *valid* GPT-2 checkpoint (HF tensor names + config):
+    config.json + model.safetensors bytes, sized by the dims — shared by
+    the fixture hub CLI, the bench driver's end-to-end pull, and the TPU
+    landing example. ~12·n_layer·n_embd² fp32 parameter bytes."""
+    import json as _json
+    import pathlib
+    import tempfile
+
+    import numpy as np
+
+    from zest_tpu.models.safetensors_io import write_safetensors
+
+    cfg = dict(model_type="gpt2", vocab_size=vocab_size,
+               n_positions=n_ctx, n_ctx=n_ctx, n_embd=n_embd,
+               n_layer=n_layer, n_head=4, layer_norm_epsilon=1e-5)
+    rng = np.random.default_rng(seed)
+    E, L = n_embd, n_layer
+    t = {
+        "wte.weight": rng.normal(0, 0.02, (vocab_size, E)),
+        "wpe.weight": rng.normal(0, 0.01, (n_ctx, E)),
+        "ln_f.weight": np.ones(E), "ln_f.bias": np.zeros(E),
+    }
+    shapes = {
+        "ln_1.weight": (E,), "ln_1.bias": (E,),
+        "ln_2.weight": (E,), "ln_2.bias": (E,),
+        "attn.c_attn.weight": (E, 3 * E), "attn.c_attn.bias": (3 * E,),
+        "attn.c_proj.weight": (E, E), "attn.c_proj.bias": (E,),
+        "mlp.c_fc.weight": (E, 4 * E), "mlp.c_fc.bias": (4 * E,),
+        "mlp.c_proj.weight": (4 * E, E), "mlp.c_proj.bias": (E,),
+    }
+    for layer in range(L):
+        for leaf, shape in shapes.items():
+            init = (np.ones if leaf.endswith("ln_1.weight")
+                    or leaf.endswith("ln_2.weight") else
+                    lambda s: rng.normal(0, 0.02, s))
+            t[f"h.{layer}.{leaf}"] = np.asarray(init(shape))
+    tensors = {k: v.astype(np.float32) for k, v in t.items()}
+    with tempfile.NamedTemporaryFile(suffix=".safetensors") as f:
+        write_safetensors(f.name, tensors)
+        blob = pathlib.Path(f.name).read_bytes()
+    return {
+        "config.json": _json.dumps(cfg).encode(),
+        "model.safetensors": blob,
+    }
