@@ -23,7 +23,8 @@ VMEM is bounded by the **leaf-group grid**, not a smaller batch tile
 dimension walks the chunk capacity ``_LEAVES_PER_GROUP`` KiB at a time,
 accumulating per-leaf CVs in scratch, and the last step folds the merge
 tree — so the input block stays at ``_LEAVES_PER_GROUP·1 KiB × 128 lanes``
-(2 MiB) regardless of chunk size. ``_LEAVES_PER_GROUP`` is the VMEM knob.
+(1 MiB at the swept G=8) regardless of chunk size. ``_LEAVES_PER_GROUP``
+is the VMEM knob.
 
 On non-TPU backends the kernel runs in interpreter mode (tests); the XLA
 version stays the production path for CPU.
@@ -138,7 +139,10 @@ def _compress_t(cv, m, counter, block_len, flags, key4):
 
 
 _TILE = 128           # lane width: Mosaic requires last block dim % 128
-_LEAVES_PER_GROUP = 16  # 16 leaves × 1 KiB × 128 lanes = 2 MiB VMEM/block
+# 8 leaves × 1 KiB × 128 lanes = 1 MiB VMEM/block. Swept on a v5e chip
+# (device-time method, bench.py): G=8 → 67.5 GB/s, G=16 → 65.8, G=32 →
+# 42 — smaller groups double-buffer better against the compute phase.
+_LEAVES_PER_GROUP = 8
 
 
 def _make_kernel(n_leaves_cap: int, leaves_per_group: int, n_groups: int,
