@@ -321,6 +321,53 @@ def test_federated_round_never_narrows_cached_entries(hub, tmp_path):
     assert len(XorbReader(b.cache.get(xh_hex))) == 6
 
 
+def test_federated_pull_cli_flags(hub, tmp_path, capsys, monkeypatch):
+    """The product surface: `pull --pods/--pod-index/--pod-addr` runs the
+    cross-pod stage inside pull_model and reports it in the stats."""
+    import re
+
+    import zest_tpu.cli as cli
+
+    def set_pod_env(i):
+        monkeypatch.setenv("HF_HOME", str(tmp_path / f"pod{i}/hf"))
+        monkeypatch.setenv("ZEST_CACHE_DIR", str(tmp_path / f"pod{i}/zest"))
+        monkeypatch.setenv("HF_TOKEN", "hf_test")
+        monkeypatch.setenv("HF_ENDPOINT", hub.url)
+
+    # pod 0: CDN pull via the CLI, then serve its cache over DCN
+    set_pod_env(0)
+    rc = cli.main(["pull", REPO_ID, "--no-p2p", "--no-seed",
+                   "--pods", "2", "--pod-index", "0"])
+    assert rc == 0
+    assert "Federated:  pod 0/2" in capsys.readouterr().out
+    cfg0 = Config(hf_home=tmp_path / "pod0/hf",
+                  cache_dir=tmp_path / "pod0/zest",
+                  hf_token="hf_test", endpoint=hub.url, dcn_port=0)
+    server = dcn.DcnServer(cfg0, XorbCache(cfg0))
+    port = server.start()
+    try:
+        # half-specified federated config is a usage error, not silence
+        assert cli.main(["pull", REPO_ID, "--no-p2p", "--no-seed",
+                         "--pods", "2"]) == 2
+        assert cli.main(["pull", REPO_ID, "--no-p2p", "--no-seed",
+                         "--pods", "2", "--pod-index", "1",
+                         "--pod-addr", "127.0.0.1:9"]) == 2
+
+        # pod 1: pulls with the DCN endpoint; foreign units ride the RPC
+        set_pod_env(1)
+        rc = cli.main(["pull", REPO_ID, "--no-p2p", "--no-seed",
+                       "--pods", "2", "--pod-index", "1",
+                       "--pod-addr", f"0=127.0.0.1:{port}"])
+        assert rc == 0
+        out1 = capsys.readouterr().out
+        assert "Federated:  pod 1/2" in out1
+        assert "0 CDN-fallback" in out1
+        m = re.search(r"(\d+) over DCN \((\d+) bytes\)", out1)
+        assert m and int(m.group(1)) > 0 and int(m.group(2)) > 0
+    finally:
+        server.shutdown()
+
+
 # ── The two-process gate ──
 
 

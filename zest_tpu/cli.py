@@ -114,9 +114,23 @@ def cmd_pull(args) -> int:
     from zest_tpu.transfer.pull import pull_model
 
     pod = True if args.pod else (False if args.no_pod else None)
+    if (args.pods is None) != (args.pod_index is None):
+        print("error: --pods and --pod-index must be given together",
+              file=sys.stderr)
+        return 2
+    pod_addrs = {}
+    for spec in args.pod_addr or []:
+        idx, eq, addr = spec.partition("=")
+        host, colon, port = addr.rpartition(":")
+        if not (eq and colon and idx.isdigit() and port.isdigit() and host):
+            print(f"error: --pod-addr {spec!r} is not I=HOST:PORT",
+                  file=sys.stderr)
+            return 2
+        pod_addrs[int(idx)] = (host, int(port))
     res = pull_model(cfg, args.repo, revision=args.revision,
                      device=args.device, swarm=swarm, no_p2p=args.no_p2p,
-                     pod=pod)
+                     pod=pod, pods=args.pods, pod_index=args.pod_index,
+                     pod_addrs=pod_addrs)
     print(f"✓ {args.repo} -> {res.snapshot_dir}")
     _print_pull_stats(res.stats)
     if not args.no_seed:
@@ -134,6 +148,11 @@ def _print_pull_stats(stats: dict) -> None:
         print(f"  From CDN:   {nbytes.get('cdn', 0)} bytes")
         print(f"  P2P ratio:  {fetch.get('p2p_ratio', 0.0):.1%}")
     print(f"  Elapsed:    {stats.get('elapsed_s', 0)}s")
+    if "federated" in stats:
+        f = stats["federated"]
+        print(f"  Federated:  pod {f['pod']}/{f['pods']}: {f['own_units']} "
+              f"own, {f['dcn_units']} over DCN ({f['dcn_bytes']} bytes), "
+              f"{f['fallback_units']} CDN-fallback")
     if "pod" in stats and not stats["pod"].get("skipped"):
         p = stats["pod"]
         print(f"  Pod round:  {p['filled']}/{p['units']} units over "
@@ -315,6 +334,14 @@ def build_parser() -> argparse.ArgumentParser:
                                 "per mesh)")
     pod_group.add_argument("--no-pod", action="store_true",
                            help="skip the pod round even with --device=tpu")
+    pull.add_argument("--pods", type=int, default=None,
+                      help="total pods in a federated multi-pod pull "
+                           "(separate processes linked over DCN)")
+    pull.add_argument("--pod-index", type=int, default=None,
+                      help="this process' pod index (0-based)")
+    pull.add_argument("--pod-addr", action="append", metavar="I=HOST:PORT",
+                      help="DCN endpoint of pod I (repeatable); units "
+                           "owned by unreachable pods degrade to CDN")
     pull.add_argument("--http-port", type=int, default=None)
     pull.set_defaults(fn=cmd_pull)
 

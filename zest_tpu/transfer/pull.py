@@ -57,6 +57,9 @@ def pull_model(
     swarm=None,
     no_p2p: bool = False,
     pod: bool | None = None,
+    pods: int | None = None,
+    pod_index: int | None = None,
+    pod_addrs: dict[int, tuple[str, int]] | None = None,
     log=print,
 ) -> PullResult:
     t0 = time.monotonic()
@@ -80,8 +83,9 @@ def pull_model(
 
         env = os.environ.get("ZEST_TPU_POD")
         pod = env == "1" if env in ("0", "1") else device == "tpu"
-    pod_stats = None
-    if pod:
+    fed = pods is not None and pods > 1 and pod_index is not None
+    pod_stats = fed_stats = None
+    if pod or fed:
         pending = [
             e for e in files
             if e.is_xet and not _is_complete(snapshot_dir, e)
@@ -92,16 +96,39 @@ def pull_model(
                 authenticated = True
                 recs = [bridge.get_reconstruction(e.xet_hash)
                         for e in pending]
-                from zest_tpu.transfer.pod import pod_round
-
-                # Byte distribution always runs over the 1-D pod mesh
-                # (pod_round's default) — the N-D model mesh from config
-                # is for checkpoint *landing*, not for moving bytes.
-                pod_stats = pod_round(bridge, recs, log=lambda m: log(m))
             except Exception as exc:  # noqa: BLE001 - round is an accelerator
-                log(f"pod round unavailable ({exc}); "
+                log(f"distribution rounds unavailable ({exc}); "
                     "continuing with the per-host waterfall",
                     file=sys.stderr)
+                recs = None
+            # Cross-pod stage first (pods that are separate processes —
+            # DCN chunk RPC), so the in-pod collective spreads a warm
+            # cache. Either round failing degrades to the waterfall.
+            if recs and fed:
+                try:
+                    from zest_tpu.transfer.federated import federated_round
+
+                    fed_stats = federated_round(
+                        bridge, recs, pod_index, pods, pod_addrs or {},
+                        log=lambda m: log(m),
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    log(f"federated round unavailable ({exc}); "
+                        "continuing with the per-host waterfall",
+                        file=sys.stderr)
+            if recs and pod:
+                try:
+                    from zest_tpu.transfer.pod import pod_round
+
+                    # Byte distribution always runs over the 1-D pod mesh
+                    # (pod_round's default) — the N-D model mesh from
+                    # config is for checkpoint *landing*, not bytes.
+                    pod_stats = pod_round(bridge, recs,
+                                          log=lambda m: log(m))
+                except Exception as exc:  # noqa: BLE001
+                    log(f"pod round unavailable ({exc}); "
+                        "continuing with the per-host waterfall",
+                        file=sys.stderr)
 
     # Direct-to-HBM landing (SURVEY.md §7 hard part #2, the north star):
     # land tensors straight from cached units BEFORE any file is written,
@@ -148,6 +175,8 @@ def pull_model(
         "elapsed_s": round(elapsed, 3),
         "fetch": bridge.stats.summary(),
     }
+    if fed_stats is not None:
+        stats["federated"] = fed_stats
     if pod_stats is not None:
         stats["pod"] = pod_stats
     if swarm is not None:
