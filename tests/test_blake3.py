@@ -92,8 +92,11 @@ class TestNativeCrossCheck:
             pytest.skip("native lib unavailable (no g++?)")
         return lib
 
+    # Exact multiples of 8/16 KiB pin the wide cores' have_final tails
+    # (a group whose last lane IS the final chunk, pushed N-1 + promoted).
     @pytest.mark.parametrize(
-        "n", [0, 1, 31, 64, 65, 1023, 1024, 1025, 2048, 4096, 10_000, 70_000]
+        "n", [0, 1, 31, 64, 65, 1023, 1024, 1025, 2048, 4096, 8192,
+              10_000, 16_384, 24_576, 32_768, 70_000, 131_072]
     )
     def test_lengths(self, native, n):
         data = _pattern(n)

@@ -118,6 +118,10 @@ def cmd_pull(args) -> int:
         print("error: --pods and --pod-index must be given together",
               file=sys.stderr)
         return 2
+    if args.pods is not None and not 0 <= args.pod_index < args.pods:
+        print(f"error: --pod-index {args.pod_index} outside [0,{args.pods})",
+              file=sys.stderr)
+        return 2
     pod_addrs = {}
     for spec in args.pod_addr or []:
         idx, eq, addr = spec.partition("=")

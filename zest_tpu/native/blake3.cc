@@ -11,12 +11,21 @@
 //   zest_blake3_keyed(key32, data, len, out32)
 //   zest_blake3_batch(data, count, item_len, out32xN)   — many equal-size items
 //
-// Layout notes: scalar core with aggressively unrolled rounds; compiled
-// -O3 -march=native so GCC vectorizes the 4-lane column/diagonal steps.
+// Layout notes: the hot path is an 8-wide AVX2 core that hashes eight
+// complete 1 KiB BLAKE3 chunks at once in transposed (SoA) form — one
+// chunk per 32-bit lane of a ymm register, the same lanes-carry-chunks
+// layout as the Pallas TPU kernel (zest_tpu/ops/blake3_pallas.py). The
+// scalar core (compiled -O3 -march=native) handles tails, parent folds,
+// and non-AVX2 builds, and is the bit-exactness anchor the wide path is
+// tested against.
 
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -111,6 +120,310 @@ void compress(const uint32_t cv[8], const uint32_t m_in[16], uint64_t counter,
   out16[15] = v15 ^ cv[7];
 }
 
+#if defined(__AVX2__)
+
+// ── 8-wide core: eight complete 1 KiB chunks per call, SoA in ymm ──
+
+// Per-round message-word schedules (the standard permutation advanced
+// r times), so rounds index the message table statically instead of
+// re-permuting 16 vectors per round.
+constexpr int SCHED[7][16] = {
+    { 0,  1,  2,  3,  4,  5,  6,  7,  8,  9, 10, 11, 12, 13, 14, 15},
+    { 2,  6,  3, 10,  7,  0,  4, 13,  1, 11, 12,  5,  9, 14, 15,  8},
+    { 3,  4, 10, 12, 13,  2,  7, 14,  6,  5,  9,  0, 11, 15,  8,  1},
+    {10,  7, 12,  9, 14,  3, 13, 15,  4,  0, 11,  2,  5,  8,  1,  6},
+    {12, 13,  9, 11, 15, 10, 14,  8,  7,  2,  5,  3,  0,  1,  6,  4},
+    { 9, 14, 11,  5,  8, 12, 15,  1, 13,  3,  0, 10,  2,  6,  4,  7},
+    {11, 15,  5,  0,  1,  9,  8,  6, 14, 10,  2, 12,  3,  4,  7, 13},
+};
+
+#if defined(__AVX512VL__)
+// AVX-512VL gives a native 32-bit rotate on 256-bit registers: 1 uop
+// for every rotate distance.
+inline __m256i rotr16v(__m256i x) { return _mm256_ror_epi32(x, 16); }
+inline __m256i rotr8v(__m256i x) { return _mm256_ror_epi32(x, 8); }
+inline __m256i rotr12v(__m256i x) { return _mm256_ror_epi32(x, 12); }
+inline __m256i rotr7v(__m256i x) { return _mm256_ror_epi32(x, 7); }
+#else
+// Byte-granularity rotates go through vpshufb (1 uop); 12/7 need shifts.
+inline __m256i rotr16v(__m256i x) {
+  const __m256i tbl = _mm256_setr_epi8(
+      2, 3, 0, 1, 6, 7, 4, 5, 10, 11, 8, 9, 14, 15, 12, 13,
+      2, 3, 0, 1, 6, 7, 4, 5, 10, 11, 8, 9, 14, 15, 12, 13);
+  return _mm256_shuffle_epi8(x, tbl);
+}
+inline __m256i rotr8v(__m256i x) {
+  const __m256i tbl = _mm256_setr_epi8(
+      1, 2, 3, 0, 5, 6, 7, 4, 9, 10, 11, 8, 13, 14, 15, 12,
+      1, 2, 3, 0, 5, 6, 7, 4, 9, 10, 11, 8, 13, 14, 15, 12);
+  return _mm256_shuffle_epi8(x, tbl);
+}
+inline __m256i rotr12v(__m256i x) {
+  return _mm256_or_si256(_mm256_srli_epi32(x, 12),
+                         _mm256_slli_epi32(x, 20));
+}
+inline __m256i rotr7v(__m256i x) {
+  return _mm256_or_si256(_mm256_srli_epi32(x, 7),
+                         _mm256_slli_epi32(x, 25));
+}
+#endif
+
+inline void g8(__m256i& a, __m256i& b, __m256i& c, __m256i& d,
+               __m256i mx, __m256i my) {
+  a = _mm256_add_epi32(_mm256_add_epi32(a, b), mx);
+  d = rotr16v(_mm256_xor_si256(d, a));
+  c = _mm256_add_epi32(c, d);
+  b = rotr12v(_mm256_xor_si256(b, c));
+  a = _mm256_add_epi32(_mm256_add_epi32(a, b), my);
+  d = rotr8v(_mm256_xor_si256(d, a));
+  c = _mm256_add_epi32(c, d);
+  b = rotr7v(_mm256_xor_si256(b, c));
+}
+
+// In-register 8x8 u32 transpose: rows of 8 words -> word-major vectors.
+inline void transpose8(__m256i r[8]) {
+  __m256i t0 = _mm256_unpacklo_epi32(r[0], r[1]);
+  __m256i t1 = _mm256_unpackhi_epi32(r[0], r[1]);
+  __m256i t2 = _mm256_unpacklo_epi32(r[2], r[3]);
+  __m256i t3 = _mm256_unpackhi_epi32(r[2], r[3]);
+  __m256i t4 = _mm256_unpacklo_epi32(r[4], r[5]);
+  __m256i t5 = _mm256_unpackhi_epi32(r[4], r[5]);
+  __m256i t6 = _mm256_unpacklo_epi32(r[6], r[7]);
+  __m256i t7 = _mm256_unpackhi_epi32(r[6], r[7]);
+  __m256i s0 = _mm256_unpacklo_epi64(t0, t2);
+  __m256i s1 = _mm256_unpackhi_epi64(t0, t2);
+  __m256i s2 = _mm256_unpacklo_epi64(t1, t3);
+  __m256i s3 = _mm256_unpackhi_epi64(t1, t3);
+  __m256i s4 = _mm256_unpacklo_epi64(t4, t6);
+  __m256i s5 = _mm256_unpackhi_epi64(t4, t6);
+  __m256i s6 = _mm256_unpacklo_epi64(t5, t7);
+  __m256i s7 = _mm256_unpackhi_epi64(t5, t7);
+  r[0] = _mm256_permute2x128_si256(s0, s4, 0x20);
+  r[1] = _mm256_permute2x128_si256(s1, s5, 0x20);
+  r[2] = _mm256_permute2x128_si256(s2, s6, 0x20);
+  r[3] = _mm256_permute2x128_si256(s3, s7, 0x20);
+  r[4] = _mm256_permute2x128_si256(s0, s4, 0x31);
+  r[5] = _mm256_permute2x128_si256(s1, s5, 0x31);
+  r[6] = _mm256_permute2x128_si256(s2, s6, 0x31);
+  r[7] = _mm256_permute2x128_si256(s3, s7, 0x31);
+}
+
+// Compress one 64-byte block of 8 chunks at once. cv: word-major state,
+// cv[w] lane L = word w of chunk L. m: 16 word-major message vectors.
+inline void compress8(__m256i cv[8], const __m256i m[16],
+                      __m256i counter_lo, __m256i counter_hi,
+                      uint32_t block_len, uint32_t flags) {
+  __m256i v0 = cv[0], v1 = cv[1], v2 = cv[2], v3 = cv[3];
+  __m256i v4 = cv[4], v5 = cv[5], v6 = cv[6], v7 = cv[7];
+  __m256i v8 = _mm256_set1_epi32((int)IV[0]);
+  __m256i v9 = _mm256_set1_epi32((int)IV[1]);
+  __m256i v10 = _mm256_set1_epi32((int)IV[2]);
+  __m256i v11 = _mm256_set1_epi32((int)IV[3]);
+  __m256i v12 = counter_lo;
+  __m256i v13 = counter_hi;
+  __m256i v14 = _mm256_set1_epi32((int)block_len);
+  __m256i v15 = _mm256_set1_epi32((int)flags);
+
+  // Fully unrolled so every SCHED index is a compile-time constant and
+  // the message words stay addressable without indirection.
+#define B3_ROUND(R)                                                     \
+  do {                                                                  \
+    g8(v0, v4, v8, v12, m[SCHED[R][0]], m[SCHED[R][1]]);                \
+    g8(v1, v5, v9, v13, m[SCHED[R][2]], m[SCHED[R][3]]);                \
+    g8(v2, v6, v10, v14, m[SCHED[R][4]], m[SCHED[R][5]]);               \
+    g8(v3, v7, v11, v15, m[SCHED[R][6]], m[SCHED[R][7]]);               \
+    g8(v0, v5, v10, v15, m[SCHED[R][8]], m[SCHED[R][9]]);               \
+    g8(v1, v6, v11, v12, m[SCHED[R][10]], m[SCHED[R][11]]);             \
+    g8(v2, v7, v8, v13, m[SCHED[R][12]], m[SCHED[R][13]]);              \
+    g8(v3, v4, v9, v14, m[SCHED[R][14]], m[SCHED[R][15]]);              \
+  } while (0)
+  B3_ROUND(0); B3_ROUND(1); B3_ROUND(2); B3_ROUND(3);
+  B3_ROUND(4); B3_ROUND(5); B3_ROUND(6);
+#undef B3_ROUND
+
+  cv[0] = _mm256_xor_si256(v0, v8);
+  cv[1] = _mm256_xor_si256(v1, v9);
+  cv[2] = _mm256_xor_si256(v2, v10);
+  cv[3] = _mm256_xor_si256(v3, v11);
+  cv[4] = _mm256_xor_si256(v4, v12);
+  cv[5] = _mm256_xor_si256(v5, v13);
+  cv[6] = _mm256_xor_si256(v6, v14);
+  cv[7] = _mm256_xor_si256(v7, v15);
+}
+
+// Hash 8 complete, non-final 1 KiB chunks starting at `data` (contiguous,
+// counters chunk_counter..+7); writes the 8 chunk CVs row-major.
+void hash8_chunks(const uint32_t key[8], uint32_t base_flags,
+                  const uint8_t* data, uint64_t chunk_counter,
+                  uint32_t out_cvs[8][8]) {
+  __m256i cv[8];
+  for (int w = 0; w < 8; w++) cv[w] = _mm256_set1_epi32((int)key[w]);
+
+  alignas(32) uint32_t ctr_lo[8], ctr_hi[8];
+  for (int i = 0; i < 8; i++) {
+    ctr_lo[i] = (uint32_t)(chunk_counter + i);
+    ctr_hi[i] = (uint32_t)((chunk_counter + i) >> 32);
+  }
+  __m256i vlo = _mm256_load_si256((const __m256i*)ctr_lo);
+  __m256i vhi = _mm256_load_si256((const __m256i*)ctr_hi);
+
+  constexpr int NBLOCKS = CHUNK_LEN / BLOCK_LEN;  // 16
+  for (int b = 0; b < NBLOCKS; b++) {
+    __m256i lo[8], hi[8];
+    for (int i = 0; i < 8; i++) {
+      const uint8_t* p = data + (size_t)i * CHUNK_LEN + (size_t)b * BLOCK_LEN;
+      lo[i] = _mm256_loadu_si256((const __m256i*)p);
+      hi[i] = _mm256_loadu_si256((const __m256i*)(p + 32));
+    }
+    transpose8(lo);  // lo[w] = word w (0-7) of each chunk's block
+    transpose8(hi);  // hi[w] = word 8+w
+    __m256i m[16];
+    for (int w = 0; w < 8; w++) { m[w] = lo[w]; m[8 + w] = hi[w]; }
+
+    uint32_t flags = base_flags;
+    if (b == 0) flags |= CHUNK_START;
+    if (b == NBLOCKS - 1) flags |= CHUNK_END;
+    compress8(cv, m, vlo, vhi, BLOCK_LEN, flags);
+  }
+
+  transpose8(cv);  // back to chunk-major rows
+  for (int i = 0; i < 8; i++)
+    _mm256_storeu_si256((__m256i*)out_cvs[i], cv[i]);
+}
+
+#endif  // __AVX2__
+
+#if defined(__AVX512F__)
+
+// ── 16-wide core: sixteen complete 1 KiB chunks per call ──
+// One 64-byte block row per chunk is exactly one zmm load; a 16x16 u32
+// transpose turns 16 row loads into the 16 word-major message vectors.
+
+inline void g16(__m512i& a, __m512i& b, __m512i& c, __m512i& d,
+                __m512i mx, __m512i my) {
+  a = _mm512_add_epi32(_mm512_add_epi32(a, b), mx);
+  d = _mm512_ror_epi32(_mm512_xor_si512(d, a), 16);
+  c = _mm512_add_epi32(c, d);
+  b = _mm512_ror_epi32(_mm512_xor_si512(b, c), 12);
+  a = _mm512_add_epi32(_mm512_add_epi32(a, b), my);
+  d = _mm512_ror_epi32(_mm512_xor_si512(d, a), 8);
+  c = _mm512_add_epi32(c, d);
+  b = _mm512_ror_epi32(_mm512_xor_si512(b, c), 7);
+}
+
+// Transpose r[i] = 16 words of row i  ->  r[w] = word w of 16 rows.
+// Four stages: epi32 unpacks (row pairs), epi64 unpacks (row quads),
+// then two rounds of 128-bit-lane shuffles. Derivation: after stage 2,
+// s[4g+m] lane k holds word 4k+m of rows 4g..4g+3; the lane shuffles
+// regroup lanes by word index.
+inline void transpose16(__m512i r[16]) {
+  __m512i t[16], s[16];
+  for (int i = 0; i < 8; i++) {
+    t[2 * i] = _mm512_unpacklo_epi32(r[2 * i], r[2 * i + 1]);
+    t[2 * i + 1] = _mm512_unpackhi_epi32(r[2 * i], r[2 * i + 1]);
+  }
+  for (int g = 0; g < 4; g++) {
+    s[4 * g + 0] = _mm512_unpacklo_epi64(t[4 * g + 0], t[4 * g + 2]);
+    s[4 * g + 1] = _mm512_unpackhi_epi64(t[4 * g + 0], t[4 * g + 2]);
+    s[4 * g + 2] = _mm512_unpacklo_epi64(t[4 * g + 1], t[4 * g + 3]);
+    s[4 * g + 3] = _mm512_unpackhi_epi64(t[4 * g + 1], t[4 * g + 3]);
+  }
+  for (int m = 0; m < 4; m++) {
+    __m512i p1 = _mm512_shuffle_i32x4(s[m], s[4 + m], 0x88);
+    __m512i p2 = _mm512_shuffle_i32x4(s[m], s[4 + m], 0xdd);
+    __m512i p3 = _mm512_shuffle_i32x4(s[8 + m], s[12 + m], 0x88);
+    __m512i p4 = _mm512_shuffle_i32x4(s[8 + m], s[12 + m], 0xdd);
+    r[m] = _mm512_shuffle_i32x4(p1, p3, 0x88);
+    r[8 + m] = _mm512_shuffle_i32x4(p1, p3, 0xdd);
+    r[4 + m] = _mm512_shuffle_i32x4(p2, p4, 0x88);
+    r[12 + m] = _mm512_shuffle_i32x4(p2, p4, 0xdd);
+  }
+}
+
+inline void compress16(__m512i cv[8], const __m512i m[16],
+                       __m512i counter_lo, __m512i counter_hi,
+                       uint32_t block_len, uint32_t flags) {
+  __m512i v0 = cv[0], v1 = cv[1], v2 = cv[2], v3 = cv[3];
+  __m512i v4 = cv[4], v5 = cv[5], v6 = cv[6], v7 = cv[7];
+  __m512i v8 = _mm512_set1_epi32((int)IV[0]);
+  __m512i v9 = _mm512_set1_epi32((int)IV[1]);
+  __m512i v10 = _mm512_set1_epi32((int)IV[2]);
+  __m512i v11 = _mm512_set1_epi32((int)IV[3]);
+  __m512i v12 = counter_lo;
+  __m512i v13 = counter_hi;
+  __m512i v14 = _mm512_set1_epi32((int)block_len);
+  __m512i v15 = _mm512_set1_epi32((int)flags);
+
+#define B3_ROUND16(R)                                                   \
+  do {                                                                  \
+    g16(v0, v4, v8, v12, m[SCHED[R][0]], m[SCHED[R][1]]);               \
+    g16(v1, v5, v9, v13, m[SCHED[R][2]], m[SCHED[R][3]]);               \
+    g16(v2, v6, v10, v14, m[SCHED[R][4]], m[SCHED[R][5]]);              \
+    g16(v3, v7, v11, v15, m[SCHED[R][6]], m[SCHED[R][7]]);              \
+    g16(v0, v5, v10, v15, m[SCHED[R][8]], m[SCHED[R][9]]);              \
+    g16(v1, v6, v11, v12, m[SCHED[R][10]], m[SCHED[R][11]]);            \
+    g16(v2, v7, v8, v13, m[SCHED[R][12]], m[SCHED[R][13]]);             \
+    g16(v3, v4, v9, v14, m[SCHED[R][14]], m[SCHED[R][15]]);             \
+  } while (0)
+  B3_ROUND16(0); B3_ROUND16(1); B3_ROUND16(2); B3_ROUND16(3);
+  B3_ROUND16(4); B3_ROUND16(5); B3_ROUND16(6);
+#undef B3_ROUND16
+
+  cv[0] = _mm512_xor_si512(v0, v8);
+  cv[1] = _mm512_xor_si512(v1, v9);
+  cv[2] = _mm512_xor_si512(v2, v10);
+  cv[3] = _mm512_xor_si512(v3, v11);
+  cv[4] = _mm512_xor_si512(v4, v12);
+  cv[5] = _mm512_xor_si512(v5, v13);
+  cv[6] = _mm512_xor_si512(v6, v14);
+  cv[7] = _mm512_xor_si512(v7, v15);
+}
+
+// Hash 16 complete, non-final 1 KiB chunks starting at `data`
+// (contiguous, counters chunk_counter..+15); CVs row-major.
+void hash16_chunks(const uint32_t key[8], uint32_t base_flags,
+                   const uint8_t* data, uint64_t chunk_counter,
+                   uint32_t out_cvs[16][8]) {
+  __m512i cv[8];
+  for (int w = 0; w < 8; w++) cv[w] = _mm512_set1_epi32((int)key[w]);
+
+  alignas(64) uint32_t ctr_lo[16], ctr_hi[16];
+  for (int i = 0; i < 16; i++) {
+    ctr_lo[i] = (uint32_t)(chunk_counter + i);
+    ctr_hi[i] = (uint32_t)((chunk_counter + i) >> 32);
+  }
+  __m512i vlo = _mm512_load_si512((const void*)ctr_lo);
+  __m512i vhi = _mm512_load_si512((const void*)ctr_hi);
+
+  constexpr int NBLOCKS = CHUNK_LEN / BLOCK_LEN;  // 16
+  for (int b = 0; b < NBLOCKS; b++) {
+    __m512i m[16];
+    for (int i = 0; i < 16; i++) {
+      m[i] = _mm512_loadu_si512(
+          (const void*)(data + (size_t)i * CHUNK_LEN + (size_t)b * BLOCK_LEN));
+    }
+    transpose16(m);
+
+    uint32_t flags = base_flags;
+    if (b == 0) flags |= CHUNK_START;
+    if (b == NBLOCKS - 1) flags |= CHUNK_END;
+    compress16(cv, m, vlo, vhi, BLOCK_LEN, flags);
+  }
+
+  // cv[w] holds word w of 16 chunks; widen to 16 rows for the store.
+  __m512i rows[16];
+  for (int w = 0; w < 8; w++) rows[w] = cv[w];
+  for (int w = 8; w < 16; w++) rows[w] = _mm512_setzero_si512();
+  transpose16(rows);
+  for (int i = 0; i < 16; i++) {
+    alignas(64) uint32_t tmp[16];
+    _mm512_store_si512((void*)tmp, rows[i]);
+    std::memcpy(out_cvs[i], tmp, 8 * sizeof(uint32_t));
+  }
+}
+
+#endif  // __AVX512F__
+
 void load_block(const uint8_t* data, size_t len, uint32_t m[16]) {
   uint8_t padded[BLOCK_LEN];
   const uint8_t* src = data;
@@ -166,13 +479,9 @@ void blake3_full(const uint32_t key[8], uint32_t base_flags,
   size_t pos = 0;
   uint32_t out16[16];
 
-  // All chunks except the last are complete; the last is handled below so
-  // the root flag can be applied at the right node.
-  while (len - pos > CHUNK_LEN) {
-    uint32_t cv[8];
-    hash_chunk(key, data + pos, CHUNK_LEN, chunk_counter, base_flags, cv,
-               nullptr);
-    pos += CHUNK_LEN;
+  // Merge one finished chunk CV into the stack (standard post-order
+  // fold: merge while the completed-chunk count's trailing zeros last).
+  auto push_cv = [&](uint32_t cv[8]) {
     chunk_counter++;
     uint64_t total = chunk_counter;
     while ((total & 1) == 0) {
@@ -184,12 +493,59 @@ void blake3_full(const uint32_t key[8], uint32_t base_flags,
       total >>= 1;
     }
     std::memcpy(cv_stack[stack_len++], cv, 8 * sizeof(uint32_t));
-  }
+  };
 
-  // Final (partial or full) chunk.
+  // In this multi-chunk branch no chunk carries ROOT (it lands on the
+  // top parent fold), so the final chunk is special only when partial.
   uint32_t cv[8];
-  hash_chunk(key, data + pos, len - pos, chunk_counter, base_flags, cv,
-             nullptr);
+  bool have_final = false;
+
+#if defined(__AVX512F__)
+  // Hottest path: 16 complete chunks per call. '>=' lets an exact
+  // 16-chunk tail ride it; its last CV becomes the final chunk.
+  while (len - pos >= 16 * CHUNK_LEN) {
+    uint32_t cvs16[16][8];
+    hash16_chunks(key, base_flags, data + pos, chunk_counter, cvs16);
+    pos += 16 * CHUNK_LEN;
+    if (pos == len) {
+      for (int i = 0; i < 15; i++) push_cv(cvs16[i]);
+      std::memcpy(cv, cvs16[15], sizeof(cv));
+      have_final = true;
+      break;
+    }
+    for (int i = 0; i < 16; i++) push_cv(cvs16[i]);
+  }
+#endif
+
+#if defined(__AVX2__)
+  // Hot path: 8 complete chunks at a time. '>=' lets an exactly-8-chunk
+  // tail ride the wide path too; its last CV becomes the final chunk.
+  while (!have_final && len - pos >= 8 * CHUNK_LEN) {
+    uint32_t cvs[8][8];
+    hash8_chunks(key, base_flags, data + pos, chunk_counter, cvs);
+    pos += 8 * CHUNK_LEN;
+    if (pos == len) {
+      for (int i = 0; i < 7; i++) push_cv(cvs[i]);
+      std::memcpy(cv, cvs[7], sizeof(cv));
+      have_final = true;
+      break;
+    }
+    for (int i = 0; i < 8; i++) push_cv(cvs[i]);
+  }
+#endif
+
+  // Remaining complete chunks, then the final (possibly partial) one.
+  if (!have_final) {
+    while (len - pos > CHUNK_LEN) {
+      uint32_t c[8];
+      hash_chunk(key, data + pos, CHUNK_LEN, chunk_counter, base_flags, c,
+                 nullptr);
+      pos += CHUNK_LEN;
+      push_cv(c);
+    }
+    hash_chunk(key, data + pos, len - pos, chunk_counter, base_flags, cv,
+               nullptr);
+  }
 
   // Fold the stack; the topmost fold is the root.
   while (stack_len > 0) {
