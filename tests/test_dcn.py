@@ -181,6 +181,50 @@ def test_pipelined_batch_order_and_stats(served_cache):
     assert server.stats.chunks_served == len(chunks)
 
 
+def test_pool_reconnects_dead_channels(served_cache):
+    """A server-side close (idle timeout, restart) marks the channel dead;
+    the pool must hand out a fresh connection, not the corpse."""
+    import time
+
+    _cfg, server, port, _b, _c, xh_hex = served_cache
+    pool = dcn.DcnPool(timeout=5.0)
+    try:
+        ch = pool.channel("127.0.0.1", port)
+        assert isinstance(
+            ch.request(hashing.hex_to_hash(xh_hex), 0, 1), dcn.DcnResponse
+        )
+        # serverectomy: close the remote end of the live channel
+        server.shutdown()
+        deadline = time.monotonic() + 5
+        while not ch.dead and time.monotonic() < deadline:
+            try:
+                ch.request(hashing.hex_to_hash(xh_hex), 0, 1)
+            except (ConnectionError, TimeoutError):
+                break
+            time.sleep(0.05)
+        # restart on the same port; the pool must replace the dead channel
+        server2 = dcn.DcnServer(_cfg, server.cache)
+        server2.cfg.dcn_port = port
+        try:
+            deadline = time.monotonic() + 5
+            while True:  # old listener may need a beat to release the port
+                try:
+                    server2.start()
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            ch2 = pool.channel("127.0.0.1", port)
+            assert ch2 is not ch
+            reply = ch2.request(hashing.hex_to_hash(xh_hex), 0, 1)
+            assert isinstance(reply, dcn.DcnResponse)
+        finally:
+            server2.shutdown()
+    finally:
+        pool.close()
+
+
 def test_pool_reuses_channels(served_cache):
     _cfg, server, port, *_ = served_cache
     pool = dcn.DcnPool()

@@ -81,6 +81,20 @@ def bench_blake3_host(iters: int = 200) -> BenchResult:
                     CHUNK_64K, iters)
 
 
+def bench_gearhash_cdc(iters: int = 20) -> BenchResult:
+    """CDC boundary scan over 4 MiB of incompressible bytes — the other
+    half of the host addressing path (blake3_64kb is the hashing half)."""
+    import numpy as np
+
+    from zest_tpu.cas import chunking
+
+    data = np.random.default_rng(3).integers(
+        0, 256, 4 * 1024 * 1024, dtype=np.uint8
+    ).tobytes()
+    return _time_fn("gearhash_cdc_4mb", lambda: chunking.cut_points(data),
+                    len(data), iters)
+
+
 def bench_sha1_info_hash(iters: int = 5000) -> BenchResult:
     from zest_tpu.p2p import peer_id
 
@@ -167,8 +181,8 @@ def bench_ici_all_gather(mbytes_per_device: int = 16) -> BenchResult:
 
 def run_synthetic(device: bool = True) -> list[BenchResult]:
     results = bench_bencode()
-    results += [bench_blake3_host(), bench_sha1_info_hash(),
-                bench_wire_frame()]
+    results += [bench_blake3_host(), bench_gearhash_cdc(),
+                bench_sha1_info_hash(), bench_wire_frame()]
     try:
         results.append(bench_wire_frame_native())
     except RuntimeError:

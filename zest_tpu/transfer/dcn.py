@@ -237,6 +237,8 @@ class DcnServer:
         self._shutdown = threading.Event()
         self._sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def start(self) -> int:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -244,6 +246,11 @@ class DcnServer:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             sock.bind(("0.0.0.0", self.cfg.dcn_port))
             sock.listen(64)
+            # Periodic timeout so shutdown() is observed promptly — a
+            # close() does not wake a thread blocked in accept(), and the
+            # kernel keeps the port bound while the syscall holds the fd
+            # (same discipline as BtServer.start).
+            sock.settimeout(0.25)
         except OSError:
             sock.close()
             raise
@@ -262,12 +269,29 @@ class DcnServer:
                 self._sock.close()
             except OSError:
                 pass
+        # The accept loop polls the flag every 0.25s; join it so no
+        # further connection can be handed out after this point.
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        # Wake live serving threads — they otherwise sit in recv until
+        # the idle timeout and their peers' channels keep looking
+        # healthy. SHUT_RDWR alone: the owning thread's `with conn:` does
+        # the only close() (a second close here could race a recycled fd).
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         assert self._sock is not None
         while not self._shutdown.is_set():
             try:
                 conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue  # poll the shutdown flag
             except OSError:
                 return  # listener closed
             with self._stats_lock:
@@ -281,8 +305,15 @@ class DcnServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             with conn:
+                # A connection accepted in the same beat as shutdown()
+                # may miss its SHUT_RDWR (registered after the snapshot);
+                # re-checking here closes that window.
+                if self._shutdown.is_set():
+                    return
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(IDLE_TIMEOUT_S)
                 _exchange_hello(conn)
@@ -296,6 +327,9 @@ class DcnServer:
                     self._serve_request(conn, msg)
         except (ConnectionError, DcnProtocolError, OSError):
             return  # peer went away / spoke garbage: drop the connection
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _serve_request(self, conn: socket.socket, req: DcnRequest) -> None:
         if not req.range_start < req.range_end:
