@@ -5,7 +5,7 @@ coordinator: each is a REAL separate jax process — no monkeypatched
 process counts — with its own cache, BT seeding server, and 4 virtual
 CPU devices, forming one global 4N-device mesh.
 
-Three phases, KV-barriered:
+Four phases, KV-barriered:
 
   A. process 0 fetches every unit from the fixture CDN and announces
      each xorb on the CoordinatorRegistry (the jax.distributed KV store).
@@ -18,6 +18,9 @@ Three phases, KV-barriered:
      multi-process make_array_from_process_local_data branch + the
      cross-process all-gather — then verify every file reassembles
      bit-identically (hash re-derived through the CAS stack).
+  D. a hierarchical (pods, hosts) round with the pod axis ON the process
+     boundary: stage 1's cross-pod gather is a real cross-process
+     collective, every unit verified byte-for-byte out of the pool.
 
 Usage: _mp_pod_worker.py PROCESS_ID NUM_PROCS COORD_ADDR HUB_URL ROOT REPO_ID
 Writes ROOT/stats_{pid}.json on success.
@@ -161,6 +164,40 @@ def main() -> int:
     )
 
     registry.barrier("phase-c", 120)
+
+    # Phase D: a hierarchical (pods, hosts) round where the pod axis
+    # crosses the PROCESS boundary — process i is pod i, so stage 1's
+    # cross-pod all-gather is a real cross-process collective (the
+    # de-simulation of test_hierarchy's monkeypatched multiprocess
+    # branch). Caches are warm, so fetch_fn serves from disk.
+    from zest_tpu.parallel.hierarchy import (
+        HierarchicalDistributor,
+        HierarchicalPlan,
+        hier_mesh,
+    )
+
+    hmesh = hier_mesh(nprocs, devices_per_proc)
+    hplan = HierarchicalPlan.build(recs, nprocs, devices_per_proc)
+    dist = HierarchicalDistributor(hmesh)
+    pool = dist.distribute(
+        hplan,
+        lambda a: bridge.fetch_unit(a.hash_hex, a.fetch_info),
+    )
+    verified_units = 0
+    for a in hplan.flat.assignments:
+        got = pool.blob(a.hash_hex, a.fetch_info.range.start)
+        assert got is not None, (pid, a.hash_hex)
+        want = bridge.fetch_unit(a.hash_hex, a.fetch_info)
+        assert got[0] == want, (pid, a.hash_hex)
+        verified_units += 1
+    stats["hier"] = {
+        "pods": nprocs,
+        "hosts_per_pod": devices_per_proc,
+        "verified_units": verified_units,
+        "stage_seconds": dist.stage_seconds,
+    }
+
+    registry.barrier("phase-d", 120)
     server.shutdown()
     (root / f"stats_{pid}.json").write_text(json.dumps(stats))
     return 0

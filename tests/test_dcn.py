@@ -195,13 +195,16 @@ def test_pool_reconnects_dead_channels(served_cache):
         )
         # serverectomy: close the remote end of the live channel
         server.shutdown()
+        # keep poking until the reader thread observes the EOF (a sendall
+        # EPIPE raises before dead is set; don't stop on it)
         deadline = time.monotonic() + 5
         while not ch.dead and time.monotonic() < deadline:
             try:
                 ch.request(hashing.hex_to_hash(xh_hex), 0, 1)
             except (ConnectionError, TimeoutError):
-                break
+                pass
             time.sleep(0.05)
+        assert ch.dead, "channel never noticed the server went away"
         # restart on the same port; the pool must replace the dead channel
         server2 = dcn.DcnServer(_cfg, server.cache)
         server2.cfg.dcn_port = port

@@ -107,3 +107,9 @@ def test_two_process_distribution(hub, tmp_path):
     # the distributed pod round saw the full global mesh in BOTH workers
     assert s0["pod"]["slots"] == s1["pod"]["slots"] == 8
     assert s0["verified_files"] == s1["verified_files"] == 1
+    # hierarchical round: pod axis == process boundary, every unit
+    # verified byte-for-byte out of the cross-process gathered pool
+    for s in (s0, s1):
+        assert s["hier"]["pods"] == 2
+        assert s["hier"]["verified_units"] > 0
+        assert s["hier"]["stage_seconds"]["dcn"] > 0

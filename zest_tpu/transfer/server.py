@@ -46,6 +46,8 @@ class BtServer:
         self._stats_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self.port: int | None = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     # ── Lifecycle ──
 
@@ -73,6 +75,16 @@ class BtServer:
                 pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # Wake serving threads blocked in recv so peers' connections die
+        # now, not at their 120s timeout (same discipline as DcnServer;
+        # SHUT_RDWR only — the owning thread performs the single close).
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def get_stats(self) -> ServerStats:
         with self._stats_lock:
@@ -96,10 +108,14 @@ class BtServer:
 
     def _handle_peer(self, conn: socket.socket) -> None:
         conn.settimeout(120)
+        with self._conns_lock:
+            self._conns.add(conn)
         stream = wire.SocketStream(conn)
         with self._stats_lock:
             self._active_peers += 1
         try:
+            if self._shutdown.is_set():
+                return  # accepted in the same beat as shutdown()
             self._handle_peer_inner(stream)
         except (wire.WireError, OSError, bep_xet.XetMessageError):
             pass  # peer went away or spoke garbage; drop quietly
@@ -107,6 +123,8 @@ class BtServer:
             with self._stats_lock:
                 self._active_peers -= 1
             stream.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _handle_peer_inner(self, stream: wire.SocketStream) -> None:
         their_hs = stream.recv_handshake()
