@@ -123,6 +123,16 @@ class FixtureHub:
         fixture = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive like a real CDN: HTTP/1.0 (the default) forces a
+            # fresh TCP connection per ranged xorb fetch, which dominates
+            # loopback pull timings and under-measures the client's
+            # session reuse. Every _send sets Content-Length, so 1.1
+            # framing is already correct. The timeout bounds how long an
+            # idle keep-alive connection pins its handler thread after
+            # the hub shuts down (threads are daemonic either way).
+            protocol_version = "HTTP/1.1"
+            timeout = 5
+
             def log_message(self, *args):  # quiet
                 pass
 

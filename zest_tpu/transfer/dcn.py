@@ -211,6 +211,37 @@ def lookup_chunk_range(
 # ── Server ──
 
 
+class ConnTracker:
+    """Live-connection registry shared by the socket servers (BtServer,
+    DcnServer). Serving threads register/discard their connection; at
+    shutdown ``wake_all`` sends SHUT_RDWR to a snapshot so threads
+    blocked in recv exit now instead of at their idle timeout. Invariant:
+    only the owning thread ever close()s (a second close here could race
+    a recycled fd); threads registered after the snapshot must re-check
+    the server's shutdown flag themselves."""
+
+    def __init__(self) -> None:
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+
+    def add(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+
+    def discard(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def wake_all(self) -> None:
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
 @dataclass
 class DcnServerStats:
     connections: int = 0
@@ -237,8 +268,7 @@ class DcnServer:
         self._shutdown = threading.Event()
         self._sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
-        self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
+        self._conns = ConnTracker()
 
     def start(self) -> int:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -270,20 +300,11 @@ class DcnServer:
             except OSError:
                 pass
         # The accept loop polls the flag every 0.25s; join it so no
-        # further connection can be handed out after this point.
+        # further connection can be handed out after this point, then
+        # wake live serving threads (ConnTracker invariants).
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2.0)
-        # Wake live serving threads — they otherwise sit in recv until
-        # the idle timeout and their peers' channels keep looking
-        # healthy. SHUT_RDWR alone: the owning thread's `with conn:` does
-        # the only close() (a second close here could race a recycled fd).
-        with self._conns_lock:
-            conns = list(self._conns)
-        for conn in conns:
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
+        self._conns.wake_all()
 
     def _accept_loop(self) -> None:
         assert self._sock is not None
@@ -305,8 +326,7 @@ class DcnServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        with self._conns_lock:
-            self._conns.add(conn)
+        self._conns.add(conn)
         try:
             with conn:
                 # A connection accepted in the same beat as shutdown()
@@ -328,8 +348,7 @@ class DcnServer:
         except (ConnectionError, DcnProtocolError, OSError):
             return  # peer went away / spoke garbage: drop the connection
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
+            self._conns.discard(conn)
 
     def _serve_request(self, conn: socket.socket, req: DcnRequest) -> None:
         if not req.range_start < req.range_end:

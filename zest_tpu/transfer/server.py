@@ -25,7 +25,7 @@ from zest_tpu.config import Config
 from zest_tpu.p2p import bep_xet, peer_id as peer_id_mod, wire
 from zest_tpu.p2p.peer import LOCAL_UT_XET_ID
 from zest_tpu.storage import XorbCache
-from zest_tpu.transfer.dcn import lookup_chunk_range
+from zest_tpu.transfer.dcn import ConnTracker, lookup_chunk_range
 
 
 @dataclass
@@ -46,8 +46,7 @@ class BtServer:
         self._stats_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self.port: int | None = None
-        self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
+        self._conns = ConnTracker()
 
     # ── Lifecycle ──
 
@@ -76,15 +75,8 @@ class BtServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
         # Wake serving threads blocked in recv so peers' connections die
-        # now, not at their 120s timeout (same discipline as DcnServer;
-        # SHUT_RDWR only — the owning thread performs the single close).
-        with self._conns_lock:
-            conns = list(self._conns)
-        for conn in conns:
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
+        # now, not at their 120s timeout (ConnTracker invariants).
+        self._conns.wake_all()
 
     def get_stats(self) -> ServerStats:
         with self._stats_lock:
@@ -108,8 +100,7 @@ class BtServer:
 
     def _handle_peer(self, conn: socket.socket) -> None:
         conn.settimeout(120)
-        with self._conns_lock:
-            self._conns.add(conn)
+        self._conns.add(conn)
         stream = wire.SocketStream(conn)
         with self._stats_lock:
             self._active_peers += 1
@@ -123,8 +114,7 @@ class BtServer:
             with self._stats_lock:
                 self._active_peers -= 1
             stream.close()
-            with self._conns_lock:
-                self._conns.discard(conn)
+            self._conns.discard(conn)
 
     def _handle_peer_inner(self, stream: wire.SocketStream) -> None:
         their_hs = stream.recv_handshake()
