@@ -1,0 +1,118 @@
+"""L6 Python-package surface: ZestClient, the hf monkey-patch, SSE pull.
+
+The reference's python/zest/* contract (SURVEY.md §2.3): `pull` returns
+the snapshot dir, `enable()`'s patched snapshot_download is transparent
+and falls back to the original on ANY zest failure, and the REST pull
+streams progress. All against the loopback fixture hub.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+import requests
+
+from tests.fixtures import FixtureHub, FixtureRepo
+from zest_tpu.api import hf_backend
+from zest_tpu.api.client import ZestClient
+from zest_tpu.config import Config
+
+REPO_ID = "acme/api-model"
+FILES = {
+    "config.json": b'{"model_type": "gpt2"}',
+    "model.safetensors": np.random.default_rng(9).integers(
+        0, 256, 300_000, dtype=np.uint8
+    ).tobytes(),
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    with FixtureHub(FixtureRepo(REPO_ID, FILES, chunks_per_xorb=2)) as h:
+        yield h
+
+
+@pytest.fixture()
+def cfg(hub, tmp_path):
+    return Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                  hf_token="hf_test", endpoint=hub.url)
+
+
+def test_client_pull_returns_snapshot(cfg):
+    import os
+    import pathlib
+
+    res = ZestClient(cfg).pull(REPO_ID)
+    # PullResult is os.PathLike (the reference contract: pull hands back
+    # the snapshot dir) and additionally carries the stats block.
+    snap = pathlib.Path(os.fspath(res))
+    for name, data in FILES.items():
+        assert (snap / name).read_bytes() == data
+    assert res.stats["fetch"]["bytes"]["cdn"] > 0
+
+
+def test_hf_patch_pulls_through_zest(cfg):
+    import huggingface_hub
+
+    original = huggingface_hub.snapshot_download
+    hf_backend.patch_hf_hub(ZestClient(cfg))
+    try:
+        assert huggingface_hub.snapshot_download is not original
+        out = huggingface_hub.snapshot_download(REPO_ID)
+        assert (
+            __import__("pathlib").Path(out) / "model.safetensors"
+        ).read_bytes() == FILES["model.safetensors"]
+        # idempotent: re-patching keeps the original recoverable
+        hf_backend.patch_hf_hub(ZestClient(cfg))
+    finally:
+        hf_backend.unpatch_hf_hub()
+    assert huggingface_hub.snapshot_download is original
+
+
+def test_hf_patch_falls_back_on_zest_failure(cfg, monkeypatch):
+    """zest must never make a download fail that would otherwise
+    succeed: a broken client degrades to the original downloader."""
+    import huggingface_hub
+
+    sentinel = object()
+    monkeypatch.setattr(huggingface_hub, "snapshot_download",
+                        lambda repo_id, *a, **k: sentinel)
+
+    class BrokenClient:
+        def pull(self, repo_id, revision="main"):
+            raise RuntimeError("zest exploded")
+
+    hf_backend.patch_hf_hub(BrokenClient())
+    try:
+        assert huggingface_hub.snapshot_download(REPO_ID) is sentinel
+    finally:
+        hf_backend.unpatch_hf_hub()
+
+
+def test_sse_pull_streams_progress_and_completes(cfg):
+    from zest_tpu.api.http_api import HttpApi
+
+    cfg.http_port = 0
+    api = HttpApi(cfg)
+    port = api.start()
+    try:
+        r = requests.post(
+            f"http://127.0.0.1:{port}/v1/pull",
+            json={"repo_id": REPO_ID}, stream=True, timeout=60,
+        )
+        assert r.status_code == 200
+        assert "text/event-stream" in r.headers["Content-Type"]
+        events = []
+        for line in r.iter_lines():
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+        assert events[0]["event"] == "start"
+        assert events[-1]["event"] == "done"
+        assert events[-1]["stats"]["files_downloaded"] == len(FILES)
+        snap = __import__("pathlib").Path(events[-1]["snapshot_dir"])
+        for name, data in FILES.items():
+            assert (snap / name).read_bytes() == data
+    finally:
+        api.close()

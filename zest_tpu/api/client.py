@@ -8,11 +8,14 @@ daemon's REST API (python/zest/client.py:48-54).
 
 from __future__ import annotations
 
-from pathlib import Path
+from typing import TYPE_CHECKING
 
 import requests
 
 from zest_tpu.config import Config
+
+if TYPE_CHECKING:
+    from zest_tpu.transfer.pull import PullResult
 
 
 class ZestClient:
@@ -24,8 +27,11 @@ class ZestClient:
         repo_id: str,
         revision: str = "main",
         device: str | None = None,
-    ) -> Path:
-        """Download ``repo_id`` through the swarm; returns the snapshot dir."""
+    ) -> "PullResult":
+        """Download ``repo_id`` through the swarm. The result is
+        os.PathLike for the snapshot dir (the reference contract,
+        python/zest/client.py:22-46) and carries ``.stats`` and — for
+        ``device='tpu'`` — the staged ``.params``."""
         from zest_tpu.transfer.pull import pull_model
 
         return pull_model(
