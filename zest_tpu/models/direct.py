@@ -186,7 +186,11 @@ def land_moe_expert_sharded(
         tensors, moe_cfg, dtype=dtype or jnp.float32
     )
     specs = moe_mod.param_specs(moe_cfg)
-    return jax.tree.map(
-        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-        params, specs, is_leaf=lambda v: isinstance(v, P),
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda v: isinstance(v, P),
     )
+    # One batched device_put for the whole tree (per-leaf puts pay a
+    # transfer-setup round trip per unique shape; loader.commit_tensors
+    # has the measurement).
+    return jax.device_put(params, shardings)

@@ -105,6 +105,54 @@ def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
         bridge.cache.put_partial(hash_hex, chunk_offset, data)
 
 
+def warm_units_parallel(
+    bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
+) -> dict:
+    """Fetch every uncached unit of ``recs`` into the local cache with
+    ``max_concurrent`` waterfall fetches in flight (the reference's
+    16-way term concurrency, config.zig:13 / parallel_download.zig).
+
+    This is the single-host stand-in for a distribution round: when no
+    collective or owner pod exists (one chip, pod round skipped), the
+    direct-to-HBM landing would otherwise pull terms SEQUENTIALLY
+    through the waterfall. Idempotent; respects cached entries.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    if max_concurrent is None:
+        max_concurrent = bridge.cfg.max_concurrent_downloads
+    entries_map = _entries_by_hash(recs)
+    wanted = [
+        (hash_hex, fi)
+        for (hash_hex, _s), fi in collect_units(recs)
+        if not _already_cached(bridge, hash_hex, fi)
+    ]
+    stats = {"units": len(wanted), "bytes": 0, "failed": 0}
+    if not wanted:
+        return stats
+
+    def fetch(unit):
+        hash_hex, fi = unit
+        data = bridge.fetch_unit(hash_hex, fi)
+        _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)
+        return len(data)
+
+    with ThreadPoolExecutor(max_workers=max_concurrent) as pool:
+        for result in pool.map(lambda u: _safe(fetch, u), wanted):
+            if result is None:
+                stats["failed"] += 1
+            else:
+                stats["bytes"] += result
+    return stats
+
+
+def _safe(fn, arg):
+    try:
+        return fn(arg)
+    except Exception:
+        return None  # the landing's own waterfall retries per term
+
+
 def federated_round(
     bridge,
     recs: list[Reconstruction],

@@ -227,7 +227,19 @@ def _try_direct_stage(
         for e in st:
             rec = bridge.get_reconstruction(e.xet_hash)
             recs_with_headers.append((rec, fetch_file_header(bridge, rec)))
-        return stage_cached_to_hbm(bridge, recs_with_headers, mesh=mesh)
+        # Whatever the distribution rounds didn't cache (single chip:
+        # everything) arrives max_concurrent-wide, not term-by-term.
+        from zest_tpu.transfer.federated import warm_units_parallel
+
+        warm = warm_units_parallel(bridge, [r for r, _h in recs_with_headers])
+        if warm["failed"]:
+            log(f"warm fetch: {warm['failed']}/{warm['units']} units "
+                "failed; landing falls back per-term", file=sys.stderr)
+        params, hbm_stats = stage_cached_to_hbm(
+            bridge, recs_with_headers, mesh=mesh
+        )
+        hbm_stats["warm"] = warm
+        return params, hbm_stats
     except Exception as exc:  # noqa: BLE001 - landing is an accelerator
         log(f"direct HBM landing unavailable ({exc}); "
             "will stage from disk after download", file=sys.stderr)
