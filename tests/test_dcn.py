@@ -181,6 +181,31 @@ def test_pipelined_batch_order_and_stats(served_cache):
     assert server.stats.chunks_served == len(chunks)
 
 
+def test_large_blob_served_intact(served_cache):
+    """A multi-megabyte response exercises the scatter-gather send path
+    (partial sendmsg resumption) end-to-end."""
+    cfg, _server, port, *_ = served_cache
+    cache = XorbCache(cfg)
+    rng = np.random.default_rng(13)
+    builder = XorbBuilder()
+    while builder.uncompressed_total < 8 * 1024 * 1024:
+        builder.add_chunk(rng.integers(0, 256, 64 * 1024,
+                                       dtype=np.uint8).tobytes())
+    n = len(builder.chunk_hashes())
+    xh_hex = hashing.hash_to_hex(builder.xorb_hash())
+    cache.put(xh_hex, builder.serialize_full())
+    ch = dcn.DcnChannel("127.0.0.1", port)
+    try:
+        reply = ch.request(hashing.hex_to_hash(xh_hex), 0, n)
+        assert isinstance(reply, dcn.DcnResponse)
+        reader = XorbReader(reply.data)
+        assert len(reader) == n
+        reader.extract_chunk(0, verify=True)
+        reader.extract_chunk(n - 1, verify=True)
+    finally:
+        ch.close()
+
+
 def test_pool_reconnects_dead_channels(served_cache):
     """A server-side close (idle timeout, restart) marks the channel dead;
     the pool must hand out a fresh connection, not the corpse."""

@@ -145,6 +145,21 @@ def decode_message(header: bytes, body: bytes) -> DcnMessage:
     raise DcnProtocolError(f"unknown message type {mtype}")
 
 
+def _sendmsg_all(sock: socket.socket, buffers: list[bytes]) -> None:
+    """sendall semantics over scatter-gather buffers (no concat copy).
+
+    sendmsg can send fewer bytes than given; resume from the split point
+    with memoryviews rather than re-joining."""
+    views = [memoryview(b) for b in buffers if len(b)]
+    while views:
+        sent = sock.sendmsg(views)
+        while views and sent >= len(views[0]):
+            sent -= len(views[0])
+            views.pop(0)
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -369,14 +384,24 @@ class DcnServer:
             ))
             return
         offset, blob = found
+        if 8 + len(blob) > MAX_MESSAGE_SIZE:
+            # An over-cap cached entry (e.g. served whole after a footer
+            # parse failure) must fail as a clean ERROR, not stream an
+            # over-cap message the client will kill the channel over.
+            conn.sendall(encode_message(DcnError(
+                req.request_id, f"entry of {len(blob)} bytes over cap"
+            )))
+            return
         # Count before sending: a client that got the last response must
         # observe the stats it implies (the send is the visibility edge).
         with self._stats_lock:
             self.stats.chunks_served += 1
             self.stats.bytes_served += len(blob)
-        conn.sendall(encode_message(
-            DcnResponse(req.request_id, offset, blob)
-        ))
+        # Scatter-gather send: the blob can be a whole 64 MiB xorb, and
+        # encode_message would memcpy it twice building one bytestring.
+        header = _HEADER.pack(MSG_RESPONSE, 0, 0, req.request_id,
+                              8 + len(blob))
+        _sendmsg_all(conn, [header + struct.pack("<Q", offset), blob])
 
 
 # ── Client ──
