@@ -1,0 +1,499 @@
+"""Llama-family flagship: pure-JAX Llama 3.x that consumes pulled checkpoints.
+
+BASELINE.md's north-star configs are Llama models (config #2 Llama-3.1-8B
+two-host DCN, #3 Llama-3.1-70B v5p-64 ICI, #5 Llama-405B hierarchical) —
+the checkpoints the pull pipeline exists to land. This module is their
+consumer, the same role models/gpt2.py plays for config #1's verify loop
+(reference: test/local/verify-model.sh:90-147). Architecture: RMSNorm,
+rotary embeddings, grouped-query attention, SwiGLU MLP — the Llama 2/3
+family (and by extension Mistral/Qwen-dense, which share the layout).
+
+Design notes (TPU-first, matching gpt2.py/moe.py):
+- stacked per-layer leaves + one ``lax.scan`` over layers: one compiled
+  block regardless of depth.
+- tensor parallelism as Megatron PartitionSpecs over the ``model`` axis:
+  q/k/v/gate/up shard their output dim, o/down their input dim — exactly
+  one GSPMD reduce per sublayer.
+- **context parallelism is first-class**: :func:`cp_forward` runs the whole
+  forward under ``shard_map`` with the sequence dimension sharded over a
+  ``seq`` mesh axis, attention as a ppermute ring
+  (zest_tpu.parallel.ring), and RoPE phases offset per shard — long
+  sequences scale across devices with O(T/P) activation memory per device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zest_tpu.parallel.ring import SEQ_AXIS, ring_self_attention
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    # Defaults are Llama-3.1-8B's config.json (BASELINE config #2).
+    vocab_size: int = 128256
+    n_ctx: int = 131072
+    n_embd: int = 4096
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 8
+    d_ff: int = 14336
+    rms_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_embeddings: bool = False
+    # Llama-3.1 "llama3" RoPE frequency scaling (config.json rope_scaling).
+    # factor None = unscaled (Llama 2 / 3.0 / Mistral).
+    rope_scaling_factor: float | None = 8.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_ctx: int = 8192
+    # Some family members decouple head_dim from n_embd/n_head
+    # (e.g. Mistral-Nemo: 5120/32 but head_dim=128). None = derived.
+    head_dim_override: int | None = None
+
+    @staticmethod
+    def tiny(**over) -> "LlamaConfig":
+        """Test/dryrun-sized config (divisible by 8-wide mesh axes)."""
+        base = dict(vocab_size=256, n_ctx=64, n_embd=64, n_layer=2,
+                    n_head=4, n_kv_head=2, d_ff=128,
+                    rope_scaling_factor=None)
+        base.update(over)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()  # defaults
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(n_embd=8192, n_layer=80, n_head=64,
+                           n_kv_head=8, d_ff=28672)
+
+    @staticmethod
+    def from_hf(cfg_json: dict) -> "LlamaConfig":
+        rs = cfg_json.get("rope_scaling") or None
+        scaling: dict = {"rope_scaling_factor": None}
+        if rs:
+            rtype = rs.get("rope_type", rs.get("type", "default"))
+            if rtype == "llama3":
+                scaling = dict(
+                    rope_scaling_factor=float(rs["factor"]),
+                    rope_low_freq_factor=float(
+                        rs.get("low_freq_factor", 1.0)),
+                    rope_high_freq_factor=float(
+                        rs.get("high_freq_factor", 4.0)),
+                    rope_original_ctx=int(
+                        rs.get("original_max_position_embeddings", 8192)),
+                )
+            elif rtype != "default":
+                # Silently dropping a scaling rule would yield wrong
+                # positional phases on every token — refuse instead.
+                raise ValueError(
+                    f"unsupported rope_scaling type {rtype!r} "
+                    "(supported: llama3, default)"
+                )
+        if cfg_json.get("attention_bias") or cfg_json.get("mlp_bias"):
+            # The tree has no bias leaves; loading such a checkpoint would
+            # silently drop its bias tensors and compute wrong logits.
+            raise ValueError(
+                "attention_bias/mlp_bias checkpoints are not supported "
+                "by this bias-free Llama tree"
+            )
+        # Fallbacks for omitted keys match transformers.LlamaConfig's
+        # defaults (an old Llama-2-era config.json omits rope_theta and
+        # must get 10000.0, not a 3.1 value).
+        return LlamaConfig(
+            **scaling,
+            vocab_size=cfg_json["vocab_size"],
+            n_ctx=cfg_json.get("max_position_embeddings", 2048),
+            n_embd=cfg_json["hidden_size"],
+            n_layer=cfg_json["num_hidden_layers"],
+            n_head=cfg_json["num_attention_heads"],
+            n_kv_head=cfg_json.get("num_key_value_heads",
+                                   cfg_json["num_attention_heads"]),
+            d_ff=cfg_json["intermediate_size"],
+            rms_eps=cfg_json.get("rms_norm_eps", 1e-6),
+            rope_theta=cfg_json.get("rope_theta", 10000.0),
+            tie_embeddings=cfg_json.get("tie_word_embeddings", False),
+            head_dim_override=cfg_json.get("head_dim"),
+        )
+
+    @property
+    def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
+        return self.n_embd // self.n_head
+
+
+# ── Parameters ──
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> dict:
+    """Random-init tree with stacked per-layer leaves (L leading)."""
+    E, L, F = cfg.n_embd, cfg.n_layer, cfg.d_ff
+    qE = cfg.n_head * cfg.head_dim  # == E unless head_dim_override
+    kvE = cfg.n_kv_head * cfg.head_dim
+    k = iter(jax.random.split(rng, 10))
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+    out = {
+        "wte": dense(next(k), (cfg.vocab_size, E)),
+        "ln_f": {"g": jnp.ones((E,), dtype)},
+        "blocks": {
+            "ln_attn": {"g": jnp.ones((L, E), dtype)},
+            "ln_mlp": {"g": jnp.ones((L, E), dtype)},
+            "attn": {
+                "q_w": dense(next(k), (L, E, qE)),
+                "k_w": dense(next(k), (L, E, kvE)),
+                "v_w": dense(next(k), (L, E, kvE)),
+                "o_w": dense(next(k), (L, qE, E), 0.02 / math.sqrt(2 * L)),
+            },
+            "mlp": {
+                "gate_w": dense(next(k), (L, E, F)),
+                "up_w": dense(next(k), (L, E, F)),
+                "down_w": dense(next(k), (L, F, E), 0.02 / math.sqrt(2 * L)),
+            },
+        },
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = dense(next(k), (E, cfg.vocab_size))
+    return out
+
+
+_HF_ATTN = {
+    "self_attn.q_proj": ("attn", "q_w"),
+    "self_attn.k_proj": ("attn", "k_w"),
+    "self_attn.v_proj": ("attn", "v_w"),
+    "self_attn.o_proj": ("attn", "o_w"),
+}
+_HF_MLP = {
+    "mlp.gate_proj": ("mlp", "gate_w"),
+    "mlp.up_proj": ("mlp", "up_w"),
+    "mlp.down_proj": ("mlp", "down_w"),
+}
+_HF_NORM = {
+    "input_layernorm": ("ln_attn", "g"),
+    "post_attention_layernorm": ("ln_mlp", "g"),
+}
+
+
+def params_from_hf(
+    tensors: dict[str, np.ndarray], cfg: LlamaConfig, dtype=jnp.float32
+) -> dict:
+    """Map an HF Llama-family checkpoint (flat name→array) onto the tree.
+
+    HF ``nn.Linear`` weights are stored [out, in]; all are transposed into
+    the x @ W layout. Tied-embedding checkpoints (no ``lm_head.weight``)
+    map onto a tree without the ``lm_head`` leaf; ``forward`` then reuses
+    ``wte``. Missing tensors raise with their names.
+    """
+
+    def take(name):
+        arr = tensors.get(name)
+        if arr is None:
+            raise ValueError(f"checkpoint missing {name}")
+        return np.asarray(arr)
+
+    out = {
+        "wte": jnp.asarray(take("model.embed_tokens.weight"), dtype),
+        "ln_f": {"g": jnp.asarray(take("model.norm.weight"), dtype)},
+    }
+    # Tied checkpoints may still serialize lm_head.weight (state_dict
+    # materializes the tie); the tree follows the config, not the file —
+    # and an untied config missing the head is an error like any other
+    # missing tensor, not a silent fallback to wte.
+    if not cfg.tie_embeddings:
+        out["lm_head"] = jnp.asarray(take("lm_head.weight").T, dtype)
+    blocks: dict = {
+        "ln_attn": {"g": []}, "ln_mlp": {"g": []},
+        "attn": {leaf: [] for _, leaf in _HF_ATTN.values()},
+        "mlp": {leaf: [] for _, leaf in _HF_MLP.values()},
+    }
+    for layer in range(cfg.n_layer):
+        pre = f"model.layers.{layer}."
+        for hf, (grp, leaf) in _HF_NORM.items():
+            blocks[grp][leaf].append(take(f"{pre}{hf}.weight"))
+        for hf, (grp, leaf) in {**_HF_ATTN, **_HF_MLP}.items():
+            blocks[grp][leaf].append(take(f"{pre}{hf}.weight").T)
+    out["blocks"] = jax.tree.map(
+        lambda leaves: jnp.asarray(np.stack(leaves), dtype),
+        blocks, is_leaf=lambda v: isinstance(v, list),
+    )
+    return out
+
+
+# ── Sharding rules (data + tensor parallel) ──
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpec tree matching ``init_params`` (Megatron-style TP)."""
+    out = {
+        # Replicated embedding (same rationale as gpt2.param_specs: spec
+        # trees stay mesh-independent; raw-checkpoint landing still shards
+        # via checkpoint_shard_rules when dims divide).
+        "wte": P(),
+        "ln_f": {"g": P()},
+        "blocks": {
+            "ln_attn": {"g": P()},
+            "ln_mlp": {"g": P()},
+            "attn": {
+                "q_w": P(None, None, MODEL_AXIS),
+                "k_w": P(None, None, MODEL_AXIS),
+                "v_w": P(None, None, MODEL_AXIS),
+                "o_w": P(None, MODEL_AXIS, None),
+            },
+            "mlp": {
+                "gate_w": P(None, None, MODEL_AXIS),
+                "up_w": P(None, None, MODEL_AXIS),
+                "down_w": P(None, MODEL_AXIS, None),
+            },
+        },
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = P(None, MODEL_AXIS)
+    return out
+
+
+def checkpoint_shard_rules() -> list[tuple[str, P]]:
+    """Name-pattern rules for landing raw HF Llama safetensors via
+    zest_tpu.models.loader (HF [out, in] orientation, so the TP dim is
+    axis 0 for column-parallel tensors and axis 1 for row-parallel)."""
+    return [
+        (r"self_attn\.[qkv]_proj\.weight$", P(MODEL_AXIS, None)),
+        (r"self_attn\.o_proj\.weight$", P(None, MODEL_AXIS)),
+        (r"mlp\.(gate|up)_proj\.weight$", P(MODEL_AXIS, None)),
+        (r"mlp\.down_proj\.weight$", P(None, MODEL_AXIS)),
+        (r"^lm_head\.weight$", P(MODEL_AXIS, None)),
+    ]
+
+
+# ── Forward ──
+
+
+def _rms_norm(x, g, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * g
+
+
+@functools.lru_cache(maxsize=None)
+def _inv_freq(cfg: LlamaConfig) -> np.ndarray:
+    """Per-dimension rotary frequencies, with the Llama-3.1 "llama3"
+    scaling rule applied when configured: long-wavelength dims slow by
+    ``factor``, short wavelengths stay, the band between interpolates
+    (HF ROPE_INIT_FUNCTIONS['llama3']). Config-static → numpy, cached."""
+    half = cfg.head_dim // 2
+    inv = cfg.rope_theta ** (-np.arange(half, dtype=np.float64) / half)
+    if cfg.rope_scaling_factor:
+        wavelen = 2.0 * math.pi / inv
+        smooth = (
+            (cfg.rope_original_ctx / wavelen - cfg.rope_low_freq_factor)
+            / (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor)
+        )
+        smooth = np.clip(smooth, 0.0, 1.0)
+        # smooth=0 (wavelen > orig/low): fully scaled; smooth=1
+        # (wavelen < orig/high): unscaled; between: linear blend.
+        inv = (1.0 - smooth) * inv / cfg.rope_scaling_factor + smooth * inv
+    return inv.astype(np.float32)
+
+
+def _rope(x, cfg: LlamaConfig, pos0=0):
+    """Rotary embedding over (B, T, H, D), HF rotate-half convention.
+
+    ``pos0`` offsets the positions — the context-parallel path passes each
+    shard's global start so phases match the unsharded computation.
+    """
+    B, T, H, D = x.shape
+    freqs = jnp.asarray(_inv_freq(cfg))
+    half = D // 2
+    pos = pos0 + jnp.arange(T, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+def _qkv(x, p, cfg: LlamaConfig, pos0=0):
+    B, T, _ = x.shape
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q = (x @ p["q_w"]).reshape(B, T, H, D)
+    k = (x @ p["k_w"]).reshape(B, T, KV, D)
+    v = (x @ p["v_w"]).reshape(B, T, KV, D)
+    return (_rope(q, cfg, pos0), _rope(k, cfg, pos0), v)
+
+
+def _attention(x, p, cfg: LlamaConfig):
+    """Dense causal GQA for the single-shard (no seq axis) path."""
+    B, T, E = x.shape
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q, k, v = _qkv(x, p, cfg)
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(D)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, H * D)
+    return out @ p["o_w"]
+
+
+def _ring_attention(x, p, cfg: LlamaConfig, seq_axis: str):
+    """Ring GQA for the context-parallel path (inside shard_map)."""
+    B, T, _ = x.shape
+    pos0 = jax.lax.axis_index(seq_axis) * T
+    q, k, v = _qkv(x, p, cfg, pos0=pos0)
+    out = ring_self_attention(q, k, v, seq_axis, causal=True)
+    return out.reshape(B, T, cfg.n_head * cfg.head_dim) @ p["o_w"]
+
+
+def _mlp(x, p):
+    return (jax.nn.silu(x @ p["gate_w"]) * (x @ p["up_w"])) @ p["down_w"]
+
+
+def _body(params, x, cfg: LlamaConfig, attn_fn):
+    def body(x, lp):
+        h = _rms_norm(x, lp["ln_attn"]["g"], cfg.rms_eps)
+        x = x + attn_fn(h, lp["attn"])
+        h = _rms_norm(x, lp["ln_mlp"]["g"], cfg.rms_eps)
+        return x + _mlp(h, lp["mlp"]), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
+    head = params.get("lm_head")
+    return x @ (head if head is not None else params["wte"].T)
+
+
+def forward(
+    params: dict, input_ids: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """(B, T) int32 ids → (B, T, vocab) logits. Jittable."""
+    x = params["wte"][input_ids]
+    return _body(params, x, cfg, lambda h, p: _attention(h, p, cfg))
+
+
+def loss_fn(params, batch, cfg: LlamaConfig):
+    """Next-token cross entropy over ``batch`` (B, T+1) ids."""
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def train_step(params, batch, cfg: LlamaConfig, lr: float = 1e-3):
+    """One SGD step; under a {data, model} mesh GSPMD inserts the TP
+    reduces and DP gradient psum (same contract as gpt2.train_step)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                          params, grads)
+    return params, loss
+
+
+# ── Context parallelism (sequence sharded, ring attention) ──
+
+
+def cp_forward(
+    params: dict,
+    input_ids: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    seq_axis: str = SEQ_AXIS,
+    data_axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Forward with the sequence dimension sharded over ``seq_axis``.
+
+    The whole transformer body runs under ``shard_map``: token/RoPE work is
+    local to each shard (phases offset by the shard's global start),
+    attention is the ppermute ring, everything else is elementwise or
+    feature-dim matmuls that need no cross-shard communication. Params are
+    replicated across the mesh inside the mapped body (TP×CP composition
+    would pass a spec tree instead). The seq-axis size must divide T
+    (shard_map needs even T/axis_size shards).
+    """
+    spec = P(data_axis, seq_axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), spec), out_specs=P(data_axis, seq_axis, None),
+    )
+    def fwd(params, ids):
+        x = params["wte"][ids]
+        return _body(
+            params, x, cfg, lambda h, p: _ring_attention(h, p, cfg, seq_axis)
+        )
+
+    return fwd(params, input_ids)
+
+
+def cp_loss_fn(params, inputs, targets, cfg: LlamaConfig, mesh: Mesh,
+               seq_axis: str = SEQ_AXIS, data_axis: str = DATA_AXIS):
+    """Cross entropy with ``inputs``/``targets`` (B, T) sharded on T.
+
+    The next-token shift crosses shard boundaries, so callers shift
+    *globally* (see :func:`cp_train_step`) and pass aligned arrays; the
+    logits stay sharded and GSPMD reduces the mean.
+    """
+    logits = cp_forward(params, inputs, cfg, mesh, seq_axis, data_axis)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def cp_train_step(params, batch, cfg: LlamaConfig, mesh: Mesh,
+                  lr: float = 1e-3, seq_axis: str = SEQ_AXIS,
+                  data_axis: str = DATA_AXIS):
+    """Context-parallel SGD step on ``batch`` (B, T+1) ids.
+
+    The shift happens on the global array — GSPMD turns the one-token halo
+    into a neighbor exchange — then forward+backward run through the
+    shard_mapped ring (its transpose is the reverse-direction ring).
+    """
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    inputs = jax.lax.with_sharding_constraint(inputs, sharding)
+    targets = jax.lax.with_sharding_constraint(targets, sharding)
+    loss, grads = jax.value_and_grad(cp_loss_fn)(
+        params, inputs, targets, cfg, mesh, seq_axis, data_axis
+    )
+    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                          params, grads)
+    return params, loss
+
+
+def generate_greedy(params, cfg: LlamaConfig, prompt_ids, steps: int):
+    """Greedy decode via ``lax.scan`` over a fixed buffer (static shapes)."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    n0 = prompt_ids.shape[0]
+    total = n0 + steps
+    if total > cfg.n_ctx:
+        raise ValueError(
+            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"n_ctx {cfg.n_ctx}"
+        )
+    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+
+    def step(carry, _):
+        buf, pos = carry
+        logits = forward(params, buf[None, :], cfg)[0]
+        nxt = jnp.argmax(logits[pos - 1]).astype(jnp.int32)
+        buf = buf.at[pos].set(nxt)
+        return (buf, pos + 1), nxt
+
+    (buf, _), _ = jax.lax.scan(step, (buf, jnp.int32(n0)), None, length=steps)
+    return buf

@@ -1,0 +1,165 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+The reference has no model math, so it has no long-context story at all —
+SURVEY.md §5 records "Long-context / sequence parallelism: Absent" and maps
+its only structural analog (range-aware partial transfer,
+src/bep_xet.zig:66-74) onto this build's sharding plane. The TPU build makes
+long context first-class: sequences shard over a ``seq`` mesh axis, and
+attention runs as a *ring* — K/V blocks rotate around the axis via
+``jax.lax.ppermute`` while each device's resident Q block folds every
+incoming block into a numerically stable streaming softmax (the blockwise /
+flash recurrence). Peak memory per device is O(T/P · T/P) for scores instead
+of O(T²), and each step's transfer overlaps the previous step's compute in
+XLA's schedule, so ICI time hides behind the MXU.
+
+Written shard_map-first: :func:`ring_self_attention` is the per-device
+program (callable only inside ``shard_map``/``vmap`` with a bound axis
+name); :func:`ring_attention` wraps it for globally sharded arrays. The
+recurrence is a ``lax.scan`` over ring steps — static trip count, no Python
+control flow under jit, reverse-differentiable (the ppermute transposes to
+the reverse rotation, giving the ring-backward of Liu et al. for free).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+_NEG_INF = float("-inf")
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact (optionally causal) attention for one sequence shard.
+
+    Must run under ``shard_map`` with ``axis_name`` bound to the mesh axis
+    the sequence dimension is sharded over. Shapes are per-device:
+
+    - ``q``: (B, Tq, H, D) — this device's query block
+    - ``k``/``v``: (B, Tk, Hkv, D) — this device's key/value block; GQA is
+      supported (H must be a multiple of Hkv)
+
+    Returns (B, Tq, H, D) in ``q``'s dtype. Score/softmax math is float32
+    (matching the dense paths in models/gpt2.py and models/moe.py); the
+    P(=axis size) ring steps each do one ppermute of (k, v) to the next
+    device and one blockwise accumulate, so every device sees every K/V
+    block exactly once. Causality is enforced with global positions
+    (block index × block length + offset), masking whole future blocks to
+    -inf — they contribute exp(-inf)=0 to the running sums, keeping every
+    shape static for XLA.
+    """
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    if H % Hkv:
+        raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
+    ring = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(D) if scale is None else scale
+
+    qf = q.astype(jnp.float32) * scale
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+    qpos = idx * Tq + jnp.arange(Tq)
+
+    def accumulate(acc, kb, vb, s):
+        """Fold the K/V block held after ``s`` rotations into the running
+        softmax. After s forward rotations this device holds the block
+        that started on device (idx - s) mod ring."""
+        m, l, o = acc
+        owner = (idx - s) % ring
+        kk = kb.astype(jnp.float32)
+        if Hkv != H:  # GQA: broadcast each kv head across its query group
+            kk = jnp.repeat(kk, H // Hkv, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kk)
+        if causal:
+            kpos = owner * Tk + jnp.arange(Tk)
+            mask = kpos[None, :] <= qpos[:, None]
+            scores = jnp.where(mask, scores, _NEG_INF)
+        block_max = jnp.max(scores, axis=-1)                    # (B, H, Tq)
+        new_m = jnp.maximum(m, block_max)
+        # Fully masked so far → new_m = -inf; subtract 0 instead so the
+        # exps stay NaN-free (scores are -inf there, giving p = 0).
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])                 # (B,H,Tq,Tk)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        vv = vb.astype(jnp.float32)
+        if Hkv != H:
+            vv = jnp.repeat(vv, H // Hkv, axis=2)
+        upd = jnp.einsum("bhqk,bkhd->bhqd", p, vv)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + upd
+        return new_m, l, o
+
+    def step(carry, s):
+        m, l, o, kb, vb = carry
+        acc = accumulate((m, l, o), kb, vb, s)
+        kb, vb = jax.lax.ppermute((kb, vb), axis_name, perm)
+        return (*acc, kb, vb), None
+
+    # The carry becomes device-varying inside the loop (it mixes with
+    # axis_index and the inputs); mark the constant initializers varying
+    # over every manual axis the operands vary over — not just the ring
+    # axis, since under a multi-axis shard_map (e.g. {data, seq}) q/k/v
+    # vary over all of them — so the scan's carry type is stable
+    # (shard_map VMA typing).
+    vary = set((axis_name,))
+    for arr in (q, k, v):
+        vary |= set(getattr(jax.typeof(arr), "vma", ()) or ())
+    acc0 = (jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, H, Tq, D), jnp.float32))
+    if hasattr(jax.lax, "pcast"):
+        m0, l0, o0 = jax.lax.pcast(acc0, tuple(sorted(vary)), to="varying")
+    else:  # pre-0.9 spelling
+        m0, l0, o0 = jax.lax.pvary(acc0, tuple(sorted(vary)))
+    # Scan the first ring-1 accumulate-then-rotate steps, then fold the
+    # final block in WITHOUT rotating — the last ppermute's output would
+    # be discarded, and the scan carry would stop XLA from DCE'ing that
+    # wasted K/V transfer (1/ring extra ICI bandwidth per layer).
+    (m, l, o, kl, vl), _ = jax.lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(ring - 1)
+    )
+    m, l, o = accumulate((m, l, o), kl, vl, ring - 1)
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    seq_axis: str = SEQ_AXIS,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Ring attention over globally (B, T, H, D) arrays sharded on T.
+
+    Convenience wrapper: shard_maps :func:`ring_self_attention` over
+    ``mesh``'s ``seq_axis``. T must divide evenly by the axis size. All
+    other mesh axes see the arrays as replicated; for combined data+seq
+    sharding call ``ring_self_attention`` from your own shard_map (as
+    models/llama.py's context-parallel step does).
+    """
+    spec = P(None, seq_axis)
+    fn = jax.shard_map(
+        functools.partial(
+            ring_self_attention, axis_name=seq_axis,
+            causal=causal, scale=scale,
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)

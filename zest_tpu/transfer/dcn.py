@@ -102,13 +102,32 @@ DcnMessage = DcnRequest | DcnResponse | DcnNotFound | DcnError
 # ── Codec (fixed-buffer roundtrip-testable, no sockets) ──
 
 
+_OFFSET = struct.Struct("<Q")
+
+
+def encode_response_prefix(
+    request_id: int, chunk_offset: int, data_len: int
+) -> bytes:
+    """Header + chunk_offset prefix of a RESPONSE carrying ``data_len``
+    payload bytes. The single source of truth for RESPONSE framing: both
+    ``encode_message`` and the server's zero-copy scatter-gather send
+    (which must not memcpy the blob into one bytestring) build from it.
+    """
+    body_len = _OFFSET.size + data_len
+    if body_len > MAX_MESSAGE_SIZE:
+        raise DcnProtocolError(f"payload of {body_len} bytes over cap")
+    return (_HEADER.pack(MSG_RESPONSE, 0, 0, request_id, body_len)
+            + _OFFSET.pack(chunk_offset))
+
+
 def encode_message(msg: DcnMessage) -> bytes:
     if isinstance(msg, DcnRequest):
         body = _REQ_BODY.pack(msg.chunk_hash, msg.range_start, msg.range_end)
         mtype = MSG_REQUEST
     elif isinstance(msg, DcnResponse):
-        body = struct.pack("<Q", msg.chunk_offset) + msg.data
-        mtype = MSG_RESPONSE
+        return encode_response_prefix(
+            msg.request_id, msg.chunk_offset, len(msg.data)
+        ) + msg.data
     elif isinstance(msg, DcnNotFound):
         body = msg.chunk_hash
         mtype = MSG_NOT_FOUND
@@ -384,7 +403,7 @@ class DcnServer:
             ))
             return
         offset, blob = found
-        if 8 + len(blob) > MAX_MESSAGE_SIZE:
+        if _OFFSET.size + len(blob) > MAX_MESSAGE_SIZE:
             # An over-cap cached entry (e.g. served whole after a footer
             # parse failure) must fail as a clean ERROR, not stream an
             # over-cap message the client will kill the channel over.
@@ -399,9 +418,9 @@ class DcnServer:
             self.stats.bytes_served += len(blob)
         # Scatter-gather send: the blob can be a whole 64 MiB xorb, and
         # encode_message would memcpy it twice building one bytestring.
-        header = _HEADER.pack(MSG_RESPONSE, 0, 0, req.request_id,
-                              8 + len(blob))
-        _sendmsg_all(conn, [header + struct.pack("<Q", offset), blob])
+        _sendmsg_all(conn, [
+            encode_response_prefix(req.request_id, offset, len(blob)), blob,
+        ])
 
 
 # ── Client ──
