@@ -6,7 +6,10 @@ mesh (``mesh``), DHT lookup becomes a pure rendezvous-hash ownership
 function (``plan``), the on-disk xorb cache gains a device-resident tier
 (``hbm``), TCP peer wire becomes one jitted all-gather over ICI
 (``collectives``), and tracker/DHT discovery becomes the jax.distributed
-KV store (``coordinator``).
+KV store (``coordinator``). The training plane's sharding modes live here
+too: ring attention for sequence/context parallelism (``ring``) and the
+GPipe SPMD schedule for pipeline parallelism (``pipeline``); tensor/
+data/expert parallelism are PartitionSpec-driven in zest_tpu.models.
 """
 
 from zest_tpu.parallel.collectives import (  # noqa: F401
@@ -40,8 +43,19 @@ from zest_tpu.parallel.mesh import (  # noqa: F401
     num_slots,
     pod_mesh,
 )
+from zest_tpu.parallel.pipeline import (  # noqa: F401
+    PIPE_AXIS,
+    microbatch,
+    pipeline_blocks,
+    unmicrobatch,
+)
 from zest_tpu.parallel.plan import (  # noqa: F401
     DistributionPlan,
     FetchAssignment,
     owner_host,
+)
+from zest_tpu.parallel.ring import (  # noqa: F401
+    SEQ_AXIS,
+    ring_attention,
+    ring_self_attention,
 )

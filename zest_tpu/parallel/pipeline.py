@@ -1,0 +1,148 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining as one SPMD
+program over a ``pipe`` mesh axis.
+
+The reference has no model execution at all (SURVEY.md §2.4: "none of
+DP/TP/PP/..."); the TPU build's training plane carries the full sharding
+set, and this module supplies PP. Design is the idiomatic-XLA formulation
+rather than a multi-program schedule: every device runs the *same* traced
+program (shard_map over the ``pipe`` axis), stage identity comes from
+``axis_index``, activations move stage-to-stage with ``ppermute``, and the
+schedule is a single ``lax.scan`` over ``M + S - 1`` ticks (M microbatches
+through S stages — the GPipe bubble). Data selection is masked (`where` on
+stage id), never branched, so shapes stay static and XLA overlaps each
+tick's ppermute with the next tick's layer compute.
+
+Composition contract: the model's per-layer params are *stacked* on a
+leading layer axis (the convention every model in zest_tpu.models already
+follows for ``lax.scan``), so sharding that axis over ``pipe`` — spec
+``P('pipe', ...)`` — gives each stage a contiguous block of layers with no
+reshuffling. Reverse-mode differentiates through ppermute/scan into the
+standard backward pipeline schedule automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from zest_tpu.parallel.spmd import pvary_over
+
+PIPE_AXIS = "pipe"
+
+
+def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """(B, ...) → (M, B/M, ...). Batch must divide evenly."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible into {n_microbatches} microbatches"
+        )
+    return x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """(M, mb, ...) → (M*mb, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def pipeline_spmd(
+    block_fn: Callable,
+    local_params,
+    xs: jax.Array,
+    axis_name: str = PIPE_AXIS,
+):
+    """The per-device pipeline program (call inside ``shard_map``).
+
+    - ``block_fn(carry, layer_params) -> (carry, None)``: one layer, the
+      exact signature ``lax.scan`` bodies already use in zest_tpu.models.
+    - ``local_params``: this stage's stacked layer slice (L/S leading dim).
+    - ``xs``: (M, mb, ...) — the full microbatched input, replicated; only
+      stage 0 reads it.
+
+    Returns (M, mb, ...) — valid on the LAST stage (other stages hold
+    zeros; the wrapper selects the last stage's copy).
+
+    Tick ``t``: stage ``s`` works on microbatch ``t - s``. A stage whose
+    microbatch index is out of [0, M) computes on masked (zero) data —
+    the pipeline bubble costs compute but keeps one uniform program.
+    """
+    S = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    M = xs.shape[0]
+    mb_shape = xs.shape[1:]
+
+    def run_stage(act):
+        out, _ = jax.lax.scan(block_fn, act, local_params)
+        return out
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # Stage 0 injects microbatch t (clamped; masked when t >= M),
+        # other stages consume what the previous stage sent last tick.
+        inj = xs[jnp.clip(t, 0, M - 1)]
+        act = jnp.where(s == 0, inj, recv)
+        act = run_stage(act)
+        # Last stage banks microbatch t - (S-1) once it's real.
+        out_idx = t - (S - 1)
+        bank = (s == S - 1) & (out_idx >= 0)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(bank, act, outputs[jnp.clip(out_idx, 0, M - 1)]),
+            jnp.clip(out_idx, 0, M - 1), 0,
+        )
+        # Shift stage s → s+1. Non-circular: stage 0 receives zeros
+        # (immediately overwritten by its injection next tick).
+        sent = jax.lax.ppermute(
+            act, axis_name, [(i, i + 1) for i in range(S - 1)]
+        )
+        return (sent, outputs), None
+
+    zeros = jnp.zeros(mb_shape, xs.dtype)
+    outputs0 = jnp.zeros((M, *mb_shape), xs.dtype)
+    zeros, outputs0 = pvary_over(
+        (zeros, outputs0), (axis_name,),
+        xs, *jax.tree.leaves(local_params),
+    )
+    (_, outputs), _ = jax.lax.scan(
+        tick, (zeros, outputs0), jnp.arange(M + S - 1)
+    )
+    # Only the last stage's bank is real; zero the rest so the caller can
+    # sum-select across the pipe axis without a gather.
+    return jnp.where(s == S - 1, outputs, 0)
+
+
+def pipeline_blocks(
+    block_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+    param_specs=None,
+) -> jax.Array:
+    """Run stacked layers over ``x`` (B, ...) through the pipeline.
+
+    ``stacked_params``: pytree with leading layer dim L on every leaf
+    (L divisible by the pipe-axis size); ``param_specs`` optionally maps
+    each leaf to its spec — defaults to ``P(axis_name)`` (layer-sharded,
+    everything else replicated). Returns (B, ...) with the same meaning as
+    ``lax.scan(block_fn, x, stacked_params)`` run unsharded.
+    """
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    xs = microbatch(x, n_microbatches)
+
+    # out_specs P() needs a device-invariant value: non-last stages hold
+    # zeros, so a psum over the pipe axis reconstructs the last stage's
+    # bank everywhere (one small all-reduce of the final activations).
+    def mapped(params, xs):
+        out = pipeline_spmd(block_fn, params, xs, axis_name)
+        return jax.lax.psum(out, axis_name)
+
+    fn = jax.shard_map(
+        mapped, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+    )
+    return unmicrobatch(fn(stacked_params, xs))

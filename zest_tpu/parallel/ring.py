@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from zest_tpu.parallel.spmd import pvary_over
+
 SEQ_AXIS = "seq"
 
 _NEG_INF = float("-inf")
@@ -108,22 +110,10 @@ def ring_self_attention(
         kb, vb = jax.lax.ppermute((kb, vb), axis_name, perm)
         return (*acc, kb, vb), None
 
-    # The carry becomes device-varying inside the loop (it mixes with
-    # axis_index and the inputs); mark the constant initializers varying
-    # over every manual axis the operands vary over — not just the ring
-    # axis, since under a multi-axis shard_map (e.g. {data, seq}) q/k/v
-    # vary over all of them — so the scan's carry type is stable
-    # (shard_map VMA typing).
-    vary = set((axis_name,))
-    for arr in (q, k, v):
-        vary |= set(getattr(jax.typeof(arr), "vma", ()) or ())
     acc0 = (jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
             jnp.zeros((B, H, Tq), jnp.float32),
             jnp.zeros((B, H, Tq, D), jnp.float32))
-    if hasattr(jax.lax, "pcast"):
-        m0, l0, o0 = jax.lax.pcast(acc0, tuple(sorted(vary)), to="varying")
-    else:  # pre-0.9 spelling
-        m0, l0, o0 = jax.lax.pvary(acc0, tuple(sorted(vary)))
+    m0, l0, o0 = pvary_over(acc0, (axis_name,), q, k, v)
     # Scan the first ring-1 accumulate-then-rotate steps, then fold the
     # final block in WITHOUT rotating — the last ppermute's output would
     # be discarded, and the scan carry would stop XLA from DCE'ing that

@@ -1,0 +1,25 @@
+"""Small shared helpers for shard_map SPMD programs."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pvary_over(tree, axis_names, *operands):
+    """Mark ``tree``'s leaves device-varying for shard_map VMA typing.
+
+    A ``lax.scan`` carry initialized from constants starts *unvarying*,
+    but the loop body mixes it with ``axis_index`` and the mapped
+    operands, so its output is varying — a carry-type mismatch. This
+    marks the initializers varying over ``axis_names`` **plus every
+    manual axis the given operands vary over**, so the same program
+    works inside single- and multi-axis shard_maps (e.g. a ring under
+    ``{data, seq}``, a pipeline under ``{data, pipe}``).
+    """
+    vary = set(axis_names)
+    for arr in operands:
+        vary |= set(getattr(jax.typeof(arr), "vma", ()) or ())
+    axes = tuple(sorted(vary))
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, axes, to="varying")
+    return jax.lax.pvary(tree, axes)  # pre-0.9 spelling
