@@ -1,0 +1,154 @@
+"""Model-family registry: config.json model_type → landing shard rules,
+and the pull path applying them so landed tensors arrive TP-placed.
+
+Reference analog: none — the reference hands files to torch and never
+needs to know the family (SURVEY.md §3.1); the TPU build shards at
+landing time, so family dispatch is part of the pull."""
+
+import json
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tests.fixtures import FixtureHub, FixtureRepo, gpt2_checkpoint_files
+from zest_tpu.config import Config, MeshConfig
+from zest_tpu.models.registry import (
+    detect_model_type,
+    shard_rules_for_model_type,
+    shard_rules_for_snapshot,
+)
+
+
+def test_detect_model_type(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"model_type": "llama", "hidden_size": 4096}
+    ))
+    assert detect_model_type(tmp_path) == "llama"
+
+
+def test_detect_missing_or_bad_config(tmp_path):
+    assert detect_model_type(tmp_path) is None
+    (tmp_path / "config.json").write_text("{not json")
+    assert detect_model_type(tmp_path) is None
+    # Valid JSON that isn't an object must degrade to None, not raise.
+    (tmp_path / "config.json").write_text("[1, 2, 3]")
+    assert detect_model_type(tmp_path) is None
+
+
+def test_rule_specs_degrade_on_mismatched_mesh():
+    """Family rules on a mesh missing their axes (or with indivisible
+    dims) must fall back to infer_spec, not break HBM landing."""
+    import jax
+    from zest_tpu.models.loader import spec_for
+    from zest_tpu.parallel.mesh import model_mesh
+
+    mesh = model_mesh({"data": 2, "model": 4})
+    moe_rules = shard_rules_for_model_type("mixtral")
+    # 'expert' axis doesn't exist here → generic largest-divisible-dim.
+    spec = spec_for("model.layers.0.self_attn.q_proj.weight", (64, 64),
+                    mesh, moe_rules)
+    assert spec == P("model", None)
+    # Rule dim indivisible (65 % 4): the rule P(None, 'model') is unusable;
+    # infer_spec shards the divisible dim 0 instead.
+    gpt2_rules = shard_rules_for_model_type("gpt2")
+    spec = spec_for("h.0.attn.c_attn.weight", (64, 65), mesh, gpt2_rules)
+    assert spec == P("model", None)
+    # Fitting rule still wins.
+    spec = spec_for("h.0.attn.c_attn.weight", (64, 192), mesh, gpt2_rules)
+    assert spec == P(None, "model")
+
+
+@pytest.mark.parametrize("family,sample", [
+    ("gpt2", "h.0.attn.c_attn.weight"),
+    ("llama", "model.layers.0.self_attn.q_proj.weight"),
+    ("mistral", "model.layers.0.self_attn.q_proj.weight"),
+    ("qwen2", "model.layers.0.self_attn.q_proj.weight"),
+    ("mixtral", "model.layers.0.block_sparse_moe.experts.0.w1.weight"),
+])
+def test_families_have_rules(family, sample):
+    import re
+
+    rules = shard_rules_for_model_type(family)
+    assert rules, family
+    assert any(re.search(pat, sample) for pat, _ in rules), family
+
+
+def test_unknown_family_returns_none():
+    assert shard_rules_for_model_type("rwkv") is None
+    assert shard_rules_for_model_type(None) is None
+
+
+def test_shard_rules_for_snapshot(tmp_path):
+    (tmp_path / "config.json").write_text('{"model_type": "gpt2"}')
+    assert shard_rules_for_snapshot(tmp_path)
+    (tmp_path / "config.json").write_text('{"model_type": "unknown"}')
+    assert shard_rules_for_snapshot(tmp_path) is None
+
+
+def test_mixtral_rules_cover_expert_tensors():
+    import re
+
+    rules = shard_rules_for_model_type("mixtral")
+    hits = {
+        "model.layers.0.self_attn.q_proj.weight": P("expert", None),
+        "model.layers.0.block_sparse_moe.experts.3.w1.weight":
+            P("expert", None),
+        "model.layers.0.block_sparse_moe.experts.3.w2.weight":
+            P(None, "expert"),
+        "model.layers.0.block_sparse_moe.gate.weight": P(),
+    }
+    for name, want in hits.items():
+        got = next(
+            (spec for pat, spec in rules if re.search(pat, name)), None
+        )
+        assert got == want, name
+
+
+# ── End-to-end: pull --device=tpu applies family rules ──
+
+
+def test_pull_lands_with_family_rules(tmp_path):
+    """A gpt2-typed repo pulled onto a {data, model} mesh must land its
+    attention weights sharded per gpt2.checkpoint_shard_rules — both on
+    the direct path (cold) and the disk path (resume)."""
+    from zest_tpu.transfer.pull import pull_model
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=2)
+    repo = FixtureRepo("acme/tiny-gpt2", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(
+            hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+            hf_token="hf_test", endpoint=hub.url,
+            mesh=MeshConfig(mesh_axes={"data": 2, "model": 4}),
+        )
+        res = pull_model(cfg, "acme/tiny-gpt2", no_p2p=True, device="tpu")
+        assert res.stats["hbm"]["direct"] is True
+        qkv = res.params["h.0.attn.c_attn.weight"]
+        assert qkv.sharding.spec == P(None, "model")
+        res.params = None
+
+        # Resume: disk staging must apply the same family rules.
+        res2 = pull_model(cfg, "acme/tiny-gpt2", no_p2p=True, device="tpu")
+        assert res2.stats["hbm"]["direct"] is False
+        qkv2 = res2.params["h.0.attn.c_attn.weight"]
+        assert qkv2.sharding.spec == P(None, "model")
+        np.testing.assert_array_equal(
+            np.asarray(qkv2).view(np.uint8).reshape(-1),
+            files_tensor(files, "h.0.attn.c_attn.weight"),
+        )
+
+
+def files_tensor(files: dict, name: str) -> np.ndarray:
+    """Reference bytes of one tensor from the fixture checkpoint."""
+    import io
+
+    from zest_tpu.models.safetensors_io import parse_header
+
+    blob = files["model.safetensors"]
+    header = parse_header(io.BytesIO(blob).read(len(blob)))
+    info = header.tensors[name]
+    start, end = info.data_offsets
+    return np.frombuffer(
+        blob[header.data_start + start:header.data_start + end], np.uint8
+    )

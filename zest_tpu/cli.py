@@ -379,7 +379,25 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _provision_virtual_devices() -> None:
+    """``ZEST_VIRTUAL_DEVICES=N`` → N-device virtual CPU mesh for this
+    process. Testing/CI knob for driving mesh-dependent CLI paths
+    (``pull --device=tpu`` with ``ZEST_TPU_MESH``) without N chips —
+    same mechanism as the driver's dryrun self-provision
+    (__graft_entry__._provision_virtual_mesh): env vars alone don't
+    stick once sitecustomize has imported jax, so go through jax.config
+    before the first device query."""
+    n = os.environ.get("ZEST_VIRTUAL_DEVICES")
+    if not n:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", int(n))
+
+
 def main(argv: list[str] | None = None) -> int:
+    _provision_virtual_devices()
     parser = build_parser()
     args = parser.parse_args(argv)
     if not getattr(args, "fn", None):

@@ -44,6 +44,26 @@ def infer_spec(
     return P()
 
 
+def _spec_fits(shape: tuple[int, ...], mesh: Mesh, spec: P) -> bool:
+    """A rule spec is usable iff every named axis exists in the mesh and
+    divides its tensor dim. Family rules are written against a family's
+    canonical mesh; on a different topology (e.g. Mixtral rules on a
+    {data, model} mesh with no 'expert' axis) the landing must degrade
+    to infer_spec, not fail the whole HBM commit."""
+    if len(spec) > len(shape):
+        return False
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        for ax in axes if isinstance(axes, tuple) else (axes,):
+            if ax not in mesh.shape:
+                return False
+            if dim % int(mesh.shape[ax]):
+                return False
+            dim //= int(mesh.shape[ax])
+    return True
+
+
 def spec_for(
     name: str,
     shape: tuple[int, ...],
@@ -53,7 +73,9 @@ def spec_for(
 ) -> P:
     for pattern, spec in rules or []:
         if re.search(pattern, name):
-            return spec
+            if _spec_fits(shape, mesh, spec):
+                return spec
+            break  # first match wins; unusable → generic fallback
     axis = default_axis or mesh.axis_names[-1]
     return infer_spec(shape, mesh, axis)
 

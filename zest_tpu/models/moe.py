@@ -234,6 +234,27 @@ def param_specs(cfg: MoEConfig) -> dict:
     }
 
 
+def checkpoint_shard_rules() -> list[tuple[str, P]]:
+    """Name-pattern rules for landing raw HF Mixtral safetensors via
+    zest_tpu.models.loader (HF [out, in] orientation).
+
+    Raw landing balances *bytes* across the mesh; per-expert tensors
+    shard their feature dims TP-style here. Expert *placement* (which
+    host's cache owns which expert's xorbs) is the separate routing
+    concern handled by zest_tpu.parallel.expert during the pull; the
+    stacked expert-parallel tree layout comes from ``params_from_hf`` +
+    ``param_specs`` afterwards.
+    """
+    return [
+        (r"self_attn\.[qkv]_proj\.weight$", P(EXPERT_AXIS, None)),
+        (r"self_attn\.o_proj\.weight$", P(None, EXPERT_AXIS)),
+        (r"experts\.\d+\.w[13]\.weight$", P(EXPERT_AXIS, None)),
+        (r"experts\.\d+\.w2\.weight$", P(None, EXPERT_AXIS)),
+        (r"block_sparse_moe\.gate\.weight$", P()),
+        (r"^lm_head\.weight$", P(EXPERT_AXIS, None)),
+    ]
+
+
 # ── Forward ──
 
 

@@ -1,0 +1,70 @@
+"""Model-family registry: config.json → landing shard rules.
+
+The reference is model-agnostic — it reassembles files and lets torch
+load them later (SURVEY.md §3.1). The TPU build lands tensors into mesh
+HBM during the pull, so it must know *how a family shards* at landing
+time. This module is that dispatch: read the snapshot's ``config.json``
+``model_type`` and return the family's ``checkpoint_shard_rules`` for
+zest_tpu.models.loader. Unknown families return ``None`` — the loader's
+``infer_spec`` fallback (shard the largest divisible dim) still lands
+them balanced, just without family-aware TP placement.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import TYPE_CHECKING, Callable
+
+if TYPE_CHECKING:  # the alias is annotation-only; keep jax off the
+    from zest_tpu.models.loader import ShardRules  # import path here
+
+
+def _gpt2_rules() -> ShardRules:
+    from zest_tpu.models import gpt2
+
+    return gpt2.checkpoint_shard_rules()
+
+
+def _llama_rules() -> ShardRules:
+    from zest_tpu.models import llama
+
+    return llama.checkpoint_shard_rules()
+
+
+def _moe_rules() -> ShardRules:
+    from zest_tpu.models import moe
+
+    return moe.checkpoint_shard_rules()
+
+
+# model_type (HF config.json) → rules factory. Mistral/Qwen dense share
+# the Llama tensor layout; Mixtral is the expert-sharded family.
+_FAMILIES: dict[str, Callable[[], ShardRules]] = {
+    "gpt2": _gpt2_rules,
+    "llama": _llama_rules,
+    "mistral": _llama_rules,
+    "qwen2": _llama_rules,
+    "mixtral": _moe_rules,
+}
+
+
+def shard_rules_for_model_type(model_type: str | None) -> ShardRules | None:
+    factory = _FAMILIES.get(model_type or "")
+    return factory() if factory else None
+
+
+def detect_model_type(snapshot_dir: str | Path) -> str | None:
+    """``model_type`` from the snapshot's config.json, or None."""
+    cfg_path = Path(snapshot_dir) / "config.json"
+    try:
+        cfg = json.loads(cfg_path.read_text())
+    except (OSError, ValueError):
+        return None
+    # Valid-but-non-object JSON (a list, a bare string) is still "no
+    # detectable family", not an exception.
+    return cfg.get("model_type") if isinstance(cfg, dict) else None
+
+
+def shard_rules_for_snapshot(snapshot_dir: str | Path) -> ShardRules | None:
+    return shard_rules_for_model_type(detect_model_type(snapshot_dir))

@@ -189,9 +189,12 @@ def pull_model(
         # must not lose the completed download — report it and return.
         from zest_tpu.models.loader import stage_snapshot_to_hbm
 
+        from zest_tpu.models.registry import shard_rules_for_snapshot
+
         try:
             hbm_params, hbm_stats = stage_snapshot_to_hbm(
-                snapshot_dir, mesh=mesh
+                snapshot_dir, mesh=mesh,
+                rules=shard_rules_for_snapshot(snapshot_dir),
             )
         except Exception as exc:  # noqa: BLE001
             log(f"HBM staging failed ({exc}); files remain in "
@@ -236,7 +239,9 @@ def _try_direct_stage(
             log(f"warm fetch: {warm['failed']}/{warm['units']} units "
                 "failed; landing falls back per-term", file=sys.stderr)
         params, hbm_stats = stage_cached_to_hbm(
-            bridge, recs_with_headers, mesh=mesh
+            bridge, recs_with_headers, mesh=mesh,
+            rules=_landing_rules(hub, repo_id, revision, files,
+                                 snapshot_dir),
         )
         hbm_stats["warm"] = warm
         return params, hbm_stats
@@ -244,6 +249,29 @@ def _try_direct_stage(
         log(f"direct HBM landing unavailable ({exc}); "
             "will stage from disk after download", file=sys.stderr)
         return None, None
+
+
+def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
+    """Family shard rules for direct landing (models.registry dispatch).
+
+    Direct landing runs before any file is written, so config.json may
+    not be on disk yet — download it early (the file loop will skip it
+    via ``_is_complete``). Returns None on any miss: the loader's
+    infer_spec fallback still lands the bytes balanced.
+    """
+    from zest_tpu.models.registry import shard_rules_for_snapshot
+
+    dest = snapshot_dir / "config.json"
+    if not dest.exists():
+        entry = next((e for e in files if e.path == "config.json"), None)
+        if entry is None:
+            return None
+        try:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            hub.download_regular_file(repo_id, revision, entry.path, dest)
+        except Exception:  # noqa: BLE001 - rules are an optimization
+            return None
+    return shard_rules_for_snapshot(snapshot_dir)
 
 
 def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
