@@ -141,12 +141,10 @@ def test_pull_lands_with_family_rules(tmp_path):
 
 def files_tensor(files: dict, name: str) -> np.ndarray:
     """Reference bytes of one tensor from the fixture checkpoint."""
-    import io
-
     from zest_tpu.models.safetensors_io import parse_header
 
     blob = files["model.safetensors"]
-    header = parse_header(io.BytesIO(blob).read(len(blob)))
+    header = parse_header(blob)
     info = header.tensors[name]
     start, end = info.data_offsets
     return np.frombuffer(

@@ -390,10 +390,19 @@ def _provision_virtual_devices() -> None:
     n = os.environ.get("ZEST_VIRTUAL_DEVICES")
     if not n:
         return
+    try:
+        count = int(n)
+    except ValueError:
+        print(f"ignoring malformed ZEST_VIRTUAL_DEVICES={n!r}",
+              file=sys.stderr)
+        return
     import jax
+    from jax._src import xla_bridge
 
+    if xla_bridge.backends_are_initialized():
+        return  # too late to re-provision (config update would raise)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", int(n))
+    jax.config.update("jax_num_cpu_devices", count)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -476,6 +476,104 @@ def cp_train_step(params, batch, cfg: LlamaConfig, mesh: Mesh,
     return params, loss
 
 
+# ── Decoding ──
+
+
+def _attention_cached(x, p, cfg: LlamaConfig, cache_k, cache_v, pos):
+    """Single-token attention against a (B, n_ctx, KV, D) cache.
+
+    ``x``: (B, 1, E) the current token's activations; ``pos``: scalar
+    position. Returns (out, new_k, new_v). The cache has static shape —
+    entries past ``pos`` are masked out of the softmax.
+    """
+    B = x.shape[0]
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q, k, v = _qkv(x, p, cfg, pos0=pos)
+    cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
+    kk, vv = cache_k, cache_v
+    if KV != H:
+        kk = jnp.repeat(kk, H // KV, axis=2)
+        vv = jnp.repeat(vv, H // KV, axis=2)
+    # (B, H, 1, T) scores over the whole static cache, future masked.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
+    valid = jnp.arange(cache_k.shape[1]) <= pos
+    scores = jnp.where(valid[None, None, None, :], scores,
+                       jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
+    return out.reshape(B, 1, H * D) @ p["o_w"], cache_k, cache_v
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  dtype=jnp.float32) -> dict:
+    """Static-shape per-layer K/V cache: (L, B, max_len, KV, D)."""
+    shape = (cfg.n_layer, batch, max_len, cfg.n_kv_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
+    """One incremental decode step: (B,) token ids at position ``pos`` →
+    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
+    instead of generate_greedy's O(T²) full recompute — the serving path.
+    Jittable; ``pos`` is a traced scalar, shapes stay static.
+    """
+    x = params["wte"][token][:, None, :]                   # (B, 1, E)
+
+    def body(carry, inp):
+        x, pos = carry
+        lp, ck, cv = inp
+        h = _rms_norm(x, lp["ln_attn"]["g"], cfg.rms_eps)
+        out, ck, cv = _attention_cached(h, lp["attn"], cfg, ck, cv, pos)
+        x = x + out
+        h = _rms_norm(x, lp["ln_mlp"]["g"], cfg.rms_eps)
+        return (x + _mlp(h, lp["mlp"]), pos), (ck, cv)
+
+    (x, _), (new_k, new_v) = jax.lax.scan(
+        body, (x, pos), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
+    head = params.get("lm_head")
+    logits = x[:, 0, :] @ (head if head is not None else params["wte"].T)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int):
+    """Greedy decode with a KV cache: prefill token-by-token, then sample
+    ``steps`` new tokens, all inside one jitted ``lax.scan``. Returns
+    (len(prompt)+steps,) ids; token-identical to ``generate_greedy``.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    n0 = prompt_ids.shape[0]
+    total = n0 + steps
+    if total > cfg.n_ctx:
+        raise ValueError(
+            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"n_ctx {cfg.n_ctx}"
+        )
+    cache = init_kv_cache(cfg, 1, total,
+                          dtype=params["wte"].dtype)
+    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+
+    def step(carry, pos):
+        buf, cache = carry
+        logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
+        nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+        # Prompt positions keep their token; past the prompt we append.
+        buf = jnp.where(
+            pos + 1 < n0, buf,
+            jax.lax.dynamic_update_index_in_dim(
+                buf, nxt, jnp.minimum(pos + 1, total - 1), 0
+            ),
+        )
+        return (buf, cache), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, cache), jnp.arange(total - 1)
+    )
+    return buf
+
+
 def generate_greedy(params, cfg: LlamaConfig, prompt_ids, steps: int):
     """Greedy decode via ``lax.scan`` over a fixed buffer (static shapes)."""
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
