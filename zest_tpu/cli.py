@@ -397,10 +397,19 @@ def _provision_virtual_devices() -> None:
               file=sys.stderr)
         return
     import jax
-    from jax._src import xla_bridge
 
-    if xla_bridge.backends_are_initialized():
-        return  # too late to re-provision (config update would raise)
+    try:  # no public API for this query; degrade to "not initialized"
+        from jax._src import xla_bridge
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 - private import may break on upgrade
+        initialized = False
+    if initialized:
+        # Re-provisioning now would raise inside jax.config; run on
+        # whatever is attached, but say so — a silent 1-device run makes
+        # downstream mesh failures undiagnosable.
+        print(f"ZEST_VIRTUAL_DEVICES={count} ignored: jax backend "
+              "already initialized", file=sys.stderr)
+        return
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", count)
 

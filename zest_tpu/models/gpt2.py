@@ -244,7 +244,8 @@ def _block(x, p, cfg: GPT2Config):
     return x + h @ p["mlp"]["proj_w"] + p["mlp"]["proj_b"]
 
 
-def forward(params: dict, input_ids: jax.Array, cfg: GPT2Config) -> jax.Array:
+def forward(params: dict, input_ids: jax.Array, cfg: GPT2Config,
+            remat: bool = False) -> jax.Array:
     """(B, T) int32 token ids → (B, T, vocab) logits. Jittable."""
     B, T = input_ids.shape
     x = params["wte"][input_ids] + params["wpe"][:T]
@@ -252,29 +253,35 @@ def forward(params: dict, input_ids: jax.Array, cfg: GPT2Config) -> jax.Array:
     def body(x, layer_params):
         return _block(x, layer_params, cfg), None
 
+    if remat:
+        # Per-layer rematerialization (jax.checkpoint): backward-pass
+        # recompute instead of saved activations — O(1) layers resident.
+        body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"],
                     cfg.layer_norm_eps)
     return x @ params["wte"].T
 
 
-def loss_fn(params, batch, cfg: GPT2Config):
+def loss_fn(params, batch, cfg: GPT2Config, remat: bool = False):
     """Next-token cross entropy over ``batch`` (B, T+1) ids."""
     inputs, targets = batch[:, :-1], batch[:, 1:]
-    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logits = forward(params, inputs, cfg, remat=remat).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
 
 
-def train_step(params, batch, cfg: GPT2Config, lr: float = 1e-3):
+def train_step(params, batch, cfg: GPT2Config, lr: float = 1e-3,
+               remat: bool = False):
     """One SGD step — the full step jitted over the mesh in dryruns.
 
     Inputs arrive sharded (params per ``param_specs``, batch over the data
     axis); GSPMD propagates the shardings and inserts the TP reduces and
-    the DP gradient psum.
+    the DP gradient psum. ``remat=True`` trades backward-pass FLOPs for
+    activation memory (per-layer jax.checkpoint).
     """
-    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, remat)
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                           params, grads)
     return params, loss

@@ -328,11 +328,14 @@ def _rope(x, cfg: LlamaConfig, pos0=0):
 
 
 def _qkv(x, p, cfg: LlamaConfig, pos0=0):
+    """Projections + RoPE. Head counts come from the weights (-1), not
+    the config, so tensor-parallel shards (H/tp local heads inside a
+    shard_map) reuse the same code path."""
     B, T, _ = x.shape
-    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    q = (x @ p["q_w"]).reshape(B, T, H, D)
-    k = (x @ p["k_w"]).reshape(B, T, KV, D)
-    v = (x @ p["v_w"]).reshape(B, T, KV, D)
+    D = cfg.head_dim
+    q = (x @ p["q_w"]).reshape(B, T, -1, D)
+    k = (x @ p["k_w"]).reshape(B, T, -1, D)
+    v = (x @ p["v_w"]).reshape(B, T, -1, D)
     return (_rope(q, cfg, pos0), _rope(k, cfg, pos0), v)
 
 
@@ -353,26 +356,40 @@ def _attention(x, p, cfg: LlamaConfig):
     return out @ p["o_w"]
 
 
-def _ring_attention(x, p, cfg: LlamaConfig, seq_axis: str):
-    """Ring GQA for the context-parallel path (inside shard_map)."""
+def _ring_attention(x, p, cfg: LlamaConfig, seq_axis: str,
+                    tp_axis: str | None = None):
+    """Ring GQA for the context-parallel path (inside shard_map).
+
+    With ``tp_axis`` the attention weights are Megatron-sharded over that
+    mesh axis (local heads); manual mode means the output projection's
+    partial sums need an explicit psum (GSPMD inserts it automatically
+    only outside shard_map)."""
     B, T, _ = x.shape
     pos0 = jax.lax.axis_index(seq_axis) * T
     q, k, v = _qkv(x, p, cfg, pos0=pos0)
     out = ring_self_attention(q, k, v, seq_axis, causal=True)
-    return out.reshape(B, T, cfg.n_head * cfg.head_dim) @ p["o_w"]
+    out = out.reshape(B, T, -1) @ p["o_w"]
+    return out if tp_axis is None else jax.lax.psum(out, tp_axis)
 
 
-def _mlp(x, p):
-    return (jax.nn.silu(x @ p["gate_w"]) * (x @ p["up_w"])) @ p["down_w"]
+def _mlp(x, p, tp_axis: str | None = None):
+    h = (jax.nn.silu(x @ p["gate_w"]) * (x @ p["up_w"])) @ p["down_w"]
+    return h if tp_axis is None else jax.lax.psum(h, tp_axis)
 
 
-def _body(params, x, cfg: LlamaConfig, attn_fn):
+def _body(params, x, cfg: LlamaConfig, attn_fn, tp_axis: str | None = None,
+          remat: bool = False):
     def body(x, lp):
         h = _rms_norm(x, lp["ln_attn"]["g"], cfg.rms_eps)
         x = x + attn_fn(h, lp["attn"])
         h = _rms_norm(x, lp["ln_mlp"]["g"], cfg.rms_eps)
-        return x + _mlp(h, lp["mlp"]), None
+        return x + _mlp(h, lp["mlp"], tp_axis), None
 
+    if remat:
+        # Per-layer rematerialization: activations inside a block are
+        # recomputed in the backward pass instead of saved — O(1) layers
+        # of residuals live at once, the standard HBM-for-FLOPs trade.
+        body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
     head = params.get("lm_head")
@@ -380,26 +397,31 @@ def _body(params, x, cfg: LlamaConfig, attn_fn):
 
 
 def forward(
-    params: dict, input_ids: jax.Array, cfg: LlamaConfig
+    params: dict, input_ids: jax.Array, cfg: LlamaConfig,
+    remat: bool = False,
 ) -> jax.Array:
     """(B, T) int32 ids → (B, T, vocab) logits. Jittable."""
     x = params["wte"][input_ids]
-    return _body(params, x, cfg, lambda h, p: _attention(h, p, cfg))
+    return _body(params, x, cfg, lambda h, p: _attention(h, p, cfg),
+                 remat=remat)
 
 
-def loss_fn(params, batch, cfg: LlamaConfig):
+def loss_fn(params, batch, cfg: LlamaConfig, remat: bool = False):
     """Next-token cross entropy over ``batch`` (B, T+1) ids."""
     inputs, targets = batch[:, :-1], batch[:, 1:]
-    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logits = forward(params, inputs, cfg, remat=remat).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
 
 
-def train_step(params, batch, cfg: LlamaConfig, lr: float = 1e-3):
+def train_step(params, batch, cfg: LlamaConfig, lr: float = 1e-3,
+               remat: bool = False):
     """One SGD step; under a {data, model} mesh GSPMD inserts the TP
-    reduces and DP gradient psum (same contract as gpt2.train_step)."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    reduces and DP gradient psum (same contract as gpt2.train_step).
+    ``remat=True`` recomputes per-layer activations in the backward pass
+    (jax.checkpoint) — memory O(1) layers instead of O(L)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, remat)
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                           params, grads)
     return params, loss
@@ -421,21 +443,30 @@ def cp_forward(
     The whole transformer body runs under ``shard_map``: token/RoPE work is
     local to each shard (phases offset by the shard's global start),
     attention is the ppermute ring, everything else is elementwise or
-    feature-dim matmuls that need no cross-shard communication. Params are
-    replicated across the mesh inside the mapped body (TP×CP composition
-    would pass a spec tree instead). The seq-axis size must divide T
-    (shard_map needs even T/axis_size shards).
+    feature-dim matmuls that need no cross-shard communication. The
+    seq-axis size must divide T (shard_map needs even T/axis_size shards).
+
+    **TP×CP composition is automatic**: if ``mesh`` also has a
+    ``MODEL_AXIS`` axis, params shard per :func:`param_specs` (Megatron
+    layout, local heads in the ring) with explicit psums after the o/down
+    projections — one 3-axis mesh runs dp+sp+tp in a single jitted step.
     """
     spec = P(data_axis, seq_axis)
+    tp = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    pspecs = param_specs(cfg) if tp else jax.tree.map(lambda _: P(), params)
+    head_sharded = tp and not cfg.tie_embeddings
+    out_spec = P(data_axis, seq_axis, MODEL_AXIS if head_sharded else None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(), spec), out_specs=P(data_axis, seq_axis, None),
+        in_specs=(pspecs, spec), out_specs=out_spec,
     )
     def fwd(params, ids):
         x = params["wte"][ids]
         return _body(
-            params, x, cfg, lambda h, p: _ring_attention(h, p, cfg, seq_axis)
+            params, x, cfg,
+            lambda h, p: _ring_attention(h, p, cfg, seq_axis, tp),
+            tp_axis=tp,
         )
 
     return fwd(params, input_ids)

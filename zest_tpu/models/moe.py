@@ -351,6 +351,7 @@ def _moe_block(x, p, cfg: MoEConfig):
 
 def forward(
     params: dict, input_ids: jax.Array, cfg: MoEConfig,
+    remat: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """(B, T) ids → ((B, T, vocab) logits, scalar aux loss). Jittable."""
     x = params["wte"][input_ids]
@@ -363,6 +364,11 @@ def forward(
         moe_out, layer_aux = _moe_block(h, layer_params["moe"], cfg)
         return (x + moe_out, aux + layer_aux), None
 
+    if remat:
+        # Per-layer rematerialization — especially valuable here, where
+        # the dispatch tensors ([tokens, experts, capacity]) dominate
+        # activation memory.
+        body = jax.checkpoint(body)
     (x, aux), _ = jax.lax.scan(
         body, (x, jnp.float32(0.0)), params["blocks"]
     )
@@ -370,18 +376,20 @@ def forward(
     return x @ params["lm_head"], aux / cfg.n_layer
 
 
-def loss_fn(params, batch, cfg: MoEConfig):
+def loss_fn(params, batch, cfg: MoEConfig, remat: bool = False):
     inputs, targets = batch[:, :-1], batch[:, 1:]
-    logits, aux = forward(params, inputs, cfg)
+    logits, aux = forward(params, inputs, cfg, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll) + cfg.aux_loss_weight * aux
 
 
-def train_step(params, batch, cfg: MoEConfig, lr: float = 1e-3):
+def train_step(params, batch, cfg: MoEConfig, lr: float = 1e-3,
+               remat: bool = False):
     """One SGD step; under a {data, expert} mesh GSPMD inserts the expert
-    all-to-alls around the dispatch einsums and the DP gradient psum."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    all-to-alls around the dispatch einsums and the DP gradient psum.
+    ``remat=True`` applies per-layer jax.checkpoint."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, remat)
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                           params, grads)
     return params, loss
