@@ -117,12 +117,49 @@ def test_from_hf_rejects_unsupported_rope_scaling():
         llama.LlamaConfig.from_hf(cfg_json)
 
 
-def test_from_hf_rejects_bias_configs():
+def test_from_hf_rejects_mlp_bias_configs():
     cfg_json = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
-                    attention_bias=True)
-    with pytest.raises(ValueError, match="bias"):
+                    mlp_bias=True)
+    with pytest.raises(ValueError, match="mlp_bias"):
         llama.LlamaConfig.from_hf(cfg_json)
+
+
+def test_forward_matches_transformers_qwen2():
+    """Qwen2 hardcodes q/k/v biases (no attention_bias config key); the
+    tree must carry and apply them — parity against the HF torch Qwen2."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(6)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    # transformers zero-inits biases; randomize so parity exercises them.
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(std=0.5)
+    cfg = llama.LlamaConfig.from_hf(
+        dict(hf_cfg.to_dict(), model_type="qwen2")
+    )
+    assert cfg.attn_bias
+    params = llama.params_from_hf(to_numpy_state(model), cfg)
+    assert params["blocks"]["attn"]["q_b"].shape == (2, 64)
+    # Bias tensors must actually be nonzero for this test to mean much.
+    assert float(np.abs(np.asarray(
+        params["blocks"]["attn"]["q_b"])).max()) > 0
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 13))
+    got = np.asarray(llama.forward(params, jnp.asarray(ids, jnp.int32), cfg))
+    with torch.no_grad():
+        want = model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
 
 def test_from_hf_fallbacks_are_hf_defaults():

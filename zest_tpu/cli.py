@@ -171,6 +171,56 @@ def _print_pull_stats(stats: dict) -> None:
                   + (" [direct]" if h.get("direct") else ""))
 
 
+def cmd_generate(args) -> int:
+    """Pull (idempotent) then greedy-decode with the family model — the
+    reference's verify loop ("pull, load, generate",
+    test/local/verify-model.sh:103-147) as a first-class command, running
+    on the pure-JAX models instead of torch."""
+    cfg = Config.load()
+    from zest_tpu.models.generate import (
+        UnsupportedModelError, load_generator, try_tokenizer,
+    )
+    from zest_tpu.transfer.pull import pull_model
+
+    # Flag validation is pull-independent — do it before a possibly
+    # multi-GB download (only the tokenizer lookup needs the snapshot).
+    prompt = None
+    if args.ids:
+        try:
+            prompt = [int(t) for t in args.ids.split(",")]
+        except ValueError:
+            print(f"error: --ids {args.ids!r} is not a comma-separated "
+                  "list of ints", file=sys.stderr)
+            return 2
+    elif args.prompt is None:
+        print("error: one of --prompt or --ids is required",
+              file=sys.stderr)
+        return 2
+
+    res = pull_model(cfg, args.repo, revision=args.revision,
+                     no_p2p=args.no_p2p)
+    tok = try_tokenizer(res.snapshot_dir)
+    if prompt is None:
+        if tok is None:
+            print("error: snapshot has no tokenizer; pass token ids via "
+                  "--ids", file=sys.stderr)
+            return 2
+        prompt = tok.encode(args.prompt)
+    try:
+        model_type, generate = load_generator(res.snapshot_dir)
+    except (UnsupportedModelError, FileNotFoundError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    out = generate(prompt, args.steps)
+    new = out[len(prompt):]
+    print(f"[{model_type}] {len(prompt)} prompt + {len(new)} new tokens")
+    if tok is not None:
+        print(tok.decode(list(out)))
+    else:
+        print(",".join(str(int(t)) for t in out))
+    return 0
+
+
 def cmd_seed(args) -> int:
     """Announce every cached xorb to the swarm (reference main.zig:307-369)."""
     cfg = Config.load()
@@ -348,6 +398,20 @@ def build_parser() -> argparse.ArgumentParser:
                            "owned by unreachable pods degrade to CDN")
     pull.add_argument("--http-port", type=int, default=None)
     pull.set_defaults(fn=cmd_pull)
+
+    gen = sub.add_parser(
+        "generate", help="pull a model and greedy-decode with it"
+    )
+    gen.add_argument("repo")
+    gen.add_argument("--revision", default="main")
+    gen.add_argument("--prompt", default=None,
+                     help="text prompt (needs a tokenizer in the snapshot)")
+    gen.add_argument("--ids", default=None,
+                     help="comma-separated prompt token ids")
+    gen.add_argument("--steps", type=int, default=20,
+                     help="new tokens to decode (default 20)")
+    gen.add_argument("--no-p2p", action="store_true")
+    gen.set_defaults(fn=cmd_generate)
 
     seed = sub.add_parser("seed", help="announce cached xorbs to the swarm")
     seed.add_argument("--tracker", default=None)

@@ -1,0 +1,94 @@
+"""Snapshot → running model: build a family model from a pulled snapshot
+and decode.
+
+This closes the reference's verify loop natively (`zest pull` then "load
+with transformers and generate", test/local/verify-model.sh:103-147):
+here the pulled safetensors feed the pure-JAX family modules directly —
+no torch on the path — selected by the same config.json dispatch the
+landing registry uses (zest_tpu.models.registry).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+class UnsupportedModelError(ValueError):
+    """config.json names a family with no generation support."""
+
+
+GENERATE_FAMILIES = ("gpt2", "llama", "mistral", "qwen2")
+
+
+def _snapshot_tensors(snapshot_dir: Path) -> dict[str, np.ndarray]:
+    from zest_tpu.models.loader import snapshot_files
+    from zest_tpu.models.safetensors_io import SafetensorsFile
+
+    tensors: dict[str, np.ndarray] = {}
+    for path in snapshot_files(snapshot_dir):
+        with SafetensorsFile(path) as sf:
+            for name in sf.names():
+                tensors[name] = sf.tensor(name)
+    if not tensors:
+        raise FileNotFoundError(
+            f"no .safetensors files under {snapshot_dir}"
+        )
+    return tensors
+
+
+def load_generator(snapshot_dir: str | Path):
+    """Build ``(model_type, generate_fn)`` from a pulled snapshot.
+
+    ``generate_fn(prompt_ids, steps) -> np.ndarray`` greedy-decodes with
+    the family's best path (KV-cached for Llama-family). Raises
+    :class:`UnsupportedModelError` for families without generation
+    support and ``FileNotFoundError`` for missing config/weights.
+    """
+    snapshot_dir = Path(snapshot_dir)
+    cfg_json = json.loads((snapshot_dir / "config.json").read_text())
+    model_type = cfg_json.get("model_type")
+    if model_type not in GENERATE_FAMILIES:
+        raise UnsupportedModelError(
+            f"model_type {model_type!r} has no generation support "
+            f"(supported: {', '.join(GENERATE_FAMILIES)})"
+        )
+    tensors = _snapshot_tensors(snapshot_dir)
+
+    if model_type == "gpt2":
+        from zest_tpu.models import gpt2 as fam
+
+        cfg = fam.GPT2Config.from_hf(cfg_json)
+        params = fam.params_from_hf(tensors, cfg)
+
+        def generate(prompt_ids, steps):
+            return np.asarray(
+                fam.generate_greedy(params, cfg, prompt_ids, steps)
+            )
+    else:  # llama family
+        from zest_tpu.models import llama as fam
+
+        cfg = fam.LlamaConfig.from_hf(cfg_json)
+        params = fam.params_from_hf(tensors, cfg)
+
+        def generate(prompt_ids, steps):
+            return np.asarray(
+                fam.generate_cached(params, cfg, prompt_ids, steps)
+            )
+    return model_type, generate
+
+
+def try_tokenizer(snapshot_dir: str | Path):
+    """The snapshot's tokenizer via transformers, or None (fixture repos
+    and minimal pulls carry no tokenizer files; callers then work in raw
+    token ids). Offline only — the snapshot is local by construction."""
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(
+            str(snapshot_dir), local_files_only=True
+        )
+    except Exception:  # noqa: BLE001 - absence of a tokenizer is normal
+        return None

@@ -60,6 +60,9 @@ class LlamaConfig:
     # Some family members decouple head_dim from n_embd/n_head
     # (e.g. Mistral-Nemo: 5120/32 but head_dim=128). None = derived.
     head_dim_override: int | None = None
+    # Qwen2-style q/k/v projection biases (Qwen2 hardcodes them on
+    # without an attention_bias config key).
+    attn_bias: bool = False
 
     @staticmethod
     def tiny(**over) -> "LlamaConfig":
@@ -102,13 +105,16 @@ class LlamaConfig:
                     f"unsupported rope_scaling type {rtype!r} "
                     "(supported: llama3, default)"
                 )
-        if cfg_json.get("attention_bias") or cfg_json.get("mlp_bias"):
-            # The tree has no bias leaves; loading such a checkpoint would
-            # silently drop its bias tensors and compute wrong logits.
+        if cfg_json.get("mlp_bias"):
+            # The tree has no MLP-bias leaves; loading such a checkpoint
+            # would silently drop tensors and compute wrong logits.
             raise ValueError(
-                "attention_bias/mlp_bias checkpoints are not supported "
-                "by this bias-free Llama tree"
+                "mlp_bias checkpoints are not supported by this tree"
             )
+        # Qwen2 hardcodes q/k/v biases without setting attention_bias.
+        attn_bias = bool(cfg_json.get(
+            "attention_bias", cfg_json.get("model_type") == "qwen2"
+        ))
         # Fallbacks for omitted keys match transformers.LlamaConfig's
         # defaults (an old Llama-2-era config.json omits rope_theta and
         # must get 10000.0, not a 3.1 value).
@@ -126,6 +132,7 @@ class LlamaConfig:
             rope_theta=cfg_json.get("rope_theta", 10000.0),
             tie_embeddings=cfg_json.get("tie_word_embeddings", False),
             head_dim_override=cfg_json.get("head_dim"),
+            attn_bias=attn_bias,
         )
 
     @property
@@ -148,18 +155,23 @@ def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> dict:
     def dense(key, shape, scale=0.02):
         return (jax.random.normal(key, shape) * scale).astype(dtype)
 
+    attn = {
+        "q_w": dense(next(k), (L, E, qE)),
+        "k_w": dense(next(k), (L, E, kvE)),
+        "v_w": dense(next(k), (L, E, kvE)),
+        "o_w": dense(next(k), (L, qE, E), 0.02 / math.sqrt(2 * L)),
+    }
+    if cfg.attn_bias:
+        attn.update(q_b=jnp.zeros((L, qE), dtype),
+                    k_b=jnp.zeros((L, kvE), dtype),
+                    v_b=jnp.zeros((L, kvE), dtype))
     out = {
         "wte": dense(next(k), (cfg.vocab_size, E)),
         "ln_f": {"g": jnp.ones((E,), dtype)},
         "blocks": {
             "ln_attn": {"g": jnp.ones((L, E), dtype)},
             "ln_mlp": {"g": jnp.ones((L, E), dtype)},
-            "attn": {
-                "q_w": dense(next(k), (L, E, qE)),
-                "k_w": dense(next(k), (L, E, kvE)),
-                "v_w": dense(next(k), (L, E, kvE)),
-                "o_w": dense(next(k), (L, qE, E), 0.02 / math.sqrt(2 * L)),
-            },
+            "attn": attn,
             "mlp": {
                 "gate_w": dense(next(k), (L, E, F)),
                 "up_w": dense(next(k), (L, E, F)),
@@ -221,12 +233,20 @@ def params_from_hf(
         "attn": {leaf: [] for _, leaf in _HF_ATTN.values()},
         "mlp": {leaf: [] for _, leaf in _HF_MLP.values()},
     }
+    if cfg.attn_bias:
+        for leaf in ("q_b", "k_b", "v_b"):
+            blocks["attn"][leaf] = []
     for layer in range(cfg.n_layer):
         pre = f"model.layers.{layer}."
         for hf, (grp, leaf) in _HF_NORM.items():
             blocks[grp][leaf].append(take(f"{pre}{hf}.weight"))
         for hf, (grp, leaf) in {**_HF_ATTN, **_HF_MLP}.items():
             blocks[grp][leaf].append(take(f"{pre}{hf}.weight").T)
+        if cfg.attn_bias:
+            for proj, leaf in (("q", "q_b"), ("k", "k_b"), ("v", "v_b")):
+                blocks["attn"][leaf].append(
+                    take(f"{pre}self_attn.{proj}_proj.bias")
+                )
     out["blocks"] = jax.tree.map(
         lambda leaves: jnp.asarray(np.stack(leaves), dtype),
         blocks, is_leaf=lambda v: isinstance(v, list),
@@ -253,6 +273,9 @@ def param_specs(cfg: LlamaConfig) -> dict:
                 "k_w": P(None, None, MODEL_AXIS),
                 "v_w": P(None, None, MODEL_AXIS),
                 "o_w": P(None, MODEL_AXIS, None),
+                **({"q_b": P(None, MODEL_AXIS),
+                    "k_b": P(None, MODEL_AXIS),
+                    "v_b": P(None, MODEL_AXIS)} if cfg.attn_bias else {}),
             },
             "mlp": {
                 "gate_w": P(None, None, MODEL_AXIS),
@@ -272,6 +295,7 @@ def checkpoint_shard_rules() -> list[tuple[str, P]]:
     axis 0 for column-parallel tensors and axis 1 for row-parallel)."""
     return [
         (r"self_attn\.[qkv]_proj\.weight$", P(MODEL_AXIS, None)),
+        (r"self_attn\.[qkv]_proj\.bias$", P(MODEL_AXIS)),
         (r"self_attn\.o_proj\.weight$", P(None, MODEL_AXIS)),
         (r"mlp\.(gate|up)_proj\.weight$", P(MODEL_AXIS, None)),
         (r"mlp\.down_proj\.weight$", P(None, MODEL_AXIS)),
@@ -333,9 +357,14 @@ def _qkv(x, p, cfg: LlamaConfig, pos0=0):
     shard_map) reuse the same code path."""
     B, T, _ = x.shape
     D = cfg.head_dim
-    q = (x @ p["q_w"]).reshape(B, T, -1, D)
-    k = (x @ p["k_w"]).reshape(B, T, -1, D)
-    v = (x @ p["v_w"]).reshape(B, T, -1, D)
+
+    def proj(w, b):
+        h = x @ p[w]
+        if b in p:  # Qwen2-style q/k/v biases
+            h = h + p[b]
+        return h.reshape(B, T, -1, D)
+
+    q, k, v = proj("q_w", "q_b"), proj("k_w", "k_b"), proj("v_w", "v_b")
     return (_rope(q, cfg, pos0), _rope(k, cfg, pos0), v)
 
 
