@@ -140,5 +140,14 @@ def test_cli_generate_requires_prompt_or_ids(tmp_path, monkeypatch, capsys):
         rc = cli.main(["generate", "acme/gen2", "--no-p2p",
                        "--prompt", "hello"])
         assert rc == 2
+        # Context overflow: clean error, not a traceback (n_ctx=64).
+        rc = cli.main(["generate", "acme/gen2", "--no-p2p",
+                       "--ids", "1,2", "--steps", "100"])
+        assert rc == 1
+        # Non-positive steps rejected before any pull.
+        rc = cli.main(["generate", "acme/gen2", "--no-p2p",
+                       "--ids", "1", "--steps", "0"])
+        assert rc == 2
     err = capsys.readouterr().err
     assert "required" in err and "tokenizer" in err
+    assert "exceeds" in err and "positive" in err

@@ -125,6 +125,46 @@ def test_from_hf_rejects_mlp_bias_configs():
         llama.LlamaConfig.from_hf(cfg_json)
 
 
+def test_forward_matches_transformers_attention_bias():
+    """Explicit attention_bias=True (HF LlamaAttention) biases o_proj as
+    well as q/k/v; all four must map and apply."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(8)
+    hf_cfg = transformers.LlamaConfig(
+        **TINY, tie_word_embeddings=False, attention_bias=True,
+        mlp_bias=False,
+    )
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    with torch.no_grad():  # transformers zero-inits biases
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.normal_(std=0.5)
+    cfg = llama.LlamaConfig.from_hf(hf_cfg.to_dict())
+    assert cfg.attn_bias and cfg.o_bias
+    params = llama.params_from_hf(to_numpy_state(model), cfg)
+    assert params["blocks"]["attn"]["o_b"].shape == (2, 64)
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 11))
+    got = np.asarray(llama.forward(params, jnp.asarray(ids, jnp.int32), cfg))
+    with torch.no_grad():
+        want = model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+    # KV-cached decode carries the biases too.
+    full = llama.generate_greedy(params, cfg, [1, 2, 3], steps=6)
+    cached = llama.generate_cached(params, cfg, [1, 2, 3], steps=6)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_qwen2_has_no_o_bias():
+    cfg = llama.LlamaConfig.from_hf(dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, model_type="qwen2"))
+    assert cfg.attn_bias and not cfg.o_bias
+
+
 def test_forward_matches_transformers_qwen2():
     """Qwen2 hardcodes q/k/v biases (no attention_bias config key); the
     tree must carry and apply them — parity against the HF torch Qwen2."""

@@ -185,6 +185,10 @@ def cmd_generate(args) -> int:
     # Flag validation is pull-independent — do it before a possibly
     # multi-GB download (only the tokenizer lookup needs the snapshot).
     prompt = None
+    if args.steps < 1:
+        print(f"error: --steps must be positive (got {args.steps})",
+              file=sys.stderr)
+        return 2
     if args.ids:
         try:
             prompt = [int(t) for t in args.ids.split(",")]
@@ -208,10 +212,12 @@ def cmd_generate(args) -> int:
         prompt = tok.encode(args.prompt)
     try:
         model_type, generate = load_generator(res.snapshot_dir)
-    except (UnsupportedModelError, FileNotFoundError) as exc:
+        out = generate(prompt, args.steps)
+    except (UnsupportedModelError, FileNotFoundError, ValueError) as exc:
+        # ValueError: context overflow (prompt+steps > n_ctx) and kin —
+        # a usage problem, not a crash.
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    out = generate(prompt, args.steps)
     new = out[len(prompt):]
     print(f"[{model_type}] {len(prompt)} prompt + {len(new)} new tokens")
     if tok is not None:

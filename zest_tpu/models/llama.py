@@ -63,6 +63,9 @@ class LlamaConfig:
     # Qwen2-style q/k/v projection biases (Qwen2 hardcodes them on
     # without an attention_bias config key).
     attn_bias: bool = False
+    # HF attention_bias=True additionally puts a bias on o_proj
+    # (Qwen2 does not), so the two are tracked separately.
+    o_bias: bool = False
 
     @staticmethod
     def tiny(**over) -> "LlamaConfig":
@@ -111,10 +114,11 @@ class LlamaConfig:
             raise ValueError(
                 "mlp_bias checkpoints are not supported by this tree"
             )
-        # Qwen2 hardcodes q/k/v biases without setting attention_bias.
-        attn_bias = bool(cfg_json.get(
-            "attention_bias", cfg_json.get("model_type") == "qwen2"
-        ))
+        # Qwen2 hardcodes q/k/v biases without setting attention_bias;
+        # an explicit attention_bias=True (HF LlamaAttention) biases
+        # o_proj as well.
+        explicit = bool(cfg_json.get("attention_bias", False))
+        attn_bias = explicit or cfg_json.get("model_type") == "qwen2"
         # Fallbacks for omitted keys match transformers.LlamaConfig's
         # defaults (an old Llama-2-era config.json omits rope_theta and
         # must get 10000.0, not a 3.1 value).
@@ -133,6 +137,7 @@ class LlamaConfig:
             tie_embeddings=cfg_json.get("tie_word_embeddings", False),
             head_dim_override=cfg_json.get("head_dim"),
             attn_bias=attn_bias,
+            o_bias=explicit,
         )
 
     @property
@@ -165,6 +170,8 @@ def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> dict:
         attn.update(q_b=jnp.zeros((L, qE), dtype),
                     k_b=jnp.zeros((L, kvE), dtype),
                     v_b=jnp.zeros((L, kvE), dtype))
+    if cfg.o_bias:
+        attn["o_b"] = jnp.zeros((L, E), dtype)
     out = {
         "wte": dense(next(k), (cfg.vocab_size, E)),
         "ln_f": {"g": jnp.ones((E,), dtype)},
@@ -236,6 +243,8 @@ def params_from_hf(
     if cfg.attn_bias:
         for leaf in ("q_b", "k_b", "v_b"):
             blocks["attn"][leaf] = []
+    if cfg.o_bias:
+        blocks["attn"]["o_b"] = []
     for layer in range(cfg.n_layer):
         pre = f"model.layers.{layer}."
         for hf, (grp, leaf) in _HF_NORM.items():
@@ -247,6 +256,10 @@ def params_from_hf(
                 blocks["attn"][leaf].append(
                     take(f"{pre}self_attn.{proj}_proj.bias")
                 )
+        if cfg.o_bias:
+            blocks["attn"]["o_b"].append(
+                take(f"{pre}self_attn.o_proj.bias")
+            )
     out["blocks"] = jax.tree.map(
         lambda leaves: jnp.asarray(np.stack(leaves), dtype),
         blocks, is_leaf=lambda v: isinstance(v, list),
@@ -276,6 +289,8 @@ def param_specs(cfg: LlamaConfig) -> dict:
                 **({"q_b": P(None, MODEL_AXIS),
                     "k_b": P(None, MODEL_AXIS),
                     "v_b": P(None, MODEL_AXIS)} if cfg.attn_bias else {}),
+                # o_b adds after the row-parallel o_w reduce → replicated.
+                **({"o_b": P()} if cfg.o_bias else {}),
             },
             "mlp": {
                 "gate_w": P(None, None, MODEL_AXIS),
@@ -296,6 +311,7 @@ def checkpoint_shard_rules() -> list[tuple[str, P]]:
     return [
         (r"self_attn\.[qkv]_proj\.weight$", P(MODEL_AXIS, None)),
         (r"self_attn\.[qkv]_proj\.bias$", P(MODEL_AXIS)),
+        (r"self_attn\.o_proj\.bias$", P(None)),
         (r"self_attn\.o_proj\.weight$", P(None, MODEL_AXIS)),
         (r"mlp\.(gate|up)_proj\.weight$", P(MODEL_AXIS, None)),
         (r"mlp\.down_proj\.weight$", P(None, MODEL_AXIS)),
@@ -382,7 +398,8 @@ def _attention(x, p, cfg: LlamaConfig):
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, H * D)
-    return out @ p["o_w"]
+    out = out @ p["o_w"]
+    return out + p["o_b"] if "o_b" in p else out
 
 
 def _ring_attention(x, p, cfg: LlamaConfig, seq_axis: str,
@@ -398,7 +415,10 @@ def _ring_attention(x, p, cfg: LlamaConfig, seq_axis: str,
     q, k, v = _qkv(x, p, cfg, pos0=pos0)
     out = ring_self_attention(q, k, v, seq_axis, causal=True)
     out = out.reshape(B, T, -1) @ p["o_w"]
-    return out if tp_axis is None else jax.lax.psum(out, tp_axis)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    # o_b is replicated: add after the reduce, not per partial sum.
+    return out + p["o_b"] if "o_b" in p else out
 
 
 def _mlp(x, p, tp_axis: str | None = None):
@@ -562,7 +582,10 @@ def _attention_cached(x, p, cfg: LlamaConfig, cache_k, cache_v, pos):
                        jnp.finfo(scores.dtype).min)
     att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
-    return out.reshape(B, 1, H * D) @ p["o_w"], cache_k, cache_v
+    out = out.reshape(B, 1, H * D) @ p["o_w"]
+    if "o_b" in p:
+        out = out + p["o_b"]
+    return out, cache_k, cache_v
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,

@@ -1,0 +1,92 @@
+"""Optimizer-equipped training over the family models (optax).
+
+The reference distributes checkpoints and never trains (SURVEY.md §2.4);
+the TPU build's training plane does, so it needs more than the models'
+inline SGD steps: this module adds the production loop — AdamW with
+warmup+cosine schedule and global-norm clipping, a ``TrainState``, and a
+jitted step factory that works with any family's ``loss_fn``
+(gpt2/llama/moe) and any mesh.
+
+Sharding needs no spec plumbing: optimizer moments are created eagerly
+with ``zeros_like`` over the params and so inherit each param's
+NamedSharding — land a checkpoint TP-sharded via zest_tpu.models.loader
+and the whole optimizer state follows its layout (see
+:func:`create_state` for why init stays out of jit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def adamw(
+    lr: float = 3e-4,
+    weight_decay: float = 0.01,
+    warmup_steps: int = 100,
+    total_steps: int = 10_000,
+    clip_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """The standard LLM recipe: linear warmup → cosine decay, AdamW,
+    global-norm clipping. Weight decay is masked to matrix-shaped leaves
+    (ndim ≥ 2) — norm gains and biases are excluded, as in the GPT-3 /
+    Llama training setups."""
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr,
+        warmup_steps=warmup_steps, decay_steps=total_steps,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(
+            sched, weight_decay=weight_decay,
+            mask=lambda params: jax.tree.map(lambda p: p.ndim >= 2, params),
+        ),
+    )
+
+
+def create_state(params, tx: optax.GradientTransformation) -> TrainState:
+    """Fresh state; moments inherit the params' shardings (zeros_like).
+
+    Call this EAGERLY (not under jit): eager ``zeros_like`` of a sharded
+    array keeps its NamedSharding, whereas under jit GSPMD is free to
+    choose output shardings unless constrained — init runs once, so
+    there is nothing to win by compiling it.
+    """
+    return TrainState(jnp.zeros((), jnp.int32), params, tx.init(params))
+
+
+def make_train_step(
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+) -> Callable:
+    """``step(state, batch) -> (state, loss)``, jitted.
+
+    ``loss_fn(params, batch) -> scalar`` — partial in the family module's
+    config first (e.g. ``functools.partial(llama.loss_fn, cfg=cfg)``).
+    Under a mesh, GSPMD propagates the param/batch shardings through
+    grads, optimizer update, and the new state. The incoming state is
+    DONATED — its buffers are dead after the call, and without donation
+    peak HBM doubles (old + new params and both moment trees live at
+    once), which OOMs meshes that otherwise fit. Corollary: don't keep
+    other references to the state's buffers (note ``device_put`` with a
+    replicated spec can *alias* its source rather than copy).
+    """
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(state: TrainState, batch) -> tuple[TrainState, jax.Array]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(state.step + 1, params, opt_state), loss
+
+    return step
