@@ -13,6 +13,27 @@ from zest_tpu.models.training import TrainState, adamw, create_state, \
     make_train_step
 
 
+def test_decay_mask_excludes_norms_and_biases():
+    """The stacked-layer trees make norm gains 2-D, so the mask must key
+    on leaf names — norm g/b and *_b excluded, weights/embeddings in."""
+    from zest_tpu.models.training import decay_mask
+
+    cfg = llama.LlamaConfig.tiny(attn_bias=True)
+    mask = decay_mask(llama.init_params(jax.random.key(0), cfg))
+    assert mask["blocks"]["ln_attn"]["g"] is False
+    assert mask["ln_f"]["g"] is False
+    assert mask["blocks"]["attn"]["q_b"] is False
+    assert mask["blocks"]["attn"]["q_w"] is True
+    assert mask["wte"] is True
+
+    gmask = decay_mask(gpt2.init_params(jax.random.key(1),
+                                        gpt2.GPT2Config.tiny()))
+    assert gmask["blocks"]["ln_1"]["g"] is False
+    assert gmask["blocks"]["ln_1"]["b"] is False
+    assert gmask["blocks"]["attn"]["qkv_b"] is False
+    assert gmask["blocks"]["attn"]["qkv_w"] is True
+
+
 def test_loss_decreases_overfitting_one_batch():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(jax.random.key(0), cfg)

@@ -2,7 +2,11 @@
 
 - safetensors_io: the checkpoint byte format (header → tensor byte ranges)
 - loader: safetensors → (pjit-sharded) jax.Arrays in HBM
-- gpt2: pure-JAX flagship model proving the pulled bytes run on the MXU
+- registry: config.json model_type → family landing shard rules
+- gpt2 / llama / moe: pure-JAX family models consuming the pulled bytes
+- generate: snapshot → running model (the `zest-tpu generate` path)
+- training: optax loop (AdamW, warmup+cosine, donation)
+- checkpoint: orbax TrainState save/restore + HF safetensors export
 """
 
 from zest_tpu.models.loader import (

@@ -1,0 +1,55 @@
+"""Training-state checkpointing (orbax) and HF-format export.
+
+The reference's "checkpoint/resume" is the idempotent xorb cache
+(SURVEY.md §5) — resuming a *download*. The training plane needs the
+other half: persisting a :class:`zest_tpu.models.training.TrainState`
+across job restarts (orbax handles sharded arrays natively — each host
+writes its own shards, restore re-lands onto the current mesh) and
+exporting trained params back to HF safetensors so anything that speaks
+``transformers`` can consume the result.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+
+
+def save_train_state(path: str | Path, state) -> None:
+    """Write a TrainState (sharded or not) with orbax StandardCheckpointer.
+
+    ``path`` must not already contain a checkpoint (orbax refuses
+    overwrites by design — version your step dirs: ``ckpt/step_000100``).
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(Path(path).resolve(), state)
+
+
+def restore_train_state(path: str | Path, state_like):
+    """Restore a TrainState saved by :func:`save_train_state`.
+
+    ``state_like`` supplies structure, dtypes, and target shardings —
+    pass the freshly-built state (``create_state(params, tx)``) whose
+    arrays sit where the restored ones should land; abstract shapes via
+    ``jax.eval_shape`` work too when paired with real shardings.
+    """
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(Path(path).resolve(), abstract)
+
+
+def export_hf_safetensors(path: str | Path, params, cfg) -> None:
+    """Trained Llama-family params → one HF-format safetensors file.
+
+    Pairs with ``llama.params_to_hf``; the output loads with
+    ``transformers`` (state_dict-compatible names/orientations).
+    """
+    from zest_tpu.models import llama
+    from zest_tpu.models.safetensors_io import write_safetensors
+
+    write_safetensors(path, llama.params_to_hf(params, cfg))

@@ -267,6 +267,37 @@ def params_from_hf(
     return out
 
 
+def params_to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Inverse of :func:`params_from_hf`: the stacked tree back to HF
+    tensor names/orientations ([out, in] Linears, per-layer unstacked).
+
+    Enables the full lifecycle: pull → finetune → export →
+    ``transformers.from_pretrained`` — write the result with
+    ``zest_tpu.models.write_safetensors``.
+    """
+    out = {
+        "model.embed_tokens.weight": np.asarray(params["wte"]),
+        "model.norm.weight": np.asarray(params["ln_f"]["g"]),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    b = params["blocks"]
+    for layer in range(cfg.n_layer):
+        pre = f"model.layers.{layer}."
+        for hf, (grp, leaf) in _HF_NORM.items():
+            out[f"{pre}{hf}.weight"] = np.asarray(b[grp][leaf][layer])
+        for hf, (grp, leaf) in {**_HF_ATTN, **_HF_MLP}.items():
+            out[f"{pre}{hf}.weight"] = np.asarray(b[grp][leaf][layer]).T
+        if cfg.attn_bias:
+            for proj, leaf in (("q", "q_b"), ("k", "k_b"), ("v", "v_b")):
+                out[f"{pre}self_attn.{proj}_proj.bias"] = \
+                    np.asarray(b["attn"][leaf][layer])
+        if cfg.o_bias:
+            out[f"{pre}self_attn.o_proj.bias"] = \
+                np.asarray(b["attn"]["o_b"][layer])
+    return out
+
+
 # ── Sharding rules (data + tensor parallel) ──
 
 

@@ -38,20 +38,33 @@ def adamw(
     clip_norm: float = 1.0,
 ) -> optax.GradientTransformation:
     """The standard LLM recipe: linear warmup → cosine decay, AdamW,
-    global-norm clipping. Weight decay is masked to matrix-shaped leaves
-    (ndim ≥ 2) — norm gains and biases are excluded, as in the GPT-3 /
-    Llama training setups."""
+    global-norm clipping. Weight decay is masked by *leaf name*, not
+    ndim — the family trees stack layers on a leading axis, so a norm
+    gain is (L, E) and raw dimensionality can't tell it from a matmul
+    weight. Norm gains (``g``/``b``) and biases (``*_b``) are excluded,
+    as in the GPT-3 / Llama training setups; embeddings decay."""
     sched = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=lr,
         warmup_steps=warmup_steps, decay_steps=total_steps,
     )
     return optax.chain(
         optax.clip_by_global_norm(clip_norm),
-        optax.adamw(
-            sched, weight_decay=weight_decay,
-            mask=lambda params: jax.tree.map(lambda p: p.ndim >= 2, params),
-        ),
+        optax.adamw(sched, weight_decay=weight_decay, mask=decay_mask),
     )
+
+
+def decay_mask(params) -> Any:
+    """True for leaves weight decay applies to, keyed on the tree path:
+    norm gains/offsets (leaf ``g``/``b``) and biases (``*_b``) are
+    excluded; matmul weights and embeddings are decayed."""
+    import jax.tree_util as jtu
+
+    def decide(path, _leaf):
+        last = path[-1]
+        key = last.key if hasattr(last, "key") else str(last)
+        return not (key in ("g", "b") or key.endswith("_b"))
+
+    return jtu.tree_map_with_path(decide, params)
 
 
 def create_state(params, tx: optax.GradientTransformation) -> TrainState:
