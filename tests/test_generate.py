@@ -84,6 +84,57 @@ def test_load_generator_llama(tmp_path):
     np.testing.assert_array_equal(out, np.asarray(want))
 
 
+def test_sampling_semantics():
+    """temperature=0 and top_k=1 are greedy; temperature>0 is seeded and
+    deterministic per seed, varied across seeds."""
+    import jax
+    import jax.numpy as jnp
+
+    from zest_tpu.models import llama
+    from zest_tpu.models.sampling import sample_token
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = [3, 7, 1]
+    greedy = llama.generate_cached(params, cfg, prompt, 8)
+    np.testing.assert_array_equal(
+        np.asarray(llama.generate_cached(params, cfg, prompt, 8,
+                                         temperature=1.0, top_k=1)),
+        np.asarray(greedy),
+    )
+    s1 = llama.generate_cached(params, cfg, prompt, 8, temperature=1.0,
+                               rng=jax.random.key(1))
+    s1b = llama.generate_cached(params, cfg, prompt, 8, temperature=1.0,
+                                rng=jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1b))
+    # A sampled draw differs from greedy for SOME seed (vocab is wide).
+    diffs = [
+        not np.array_equal(
+            np.asarray(llama.generate_cached(
+                params, cfg, prompt, 8, temperature=2.0,
+                rng=jax.random.key(s))),
+            np.asarray(greedy))
+        for s in range(4)
+    ]
+    assert any(diffs)
+    # top_k masks everything outside the k best.
+    logits = jnp.asarray([0.0, 5.0, 4.0, -1.0])
+    for s in range(8):
+        tok = int(sample_token(logits, jax.random.key(s),
+                               temperature=5.0, top_k=2))
+        assert tok in (1, 2)
+
+
+def test_gpt2_sampling_matches_greedy_at_topk1(tmp_path):
+    snap = write_gpt2_snapshot(tmp_path / "snap")
+    _, generate = load_generator(snap)
+    g = generate([1, 2], 5)
+    s = generate([1, 2], 5, temperature=0.7, top_k=1)
+    np.testing.assert_array_equal(g, s)
+    s2 = generate([1, 2], 5, temperature=1.5, seed=3)
+    assert s2.shape == (7,)
+
+
 def test_load_generator_unsupported(tmp_path):
     (tmp_path / "config.json").write_text('{"model_type": "rwkv"}')
     with pytest.raises(UnsupportedModelError, match="rwkv"):
@@ -119,6 +170,70 @@ def test_cli_generate_end_to_end(tmp_path, monkeypatch, capsys):
     last = out.strip().splitlines()[-1]
     ids = [int(t) for t in last.split(",")]
     assert len(ids) == 7 and ids[:3] == [1, 2, 3]
+
+
+def test_http_generate_endpoint(tmp_path):
+    """POST /v1/generate: pull + decode streamed as SSE, ids in `done`."""
+    import requests
+
+    from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.config import Config
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=2)
+    repo = FixtureRepo("acme/api-gen", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                     hf_token="hf_test", endpoint=hub.url, http_port=0)
+        api = HttpApi(cfg)
+        port = api.start()
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{port}/v1/generate",
+                json={"repo_id": "acme/api-gen", "ids": [1, 2, 3],
+                      "steps": 4},
+                timeout=120, stream=True,
+            )
+            events = [json.loads(line[len("data: "):])
+                      for line in r.iter_lines(decode_unicode=True)
+                      if line.startswith("data: ")]
+        finally:
+            api.close()
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "start" and "pulled" in kinds
+    done = events[-1]
+    assert done["event"] == "done", events
+    assert done["model_type"] == "gpt2"
+    assert done["ids"][:3] == [1, 2, 3] and len(done["ids"]) == 7
+
+
+def test_http_generate_rejects_bad_body(tmp_config):
+    import requests
+
+    from zest_tpu.api.http_api import HttpApi
+
+    tmp_config.http_port = 0
+    api = HttpApi(tmp_config)
+    port = api.start()
+    try:
+        r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
+                          data=b"not json", timeout=5)
+        assert r.status_code == 400
+        # Valid JSON that isn't an object must also 400, not crash.
+        r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
+                          data=b"[1, 2]", timeout=5)
+        assert r.status_code == 400
+        r = requests.post(f"http://127.0.0.1:{port}/v1/pull",
+                          data=b"123", timeout=5)
+        assert r.status_code == 400
+        # Missing prompt/ids surfaces as an SSE error event, not a crash.
+        r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
+                          json={"repo_id": "no/such"}, timeout=30)
+        events = [json.loads(line[len("data: "):])
+                  for line in r.text.splitlines()
+                  if line.startswith("data: ")]
+        assert events[-1]["event"] == "error"
+    finally:
+        api.close()
 
 
 def test_cli_generate_requires_prompt_or_ids(tmp_path, monkeypatch, capsys):

@@ -8,6 +8,9 @@ The reference's http_api.zig: loopback-bound HTTP server routing
 - ``POST /v1/pull`` is implemented for real (the reference shipped a stub,
   src/http_api.zig:138-142): it streams SSE progress events while the pull
   runs, per DESIGN.md's intended contract.
+- ``POST /v1/generate`` (no reference counterpart — the serving surface):
+  pull + family-model decode, streamed as SSE ``start``/``pulled``/``done``
+  events with output token ids (and text when a tokenizer is present).
 - ``/v1/status`` additionally reports pod-level fields (HBM staging
   occupancy, mesh axes) — the TPU build's control plane surfaces the
   device tier too (SURVEY.md §2.1 row 16).
@@ -46,6 +49,8 @@ class HttpApi:
         self.shutdown_event = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
         self._lock = threading.Lock()
+        # snapshot_dir → (model_type, generate); see generate_events.
+        self._generators: dict = {}
 
     # ── Lifecycle ──
 
@@ -198,6 +203,53 @@ class HttpApi:
         else:
             yield {"event": "error", "message": result.get("error", "?")}
 
+    def generate_events(self, repo_id: str, req: dict):
+        """Generator of SSE events for one pull+decode (serving path):
+        ``start`` → ``pulled`` → ``done`` with output ids (and text when
+        the snapshot carries a tokenizer). Decodes with the family's
+        best path via models.generate.load_generator."""
+        from zest_tpu.models.generate import load_generator, try_tokenizer
+        from zest_tpu.transfer.pull import pull_model
+
+        yield {"event": "start", "repo_id": repo_id}
+        try:
+            res = pull_model(self.cfg, repo_id,
+                             revision=req.get("revision", "main"),
+                             swarm=self.swarm, log=lambda *a, **k: None)
+            yield {"event": "pulled",
+                   "snapshot_dir": str(res.snapshot_dir)}
+            tok = try_tokenizer(res.snapshot_dir)
+            if "ids" in req:
+                prompt = [int(t) for t in req["ids"]]
+            elif "prompt" in req and tok is not None:
+                prompt = tok.encode(req["prompt"])
+            else:
+                yield {"event": "error",
+                       "message": "need ids, or prompt + a tokenizer "
+                                  "in the snapshot"}
+                return
+            # Memoized per snapshot: load_generator reads every tensor
+            # and compiles the decode scan — seconds-to-minutes a real
+            # model must not pay again per request.
+            key = str(res.snapshot_dir)
+            if key not in self._generators:
+                self._generators[key] = load_generator(res.snapshot_dir)
+            model_type, generate = self._generators[key]
+            top_k = req.get("top_k")
+            out = generate(
+                prompt, int(req.get("steps", 20)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=None if top_k is None else int(top_k),
+                seed=int(req.get("seed", 0)),
+            )
+            payload = {"event": "done", "model_type": model_type,
+                       "ids": [int(t) for t in out]}
+            if tok is not None:
+                payload["text"] = tok.decode(list(out))
+            yield payload
+        except Exception as exc:  # noqa: BLE001 - reported to client
+            yield {"event": "error", "message": str(exc)}
+
 
 class _Handler(BaseHTTPRequestHandler):
     api: HttpApi
@@ -238,31 +290,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"status": "stopping"})
             self.api.trigger_shutdown()
         elif self.path == "/v1/pull":
-            n = int(self.headers.get("Content-Length") or 0)
-            try:
-                req = json.loads(self.rfile.read(n) or b"{}")
-                repo_id = req["repo_id"]
-            except (json.JSONDecodeError, KeyError):
-                self._json({"error": "body must be JSON with repo_id"}, 400)
+            req = self._read_json_body()
+            if req is None:
                 return
-            revision = req.get("revision", "main")
-            device = req.get("device")
-            self.send_response(200)
-            self.send_header("Content-Type", "text/event-stream")
-            self.send_header("Cache-Control", "no-cache")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            try:
-                for ev in self.api.pull_events(repo_id, revision, device):
-                    data = f"data: {json.dumps(ev)}\n\n".encode()
-                    self.wfile.write(f"{len(data):x}\r\n".encode()
-                                     + data + b"\r\n")
-                    self.wfile.flush()
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError):
-                pass  # client went away mid-pull; the pull thread finishes
+            self._begin_sse()
+            self._stream_sse(self.api.pull_events(
+                req["repo_id"], req.get("revision", "main"),
+                req.get("device"),
+            ))
+        elif self.path == "/v1/generate":
+            req = self._read_json_body()
+            if req is None:
+                return
+            self._begin_sse()
+            self._stream_sse(self.api.generate_events(req["repo_id"], req))
         else:
             self._json({"error": "not found"}, 404)
+
+    def _read_json_body(self) -> dict | None:
+        """JSON-object body with ``repo_id``, or None after a 400 (covers
+        malformed JSON AND valid-but-non-object bodies like ``[1,2]``)."""
+        n = int(self.headers.get("Content-Length") or 0)
+        try:
+            req = json.loads(self.rfile.read(n) or b"{}")
+            req["repo_id"]
+        except (json.JSONDecodeError, KeyError, TypeError):
+            self._json({"error": "body must be JSON with repo_id"}, 400)
+            return None
+        return req
+
+    def _begin_sse(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _stream_sse(self, events) -> None:
+        """Write an event generator as chunked SSE (headers sent via
+        ``_begin_sse``)."""
+        try:
+            for ev in events:
+                data = f"data: {json.dumps(ev)}\n\n".encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-work; the worker finishes
 
 
 DASHBOARD_HTML = """<!doctype html>

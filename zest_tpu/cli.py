@@ -212,7 +212,8 @@ def cmd_generate(args) -> int:
         prompt = tok.encode(args.prompt)
     try:
         model_type, generate = load_generator(res.snapshot_dir)
-        out = generate(prompt, args.steps)
+        out = generate(prompt, args.steps, temperature=args.temperature,
+                       top_k=args.top_k, seed=args.seed)
     except (UnsupportedModelError, FileNotFoundError, ValueError) as exc:
         # ValueError: context overflow (prompt+steps > n_ctx) and kin —
         # a usage problem, not a crash.
@@ -416,6 +417,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="comma-separated prompt token ids")
     gen.add_argument("--steps", type=int, default=20,
                      help="new tokens to decode (default 20)")
+    gen.add_argument("--temperature", type=float, default=0.0,
+                     help="0 = greedy (default); >0 samples")
+    gen.add_argument("--top-k", type=int, default=None,
+                     help="restrict sampling to the k most likely tokens")
+    gen.add_argument("--seed", type=int, default=0,
+                     help="sampling PRNG seed (default 0)")
     gen.add_argument("--no-p2p", action="store_true")
     gen.set_defaults(fn=cmd_generate)
 

@@ -42,10 +42,12 @@ def _snapshot_tensors(snapshot_dir: Path) -> dict[str, np.ndarray]:
 def load_generator(snapshot_dir: str | Path):
     """Build ``(model_type, generate_fn)`` from a pulled snapshot.
 
-    ``generate_fn(prompt_ids, steps) -> np.ndarray`` greedy-decodes with
-    the family's best path (KV-cached for Llama-family). Raises
-    :class:`UnsupportedModelError` for families without generation
-    support and ``FileNotFoundError`` for missing config/weights.
+    ``generate_fn(prompt_ids, steps, temperature=0.0, top_k=None,
+    seed=0) -> np.ndarray`` decodes with the family's best path
+    (KV-cached for Llama-family); greedy by default, sampling when
+    ``temperature>0``. Raises :class:`UnsupportedModelError` for
+    families without generation support and ``FileNotFoundError`` for
+    missing config/weights.
     """
     snapshot_dir = Path(snapshot_dir)
     cfg_json = json.loads((snapshot_dir / "config.json").read_text())
@@ -62,21 +64,22 @@ def load_generator(snapshot_dir: str | Path):
 
         cfg = fam.GPT2Config.from_hf(cfg_json)
         params = fam.params_from_hf(tensors, cfg)
-
-        def generate(prompt_ids, steps):
-            return np.asarray(
-                fam.generate_greedy(params, cfg, prompt_ids, steps)
-            )
+        decode = fam.generate_greedy
     else:  # llama family
         from zest_tpu.models import llama as fam
 
         cfg = fam.LlamaConfig.from_hf(cfg_json)
         params = fam.params_from_hf(tensors, cfg)
+        decode = fam.generate_cached
 
-        def generate(prompt_ids, steps):
-            return np.asarray(
-                fam.generate_cached(params, cfg, prompt_ids, steps)
-            )
+    def generate(prompt_ids, steps, temperature=0.0, top_k=None, seed=0):
+        import jax
+
+        return np.asarray(decode(
+            params, cfg, prompt_ids, steps, temperature=temperature,
+            top_k=top_k, rng=jax.random.key(seed),
+        ))
+
     return model_type, generate
 
 

@@ -287,9 +287,15 @@ def train_step(params, batch, cfg: GPT2Config, lr: float = 1e-3,
     return params, loss
 
 
-def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int):
-    """Greedy decode via ``lax.scan`` over a fixed-size buffer (static
-    shapes; no Python loop under jit). Returns (len(prompt)+steps,) ids."""
+def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int,
+                    temperature: float = 0.0, top_k: int | None = None,
+                    rng: jax.Array | None = None):
+    """Decode via ``lax.scan`` over a fixed-size buffer (static shapes;
+    no Python loop under jit). Returns (len(prompt)+steps,) ids. Default
+    greedy; ``temperature``/``top_k`` switch to sampling (see
+    models.sampling.sample_token)."""
+    from zest_tpu.models.sampling import sample_token
+
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     n0 = prompt_ids.shape[0]
     total = n0 + steps
@@ -299,13 +305,16 @@ def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int):
             f"n_ctx {cfg.n_ctx}"
         )
     buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+    keys = jax.random.split(
+        jax.random.key(0) if rng is None else rng, steps
+    )
 
-    def step(carry, _):
+    def step(carry, key):
         buf, pos = carry
         logits = forward(params, buf[None, :], cfg)[0]
-        nxt = jnp.argmax(logits[pos - 1]).astype(jnp.int32)
+        nxt = sample_token(logits[pos - 1], key, temperature, top_k)
         buf = buf.at[pos].set(nxt)
         return (buf, pos + 1), nxt
 
-    (buf, _), _ = jax.lax.scan(step, (buf, jnp.int32(n0)), None, length=steps)
+    (buf, _), _ = jax.lax.scan(step, (buf, jnp.int32(n0)), keys)
     return buf

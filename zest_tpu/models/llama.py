@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from zest_tpu.models.sampling import sample_token
 from zest_tpu.parallel.ring import SEQ_AXIS, ring_self_attention
 
 DATA_AXIS = "data"
@@ -652,10 +653,14 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
     return logits, {"k": new_k, "v": new_v}
 
 
-def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int):
-    """Greedy decode with a KV cache: prefill token-by-token, then sample
+def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
+                    temperature: float = 0.0, top_k: int | None = None,
+                    rng: jax.Array | None = None):
+    """Decode with a KV cache: prefill token-by-token, then produce
     ``steps`` new tokens, all inside one jitted ``lax.scan``. Returns
-    (len(prompt)+steps,) ids; token-identical to ``generate_greedy``.
+    (len(prompt)+steps,) ids. Default is greedy (token-identical to
+    ``generate_greedy``); ``temperature``/``top_k`` switch to sampling
+    (``rng`` defaults to key 0 — pass one for varied draws).
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     n0 = prompt_ids.shape[0]
@@ -668,11 +673,15 @@ def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int):
     cache = init_kv_cache(cfg, 1, total,
                           dtype=params["wte"].dtype)
     buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+    keys = jax.random.split(
+        jax.random.key(0) if rng is None else rng, total - 1
+    )
 
-    def step(carry, pos):
+    def step(carry, inp):
+        pos, key = inp
         buf, cache = carry
         logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
-        nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+        nxt = sample_token(logits[0], key, temperature, top_k)
         # Prompt positions keep their token; past the prompt we append.
         buf = jnp.where(
             pos + 1 < n0, buf,
@@ -683,7 +692,7 @@ def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int):
         return (buf, cache), None
 
     (buf, _), _ = jax.lax.scan(
-        step, (buf, cache), jnp.arange(total - 1)
+        step, (buf, cache), (jnp.arange(total - 1), keys)
     )
     return buf
 

@@ -1,0 +1,21 @@
+"""Token sampling shared by the family decode paths."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(logits, key, temperature: float = 0.0,
+                 top_k: int | None = None) -> jax.Array:
+    """One token from (vocab,) logits: greedy at ``temperature<=0``,
+    otherwise softmax sampling at the given temperature, optionally
+    restricted to the ``top_k`` most likely tokens. Static-shape (the
+    top-k restriction masks, never gathers); jittable."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][-1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
