@@ -1,0 +1,70 @@
+"""The full model lifecycle: pull → finetune → checkpoint → export.
+
+Pull a Llama-family checkpoint through the swarm, fine-tune it with the
+optax loop (AdamW, warmup+cosine, donated steps), checkpoint the
+TrainState with orbax, and export the result back to HF safetensors —
+which loads with ``transformers.from_pretrained`` unchanged.
+
+Run against a real repo (network required), or point HF_ENDPOINT at the
+fixture hub (scripts/fixture_hub.py) for a no-network demo:
+
+    python examples/finetune_and_export.py meta-llama/Llama-3.2-1B
+"""
+
+import functools
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import zest_tpu as zest
+from zest_tpu.models import llama
+from zest_tpu.models.checkpoint import (
+    export_hf_safetensors,
+    restore_train_state,
+    save_train_state,
+)
+from zest_tpu.models.generate import _snapshot_tensors
+from zest_tpu.models.training import adamw, create_state, make_train_step
+
+
+def main() -> int:
+    repo = sys.argv[1] if len(sys.argv) > 1 else "meta-llama/Llama-3.2-1B"
+    snapshot = Path(zest.pull(repo))
+    print(f"pulled {repo} -> {snapshot}")
+
+    cfg = llama.LlamaConfig.from_hf(
+        json.loads((snapshot / "config.json").read_text())
+    )
+    params = llama.params_from_hf(_snapshot_tensors(snapshot), cfg)
+
+    tx = adamw(lr=1e-4, warmup_steps=10, total_steps=1000)
+    step = make_train_step(tx, functools.partial(llama.loss_fn, cfg=cfg))
+    state = create_state(params, tx)
+
+    # Stand-in data: random tokens. Real training swaps in a data loader.
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 129)), jnp.int32
+    )
+    for _ in range(5):
+        state, loss = step(state, batch)
+        print(f"step {int(state.step)}: loss {float(loss):.4f}")
+
+    ckpt = snapshot.parent / f"trainstate_step{int(state.step)}"
+    save_train_state(ckpt, state)
+    state = restore_train_state(ckpt, state)
+    print(f"checkpointed + restored at step {int(state.step)} -> {ckpt}")
+
+    out = snapshot.parent / "finetuned.safetensors"
+    export_hf_safetensors(out, state.params, cfg)
+    print(f"exported HF-format weights -> {out}")
+    print("load with: transformers.LlamaForCausalLM + load_state_dict")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -123,6 +123,10 @@ def test_sampling_semantics():
         tok = int(sample_token(logits, jax.random.key(s),
                                temperature=5.0, top_k=2))
         assert tok in (1, 2)
+    # top_k beyond the vocab means "no restriction", not a top_k error.
+    tok = int(sample_token(logits, jax.random.key(0),
+                           temperature=1.0, top_k=100000))
+    assert 0 <= tok < 4
 
 
 def test_gpt2_sampling_matches_greedy_at_topk1(tmp_path):
@@ -212,6 +216,10 @@ def test_http_generate_rejects_bad_body(tmp_config):
     from zest_tpu.api.http_api import HttpApi
 
     tmp_config.http_port = 0
+    # Hermeticity: the missing-prompt request below drives a pull; point
+    # the hub at a closed local port so failure is immediate, not a live
+    # huggingface.co dependency.
+    tmp_config.endpoint = "http://127.0.0.1:9"
     api = HttpApi(tmp_config)
     port = api.start()
     try:

@@ -230,11 +230,19 @@ class HttpApi:
                 return
             # Memoized per snapshot: load_generator reads every tensor
             # and compiles the decode scan — seconds-to-minutes a real
-            # model must not pay again per request.
+            # model must not pay again per request. Guarded by the API
+            # lock (handlers run in ThreadingHTTPServer threads) and
+            # bounded: evicting oldest caps resident param trees.
             key = str(res.snapshot_dir)
-            if key not in self._generators:
-                self._generators[key] = load_generator(res.snapshot_dir)
-            model_type, generate = self._generators[key]
+            with self._lock:
+                cached = self._generators.get(key)
+            if cached is None:
+                cached = load_generator(res.snapshot_dir)
+                with self._lock:
+                    self._generators[key] = cached
+                    while len(self._generators) > 4:
+                        self._generators.pop(next(iter(self._generators)))
+            model_type, generate = cached
             top_k = req.get("top_k")
             out = generate(
                 prompt, int(req.get("steps", 20)),

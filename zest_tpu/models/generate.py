@@ -43,8 +43,8 @@ def load_generator(snapshot_dir: str | Path):
     """Build ``(model_type, generate_fn)`` from a pulled snapshot.
 
     ``generate_fn(prompt_ids, steps, temperature=0.0, top_k=None,
-    seed=0) -> np.ndarray`` decodes with the family's best path
-    (KV-cached for Llama-family); greedy by default, sampling when
+    seed=0) -> np.ndarray`` decodes with a KV cache (O(T) per token,
+    every family); greedy by default, sampling when
     ``temperature>0``. Raises :class:`UnsupportedModelError` for
     families without generation support and ``FileNotFoundError`` for
     missing config/weights.
@@ -64,7 +64,7 @@ def load_generator(snapshot_dir: str | Path):
 
         cfg = fam.GPT2Config.from_hf(cfg_json)
         params = fam.params_from_hf(tensors, cfg)
-        decode = fam.generate_greedy
+        decode = fam.generate_cached
     else:  # llama family
         from zest_tpu.models import llama as fam
 

@@ -287,6 +287,97 @@ def train_step(params, batch, cfg: GPT2Config, lr: float = 1e-3,
     return params, loss
 
 
+def init_kv_cache(cfg: GPT2Config, batch: int, max_len: int,
+                  dtype=jnp.float32) -> dict:
+    """Static-shape per-layer K/V cache: (L, B, max_len, H, head_dim)."""
+    H = cfg.n_head
+    shape = (cfg.n_layer, batch, max_len, H, cfg.n_embd // H)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
+    """One incremental decode step: (B,) ids at position ``pos`` →
+    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
+    (same contract as llama.decode_step). Jittable; ``pos`` traced."""
+    B = token.shape[0]
+    H, D = cfg.n_head, cfg.n_embd // cfg.n_head
+    x = (params["wte"][token] + params["wpe"][pos])[:, None, :]
+
+    def body(carry, inp):
+        x, pos = carry
+        lp, ck, cv = inp
+        h = _layer_norm(x, lp["ln_1"]["g"], lp["ln_1"]["b"],
+                        cfg.layer_norm_eps)
+        qkv = h @ lp["attn"]["qkv_w"] + lp["attn"]["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, 1, H, D)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            ck, k.reshape(B, 1, H, D), pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cv, v.reshape(B, 1, H, D), pos, axis=1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / math.sqrt(D)
+        valid = jnp.arange(ck.shape[1]) <= pos
+        scores = jnp.where(valid[None, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), cv)
+        out = out.reshape(B, 1, cfg.n_embd)
+        x = x + out @ lp["attn"]["proj_w"] + lp["attn"]["proj_b"]
+        h = _layer_norm(x, lp["ln_2"]["g"], lp["ln_2"]["b"],
+                        cfg.layer_norm_eps)
+        h = jax.nn.gelu(h @ lp["mlp"]["fc_w"] + lp["mlp"]["fc_b"],
+                        approximate=True)
+        return (x + h @ lp["mlp"]["proj_w"] + lp["mlp"]["proj_b"], pos), \
+            (ck, cv)
+
+    (x, _), (new_k, new_v) = jax.lax.scan(
+        body, (x, pos), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"],
+                    cfg.layer_norm_eps)
+    return x[:, 0, :] @ params["wte"].T, {"k": new_k, "v": new_v}
+
+
+def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
+                    temperature: float = 0.0, top_k: int | None = None,
+                    rng: jax.Array | None = None):
+    """Decode with a KV cache (O(T) per token); same semantics as
+    ``generate_greedy`` — token-identical at temperature 0."""
+    from zest_tpu.models.sampling import sample_token
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    n0 = prompt_ids.shape[0]
+    total = n0 + steps
+    if total > cfg.n_ctx:
+        raise ValueError(
+            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"n_ctx {cfg.n_ctx}"
+        )
+    cache = init_kv_cache(cfg, 1, total, dtype=params["wte"].dtype)
+    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+    keys = jax.random.split(
+        jax.random.key(0) if rng is None else rng, total - 1
+    )
+
+    def step(carry, inp):
+        pos, key = inp
+        buf, cache = carry
+        logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
+        nxt = sample_token(logits[0], key, temperature, top_k)
+        buf = jnp.where(
+            pos + 1 < n0, buf,
+            jax.lax.dynamic_update_index_in_dim(
+                buf, nxt, jnp.minimum(pos + 1, total - 1), 0
+            ),
+        )
+        return (buf, cache), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, cache), (jnp.arange(total - 1), keys)
+    )
+    return buf
+
+
 def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     rng: jax.Array | None = None):

@@ -16,6 +16,8 @@ def sample_token(logits, key, temperature: float = 0.0,
         return jnp.argmax(logits).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][-1]
+        # k > vocab means "no restriction", not an internal top_k error.
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][-1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
