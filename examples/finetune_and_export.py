@@ -27,7 +27,7 @@ from zest_tpu.models.checkpoint import (
     restore_train_state,
     save_train_state,
 )
-from zest_tpu.models.generate import _snapshot_tensors
+from zest_tpu.models.generate import snapshot_tensors
 from zest_tpu.models.training import adamw, create_state, make_train_step
 
 
@@ -39,7 +39,7 @@ def main() -> int:
     cfg = llama.LlamaConfig.from_hf(
         json.loads((snapshot / "config.json").read_text())
     )
-    params = llama.params_from_hf(_snapshot_tensors(snapshot), cfg)
+    params = llama.params_from_hf(snapshot_tensors(snapshot), cfg)
 
     tx = adamw(lr=1e-4, warmup_steps=10, total_steps=1000)
     step = make_train_step(tx, functools.partial(llama.loss_fn, cfg=cfg))

@@ -210,6 +210,45 @@ def test_http_generate_endpoint(tmp_path):
     assert done["ids"][:3] == [1, 2, 3] and len(done["ids"]) == 7
 
 
+def test_generator_cache_single_flight_and_lru(tmp_config, monkeypatch):
+    """Concurrent first requests share one load; hits refresh LRU order."""
+    import threading
+    import time
+
+    import zest_tpu.models.generate as gen_mod
+    from zest_tpu.api.http_api import HttpApi
+
+    api = HttpApi(tmp_config)
+    calls = []
+
+    def slow_load(snapshot_dir):
+        calls.append(str(snapshot_dir))
+        time.sleep(0.2)
+        return ("fake", lambda *a, **k: None)
+
+    monkeypatch.setattr(gen_mod, "load_generator", slow_load)
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(api._generator_for("/snap/a"))
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1          # single flight
+    assert all(r == ("fake", results[0][1]) for r in results)
+    # LRU: touch a, then fill past the bound — a must survive.
+    for name in ("b", "c", "d", "e"):
+        api._generator_for(f"/snap/{name}")
+    api._generator_for("/snap/a")      # refresh
+    api._generator_for("/snap/f")      # evicts b (oldest), not a
+    assert "/snap/a" in api._generators
+    assert "/snap/b" not in api._generators
+
+
 def test_http_generate_rejects_bad_body(tmp_config):
     import requests
 

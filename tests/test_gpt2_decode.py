@@ -60,3 +60,94 @@ def test_generate_cached_rejects_overflow():
     params = gpt2.init_params(jax.random.key(3), cfg)
     with pytest.raises(ValueError, match="exceeds"):
         gpt2.generate_cached(params, cfg, [1] * 60, steps=10)
+
+
+# ── MoE (Mixtral) cached decode ──
+
+
+def test_moe_decode_step_matches_full_forward():
+    """Per-position cache correctness. capacity_factor is raised so the
+    full forward drops no tokens — decode is per-token (capacity ≥
+    top_k per token, the serving semantics), so parity only holds when
+    batch-capacity contention is out of the picture."""
+    from zest_tpu.models import moe
+
+    cfg = moe.MoEConfig.tiny(capacity_factor=8.0)
+    params = moe.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(1, 8)),
+                      jnp.int32)
+    full, _aux = moe.forward(params, ids, cfg)
+    full = np.asarray(full)
+    cache = moe.init_kv_cache(cfg, 1, 8)
+    for pos in range(8):
+        logits, cache = moe.decode_step(
+            params, cache, ids[:, pos], pos, cfg
+        )
+        np.testing.assert_allclose(np.asarray(logits[0]), full[0, pos],
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_moe_batched_decode_has_per_token_capacity():
+    """Batched decode must equal independent per-row decodes: each token
+    dispatches with its own expert capacity, so B tokens crowding one
+    expert can't drop anyone to the residual path (regression: shared
+    batch capacity C = f(B) silently zeroed contributions)."""
+    from zest_tpu.models import moe
+
+    cfg = moe.MoEConfig.tiny(capacity_factor=0.1)  # tight on purpose
+    params = moe.init_params(jax.random.key(4), cfg)
+    # Bias the router hard toward expert 0 so all tokens collide.
+    params["blocks"]["moe"]["router_w"] = (
+        params["blocks"]["moe"]["router_w"].at[..., 0].set(10.0)
+    )
+    tokens = jnp.asarray([5, 9, 13], jnp.int32)
+    cache3 = moe.init_kv_cache(cfg, 3, 4)
+    batched, _ = moe.decode_step(params, cache3, tokens, 0, cfg)
+    for i in range(3):
+        cache1 = moe.init_kv_cache(cfg, 1, 4)
+        single, _ = moe.decode_step(params, cache1, tokens[i:i + 1], 0, cfg)
+        np.testing.assert_allclose(np.asarray(batched[i]),
+                                   np.asarray(single[0]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_moe_generate_cached_runs_and_is_deterministic():
+    from zest_tpu.models import moe
+
+    cfg = moe.MoEConfig.tiny()
+    params = moe.init_params(jax.random.key(1), cfg)
+    a = moe.generate_cached(params, cfg, [3, 5], steps=6)
+    b = moe.generate_cached(params, cfg, [3, 5], steps=6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (8,)
+    assert list(np.asarray(a[:2])) == [3, 5]
+
+
+def test_mixtral_generate_via_registry(tmp_path):
+    """load_generator dispatches mixtral to the MoE cached decode."""
+    import json
+
+    from zest_tpu.models import moe
+    from zest_tpu.models.generate import load_generator
+    from zest_tpu.models.safetensors_io import write_safetensors
+    from tests.test_moe import _hf_mixtral_tensors
+
+    cfg = moe.MoEConfig.tiny(n_layer=1, n_experts=4)
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    write_safetensors(snap / "model.safetensors", _hf_mixtral_tensors(cfg))
+    (snap / "config.json").write_text(json.dumps(dict(
+        model_type="mixtral", vocab_size=cfg.vocab_size,
+        hidden_size=cfg.n_embd, intermediate_size=cfg.d_ff,
+        num_hidden_layers=cfg.n_layer,
+        num_attention_heads=cfg.n_head,
+        num_key_value_heads=cfg.n_kv_head,
+        num_local_experts=cfg.n_experts,
+        num_experts_per_tok=cfg.top_k,
+        max_position_embeddings=cfg.n_ctx,
+    )))
+    model_type, generate = load_generator(snap)
+    assert model_type == "mixtral"
+    out = generate([1, 2], 5)
+    assert out.shape == (7,) and list(out[:2]) == [1, 2]

@@ -49,8 +49,10 @@ class HttpApi:
         self.shutdown_event = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
         self._lock = threading.Lock()
-        # snapshot_dir → (model_type, generate); see generate_events.
+        # snapshot_dir → (model_type, generate); see _generator_for.
         self._generators: dict = {}
+        self._gen_lock = threading.Lock()
+        self._gen_loading: dict = {}
 
     # ── Lifecycle ──
 
@@ -203,12 +205,52 @@ class HttpApi:
         else:
             yield {"event": "error", "message": result.get("error", "?")}
 
+    def _generator_for(self, snapshot_dir):
+        """Memoized ``(model_type, generate)`` per snapshot.
+
+        load_generator reads every tensor and compiles the decode scan —
+        seconds-to-minutes a real model must not pay again per request.
+        Concurrency-safe: one loader per key (latecomers wait on its
+        event instead of duplicating the load, which would hold two full
+        param trees at once); LRU-bounded so hot models stay resident.
+        """
+        from zest_tpu.models.generate import load_generator
+
+        key = str(snapshot_dir)
+        while True:
+            with self._gen_lock:
+                cached = self._generators.get(key)
+                if cached is not None:
+                    self._generators.pop(key)          # LRU: move to end
+                    self._generators[key] = cached
+                    return cached
+                pending = self._gen_loading.get(key)
+                if pending is None:
+                    pending = self._gen_loading[key] = threading.Event()
+                    loading = True
+                else:
+                    loading = False
+            if not loading:
+                pending.wait()
+                continue  # loader finished (or failed) — re-check cache
+            try:
+                cached = load_generator(snapshot_dir)
+                with self._gen_lock:
+                    self._generators[key] = cached
+                    while len(self._generators) > 4:
+                        self._generators.pop(next(iter(self._generators)))
+                return cached
+            finally:
+                with self._gen_lock:
+                    self._gen_loading.pop(key, None)
+                pending.set()
+
     def generate_events(self, repo_id: str, req: dict):
         """Generator of SSE events for one pull+decode (serving path):
         ``start`` → ``pulled`` → ``done`` with output ids (and text when
         the snapshot carries a tokenizer). Decodes with the family's
         best path via models.generate.load_generator."""
-        from zest_tpu.models.generate import load_generator, try_tokenizer
+        from zest_tpu.models.generate import try_tokenizer
         from zest_tpu.transfer.pull import pull_model
 
         yield {"event": "start", "repo_id": repo_id}
@@ -228,21 +270,7 @@ class HttpApi:
                        "message": "need ids, or prompt + a tokenizer "
                                   "in the snapshot"}
                 return
-            # Memoized per snapshot: load_generator reads every tensor
-            # and compiles the decode scan — seconds-to-minutes a real
-            # model must not pay again per request. Guarded by the API
-            # lock (handlers run in ThreadingHTTPServer threads) and
-            # bounded: evicting oldest caps resident param trees.
-            key = str(res.snapshot_dir)
-            with self._lock:
-                cached = self._generators.get(key)
-            if cached is None:
-                cached = load_generator(res.snapshot_dir)
-                with self._lock:
-                    self._generators[key] = cached
-                    while len(self._generators) > 4:
-                        self._generators.pop(next(iter(self._generators)))
-            model_type, generate = cached
+            model_type, generate = self._generator_for(res.snapshot_dir)
             top_k = req.get("top_k")
             out = generate(
                 prompt, int(req.get("steps", 20)),

@@ -20,15 +20,19 @@ class UnsupportedModelError(ValueError):
     """config.json names a family with no generation support."""
 
 
-GENERATE_FAMILIES = ("gpt2", "llama", "mistral", "qwen2")
+GENERATE_FAMILIES = ("gpt2", "llama", "mistral", "qwen2", "mixtral")
 
 
-def _snapshot_tensors(snapshot_dir: Path) -> dict[str, np.ndarray]:
+def snapshot_tensors(snapshot_dir: str | Path) -> dict[str, np.ndarray]:
+    """All tensors of a snapshot as a flat host-side name→numpy dict
+    (the input ``params_from_hf`` wants; contrast loader.load_checkpoint,
+    which lands on device). Public — examples and user code build on it.
+    """
     from zest_tpu.models.loader import snapshot_files
     from zest_tpu.models.safetensors_io import SafetensorsFile
 
     tensors: dict[str, np.ndarray] = {}
-    for path in snapshot_files(snapshot_dir):
+    for path in snapshot_files(Path(snapshot_dir)):
         with SafetensorsFile(path) as sf:
             for name in sf.names():
                 tensors[name] = sf.tensor(name)
@@ -37,6 +41,9 @@ def _snapshot_tensors(snapshot_dir: Path) -> dict[str, np.ndarray]:
             f"no .safetensors files under {snapshot_dir}"
         )
     return tensors
+
+
+_snapshot_tensors = snapshot_tensors  # back-compat alias
 
 
 def load_generator(snapshot_dir: str | Path):
@@ -57,20 +64,22 @@ def load_generator(snapshot_dir: str | Path):
             f"model_type {model_type!r} has no generation support "
             f"(supported: {', '.join(GENERATE_FAMILIES)})"
         )
-    tensors = _snapshot_tensors(snapshot_dir)
+    tensors = snapshot_tensors(snapshot_dir)
 
     if model_type == "gpt2":
         from zest_tpu.models import gpt2 as fam
 
         cfg = fam.GPT2Config.from_hf(cfg_json)
-        params = fam.params_from_hf(tensors, cfg)
-        decode = fam.generate_cached
+    elif model_type == "mixtral":
+        from zest_tpu.models import moe as fam
+
+        cfg = fam.MoEConfig.from_hf(cfg_json)
     else:  # llama family
         from zest_tpu.models import llama as fam
 
         cfg = fam.LlamaConfig.from_hf(cfg_json)
-        params = fam.params_from_hf(tensors, cfg)
-        decode = fam.generate_cached
+    params = fam.params_from_hf(tensors, cfg)
+    decode = fam.generate_cached
 
     def generate(prompt_ids, steps, temperature=0.0, top_k=None, seed=0):
         import jax

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from zest_tpu.models.sampling import sample_token
+from zest_tpu.models.sampling import cached_decode_loop, sample_token
 from zest_tpu.parallel.ring import SEQ_AXIS, ring_self_attention
 
 DATA_AXIS = "data"
@@ -656,45 +656,12 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
 def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     rng: jax.Array | None = None):
-    """Decode with a KV cache: prefill token-by-token, then produce
-    ``steps`` new tokens, all inside one jitted ``lax.scan``. Returns
-    (len(prompt)+steps,) ids. Default is greedy (token-identical to
-    ``generate_greedy``); ``temperature``/``top_k`` switch to sampling
-    (``rng`` defaults to key 0 — pass one for varied draws).
-    """
-    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-    n0 = prompt_ids.shape[0]
-    total = n0 + steps
-    if total > cfg.n_ctx:
-        raise ValueError(
-            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
-            f"n_ctx {cfg.n_ctx}"
-        )
-    cache = init_kv_cache(cfg, 1, total,
-                          dtype=params["wte"].dtype)
-    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
-    keys = jax.random.split(
-        jax.random.key(0) if rng is None else rng, total - 1
+    """KV-cached decode (O(T) per token; sampling.cached_decode_loop).
+    Default greedy, token-identical to ``generate_greedy``."""
+    return cached_decode_loop(
+        init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
+        temperature=temperature, top_k=top_k, rng=rng,
     )
-
-    def step(carry, inp):
-        pos, key = inp
-        buf, cache = carry
-        logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
-        nxt = sample_token(logits[0], key, temperature, top_k)
-        # Prompt positions keep their token; past the prompt we append.
-        buf = jnp.where(
-            pos + 1 < n0, buf,
-            jax.lax.dynamic_update_index_in_dim(
-                buf, nxt, jnp.minimum(pos + 1, total - 1), 0
-            ),
-        )
-        return (buf, cache), None
-
-    (buf, _), _ = jax.lax.scan(
-        step, (buf, cache), (jnp.arange(total - 1), keys)
-    )
-    return buf
 
 
 def generate_greedy(params, cfg: LlamaConfig, prompt_ids, steps: int):

@@ -263,12 +263,14 @@ def _rms_norm(x, g, eps):
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * g
 
 
-def _rope(x, theta):
-    """Rotary embedding over (B, T, H, D) with D split in interleaved halves."""
+def _rope(x, theta, pos0=0):
+    """Rotary embedding over (B, T, H, D) with D split in interleaved
+    halves; ``pos0`` offsets positions (incremental decode)."""
     B, T, H, D = x.shape
     half = D // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]
+    pos = pos0 + jnp.arange(T, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
     x1, x2 = x[..., :half], x[..., half:]
     rot = jnp.concatenate([x1 * cos[None, :, None, :].astype(x.dtype)
@@ -374,6 +376,77 @@ def forward(
     )
     x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
     return x @ params["lm_head"], aux / cfg.n_layer
+
+
+# ── Incremental decode (serving) ──
+
+
+def init_kv_cache(cfg: MoEConfig, batch: int, max_len: int,
+                  dtype=jnp.float32) -> dict:
+    """Static-shape per-layer K/V cache: (L, B, max_len, KV, head_dim)."""
+    shape = (cfg.n_layer, batch, max_len, cfg.n_kv_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: MoEConfig):
+    """One incremental decode step: (B,) ids at ``pos`` → ((B, vocab)
+    logits, updated cache). Each token sees per-token expert capacity
+    (≥ top_k), so decode never drops to the residual path — the correct
+    serving semantics (the training-time capacity contention is a batch
+    phenomenon)."""
+    B = token.shape[0]
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    x = params["wte"][token][:, None, :]
+
+    def body(carry, inp):
+        x, pos = carry
+        lp, ck, cv = inp
+        h = _rms_norm(x, lp["ln_attn"]["g"], cfg.rms_eps)
+        q = (h @ lp["attn"]["q_w"]).reshape(B, 1, H, D)
+        k = (h @ lp["attn"]["k_w"]).reshape(B, 1, KV, D)
+        v = (h @ lp["attn"]["v_w"]).reshape(B, 1, KV, D)
+        q, k = _rope(q, cfg.rope_theta, pos), _rope(k, cfg.rope_theta, pos)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        kk, vv = ck, cv
+        if KV != H:
+            kk = jnp.repeat(kk, H // KV, axis=2)
+            vv = jnp.repeat(vv, H // KV, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
+        valid = jnp.arange(ck.shape[1]) <= pos
+        scores = jnp.where(valid[None, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
+        x = x + out.reshape(B, 1, cfg.n_embd) @ lp["attn"]["o_w"]
+        h = _rms_norm(x, lp["ln_moe"]["g"], cfg.rms_eps)
+        # vmap over batch: each token dispatches with its own capacity
+        # (C >= top_k), so batched decode never hits the batch-capacity
+        # contention of the training-time dispatch — the documented
+        # serving semantics for any B, not just B=1.
+        moe_out = jax.vmap(
+            lambda hh: _moe_block(hh[None], lp["moe"], cfg)[0][0]
+        )(h)
+        return (x + moe_out, pos), (ck, cv)
+
+    (x, _), (new_k, new_v) = jax.lax.scan(
+        body, (x, pos), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
+    return x[:, 0, :] @ params["lm_head"], {"k": new_k, "v": new_v}
+
+
+def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
+                    temperature: float = 0.0, top_k: int | None = None,
+                    rng: jax.Array | None = None):
+    """KV-cached decode (O(T) per token; sampling.cached_decode_loop);
+    greedy by default, sampling via ``temperature``/``top_k``."""
+    from zest_tpu.models.sampling import cached_decode_loop
+
+    return cached_decode_loop(
+        init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
+        temperature=temperature, top_k=top_k, rng=rng,
+    )
 
 
 def loss_fn(params, batch, cfg: MoEConfig, remat: bool = False):

@@ -1,6 +1,8 @@
-"""Token sampling shared by the family decode paths."""
+"""Token sampling and the shared cached-decode loop for all families."""
 
 from __future__ import annotations
+
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -21,3 +23,57 @@ def sample_token(logits, key, temperature: float = 0.0,
         kth = jax.lax.top_k(logits, k)[0][-1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def cached_decode_loop(
+    init_kv_cache: Callable,
+    decode_step: Callable,
+    params,
+    cfg,
+    prompt_ids,
+    steps: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """The one decode driver every family shares: prefill token-by-token
+    through a static-shape KV cache, then produce ``steps`` new tokens,
+    all inside one jitted ``lax.scan``. Returns (len(prompt)+steps,) ids.
+
+    The family contributes only its ``init_kv_cache(cfg, batch, max_len,
+    dtype)`` and ``decode_step(params, cache, token, pos, cfg)``; the
+    overflow check, prompt-preservation ``where``, buffer clamping, and
+    key splitting live here exactly once.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    n0 = prompt_ids.shape[0]
+    total = n0 + steps
+    if total > cfg.n_ctx:
+        raise ValueError(
+            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"n_ctx {cfg.n_ctx}"
+        )
+    cache = init_kv_cache(cfg, 1, total, dtype=params["wte"].dtype)
+    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
+    keys = jax.random.split(
+        jax.random.key(0) if rng is None else rng, total - 1
+    )
+
+    def step(carry, inp):
+        pos, key = inp
+        buf, cache = carry
+        logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
+        nxt = sample_token(logits[0], key, temperature, top_k)
+        # Prompt positions keep their token; past the prompt we append.
+        buf = jnp.where(
+            pos + 1 < n0, buf,
+            jax.lax.dynamic_update_index_in_dim(
+                buf, nxt, jnp.minimum(pos + 1, total - 1), 0
+            ),
+        )
+        return (buf, cache), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, cache), (jnp.arange(total - 1), keys)
+    )
+    return buf
