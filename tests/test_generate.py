@@ -240,11 +240,14 @@ def test_generator_cache_single_flight_and_lru(tmp_config, monkeypatch):
         t.join()
     assert len(calls) == 1          # single flight
     assert all(r == ("fake", results[0][1]) for r in results)
-    # LRU: touch a, then fill past the bound — a must survive.
-    for name in ("b", "c", "d", "e"):
+    # LRU: fill exactly to the bound (a,b,c,d), HIT a to refresh its
+    # recency, then overflow — the eviction must take b, not a.
+    for name in ("b", "c", "d"):
         api._generator_for(f"/snap/{name}")
-    api._generator_for("/snap/a")      # refresh
-    api._generator_for("/snap/f")      # evicts b (oldest), not a
+    assert len(calls) == 4             # a,b,c,d each loaded once
+    api._generator_for("/snap/a")      # cache hit → move-to-end
+    assert len(calls) == 4             # ...and not a reload
+    api._generator_for("/snap/e")      # overflow evicts b (oldest)
     assert "/snap/a" in api._generators
     assert "/snap/b" not in api._generators
 

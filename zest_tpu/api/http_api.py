@@ -48,7 +48,6 @@ class HttpApi:
         self.http_requests = 0
         self.shutdown_event = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
-        self._lock = threading.Lock()
         # snapshot_dir → (model_type, generate); see _generator_for.
         self._generators: dict = {}
         self._gen_lock = threading.Lock()

@@ -43,8 +43,6 @@ def snapshot_tensors(snapshot_dir: str | Path) -> dict[str, np.ndarray]:
     return tensors
 
 
-_snapshot_tensors = snapshot_tensors  # back-compat alias
-
 
 def load_generator(snapshot_dir: str | Path):
     """Build ``(model_type, generate_fn)`` from a pulled snapshot.
