@@ -105,6 +105,53 @@ def test_mixtral_rules_cover_expert_tensors():
         assert got == want, name
 
 
+def test_resolve_dtype():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from zest_tpu.models.loader import resolve_dtype
+
+    assert resolve_dtype(None) is None
+    assert resolve_dtype("bf16") == jnp.bfloat16
+    assert resolve_dtype("BFLOAT16") == jnp.bfloat16
+    assert resolve_dtype("f32") == jnp.float32
+    with _pytest.raises(ValueError, match="int8"):
+        resolve_dtype("int8")
+
+
+def test_pull_rejects_bad_dtype_before_network(tmp_path):
+    """A landing-dtype typo fails fast — before resolving the repo."""
+    from zest_tpu.transfer.pull import pull_model
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test", endpoint="http://127.0.0.1:9",
+                 land_dtype="fp16")
+    with pytest.raises(ValueError, match="fp16"):
+        pull_model(cfg, "any/repo", no_p2p=True, device="tpu")
+
+
+def test_pull_lands_bf16(tmp_path):
+    """--dtype bf16 halves landed bytes on both the direct path and the
+    disk-resume path."""
+    from zest_tpu.transfer.pull import pull_model
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=2)
+    repo = FixtureRepo("acme/bf16-gpt2", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(
+            hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+            hf_token="hf_test", endpoint=hub.url, land_dtype="bf16",
+        )
+        res = pull_model(cfg, "acme/bf16-gpt2", no_p2p=True, device="tpu")
+        assert res.stats["hbm"]["direct"] is True
+        arr = res.params["h.0.attn.c_attn.weight"]
+        assert str(arr.dtype) == "bfloat16"
+        res.params = None
+        res2 = pull_model(cfg, "acme/bf16-gpt2", no_p2p=True, device="tpu")
+        assert res2.stats["hbm"]["direct"] is False
+        assert str(res2.params["h.0.attn.c_attn.weight"].dtype) == "bfloat16"
+
+
 # ── End-to-end: pull --device=tpu applies family rules ──
 
 

@@ -100,6 +100,8 @@ def cmd_pull(args) -> int:
     cfg = Config.load()
     if args.http_port:
         cfg.http_port = args.http_port
+    if args.dtype:
+        cfg.land_dtype = args.dtype
     swarm = None
     if not args.no_p2p:
         try:
@@ -380,6 +382,11 @@ def build_parser() -> argparse.ArgumentParser:
     pull.add_argument("repo")
     pull.add_argument("--revision", default="main")
     pull.add_argument("--device", choices=["tpu"], default=None)
+    pull.add_argument("--dtype", choices=["bf16", "f16", "f32"],
+                      default=None,
+                      help="cast tensors when landing in HBM "
+                           "(bf16 halves HBM; default keeps checkpoint "
+                           "dtype; also ZEST_TPU_DTYPE)")
     pull.add_argument("--peer", action="append",
                       help="direct peer host:port (repeatable)")
     pull.add_argument("--tracker", default=None, help="tracker announce URL")

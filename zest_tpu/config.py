@@ -90,6 +90,9 @@ class Config:
     hbm_staging_bytes: int = DEFAULT_HBM_STAGING_BYTES
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
+    # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
+    # halves HBM and transfer bytes). Resolved by models.loader.
+    land_dtype: str | None = None
 
     # ── Construction ──
 
@@ -128,6 +131,7 @@ class Config:
             ),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
+            land_dtype=env.get("ZEST_TPU_DTYPE") or None,
         )
 
     # ── Path builders (reference: src/config.zig:95-133) ──

@@ -518,6 +518,7 @@ def cp_forward(
     mesh: Mesh,
     seq_axis: str = SEQ_AXIS,
     data_axis: str = DATA_AXIS,
+    remat: bool = False,
 ) -> jax.Array:
     """Forward with the sequence dimension sharded over ``seq_axis``.
 
@@ -547,21 +548,23 @@ def cp_forward(
         return _body(
             params, x, cfg,
             lambda h, p: _ring_attention(h, p, cfg, seq_axis, tp),
-            tp_axis=tp,
+            tp_axis=tp, remat=remat,
         )
 
     return fwd(params, input_ids)
 
 
 def cp_loss_fn(params, inputs, targets, cfg: LlamaConfig, mesh: Mesh,
-               seq_axis: str = SEQ_AXIS, data_axis: str = DATA_AXIS):
+               seq_axis: str = SEQ_AXIS, data_axis: str = DATA_AXIS,
+               remat: bool = False):
     """Cross entropy with ``inputs``/``targets`` (B, T) sharded on T.
 
     The next-token shift crosses shard boundaries, so callers shift
     *globally* (see :func:`cp_train_step`) and pass aligned arrays; the
     logits stay sharded and GSPMD reduces the mean.
     """
-    logits = cp_forward(params, inputs, cfg, mesh, seq_axis, data_axis)
+    logits = cp_forward(params, inputs, cfg, mesh, seq_axis, data_axis,
+                        remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
@@ -569,19 +572,23 @@ def cp_loss_fn(params, inputs, targets, cfg: LlamaConfig, mesh: Mesh,
 
 def cp_train_step(params, batch, cfg: LlamaConfig, mesh: Mesh,
                   lr: float = 1e-3, seq_axis: str = SEQ_AXIS,
-                  data_axis: str = DATA_AXIS):
+                  data_axis: str = DATA_AXIS, remat: bool = False):
     """Context-parallel SGD step on ``batch`` (B, T+1) ids.
 
     The shift happens on the global array — GSPMD turns the one-token halo
     into a neighbor exchange — then forward+backward run through the
     shard_mapped ring (its transpose is the reverse-direction ring).
+    ``remat=True`` recomputes per-layer activations in the backward —
+    with CP this compounds with the O(T/P) sequence sharding. Remat
+    inside shard_map requires the step be jitted (eager ``closed_call``
+    under shard_map is unimplemented in JAX).
     """
     inputs, targets = batch[:, :-1], batch[:, 1:]
     sharding = NamedSharding(mesh, P(data_axis, seq_axis))
     inputs = jax.lax.with_sharding_constraint(inputs, sharding)
     targets = jax.lax.with_sharding_constraint(targets, sharding)
     loss, grads = jax.value_and_grad(cp_loss_fn)(
-        params, inputs, targets, cfg, mesh, seq_axis, data_axis
+        params, inputs, targets, cfg, mesh, seq_axis, data_axis, remat
     )
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                           params, grads)

@@ -117,28 +117,49 @@ def load_checkpoint(
             for name in sf.names():
                 if predicate is not None and not predicate(name):
                     continue
-                arr = sf.tensor(name)
-                if dtype is not None:
-                    arr = arr.astype(dtype)
-                host[name] = arr
+                host[name] = sf.tensor(name)
             # Commit per file: one batched transfer per shard keeps host
             # peak at ~one safetensors file (the sharding contract) while
-            # still amortizing the per-shape transfer setup.
-            out.update(commit_tensors(host, mesh, rules))
+            # still amortizing the per-shape transfer setup; casting
+            # lives in commit_tensors (one implementation, both paths).
+            out.update(commit_tensors(host, mesh, rules, dtype=dtype))
     return out
+
+
+def resolve_dtype(name: str | None):
+    """Landing-dtype names (config/CLI) → jnp dtype, None = keep."""
+    if name is None:
+        return None
+    import jax.numpy as jnp
+
+    table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+             "f16": jnp.float16, "float16": jnp.float16,
+             "f32": jnp.float32, "float32": jnp.float32}
+    try:
+        return table[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown landing dtype {name!r} "
+            f"(supported: {', '.join(sorted(table))})"
+        ) from None
 
 
 def commit_tensors(
     host: dict[str, np.ndarray],
     mesh: Mesh | None = None,
     rules: ShardRules | None = None,
+    dtype=None,
 ) -> dict[str, jax.Array]:
     """One BATCHED ``device_put`` for a whole tensor dict.
 
     Committing per tensor costs a transfer-setup round trip per unique
     shape — seconds for a checkpoint of ~dozens of shapes on a remote
     chip (measured ~0.1s/shape vs ~30ms for the whole batched commit);
-    a single call lets the runtime pipeline every buffer."""
+    a single call lets the runtime pipeline every buffer. ``dtype``
+    optionally casts on the host first (f32 checkpoints land bf16 at
+    half the HBM and half the transfer bytes)."""
+    if dtype is not None:
+        host = {n: np.asarray(a).astype(dtype) for n, a in host.items()}
     names = list(host)
     if mesh is None:
         shardings = None
@@ -170,6 +191,7 @@ def stage_snapshot_to_hbm(
     snapshot_dir: str | Path,
     mesh: Mesh | None = None,
     rules: ShardRules | None = None,
+    dtype=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Disk-path HBM commit: read a pulled snapshot's files into device
     arrays.
@@ -181,7 +203,8 @@ def stage_snapshot_to_hbm(
     — the "HBM commit" stage of the BASELINE per-stage timing).
     """
     t0 = time.monotonic()
-    params = load_checkpoint(snapshot_dir, mesh=mesh, rules=rules)
+    params = load_checkpoint(snapshot_dir, mesh=mesh, rules=rules,
+                             dtype=dtype)
     for arr in params.values():
         arr.block_until_ready()
     dt = time.monotonic() - t0
@@ -193,6 +216,7 @@ def stage_cached_to_hbm(
     recs_with_headers,
     mesh: Mesh | None = None,
     rules: ShardRules | None = None,
+    dtype=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -212,7 +236,7 @@ def stage_cached_to_hbm(
         # One batched commit per checkpoint shard (see load_checkpoint's
         # note: amortized transfer setup, file-bounded host peak).
         host = land_tensors(bridge.cache, rec, header, bridge=bridge)
-        params.update(commit_tensors(host, mesh, rules))
+        params.update(commit_tensors(host, mesh, rules, dtype=dtype))
         del host
     for arr in params.values():
         arr.block_until_ready()

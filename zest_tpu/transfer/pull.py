@@ -63,6 +63,12 @@ def pull_model(
     log=print,
 ) -> PullResult:
     t0 = time.monotonic()
+    # Validate the landing dtype BEFORE any network work: a config typo
+    # (ZEST_TPU_DTYPE=fp16) must fail fast here, not be swallowed by the
+    # staging try/excepts after a multi-GB warm fetch.
+    from zest_tpu.models.loader import resolve_dtype
+
+    land_dtype = resolve_dtype(cfg.land_dtype)
     hub = HubClient(cfg)
 
     commit_sha = hub.resolve_revision(repo_id, revision)
@@ -143,7 +149,8 @@ def pull_model(
 
             mesh = mesh_from_config(cfg.mesh)
         hbm_params, hbm_stats = _try_direct_stage(
-            bridge, hub, repo_id, revision, files, snapshot_dir, mesh, log
+            bridge, hub, repo_id, revision, files, snapshot_dir, mesh,
+            land_dtype, log,
         )
         authenticated = authenticated or bridge.cas is not None
 
@@ -195,6 +202,7 @@ def pull_model(
             hbm_params, hbm_stats = stage_snapshot_to_hbm(
                 snapshot_dir, mesh=mesh,
                 rules=shard_rules_for_snapshot(snapshot_dir),
+                dtype=land_dtype,
             )
         except Exception as exc:  # noqa: BLE001
             log(f"HBM staging failed ({exc}); files remain in "
@@ -207,7 +215,7 @@ def pull_model(
 
 
 def _try_direct_stage(
-    bridge, hub, repo_id, revision, files, snapshot_dir, mesh, log
+    bridge, hub, repo_id, revision, files, snapshot_dir, mesh, dtype, log
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
@@ -242,6 +250,7 @@ def _try_direct_stage(
             bridge, recs_with_headers, mesh=mesh,
             rules=_landing_rules(hub, repo_id, revision, files,
                                  snapshot_dir),
+            dtype=dtype,
         )
         hbm_stats["warm"] = warm
         return params, hbm_stats
