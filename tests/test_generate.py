@@ -286,6 +286,26 @@ def test_http_generate_rejects_bad_body(tmp_config):
         api.close()
 
 
+def test_cli_pull_profile_writes_trace(tmp_path, monkeypatch, capsys):
+    """--profile wraps the pull in jax.profiler.trace and produces a
+    TensorBoard-consumable trace directory."""
+    from zest_tpu import cli
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=1)
+    repo = FixtureRepo("acme/prof-cli", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        monkeypatch.setenv("HF_HOME", str(tmp_path / "hf"))
+        monkeypatch.setenv("ZEST_CACHE_DIR", str(tmp_path / "zest"))
+        monkeypatch.setenv("HF_TOKEN", "hf_test")
+        monkeypatch.setenv("HF_ENDPOINT", hub.url)
+        trace = tmp_path / "trace"
+        rc = cli.main(["pull", "acme/prof-cli", "--no-p2p", "--no-seed",
+                       "--profile", str(trace)])
+    assert rc == 0
+    assert "profiler trace written" in capsys.readouterr().out
+    assert any(p.is_file() for p in trace.rglob("*"))
+
+
 def test_cli_generate_requires_prompt_or_ids(tmp_path, monkeypatch, capsys):
     from zest_tpu import cli
 

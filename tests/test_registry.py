@@ -120,7 +120,8 @@ def test_resolve_dtype():
 
 
 def test_pull_rejects_bad_dtype_before_network(tmp_path):
-    """A landing-dtype typo fails fast — before resolving the repo."""
+    """A landing-dtype typo fails fast — before resolving the repo —
+    but only the TPU path consumes it (plain pulls ignore it)."""
     from zest_tpu.transfer.pull import pull_model
 
     cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
@@ -128,6 +129,25 @@ def test_pull_rejects_bad_dtype_before_network(tmp_path):
                  land_dtype="fp16")
     with pytest.raises(ValueError, match="fp16"):
         pull_model(cfg, "any/repo", no_p2p=True, device="tpu")
+    # Non-TPU pull never touches land_dtype: it fails on the (closed)
+    # endpoint instead, proving dtype validation didn't abort it.
+    with pytest.raises(Exception) as ei:
+        pull_model(cfg, "any/repo", no_p2p=True)
+    assert not isinstance(ei.value, ValueError) or "fp16" not in str(ei.value)
+
+
+def test_commit_tensors_dtype_skips_integers():
+    """--dtype casts floats only; integer buffers keep their dtype."""
+    import jax.numpy as jnp
+
+    from zest_tpu.models.loader import commit_tensors
+
+    host = {"w": np.ones((4, 4), np.float32),
+            "ids": np.arange(4, dtype=np.int64)}
+    out = commit_tensors(host, dtype=jnp.bfloat16)
+    assert str(out["w"].dtype) == "bfloat16"
+    assert str(out["ids"].dtype) in ("int64", "int32")  # x64-dependent
+    np.testing.assert_array_equal(np.asarray(out["ids"]), host["ids"])
 
 
 def test_pull_lands_bf16(tmp_path):

@@ -133,10 +133,29 @@ def cmd_pull(args) -> int:
                   file=sys.stderr)
             return 2
         pod_addrs[int(idx)] = (host, int(port))
-    res = pull_model(cfg, args.repo, revision=args.revision,
-                     device=args.device, swarm=swarm, no_p2p=args.no_p2p,
-                     pod=pod, pods=args.pods, pod_index=args.pod_index,
-                     pod_addrs=pod_addrs)
+    import contextlib
+
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        # Standard JAX profiler hook (SURVEY.md §5 tracing): the whole
+        # pull — CAS, distribution round, HBM commit — lands in one
+        # TensorBoard/Perfetto trace directory.
+        import jax
+
+        profile_ctx = jax.profiler.trace(args.profile)
+    try:
+        with profile_ctx:
+            res = pull_model(cfg, args.repo, revision=args.revision,
+                             device=args.device, swarm=swarm,
+                             no_p2p=args.no_p2p, pod=pod, pods=args.pods,
+                             pod_index=args.pod_index, pod_addrs=pod_addrs)
+    except ValueError as exc:
+        # Config-validation errors (e.g. a bad ZEST_TPU_DTYPE) follow
+        # the CLI's error contract, not a traceback.
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
     print(f"✓ {args.repo} -> {res.snapshot_dir}")
     _print_pull_stats(res.stats)
     if not args.no_seed:
@@ -382,6 +401,9 @@ def build_parser() -> argparse.ArgumentParser:
     pull.add_argument("repo")
     pull.add_argument("--revision", default="main")
     pull.add_argument("--device", choices=["tpu"], default=None)
+    pull.add_argument("--profile", metavar="DIR", default=None,
+                      help="write a JAX profiler trace of the pull "
+                           "(view with TensorBoard/Perfetto)")
     pull.add_argument("--dtype", choices=["bf16", "f16", "f32"],
                       default=None,
                       help="cast tensors when landing in HBM "

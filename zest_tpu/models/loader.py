@@ -156,10 +156,17 @@ def commit_tensors(
     shape — seconds for a checkpoint of ~dozens of shapes on a remote
     chip (measured ~0.1s/shape vs ~30ms for the whole batched commit);
     a single call lets the runtime pipeline every buffer. ``dtype``
-    optionally casts on the host first (f32 checkpoints land bf16 at
-    half the HBM and half the transfer bytes)."""
+    optionally casts *floating* tensors on the host first (f32
+    checkpoints land bf16 at half the HBM and half the transfer bytes);
+    integer/bool tensors keep their dtype — casting a token-id or
+    position buffer would silently corrupt it. ``copy=False`` keeps the
+    matched-dtype case free (no doubled host peak)."""
     if dtype is not None:
-        host = {n: np.asarray(a).astype(dtype) for n, a in host.items()}
+        host = {
+            n: (np.asarray(a).astype(dtype, copy=False)
+                if np.issubdtype(np.asarray(a).dtype, np.floating) else a)
+            for n, a in host.items()
+        }
     names = list(host)
     if mesh is None:
         shardings = None

@@ -65,10 +65,13 @@ def pull_model(
     t0 = time.monotonic()
     # Validate the landing dtype BEFORE any network work: a config typo
     # (ZEST_TPU_DTYPE=fp16) must fail fast here, not be swallowed by the
-    # staging try/excepts after a multi-GB warm fetch.
-    from zest_tpu.models.loader import resolve_dtype
+    # staging try/excepts after a multi-GB warm fetch. Only the TPU
+    # device path consumes it — a plain pull ignores a bad value.
+    land_dtype = None
+    if device == "tpu":
+        from zest_tpu.models.loader import resolve_dtype
 
-    land_dtype = resolve_dtype(cfg.land_dtype)
+        land_dtype = resolve_dtype(cfg.land_dtype)
     hub = HubClient(cfg)
 
     commit_sha = hub.resolve_revision(repo_id, revision)
