@@ -148,6 +148,13 @@ def test_commit_tensors_dtype_skips_integers():
     assert str(out["w"].dtype) == "bfloat16"
     assert str(out["ids"].dtype) in ("int64", "int32")  # x64-dependent
     np.testing.assert_array_equal(np.asarray(out["ids"]), host["ids"])
+    # ml_dtypes sources (bf16 checkpoints) are NOT np.floating subtypes
+    # but must still cast — e.g. upcasting a bf16 checkpoint to f32.
+    import ml_dtypes
+
+    host = {"w": np.ones((2, 2), ml_dtypes.bfloat16)}
+    out = commit_tensors(host, dtype=jnp.float32)
+    assert str(out["w"].dtype) == "float32"
 
 
 def test_pull_lands_bf16(tmp_path):

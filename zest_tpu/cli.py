@@ -143,17 +143,23 @@ def cmd_pull(args) -> int:
         import jax
 
         profile_ctx = jax.profiler.trace(args.profile)
-    try:
-        with profile_ctx:
-            res = pull_model(cfg, args.repo, revision=args.revision,
-                             device=args.device, swarm=swarm,
-                             no_p2p=args.no_p2p, pod=pod, pods=args.pods,
-                             pod_index=args.pod_index, pod_addrs=pod_addrs)
-    except ValueError as exc:
-        # Config-validation errors (e.g. a bad ZEST_TPU_DTYPE) follow
-        # the CLI's error contract, not a traceback.
-        print(f"error: {exc}", file=sys.stderr)
-        return 2
+    if args.device == "tpu":
+        # Validate up front with the CLI's error contract; a blanket
+        # except around the pull would misreport deep failures (e.g.
+        # requests' JSONDecodeError subclasses ValueError) as config
+        # errors.
+        from zest_tpu.models.loader import resolve_dtype
+
+        try:
+            resolve_dtype(cfg.land_dtype)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+    with profile_ctx:
+        res = pull_model(cfg, args.repo, revision=args.revision,
+                         device=args.device, swarm=swarm,
+                         no_p2p=args.no_p2p, pod=pod, pods=args.pods,
+                         pod_index=args.pod_index, pod_addrs=pod_addrs)
     if args.profile:
         print(f"profiler trace written to {args.profile}")
     print(f"✓ {args.repo} -> {res.snapshot_dir}")

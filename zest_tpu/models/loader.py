@@ -156,17 +156,22 @@ def commit_tensors(
     shape — seconds for a checkpoint of ~dozens of shapes on a remote
     chip (measured ~0.1s/shape vs ~30ms for the whole batched commit);
     a single call lets the runtime pipeline every buffer. ``dtype``
-    optionally casts *floating* tensors on the host first (f32
+    optionally casts *non-integer* tensors on the host first (f32
     checkpoints land bf16 at half the HBM and half the transfer bytes);
     integer/bool tensors keep their dtype — casting a token-id or
-    position buffer would silently corrupt it. ``copy=False`` keeps the
-    matched-dtype case free (no doubled host peak)."""
+    position buffer would silently corrupt it. The filter excludes
+    int/bool rather than matching np.floating because ml_dtypes
+    extension types (the bf16 most modern checkpoints ship) are NOT
+    np.floating subtypes. ``copy=False`` keeps the matched-dtype case
+    free (no doubled host peak)."""
     if dtype is not None:
-        host = {
-            n: (np.asarray(a).astype(dtype, copy=False)
-                if np.issubdtype(np.asarray(a).dtype, np.floating) else a)
-            for n, a in host.items()
-        }
+        def cast(a):
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+                return a
+            return a.astype(dtype, copy=False)
+
+        host = {n: cast(a) for n, a in host.items()}
     names = list(host)
     if mesh is None:
         shardings = None
