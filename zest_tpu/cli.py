@@ -143,18 +143,19 @@ def cmd_pull(args) -> int:
         import jax
 
         profile_ctx = jax.profiler.trace(args.profile)
-    if args.device == "tpu":
-        # Validate up front with the CLI's error contract; a blanket
-        # except around the pull would misreport deep failures (e.g.
-        # requests' JSONDecodeError subclasses ValueError) as config
-        # errors.
-        from zest_tpu.models.loader import resolve_dtype
+    # Validate cheap config up front with the CLI's error contract; a
+    # blanket except around the pull would misreport deep failures
+    # (e.g. requests' JSONDecodeError subclasses ValueError) as config
+    # errors.
+    try:
+        cfg.model_cache_dir(args.repo)  # repo-id syntax
+        if args.device == "tpu":
+            from zest_tpu.models.loader import resolve_dtype
 
-        try:
             resolve_dtype(cfg.land_dtype)
-        except ValueError as exc:
-            print(f"error: {exc}", file=sys.stderr)
-            return 2
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     with profile_ctx:
         res = pull_model(cfg, args.repo, revision=args.revision,
                          device=args.device, swarm=swarm,

@@ -38,37 +38,49 @@ def cached_decode_loop(
 ) -> jax.Array:
     """The one decode driver every family shares: prefill token-by-token
     through a static-shape KV cache, then produce ``steps`` new tokens,
-    all inside one jitted ``lax.scan``. Returns (len(prompt)+steps,) ids.
+    all inside one jitted ``lax.scan``.
+
+    ``prompt_ids`` is (T0,) for one sequence — returns (T0+steps,) —
+    or (B, T0) for a batch of equal-length prompts — returns
+    (B, T0+steps), each row decoded independently (per-row sample keys).
 
     The family contributes only its ``init_kv_cache(cfg, batch, max_len,
     dtype)`` and ``decode_step(params, cache, token, pos, cfg)``; the
     overflow check, prompt-preservation ``where``, buffer clamping, and
     key splitting live here exactly once.
     """
-    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-    n0 = prompt_ids.shape[0]
+    prompt = jnp.asarray(prompt_ids, jnp.int32)
+    batched = prompt.ndim == 2
+    if not batched:
+        prompt = prompt[None, :]
+    B, n0 = prompt.shape
     total = n0 + steps
     if total > cfg.n_ctx:
         raise ValueError(
             f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
             f"n_ctx {cfg.n_ctx}"
         )
-    cache = init_kv_cache(cfg, 1, total, dtype=params["wte"].dtype)
-    buf = jnp.zeros((total,), jnp.int32).at[:n0].set(prompt_ids)
-    keys = jax.random.split(
-        jax.random.key(0) if rng is None else rng, total - 1
-    )
+    cache = init_kv_cache(cfg, B, total, dtype=params["wte"].dtype)
+    buf = jnp.zeros((B, total), jnp.int32).at[:, :n0].set(prompt)
+    key = jax.random.key(0) if rng is None else rng
+    if not jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        # Legacy raw uint32 keys (jax.random.PRNGKey) can't reshape
+        # after split — normalize to a typed key first.
+        key = jax.random.wrap_key_data(key)
+    keys = jax.random.split(key, (total - 1) * B).reshape(total - 1, B)
 
     def step(carry, inp):
-        pos, key = inp
+        pos, keys_b = inp
         buf, cache = carry
-        logits, cache = decode_step(params, cache, buf[None, pos], pos, cfg)
-        nxt = sample_token(logits[0], key, temperature, top_k)
+        logits, cache = decode_step(params, cache, buf[:, pos], pos, cfg)
+        nxt = jax.vmap(
+            lambda l, k: sample_token(l, k, temperature, top_k)
+        )(logits, keys_b)
         # Prompt positions keep their token; past the prompt we append.
         buf = jnp.where(
             pos + 1 < n0, buf,
-            jax.lax.dynamic_update_index_in_dim(
-                buf, nxt, jnp.minimum(pos + 1, total - 1), 0
+            jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], jnp.minimum(pos + 1, total - 1), 1
             ),
         )
         return (buf, cache), None
@@ -76,4 +88,4 @@ def cached_decode_loop(
     (buf, _), _ = jax.lax.scan(
         step, (buf, cache), (jnp.arange(total - 1), keys)
     )
-    return buf
+    return buf if batched else buf[0]
