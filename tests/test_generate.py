@@ -129,6 +129,57 @@ def test_sampling_semantics():
     assert 0 <= tok < 4
 
 
+def test_nucleus_sampling():
+    """top_p keeps the smallest prefix of the sorted distribution whose
+    mass reaches p (the crossing token included, HF semantics); a tiny p
+    degenerates to greedy; p>=1 is unrestricted; composes with top_k and
+    is jit-safe (static shapes throughout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from zest_tpu.models.sampling import sample_token
+
+    # probs ~ [0.643, 0.237, 0.087, 0.032, 0.00059] over tokens 3,0,2,4,1
+    logits = jnp.asarray([4.0, -2.0, 3.0, 5.0, 2.0])
+    for s in range(16):
+        # p=0.7: mass before token 0 is 0.643 < 0.7, before token 2 is
+        # 0.88 >= 0.7 — nucleus is exactly {3, 0}.
+        tok = int(sample_token(logits, jax.random.key(s),
+                               temperature=1.0, top_p=0.7))
+        assert tok in (0, 3), tok
+        # tiny p: only the argmax survives.
+        tok = int(sample_token(logits, jax.random.key(s),
+                               temperature=3.0, top_p=1e-6))
+        assert tok == 3
+        # top_k=3 ∩ top_p=0.7 is still {3, 0}.
+        tok = int(sample_token(logits, jax.random.key(s),
+                               temperature=1.0, top_k=3, top_p=0.7))
+        assert tok in (0, 3)
+    # p >= 1 imposes no restriction (and must not mask the tail away).
+    seen = {int(sample_token(logits, jax.random.key(s),
+                             temperature=50.0, top_p=1.0))
+            for s in range(64)}
+    assert len(seen) >= 4
+    # Jit-compatible (the decode loop jits the whole scan around it).
+    jitted = jax.jit(lambda l, k: sample_token(l, k, 1.0, None, 0.7))
+    assert int(jitted(logits, jax.random.key(0))) in (0, 3)
+    # Out-of-range p is an error, not a silent no-restriction.
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="top_p"):
+            sample_token(logits, jax.random.key(0), 1.0, None, bad)
+
+
+def test_generate_top_p_threading(tmp_path):
+    snap = write_gpt2_snapshot(tmp_path / "snap")
+    _, generate = load_generator(snap)
+    g = generate([1, 2], 5)
+    # A degenerate nucleus is greedy regardless of temperature.
+    s = generate([1, 2], 5, temperature=2.0, top_p=1e-6)
+    np.testing.assert_array_equal(g, s)
+    s2 = generate([1, 2], 5, temperature=1.5, top_p=0.9, seed=3)
+    assert s2.shape == (7,)
+
+
 def test_gpt2_sampling_matches_greedy_at_topk1(tmp_path):
     snap = write_gpt2_snapshot(tmp_path / "snap")
     _, generate = load_generator(snap)

@@ -271,10 +271,12 @@ class HttpApi:
                 return
             model_type, generate = self._generator_for(res.snapshot_dir)
             top_k = req.get("top_k")
+            top_p = req.get("top_p")
             out = generate(
                 prompt, int(req.get("steps", 20)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=None if top_k is None else int(top_k),
+                top_p=None if top_p is None else float(top_p),
                 seed=int(req.get("seed", 0)),
             )
             payload = {"event": "done", "model_type": model_type,

@@ -241,7 +241,7 @@ def cmd_generate(args) -> int:
     try:
         model_type, generate = load_generator(res.snapshot_dir)
         out = generate(prompt, args.steps, temperature=args.temperature,
-                       top_k=args.top_k, seed=args.seed)
+                       top_k=args.top_k, top_p=args.top_p, seed=args.seed)
     except (UnsupportedModelError, FileNotFoundError, ValueError) as exc:
         # ValueError: context overflow (prompt+steps > n_ctx) and kin —
         # a usage problem, not a crash.
@@ -457,6 +457,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="0 = greedy (default); >0 samples")
     gen.add_argument("--top-k", type=int, default=None,
                      help="restrict sampling to the k most likely tokens")
+    gen.add_argument("--top-p", type=float, default=None,
+                     help="nucleus sampling: restrict to the smallest set "
+                          "of tokens with cumulative probability top_p")
     gen.add_argument("--seed", type=int, default=0,
                      help="sampling PRNG seed (default 0)")
     gen.add_argument("--no-p2p", action="store_true")

@@ -48,11 +48,12 @@ def load_generator(snapshot_dir: str | Path):
     """Build ``(model_type, generate_fn)`` from a pulled snapshot.
 
     ``generate_fn(prompt_ids, steps, temperature=0.0, top_k=None,
-    seed=0) -> np.ndarray`` decodes with a KV cache (O(T) per token,
-    every family); greedy by default, sampling when
-    ``temperature>0``. Raises :class:`UnsupportedModelError` for
-    families without generation support and ``FileNotFoundError`` for
-    missing config/weights.
+    top_p=None, seed=0) -> np.ndarray`` decodes with a KV cache (O(T)
+    per token, every family); greedy by default, sampling when
+    ``temperature>0``, optionally top-k- and/or nucleus-restricted.
+    Raises :class:`UnsupportedModelError` for families without
+    generation support and ``FileNotFoundError`` for missing
+    config/weights.
     """
     snapshot_dir = Path(snapshot_dir)
     cfg_json = json.loads((snapshot_dir / "config.json").read_text())
@@ -79,12 +80,13 @@ def load_generator(snapshot_dir: str | Path):
     params = fam.params_from_hf(tensors, cfg)
     decode = fam.generate_cached
 
-    def generate(prompt_ids, steps, temperature=0.0, top_k=None, seed=0):
+    def generate(prompt_ids, steps, temperature=0.0, top_k=None,
+                 top_p=None, seed=0):
         import jax
 
         return np.asarray(decode(
             params, cfg, prompt_ids, steps, temperature=temperature,
-            top_k=top_k, rng=jax.random.key(seed),
+            top_k=top_k, top_p=top_p, rng=jax.random.key(seed),
         ))
 
     return model_type, generate

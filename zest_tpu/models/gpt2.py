@@ -340,6 +340,7 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
 
 def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
+                    top_p: float | None = None,
                     rng: jax.Array | None = None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop);
     token-identical to ``generate_greedy`` at temperature 0."""
@@ -347,12 +348,13 @@ def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
 
     return cached_decode_loop(
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
-        temperature=temperature, top_k=top_k, rng=rng,
+        temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
     )
 
 
 def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
+                    top_p: float | None = None,
                     rng: jax.Array | None = None):
     """Decode via ``lax.scan`` over a fixed-size buffer (static shapes;
     no Python loop under jit). Returns (len(prompt)+steps,) ids. Default
@@ -376,7 +378,7 @@ def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int,
     def step(carry, key):
         buf, pos = carry
         logits = forward(params, buf[None, :], cfg)[0]
-        nxt = sample_token(logits[pos - 1], key, temperature, top_k)
+        nxt = sample_token(logits[pos - 1], key, temperature, top_k, top_p)
         buf = buf.at[pos].set(nxt)
         return (buf, pos + 1), nxt
 

@@ -438,6 +438,7 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: MoEConfig):
 
 def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
+                    top_p: float | None = None,
                     rng: jax.Array | None = None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop);
     greedy by default, sampling via ``temperature``/``top_k``."""
@@ -445,7 +446,7 @@ def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
 
     return cached_decode_loop(
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
-        temperature=temperature, top_k=top_k, rng=rng,
+        temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
     )
 
 

@@ -9,11 +9,17 @@ import jax.numpy as jnp
 
 
 def sample_token(logits, key, temperature: float = 0.0,
-                 top_k: int | None = None) -> jax.Array:
+                 top_k: int | None = None,
+                 top_p: float | None = None) -> jax.Array:
     """One token from (vocab,) logits: greedy at ``temperature<=0``,
     otherwise softmax sampling at the given temperature, optionally
-    restricted to the ``top_k`` most likely tokens. Static-shape (the
-    top-k restriction masks, never gathers); jittable."""
+    restricted to the ``top_k`` most likely tokens and/or the nucleus
+    of cumulative probability ``top_p``. Static-shape (both
+    restrictions mask, never gather); jittable."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # A silently-ignored top_p=0 would turn the most restrictive
+        # request into unrestricted sampling (HF raises here too).
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature <= 0.0:
         return jnp.argmax(logits).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
@@ -22,6 +28,20 @@ def sample_token(logits, key, temperature: float = 0.0,
         k = min(int(top_k), logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][-1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p. ``cum - probs < top_p`` keeps every
+        # token whose mass *before* it is under the budget — so the
+        # most likely token always survives and the boundary token that
+        # crosses the budget is included (HF semantics).
+        sorted_logits = jnp.sort(logits)[::-1]
+        probs = jax.nn.softmax(sorted_logits)
+        cum = jnp.cumsum(probs)
+        kept = jnp.sum(cum - probs < top_p).astype(jnp.int32)
+        cutoff = jax.lax.dynamic_index_in_dim(
+            sorted_logits, jnp.maximum(kept - 1, 0), keepdims=False
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
@@ -34,6 +54,7 @@ def cached_decode_loop(
     steps: int,
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """The one decode driver every family shares: prefill token-by-token
@@ -74,7 +95,7 @@ def cached_decode_loop(
         buf, cache = carry
         logits, cache = decode_step(params, cache, buf[:, pos], pos, cfg)
         nxt = jax.vmap(
-            lambda l, k: sample_token(l, k, temperature, top_k)
+            lambda l, k: sample_token(l, k, temperature, top_k, top_p)
         )(logits, keys_b)
         # Prompt positions keep their token; past the prompt we append.
         buf = jnp.where(
