@@ -167,6 +167,93 @@ def test_nucleus_sampling():
     for bad in (0.0, -0.5, 1.5):
         with pytest.raises(ValueError, match="top_p"):
             sample_token(logits, jax.random.key(0), 1.0, None, bad)
+    # Ties at the nucleus boundary don't widen it: with ALL logits
+    # equal, a tiny p must still degenerate to one token (the stable
+    # argsort keeps the earliest index, as HF's sorted-gather does).
+    flat = jnp.zeros((16,))
+    for s in range(8):
+        assert int(sample_token(flat, jax.random.key(s),
+                                temperature=5.0, top_p=1e-6)) == 0
+
+
+def test_eos_stop_and_trim(tmp_path):
+    """A generated eos_token_id freezes the row and the generator trims
+    just past it; stop_at_eos=False keeps the full buffer; prompt
+    occurrences of the EOS id don't stop anything."""
+    snap = write_gpt2_snapshot(tmp_path / "snap")
+    _, generate = load_generator(snap)
+    base = generate([1, 2], 8)          # no eos_token_id in config: full
+    assert base.shape == (10,)
+    eos = int(base[4])                  # the 3rd generated token
+    # The tiny model may repeat tokens: the stop happens at the FIRST
+    # generated occurrence, wherever that is.
+    first = 2 + next(i for i, t in enumerate(base[2:]) if t == eos)
+    cfg = json.loads((snap / "config.json").read_text())
+    cfg["eos_token_id"] = eos
+    (snap / "config.json").write_text(json.dumps(cfg))
+    _, generate = load_generator(snap)
+    assert generate.eos_id == eos
+    out = generate([1, 2], 8)
+    np.testing.assert_array_equal(out, base[:first + 1])
+    assert int(out[-1]) == eos
+    # Full buffer on request; the frozen tail repeats EOS.
+    full = generate([1, 2], 8, stop_at_eos=False)
+    assert full.shape == (10,)
+    np.testing.assert_array_equal(full, base)
+    # EOS in the *prompt* doesn't count as a stop.
+    out = generate([1, eos, 2], 8)
+    assert len(out) > 3
+    # eos_token_id as a list (HF allows several): first entry is used.
+    cfg["eos_token_id"] = [eos, 999]
+    (snap / "config.json").write_text(json.dumps(cfg))
+    _, generate = load_generator(snap)
+    assert generate.eos_id == eos
+
+
+def test_eos_freezes_rows_independently():
+    """Batched decode: a row that generates EOS pads the rest of its row
+    with EOS without disturbing the other rows."""
+    import jax
+
+    from zest_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompts = np.asarray([[3, 7, 1], [5, 2, 9]])
+    base = np.asarray(llama.generate_cached(params, cfg, prompts, 8))
+    eos = int(base[0, 4])
+    if eos == int(base[1, 4]):  # want the rows to stop at different times
+        eos = int(base[0, 5])
+    out = np.asarray(llama.generate_cached(params, cfg, prompts, 8,
+                                           eos_id=eos))
+    row0 = list(base[0]).index(eos, 3)
+    assert set(out[0, row0:].tolist()) == {eos}
+    np.testing.assert_array_equal(out[0, :row0 + 1], base[0, :row0 + 1])
+    # Row 1 is untouched up to its own first generated EOS (if any).
+    hits = [i for i, t in enumerate(base[1]) if t == eos and i >= 3]
+    end1 = hits[0] + 1 if hits else base.shape[1]
+    np.testing.assert_array_equal(out[1, :end1], base[1, :end1])
+
+
+def test_on_token_streams_every_position():
+    """The ordered io_callback reports every written position in order,
+    and the streamed tokens agree with the returned buffer."""
+    import jax
+
+    from zest_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    seen = []
+    out = np.asarray(llama.generate_cached(
+        params, cfg, [3, 7, 1], 6,
+        on_token=lambda pos, toks: seen.append(
+            (int(pos), int(np.asarray(toks).ravel()[0]))),
+    ))
+    jax.effects_barrier()
+    assert [p for p, _ in seen] == list(range(1, 9))
+    for pos, tid in seen:
+        assert out[pos] == tid
 
 
 def test_generate_top_p_threading(tmp_path):
@@ -387,3 +474,38 @@ def test_cli_generate_requires_prompt_or_ids(tmp_path, monkeypatch, capsys):
     err = capsys.readouterr().err
     assert "required" in err and "tokenizer" in err
     assert "exceeds" in err and "positive" in err
+
+def test_http_generate_streams_tokens(tmp_path):
+    """POST /v1/generate with stream:true: one `token` SSE event per
+    generated position (prompt prefill filtered out), consistent with
+    the final `done` ids."""
+    import requests
+
+    from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.config import Config
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=2)
+    repo = FixtureRepo("acme/api-stream", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                     hf_token="hf_test", endpoint=hub.url, http_port=0)
+        api = HttpApi(cfg)
+        port = api.start()
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{port}/v1/generate",
+                json={"repo_id": "acme/api-stream", "ids": [1, 2, 3],
+                      "steps": 4, "stream": True},
+                timeout=120, stream=True,
+            )
+            events = [json.loads(line[len("data: "):])
+                      for line in r.iter_lines(decode_unicode=True)
+                      if line.startswith("data: ")]
+        finally:
+            api.close()
+    done = events[-1]
+    assert done["event"] == "done", events
+    tokens = [e for e in events if e["event"] == "token"]
+    assert [t["pos"] for t in tokens] == [3, 4, 5, 6]
+    for t in tokens:
+        assert done["ids"][t["pos"]] == t["id"]

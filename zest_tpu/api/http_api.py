@@ -246,9 +246,15 @@ class HttpApi:
 
     def generate_events(self, repo_id: str, req: dict):
         """Generator of SSE events for one pull+decode (serving path):
-        ``start`` → ``pulled`` → ``done`` with output ids (and text when
-        the snapshot carries a tokenizer). Decodes with the family's
-        best path via models.generate.load_generator."""
+        ``start`` → ``pulled`` → [``token``…] → ``done`` with output ids
+        (and text when the snapshot carries a tokenizer). Decodes with
+        the family's best path via models.generate.load_generator.
+
+        With ``"stream": true`` each generated token is its own SSE
+        event the moment the scan produces it — an ordered io_callback
+        inside the compiled decode posts to a queue this generator
+        drains (one host round-trip per token: serving UX; the
+        non-streamed path stays single-dispatch)."""
         from zest_tpu.models.generate import try_tokenizer
         from zest_tpu.transfer.pull import pull_model
 
@@ -272,20 +278,105 @@ class HttpApi:
             model_type, generate = self._generator_for(res.snapshot_dir)
             top_k = req.get("top_k")
             top_p = req.get("top_p")
-            out = generate(
-                prompt, int(req.get("steps", 20)),
+            kwargs = dict(
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=None if top_k is None else int(top_k),
                 top_p=None if top_p is None else float(top_p),
                 seed=int(req.get("seed", 0)),
+                stop_at_eos=bool(req.get("stop_at_eos", True)),
             )
-            payload = {"event": "done", "model_type": model_type,
-                       "ids": [int(t) for t in out]}
-            if tok is not None:
-                payload["text"] = tok.decode(list(out))
-            yield payload
+            steps = int(req.get("steps", 20))
+            if req.get("stream"):
+                yield from self._streamed_decode(
+                    generate, model_type, prompt, steps, tok, kwargs
+                )
+                return
+            out = generate(prompt, steps, **kwargs)
+            yield self._done_event(model_type, out, tok)
         except Exception as exc:  # noqa: BLE001 - reported to client
             yield {"event": "error", "message": str(exc)}
+
+    @staticmethod
+    def _done_event(model_type: str, out, tok) -> dict:
+        payload = {"event": "done", "model_type": model_type,
+                   "ids": [int(t) for t in out]}
+        if tok is not None:
+            payload["text"] = tok.decode(list(out))
+        return payload
+
+    def _streamed_decode(self, generate, model_type: str, prompt, steps,
+                         tok, kwargs: dict):
+        """Run the decode in a worker; relay its io_callback token queue
+        as SSE events. Prompt prefill positions are filtered here (the
+        callback reports every written position), and token events stop
+        at the first generated EOS (the frozen tail repeats EOS).
+
+        A disconnected client (GeneratorExit at a yield) sets the
+        cancel flag; the next io_callback raises, aborting the rest of
+        the compiled decode instead of burning device time on an
+        abandoned stream."""
+        import queue
+
+        import numpy as np
+
+        q: queue.Queue = queue.Queue()
+        n0 = len(prompt)
+        cancelled = threading.Event()
+
+        def on_token(pos, toks):
+            if cancelled.is_set():
+                raise RuntimeError("client disconnected; decode cancelled")
+            q.put(("tok", int(pos), int(np.asarray(toks).ravel()[0])))
+
+        def worker():
+            try:
+                out = generate(prompt, steps, on_token=on_token, **kwargs)
+                # Token callbacks ride a separate host-callback thread;
+                # without the barrier the tail of them could land after
+                # the 'done' sentinel and be dropped by the drain loop.
+                import jax
+
+                jax.effects_barrier()
+                q.put(("done", out))
+            except Exception as exc:  # noqa: BLE001 - relayed as SSE
+                q.put(("error", exc))
+
+        threading.Thread(target=worker, daemon=True,
+                         name="zest-generate-stream").start()
+        eos_id = getattr(generate, "eos_id", None)
+        if not kwargs.get("stop_at_eos", True):
+            eos_id = None
+        ended = False
+        gen_ids: list[int] = []
+        sent_text = ""
+        try:
+            while True:
+                item = q.get()
+                if item[0] == "done":
+                    out = item[1]
+                    break
+                if item[0] == "error":
+                    yield {"event": "error", "message": str(item[1])}
+                    return
+                _, pos, tid = item
+                if pos >= n0 and not ended:
+                    ev = {"event": "token", "pos": pos, "id": tid}
+                    if tok is not None:
+                        # Diff of full decodes, not per-token decode:
+                        # BPE/sentencepiece merges and multi-byte UTF-8
+                        # only render correctly in context (a lone
+                        # trailing replacement char means a split byte
+                        # sequence — hold it back until it completes).
+                        gen_ids.append(tid)
+                        full = tok.decode(gen_ids)
+                        if not full.endswith("�"):
+                            ev["text"] = full[len(sent_text):]
+                            sent_text = full
+                    yield ev
+                    ended = eos_id is not None and tid == eos_id
+        finally:
+            cancelled.set()
+        yield self._done_event(model_type, out, tok)
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -241,7 +241,8 @@ def cmd_generate(args) -> int:
     try:
         model_type, generate = load_generator(res.snapshot_dir)
         out = generate(prompt, args.steps, temperature=args.temperature,
-                       top_k=args.top_k, top_p=args.top_p, seed=args.seed)
+                       top_k=args.top_k, top_p=args.top_p, seed=args.seed,
+                       stop_at_eos=not args.ignore_eos)
     except (UnsupportedModelError, FileNotFoundError, ValueError) as exc:
         # ValueError: context overflow (prompt+steps > n_ctx) and kin —
         # a usage problem, not a crash.
@@ -462,6 +463,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "of tokens with cumulative probability top_p")
     gen.add_argument("--seed", type=int, default=0,
                      help="sampling PRNG seed (default 0)")
+    gen.add_argument("--ignore-eos", action="store_true",
+                     help="decode all --steps tokens even past the "
+                          "model's eos_token_id")
     gen.add_argument("--no-p2p", action="store_true")
     gen.set_defaults(fn=cmd_generate)
 

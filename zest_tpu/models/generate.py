@@ -48,12 +48,18 @@ def load_generator(snapshot_dir: str | Path):
     """Build ``(model_type, generate_fn)`` from a pulled snapshot.
 
     ``generate_fn(prompt_ids, steps, temperature=0.0, top_k=None,
-    top_p=None, seed=0) -> np.ndarray`` decodes with a KV cache (O(T)
-    per token, every family); greedy by default, sampling when
-    ``temperature>0``, optionally top-k- and/or nucleus-restricted.
-    Raises :class:`UnsupportedModelError` for families without
-    generation support and ``FileNotFoundError`` for missing
-    config/weights.
+    top_p=None, seed=0, stop_at_eos=True, on_token=None) -> np.ndarray``
+    decodes with a KV cache (O(T) per token, every family); greedy by
+    default, sampling when ``temperature>0``, optionally top-k- and/or
+    nucleus-restricted. When the snapshot's config.json names an
+    ``eos_token_id`` and ``stop_at_eos`` is true, generation freezes
+    rows at their first generated EOS and the returned ids are trimmed
+    just past it (HF stop semantics; pass ``stop_at_eos=False`` for the
+    full fixed-length buffer). ``on_token(pos, tokens)`` streams every
+    written position from inside the compiled scan (see
+    sampling.cached_decode_loop). Raises
+    :class:`UnsupportedModelError` for families without generation
+    support and ``FileNotFoundError`` for missing config/weights.
     """
     snapshot_dir = Path(snapshot_dir)
     cfg_json = json.loads((snapshot_dir / "config.json").read_text())
@@ -79,17 +85,52 @@ def load_generator(snapshot_dir: str | Path):
         cfg = fam.LlamaConfig.from_hf(cfg_json)
     params = fam.params_from_hf(tensors, cfg)
     decode = fam.generate_cached
+    eos_id = _eos_token_id(cfg_json)
 
     def generate(prompt_ids, steps, temperature=0.0, top_k=None,
-                 top_p=None, seed=0):
+                 top_p=None, seed=0, stop_at_eos=True, on_token=None):
         import jax
 
-        return np.asarray(decode(
+        eos = eos_id if stop_at_eos else None
+        out = np.asarray(decode(
             params, cfg, prompt_ids, steps, temperature=temperature,
             top_k=top_k, top_p=top_p, rng=jax.random.key(seed),
+            eos_id=eos, on_token=on_token,
         ))
+        if eos is not None:
+            out = trim_at_eos(out, np.shape(prompt_ids)[-1], eos)
+        return out
 
+    generate.eos_id = eos_id  # callers (SSE streaming) filter on it
     return model_type, generate
+
+
+def _eos_token_id(cfg_json: dict) -> int | None:
+    """config.json's ``eos_token_id`` as one int (HF allows a list —
+    multiple stop ids; the decode-loop freeze takes one, so use the
+    first) or None when absent."""
+    eos = cfg_json.get("eos_token_id")
+    if isinstance(eos, list):
+        eos = eos[0] if eos else None
+    return None if eos is None else int(eos)
+
+
+def trim_at_eos(out: np.ndarray, n_prompt: int, eos_id: int) -> np.ndarray:
+    """Cut a decoded row just past its first *generated* EOS (prompt
+    occurrences don't count). Batched (B, T) input keeps its rectangular
+    shape — frozen rows already pad with EOS, so trimming to the longest
+    row loses nothing."""
+    if out.ndim == 2:
+        keep = 0
+        for row in out:
+            keep = max(keep, _row_end(row, n_prompt, eos_id))
+        return out[:, :keep]
+    return out[: _row_end(out, n_prompt, eos_id)]
+
+
+def _row_end(row: np.ndarray, n_prompt: int, eos_id: int) -> int:
+    hits = np.nonzero(row[n_prompt:] == eos_id)[0]
+    return len(row) if hits.size == 0 else n_prompt + int(hits[0]) + 1
 
 
 def try_tokenizer(snapshot_dir: str | Path):

@@ -341,7 +341,9 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
 def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     top_p: float | None = None,
-                    rng: jax.Array | None = None):
+                    rng: jax.Array | None = None,
+                    eos_id: int | None = None,
+                    on_token=None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop);
     token-identical to ``generate_greedy`` at temperature 0."""
     from zest_tpu.models.sampling import cached_decode_loop
@@ -349,6 +351,7 @@ def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
     return cached_decode_loop(
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+        eos_id=eos_id, on_token=on_token,
     )
 
 
@@ -358,8 +361,9 @@ def generate_greedy(params, cfg: GPT2Config, prompt_ids, steps: int,
                     rng: jax.Array | None = None):
     """Decode via ``lax.scan`` over a fixed-size buffer (static shapes;
     no Python loop under jit). Returns (len(prompt)+steps,) ids. Default
-    greedy; ``temperature``/``top_k`` switch to sampling (see
-    models.sampling.sample_token)."""
+    greedy; ``temperature``/``top_k``/``top_p`` switch to sampling (see
+    models.sampling.sample_token). EOS stopping and token streaming
+    live only on the cached path (``generate_cached``)."""
     from zest_tpu.models.sampling import sample_token
 
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)

@@ -663,12 +663,15 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
 def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     top_p: float | None = None,
-                    rng: jax.Array | None = None):
+                    rng: jax.Array | None = None,
+                    eos_id: int | None = None,
+                    on_token=None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop).
     Default greedy, token-identical to ``generate_greedy``."""
     return cached_decode_loop(
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+        eos_id=eos_id, on_token=on_token,
     )
 
 

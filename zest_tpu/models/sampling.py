@@ -33,15 +33,16 @@ def sample_token(logits, key, temperature: float = 0.0,
         # whose mass reaches top_p. ``cum - probs < top_p`` keeps every
         # token whose mass *before* it is under the budget — so the
         # most likely token always survives and the boundary token that
-        # crosses the budget is included (HF semantics).
-        sorted_logits = jnp.sort(logits)[::-1]
-        probs = jax.nn.softmax(sorted_logits)
+        # crosses the budget is included (HF semantics). The mask is
+        # scattered back by sorted *position*, not by logit value, so
+        # ties at the boundary don't widen the nucleus (argsort is
+        # stable: the earliest-index of equal logits wins, as in HF).
+        order = jnp.argsort(-logits)
+        probs = jax.nn.softmax(logits[order])
         cum = jnp.cumsum(probs)
-        kept = jnp.sum(cum - probs < top_p).astype(jnp.int32)
-        cutoff = jax.lax.dynamic_index_in_dim(
-            sorted_logits, jnp.maximum(kept - 1, 0), keepdims=False
-        )
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        keep_sorted = cum - probs < top_p
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
@@ -56,6 +57,8 @@ def cached_decode_loop(
     top_k: int | None = None,
     top_p: float | None = None,
     rng: jax.Array | None = None,
+    eos_id: int | None = None,
+    on_token: Callable | None = None,
 ) -> jax.Array:
     """The one decode driver every family shares: prefill token-by-token
     through a static-shape KV cache, then produce ``steps`` new tokens,
@@ -65,10 +68,22 @@ def cached_decode_loop(
     or (B, T0) for a batch of equal-length prompts — returns
     (B, T0+steps), each row decoded independently (per-row sample keys).
 
+    ``eos_id`` gives HF stop semantics without dynamic shapes: once a
+    row *generates* ``eos_id`` (prompt occurrences don't count), every
+    later generated token in that row is forced to ``eos_id`` — the
+    scan's trip count never changes, callers trim at the first EOS.
+
+    ``on_token(pos, tokens)`` streams generation: an ordered
+    ``io_callback`` fires from inside the compiled scan after every
+    step with the 0-based position just written and the ``(B,)`` int32
+    token row (prompt positions included — filter on ``pos >= len(
+    prompt)``). One host round-trip per token: serving UX, not a
+    throughput path.
+
     The family contributes only its ``init_kv_cache(cfg, batch, max_len,
     dtype)`` and ``decode_step(params, cache, token, pos, cfg)``; the
-    overflow check, prompt-preservation ``where``, buffer clamping, and
-    key splitting live here exactly once.
+    overflow check, prompt-preservation ``where``, buffer clamping, EOS
+    freezing, and key splitting live here exactly once.
     """
     prompt = jnp.asarray(prompt_ids, jnp.int32)
     batched = prompt.ndim == 2
@@ -90,13 +105,20 @@ def cached_decode_loop(
         key = jax.random.wrap_key_data(key)
     keys = jax.random.split(key, (total - 1) * B).reshape(total - 1, B)
 
+    done0 = jnp.zeros((B,), bool)
+
     def step(carry, inp):
         pos, keys_b = inp
-        buf, cache = carry
+        buf, cache, done = carry
         logits, cache = decode_step(params, cache, buf[:, pos], pos, cfg)
         nxt = jax.vmap(
             lambda l, k: sample_token(l, k, temperature, top_k, top_p)
         )(logits, keys_b)
+        if eos_id is not None:
+            # Rows that already generated EOS keep emitting EOS; a row
+            # becomes done when a *generated* position produces EOS.
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | ((pos + 1 >= n0) & (nxt == eos_id))
         # Prompt positions keep their token; past the prompt we append.
         buf = jnp.where(
             pos + 1 < n0, buf,
@@ -104,9 +126,18 @@ def cached_decode_loop(
                 buf, nxt[:, None], jnp.minimum(pos + 1, total - 1), 1
             ),
         )
-        return (buf, cache), None
+        if on_token is not None:
+            from jax.experimental import io_callback
 
-    (buf, _), _ = jax.lax.scan(
-        step, (buf, cache), (jnp.arange(total - 1), keys)
+            wrote = jnp.minimum(pos + 1, total - 1)
+            io_callback(
+                on_token, None, wrote,
+                jax.lax.dynamic_index_in_dim(buf, wrote, 1, keepdims=False),
+                ordered=True,
+            )
+        return (buf, cache, done), None
+
+    (buf, _, _), _ = jax.lax.scan(
+        step, (buf, cache, done0), (jnp.arange(total - 1), keys)
     )
     return buf if batched else buf[0]
