@@ -236,11 +236,14 @@ def test_eos_freezes_rows_independently():
 
 
 def test_on_token_streams_every_position():
-    """The ordered io_callback reports every written position in order,
-    and the streamed tokens agree with the returned buffer."""
+    """The ordered io_callback reports, in order, every *generated*
+    position on the prefill path (family generate_cached) and every
+    written position on the sequential path; streamed tokens agree
+    with the returned buffer either way."""
     import jax
 
     from zest_tpu.models import llama
+    from zest_tpu.models.sampling import cached_decode_loop
 
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(jax.random.key(0), cfg)
@@ -251,9 +254,19 @@ def test_on_token_streams_every_position():
             (int(pos), int(np.asarray(toks).ravel()[0]))),
     ))
     jax.effects_barrier()
-    assert [p for p, _ in seen] == list(range(1, 9))
+    assert [p for p, _ in seen] == list(range(3, 9))  # generated only
     for pos, tid in seen:
         assert out[pos] == tid
+    seen_seq = []
+    out_seq = np.asarray(cached_decode_loop(
+        llama.init_kv_cache, llama.decode_step, params, cfg, [3, 7, 1], 6,
+        on_token=lambda pos, toks: seen_seq.append(
+            (int(pos), int(np.asarray(toks).ravel()[0]))),
+    ))
+    jax.effects_barrier()
+    assert [p for p, _ in seen_seq] == list(range(1, 9))  # all written
+    for pos, tid in seen_seq:
+        assert out_seq[pos] == tid
 
 
 def test_generate_top_p_threading(tmp_path):
@@ -509,3 +522,54 @@ def test_http_generate_streams_tokens(tmp_path):
     assert [t["pos"] for t in tokens] == [3, 4, 5, 6]
     for t in tokens:
         assert done["ids"][t["pos"]] == t["id"]
+
+
+def test_prefill_matches_sequential_decode():
+    """The batched prefill (family decode_window) must be token-identical
+    to the sequential replay path, greedy and sampled, single and
+    batched, for every family — same per-position keys, same cache
+    contents, same logits."""
+    import jax
+
+    from zest_tpu.models import gpt2, llama, moe
+    from zest_tpu.models.sampling import cached_decode_loop
+
+    cases = [
+        (gpt2, gpt2.GPT2Config.tiny()),
+        (llama, llama.LlamaConfig.tiny()),
+        (moe, moe.MoEConfig.tiny()),
+    ]
+    for fam, cfg in cases:
+        params = fam.init_params(jax.random.key(0), cfg)
+        for prompt in ([3, 7, 1, 4, 2], [[3, 7, 1], [5, 2, 9]]):
+            for kw in (dict(),
+                       dict(temperature=1.3, top_p=0.9,
+                            rng=jax.random.key(5))):
+                seq = cached_decode_loop(
+                    fam.init_kv_cache, fam.decode_step, params, cfg,
+                    prompt, 6, **kw)              # no prefill_step
+                pre = fam.generate_cached(params, cfg, prompt, 6, **kw)
+                np.testing.assert_array_equal(
+                    np.asarray(pre), np.asarray(seq),
+                    err_msg=f"{fam.__name__} prompt={prompt} kw={kw}")
+
+
+def test_prefill_respects_eos():
+    """EOS freezing is identical on the prefill path — including an EOS
+    sampled as the very first generated token (the prefill's sample)."""
+    import jax
+
+    from zest_tpu.models import llama
+    from zest_tpu.models.sampling import cached_decode_loop
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    base = np.asarray(llama.generate_cached(params, cfg, [3, 7, 1], 8))
+    first_gen = int(base[3])
+    out = np.asarray(llama.generate_cached(params, cfg, [3, 7, 1], 8,
+                                           eos_id=first_gen))
+    assert set(out[3:].tolist()) == {first_gen}
+    seq = cached_decode_loop(
+        llama.init_kv_cache, llama.decode_step, params, cfg,
+        [3, 7, 1], 8, eos_id=first_gen)
+    np.testing.assert_array_equal(out, np.asarray(seq))

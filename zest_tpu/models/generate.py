@@ -55,9 +55,10 @@ def load_generator(snapshot_dir: str | Path):
     ``eos_token_id`` and ``stop_at_eos`` is true, generation freezes
     rows at their first generated EOS and the returned ids are trimmed
     just past it (HF stop semantics; pass ``stop_at_eos=False`` for the
-    full fixed-length buffer). ``on_token(pos, tokens)`` streams every
-    written position from inside the compiled scan (see
-    sampling.cached_decode_loop). Raises
+    full fixed-length buffer). ``on_token(pos, tokens)`` streams each
+    *generated* position from inside the compiled decode (the prompt
+    lands in one prefill dispatch; see sampling.cached_decode_loop).
+    Raises
     :class:`UnsupportedModelError` for families without generation
     support and ``FileNotFoundError`` for missing config/weights.
     """

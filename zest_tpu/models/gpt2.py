@@ -295,13 +295,17 @@ def init_kv_cache(cfg: GPT2Config, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
-    """One incremental decode step: (B,) ids at position ``pos`` →
-    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
-    (same contract as llama.decode_step). Jittable; ``pos`` traced."""
-    B = token.shape[0]
+def decode_window(params, cache: dict, tokens: jax.Array, pos,
+                  cfg: GPT2Config, last_only: bool = False):
+    """Cached step over a token window: (B, S) ids occupying positions
+    ``pos``..``pos+S-1`` → ((B, S, vocab) logits, updated cache).
+    S=1 is one incremental decode step; S=len(prompt) is the batched
+    prefill (one MXU-shaped dispatch for the whole prompt — same
+    contract as llama.decode_window). Jittable; ``pos`` traced."""
+    B, S = tokens.shape
     H, D = cfg.n_head, cfg.n_embd // cfg.n_head
-    x = (params["wte"][token] + params["wpe"][pos])[:, None, :]
+    wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, S, axis=0)
+    x = params["wte"][tokens] + wpe[None, :, :]            # (B, S, E)
 
     def body(carry, inp):
         x, pos = carry
@@ -310,18 +314,19 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
                         cfg.layer_norm_eps)
         qkv = h @ lp["attn"]["qkv_w"] + lp["attn"]["qkv_b"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, 1, H, D)
+        q = q.reshape(B, S, H, D)
         ck = jax.lax.dynamic_update_slice_in_dim(
-            ck, k.reshape(B, 1, H, D), pos, axis=1)
+            ck, k.reshape(B, S, H, D), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(
-            cv, v.reshape(B, 1, H, D), pos, axis=1)
+            cv, v.reshape(B, S, H, D), pos, axis=1)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / math.sqrt(D)
-        valid = jnp.arange(ck.shape[1]) <= pos
-        scores = jnp.where(valid[None, None, None, :], scores,
+        valid = (jnp.arange(ck.shape[1])[None, :]
+                 <= pos + jnp.arange(S)[:, None])
+        scores = jnp.where(valid[None, None, :, :], scores,
                            jnp.finfo(scores.dtype).min)
         att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), cv)
-        out = out.reshape(B, 1, cfg.n_embd)
+        out = out.reshape(B, S, cfg.n_embd)
         x = x + out @ lp["attn"]["proj_w"] + lp["attn"]["proj_b"]
         h = _layer_norm(x, lp["ln_2"]["g"], lp["ln_2"]["b"],
                         cfg.layer_norm_eps)
@@ -335,7 +340,20 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
     )
     x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"],
                     cfg.layer_norm_eps)
-    return x[:, 0, :] @ params["wte"].T, {"k": new_k, "v": new_v}
+    if last_only:
+        # Prefill wants one next-token distribution: skip the (B, S,
+        # vocab) unembedding for all but the final position.
+        x = x[:, -1:, :]
+    return x @ params["wte"].T, {"k": new_k, "v": new_v}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: GPT2Config):
+    """One incremental decode step: (B,) ids at position ``pos`` →
+    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
+    (same contract as llama.decode_step); the S=1 specialization of
+    :func:`decode_window`."""
+    logits, cache = decode_window(params, cache, token[:, None], pos, cfg)
+    return logits[:, 0, :], cache
 
 
 def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
@@ -352,6 +370,7 @@ def generate_cached(params, cfg: GPT2Config, prompt_ids, steps: int,
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
         eos_id=eos_id, on_token=on_token,
+        prefill_step=decode_window,
     )
 
 

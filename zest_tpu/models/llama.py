@@ -599,13 +599,14 @@ def cp_train_step(params, batch, cfg: LlamaConfig, mesh: Mesh,
 
 
 def _attention_cached(x, p, cfg: LlamaConfig, cache_k, cache_v, pos):
-    """Single-token attention against a (B, n_ctx, KV, D) cache.
+    """Window attention against a (B, n_ctx, KV, D) cache.
 
-    ``x``: (B, 1, E) the current token's activations; ``pos``: scalar
-    position. Returns (out, new_k, new_v). The cache has static shape —
-    entries past ``pos`` are masked out of the softmax.
+    ``x``: (B, S, E) activations for tokens occupying positions
+    ``pos``..``pos+S-1`` (S=1 is the incremental-decode case; S=n0 is
+    the batched prefill). Returns (out, new_k, new_v). The cache has
+    static shape — row s attends to entries ``<= pos+s``.
     """
-    B = x.shape[0]
+    B, S, _ = x.shape
     H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     q, k, v = _qkv(x, p, cfg, pos0=pos)
     cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
@@ -614,14 +615,16 @@ def _attention_cached(x, p, cfg: LlamaConfig, cache_k, cache_v, pos):
     if KV != H:
         kk = jnp.repeat(kk, H // KV, axis=2)
         vv = jnp.repeat(vv, H // KV, axis=2)
-    # (B, H, 1, T) scores over the whole static cache, future masked.
+    # (B, H, S, T) scores over the whole static cache, future masked
+    # causally within the window.
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
-    valid = jnp.arange(cache_k.shape[1]) <= pos
-    scores = jnp.where(valid[None, None, None, :], scores,
+    valid = (jnp.arange(cache_k.shape[1])[None, :]
+             <= pos + jnp.arange(S)[:, None])
+    scores = jnp.where(valid[None, None, :, :], scores,
                        jnp.finfo(scores.dtype).min)
     att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
-    out = out.reshape(B, 1, H * D) @ p["o_w"]
+    out = out.reshape(B, S, H * D) @ p["o_w"]
     if "o_b" in p:
         out = out + p["o_b"]
     return out, cache_k, cache_v
@@ -634,13 +637,17 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
-    """One incremental decode step: (B,) token ids at position ``pos`` →
-    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
-    instead of generate_greedy's O(T²) full recompute — the serving path.
-    Jittable; ``pos`` is a traced scalar, shapes stay static.
+def decode_window(params, cache: dict, tokens: jax.Array, pos,
+                  cfg: LlamaConfig, last_only: bool = False):
+    """Cached step over a token window: (B, S) ids occupying positions
+    ``pos``..``pos+S-1`` → ((B, S, vocab) logits, updated cache).
+
+    S=1 is one incremental decode step; S=len(prompt) is the batched
+    prefill — the whole prompt becomes one MXU-shaped dispatch instead
+    of S sequential single-token steps (sampling.cached_decode_loop
+    uses both). Jittable; ``pos`` is a traced scalar, shapes static.
     """
-    x = params["wte"][token][:, None, :]                   # (B, 1, E)
+    x = params["wte"][tokens]                              # (B, S, E)
 
     def body(carry, inp):
         x, pos = carry
@@ -655,9 +662,24 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
         body, (x, pos), (params["blocks"], cache["k"], cache["v"])
     )
     x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
+    if last_only:
+        # Prefill wants one next-token distribution: project only the
+        # final hidden state through the (huge) unembedding instead of
+        # materializing (B, S, vocab).
+        x = x[:, -1:, :]
     head = params.get("lm_head")
-    logits = x[:, 0, :] @ (head if head is not None else params["wte"].T)
+    logits = x @ (head if head is not None else params["wte"].T)
     return logits, {"k": new_k, "v": new_v}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: LlamaConfig):
+    """One incremental decode step: (B,) token ids at position ``pos`` →
+    ((B, vocab) logits, updated cache). O(T) per token via the KV cache
+    instead of generate_greedy's O(T²) full recompute — the serving path.
+    The S=1 specialization of :func:`decode_window`.
+    """
+    logits, cache = decode_window(params, cache, token[:, None], pos, cfg)
+    return logits[:, 0, :], cache
 
 
 def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
@@ -672,6 +694,7 @@ def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
         eos_id=eos_id, on_token=on_token,
+        prefill_step=decode_window,
     )
 
 

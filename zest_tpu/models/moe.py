@@ -388,23 +388,26 @@ def init_kv_cache(cfg: MoEConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def decode_step(params, cache: dict, token: jax.Array, pos, cfg: MoEConfig):
-    """One incremental decode step: (B,) ids at ``pos`` → ((B, vocab)
-    logits, updated cache). Each token sees per-token expert capacity
-    (≥ top_k), so decode never drops to the residual path — the correct
-    serving semantics (the training-time capacity contention is a batch
-    phenomenon)."""
-    B = token.shape[0]
+def decode_window(params, cache: dict, tokens: jax.Array, pos,
+                  cfg: MoEConfig, last_only: bool = False):
+    """Cached step over a token window: (B, S) ids occupying positions
+    ``pos``..``pos+S-1`` → ((B, S, vocab) logits, updated cache).
+    S=1 is one incremental decode step; S=len(prompt) is the batched
+    prefill. Every token dispatches with per-token expert capacity
+    (≥ top_k), so no token ever drops to the residual path — the
+    correct serving semantics (the training-time capacity contention
+    is a batch phenomenon), identical for any window size."""
+    B, S = tokens.shape
     H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    x = params["wte"][token][:, None, :]
+    x = params["wte"][tokens]                              # (B, S, E)
 
     def body(carry, inp):
         x, pos = carry
         lp, ck, cv = inp
         h = _rms_norm(x, lp["ln_attn"]["g"], cfg.rms_eps)
-        q = (h @ lp["attn"]["q_w"]).reshape(B, 1, H, D)
-        k = (h @ lp["attn"]["k_w"]).reshape(B, 1, KV, D)
-        v = (h @ lp["attn"]["v_w"]).reshape(B, 1, KV, D)
+        q = (h @ lp["attn"]["q_w"]).reshape(B, S, H, D)
+        k = (h @ lp["attn"]["k_w"]).reshape(B, S, KV, D)
+        v = (h @ lp["attn"]["v_w"]).reshape(B, S, KV, D)
         q, k = _rope(q, cfg.rope_theta, pos), _rope(k, cfg.rope_theta, pos)
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
@@ -413,27 +416,39 @@ def decode_step(params, cache: dict, token: jax.Array, pos, cfg: MoEConfig):
             kk = jnp.repeat(kk, H // KV, axis=2)
             vv = jnp.repeat(vv, H // KV, axis=2)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
-        valid = jnp.arange(ck.shape[1]) <= pos
-        scores = jnp.where(valid[None, None, None, :], scores,
+        valid = (jnp.arange(ck.shape[1])[None, :]
+                 <= pos + jnp.arange(S)[:, None])
+        scores = jnp.where(valid[None, None, :, :], scores,
                            jnp.finfo(scores.dtype).min)
         att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
-        x = x + out.reshape(B, 1, cfg.n_embd) @ lp["attn"]["o_w"]
+        x = x + out.reshape(B, S, cfg.n_embd) @ lp["attn"]["o_w"]
         h = _rms_norm(x, lp["ln_moe"]["g"], cfg.rms_eps)
-        # vmap over batch: each token dispatches with its own capacity
-        # (C >= top_k), so batched decode never hits the batch-capacity
-        # contention of the training-time dispatch — the documented
-        # serving semantics for any B, not just B=1.
+        # vmap over every token (batch × window): each dispatches with
+        # its own capacity (C >= top_k), so windowed decode never hits
+        # the batch-capacity contention of the training-time dispatch.
         moe_out = jax.vmap(
-            lambda hh: _moe_block(hh[None], lp["moe"], cfg)[0][0]
-        )(h)
+            lambda hh: _moe_block(hh[None, None], lp["moe"], cfg)[0][0, 0]
+        )(h.reshape(B * S, cfg.n_embd)).reshape(B, S, cfg.n_embd)
         return (x + moe_out, pos), (ck, cv)
 
     (x, _), (new_k, new_v) = jax.lax.scan(
         body, (x, pos), (params["blocks"], cache["k"], cache["v"])
     )
     x = _rms_norm(x, params["ln_f"]["g"], cfg.rms_eps)
-    return x[:, 0, :] @ params["lm_head"], {"k": new_k, "v": new_v}
+    if last_only:
+        # Prefill wants one next-token distribution: skip the (B, S,
+        # vocab) unembedding for all but the final position.
+        x = x[:, -1:, :]
+    return x @ params["lm_head"], {"k": new_k, "v": new_v}
+
+
+def decode_step(params, cache: dict, token: jax.Array, pos, cfg: MoEConfig):
+    """One incremental decode step: (B,) ids at ``pos`` → ((B, vocab)
+    logits, updated cache); the S=1 specialization of
+    :func:`decode_window`."""
+    logits, cache = decode_window(params, cache, token[:, None], pos, cfg)
+    return logits[:, 0, :], cache
 
 
 def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
@@ -450,6 +465,7 @@ def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
         init_kv_cache, decode_step, params, cfg, prompt_ids, steps,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
         eos_id=eos_id, on_token=on_token,
+        prefill_step=decode_window,
     )
 
 

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import functools
+import itertools
 from typing import Callable
 
 import jax
@@ -46,6 +48,115 @@ def sample_token(logits, key, temperature: float = 0.0,
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+# ── Streaming relay ──
+#
+# The jitted decode must be cacheable across requests, but each request
+# brings its own on_token closure — baking the closure into the jit
+# signature would retrace per request. Instead the compiled program
+# always calls this stable relay with a *traced* request tag; the relay
+# routes to that request's registered callback, so any number of
+# streaming decodes run concurrently against one compiled program.
+
+_STREAM_CBS: dict[int, Callable] = {}
+_STREAM_SEQ = itertools.count(1)
+
+
+def _stream_relay(tag, pos, tokens):
+    cb = _STREAM_CBS.get(int(tag))
+    if cb is not None:
+        cb(pos, tokens)
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
+               prefill_step: Callable | None, cfg, steps: int,
+               temperature: float, top_k: int | None, top_p: float | None,
+               eos_id: int | None, stream: bool) -> Callable:
+    """Build + jit the whole decode once per static signature.
+
+    Eagerly re-running the loop re-traces its scan closures every call
+    (measured ~0.7 s/request on a tiny model — pure Python tracing, not
+    compute). Caching on (family fns, config, sampling statics) makes
+    repeat requests hit the jit cache and run at device speed;
+    per-prompt-shape retraces are jit's normal behavior. The cache is
+    *bounded* (LRU): steps/temperature/top_k/top_p arrive from HTTP
+    requests, and an unbounded cache keyed on user input would let a
+    parameter sweep pin compiled executables until the server OOMs.
+    """
+
+    def run(params, prompt, key, tag):
+        B, n0 = prompt.shape
+        total = n0 + steps
+        cache = init_kv_cache(cfg, B, total, dtype=params["wte"].dtype)
+        buf = jnp.zeros((B, total), jnp.int32).at[:, :n0].set(prompt)
+        keys = jax.random.split(key, (total - 1) * B).reshape(total - 1, B)
+
+        done0 = jnp.zeros((B,), bool)
+        start = 0
+        if prefill_step is not None and n0 > 1 and steps > 0:
+            # Batched prefill: one windowed dispatch writes K/V for
+            # every prompt position and yields the last position's
+            # logits, from which the first generated token is sampled —
+            # with the same key the sequential path would use
+            # (keys[n0-1]).
+            logits, cache = prefill_step(params, cache, prompt,
+                                         jnp.int32(0), cfg,
+                                         last_only=True)
+            nxt = jax.vmap(
+                lambda l, k: sample_token(l, k, temperature, top_k, top_p)
+            )(logits[:, -1, :], keys[n0 - 1])
+            if eos_id is not None:
+                done0 = nxt == eos_id
+            buf = buf.at[:, n0].set(nxt)
+            if stream:
+                from jax.experimental import io_callback
+
+                io_callback(_stream_relay, None, tag, jnp.int32(n0), nxt,
+                            ordered=True)
+            start = n0
+
+        def step(carry, inp):
+            pos, keys_b = inp
+            buf, cache, done = carry
+            logits, cache = decode_step(params, cache, buf[:, pos], pos,
+                                        cfg)
+            nxt = jax.vmap(
+                lambda l, k: sample_token(l, k, temperature, top_k, top_p)
+            )(logits, keys_b)
+            if eos_id is not None:
+                # Rows that already generated EOS keep emitting EOS; a
+                # row becomes done when a *generated* position produces
+                # EOS.
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | ((pos + 1 >= n0) & (nxt == eos_id))
+            # Prompt positions keep their token; past it we append.
+            buf = jnp.where(
+                pos + 1 < n0, buf,
+                jax.lax.dynamic_update_slice_in_dim(
+                    buf, nxt[:, None], jnp.minimum(pos + 1, total - 1), 1
+                ),
+            )
+            if stream:
+                from jax.experimental import io_callback
+
+                wrote = jnp.minimum(pos + 1, total - 1)
+                io_callback(
+                    _stream_relay, None, tag, wrote,
+                    jax.lax.dynamic_index_in_dim(buf, wrote, 1,
+                                                 keepdims=False),
+                    ordered=True,
+                )
+            return (buf, cache, done), None
+
+        (buf, _, _), _ = jax.lax.scan(
+            step, (buf, cache, done0),
+            (jnp.arange(start, total - 1), keys[start:]),
+        )
+        return buf
+
+    return jax.jit(run)
+
+
 def cached_decode_loop(
     init_kv_cache: Callable,
     decode_step: Callable,
@@ -59,10 +170,20 @@ def cached_decode_loop(
     rng: jax.Array | None = None,
     eos_id: int | None = None,
     on_token: Callable | None = None,
+    prefill_step: Callable | None = None,
 ) -> jax.Array:
-    """The one decode driver every family shares: prefill token-by-token
-    through a static-shape KV cache, then produce ``steps`` new tokens,
-    all inside one jitted ``lax.scan``.
+    """The one decode driver every family shares: prefill the prompt
+    through a static-shape KV cache, then produce ``steps`` new tokens
+    via a ``lax.scan`` of single-token cached steps — the whole thing
+    one cached jitted program per (family, config, sampling) signature.
+
+    With ``prefill_step`` (the family's ``decode_window``) the whole
+    prompt is one batched dispatch — MXU-shaped matmuls over all
+    prompt positions at once instead of ``len(prompt)`` sequential
+    single-token steps; the scan then only covers generated tokens.
+    Without it the prompt replays through ``decode_step`` inside the
+    scan. Both paths sample bit-identically (the per-position key
+    layout is shared).
 
     ``prompt_ids`` is (T0,) for one sequence — returns (T0+steps,) —
     or (B, T0) for a batch of equal-length prompts — returns
@@ -74,11 +195,15 @@ def cached_decode_loop(
     scan's trip count never changes, callers trim at the first EOS.
 
     ``on_token(pos, tokens)`` streams generation: an ordered
-    ``io_callback`` fires from inside the compiled scan after every
-    step with the 0-based position just written and the ``(B,)`` int32
-    token row (prompt positions included — filter on ``pos >= len(
-    prompt)``). One host round-trip per token: serving UX, not a
-    throughput path.
+    ``io_callback`` fires after every step with the 0-based position
+    just written and the ``(B,)`` int32 token row. On the prefill path
+    only *generated* positions are reported (the prompt lands in one
+    dispatch); the sequential path also reports prompt replay
+    positions — filter on ``pos >= len(prompt)`` either way. One host
+    round-trip per token (serving UX, not a throughput path); the
+    compiled program stays request-independent by routing callbacks
+    through a traced request tag, so concurrent streams don't
+    serialize.
 
     The family contributes only its ``init_kv_cache(cfg, batch, max_len,
     dtype)`` and ``decode_step(params, cache, token, pos, cfg)``; the
@@ -89,55 +214,31 @@ def cached_decode_loop(
     batched = prompt.ndim == 2
     if not batched:
         prompt = prompt[None, :]
-    B, n0 = prompt.shape
-    total = n0 + steps
-    if total > cfg.n_ctx:
+    n0 = prompt.shape[1]
+    if n0 + steps > cfg.n_ctx:
         raise ValueError(
-            f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+            f"prompt ({n0}) + steps ({steps}) = {n0 + steps} exceeds "
             f"n_ctx {cfg.n_ctx}"
         )
-    cache = init_kv_cache(cfg, B, total, dtype=params["wte"].dtype)
-    buf = jnp.zeros((B, total), jnp.int32).at[:, :n0].set(prompt)
     key = jax.random.key(0) if rng is None else rng
     if not jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
         # Legacy raw uint32 keys (jax.random.PRNGKey) can't reshape
         # after split — normalize to a typed key first.
         key = jax.random.wrap_key_data(key)
-    keys = jax.random.split(key, (total - 1) * B).reshape(total - 1, B)
 
-    done0 = jnp.zeros((B,), bool)
-
-    def step(carry, inp):
-        pos, keys_b = inp
-        buf, cache, done = carry
-        logits, cache = decode_step(params, cache, buf[:, pos], pos, cfg)
-        nxt = jax.vmap(
-            lambda l, k: sample_token(l, k, temperature, top_k, top_p)
-        )(logits, keys_b)
-        if eos_id is not None:
-            # Rows that already generated EOS keep emitting EOS; a row
-            # becomes done when a *generated* position produces EOS.
-            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
-            done = done | ((pos + 1 >= n0) & (nxt == eos_id))
-        # Prompt positions keep their token; past the prompt we append.
-        buf = jnp.where(
-            pos + 1 < n0, buf,
-            jax.lax.dynamic_update_slice_in_dim(
-                buf, nxt[:, None], jnp.minimum(pos + 1, total - 1), 1
-            ),
-        )
-        if on_token is not None:
-            from jax.experimental import io_callback
-
-            wrote = jnp.minimum(pos + 1, total - 1)
-            io_callback(
-                on_token, None, wrote,
-                jax.lax.dynamic_index_in_dim(buf, wrote, 1, keepdims=False),
-                ordered=True,
-            )
-        return (buf, cache, done), None
-
-    (buf, _, _), _ = jax.lax.scan(
-        step, (buf, cache, done0), (jnp.arange(total - 1), keys)
-    )
+    fn = _decode_fn(init_kv_cache, decode_step, prefill_step, cfg,
+                    int(steps), float(temperature), top_k, top_p, eos_id,
+                    on_token is not None)
+    if on_token is None:
+        buf = fn(params, prompt, key, jnp.int32(0))
+    else:
+        tag = next(_STREAM_SEQ)
+        _STREAM_CBS[tag] = on_token
+        try:
+            buf = fn(params, prompt, key, jnp.int32(tag))
+            # Callbacks ride a separate host thread; drain them before
+            # unregistering or the tail of the stream would be dropped.
+            jax.effects_barrier()
+        finally:
+            _STREAM_CBS.pop(tag, None)
     return buf if batched else buf[0]
