@@ -6,6 +6,7 @@ zest-tpu. Run against the real Hub (needs network + HF_TOKEN for Xet
 repos), or against the loopback fixture hub for an offline demo:
 
     python scripts/fixture_hub.py --url-file /tmp/hub.url &
+    while [ ! -s /tmp/hub.url ]; do sleep 0.2; done
     HF_ENDPOINT=$(cat /tmp/hub.url) HF_TOKEN=hf_test \
         python examples/download_model.py acme/loopback-model
 """

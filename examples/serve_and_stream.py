@@ -1,0 +1,72 @@
+"""Serving flow: pull once, then stream tokens over the REST API.
+
+The daemon's ``POST /v1/generate`` (zest_tpu.api.http_api — the working
+replacement for the reference's stubbed ``POST /v1/pull``,
+src/http_api.zig:138-142) pulls the repo if needed and decodes with the
+family's KV-cached path: batched prompt prefill, then one sampled token
+per step, each emitted as its own SSE event the moment the compiled
+scan produces it (``"stream": true``). The decode is one cached jitted
+program per request signature, so the first request compiles and
+repeats run at device speed.
+
+Run against a real server:
+
+    zest-tpu serve &                  # REST on :9847
+    python examples/serve_and_stream.py openai-community/gpt2
+
+or self-contained against the loopback fixture hub (fixture repos carry
+no tokenizer, so pass raw prompt ids as the second argument; the while
+loop waits for the hub to write its url file):
+
+    python scripts/fixture_hub.py --url-file /tmp/hub.url --gpt2 &
+    while [ ! -s /tmp/hub.url ]; do sleep 0.2; done
+    HF_ENDPOINT=$(cat /tmp/hub.url) HF_TOKEN=hf_test \
+        python examples/serve_and_stream.py acme/loopback-model 1,2,3
+"""
+
+import json
+import os
+import sys
+
+import requests
+
+import zest_tpu as zest
+
+
+def main() -> int:
+    repo = sys.argv[1] if len(sys.argv) > 1 else "openai-community/gpt2"
+    port = int(os.environ.get("ZEST_HTTP_PORT", "9847"))
+    zest.enable()  # start the daemon if it isn't running
+
+    body = {
+        "repo_id": repo,
+        "steps": 24,
+        "temperature": 0.8,
+        "top_p": 0.95,
+        "stream": True,
+    }
+    if len(sys.argv) > 2:
+        # Raw token ids (fixture repos carry no tokenizer files).
+        body["ids"] = [int(t) for t in sys.argv[2].split(",")]
+    else:
+        body["prompt"] = "The pod woke up and"
+    r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
+                      json=body, stream=True, timeout=600)
+    r.raise_for_status()
+    for line in r.iter_lines(decode_unicode=True):
+        if not line.startswith("data: "):
+            continue
+        ev = json.loads(line[len("data: "):])
+        if ev["event"] == "token":
+            print(ev.get("text", f"<{ev['id']}>"), end="", flush=True)
+        elif ev["event"] == "done":
+            print()
+            print(f"[done: {len(ev['ids'])} ids]")
+        elif ev["event"] == "error":
+            print(f"\nerror: {ev['message']}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
