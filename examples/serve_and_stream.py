@@ -53,13 +53,30 @@ def main() -> int:
     r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
                       json=body, stream=True, timeout=600)
     r.raise_for_status()
+    saw_text = False
+    pending_ids: list[int] = []
     for line in r.iter_lines(decode_unicode=True):
         if not line.startswith("data: "):
             continue
         ev = json.loads(line[len("data: "):])
         if ev["event"] == "token":
-            print(ev.get("text", f"<{ev['id']}>"), end="", flush=True)
+            # A token event may omit "text" while the server holds back
+            # an incomplete UTF-8/BPE sequence — those characters arrive
+            # merged into a LATER event's diff, so printing a
+            # placeholder would interleave spurious '<id>' markers with
+            # real text. Buffer id-only events instead: a text event
+            # clears the buffer (the held characters arrived merged into
+            # its diff), and whatever is still pending at 'done' — the
+            # no-tokenizer case, or a stream truncated mid-sequence —
+            # is flushed as trailing '<id>' markers.
+            if "text" in ev:
+                saw_text = True
+                pending_ids.clear()  # their text arrived merged here
+                print(ev["text"], end="", flush=True)
+            else:
+                pending_ids.append(ev["id"])
         elif ev["event"] == "done":
+            print("".join(f"<{t}>" for t in pending_ids), end="")
             print()
             print(f"[done: {len(ev['ids'])} ids]")
         elif ev["event"] == "error":

@@ -192,7 +192,7 @@ def test_eos_stop_and_trim(tmp_path):
     cfg["eos_token_id"] = eos
     (snap / "config.json").write_text(json.dumps(cfg))
     _, generate = load_generator(snap)
-    assert generate.eos_id == eos
+    assert generate.eos_ids == (eos,)
     out = generate([1, 2], 8)
     np.testing.assert_array_equal(out, base[:first + 1])
     assert int(out[-1]) == eos
@@ -203,11 +203,17 @@ def test_eos_stop_and_trim(tmp_path):
     # EOS in the *prompt* doesn't count as a stop.
     out = generate([1, eos, 2], 8)
     assert len(out) > 3
-    # eos_token_id as a list (HF allows several): first entry is used.
-    cfg["eos_token_id"] = [eos, 999]
+    # eos_token_id as a list (HF allows several, e.g. Llama-3's two
+    # ids): ALL entries stop generation, not just the first. Put the
+    # observed stop token in the SECOND slot — generation must still
+    # stop at it, and the frozen tail pads with the FIRST id.
+    cfg["eos_token_id"] = [999, eos]
     (snap / "config.json").write_text(json.dumps(cfg))
     _, generate = load_generator(snap)
-    assert generate.eos_id == eos
+    assert generate.eos_ids == (999, eos)
+    out = generate([1, 2], 8)
+    np.testing.assert_array_equal(out, base[:first + 1])
+    assert int(out[-1]) == eos
 
 
 def test_eos_freezes_rows_independently():
@@ -228,6 +234,13 @@ def test_eos_freezes_rows_independently():
                                            eos_id=eos))
     row0 = list(base[0]).index(eos, 3)
     assert set(out[0, row0:].tolist()) == {eos}
+    # A tuple of stop ids: stops on the SECOND listed id (999 is out of
+    # the tiny vocab so only `eos` can fire) and the frozen tail pads
+    # with the FIRST listed id — Llama-3-style multi-EOS semantics.
+    out2 = np.asarray(llama.generate_cached(params, cfg, prompts, 8,
+                                            eos_id=(999, eos)))
+    np.testing.assert_array_equal(out2[0, :row0 + 1], base[0, :row0 + 1])
+    assert set(out2[0, row0 + 1:].tolist()) <= {999}
     np.testing.assert_array_equal(out[0, :row0 + 1], base[0, :row0 + 1])
     # Row 1 is untouched up to its own first generated EOS (if any).
     hits = [i for i, t in enumerate(base[1]) if t == eos and i >= 3]
@@ -253,7 +266,8 @@ def test_on_token_streams_every_position():
         on_token=lambda pos, toks: seen.append(
             (int(pos), int(np.asarray(toks).ravel()[0]))),
     ))
-    jax.effects_barrier()
+    # No effects_barrier: the loop's per-request sentinel drain
+    # guarantees every callback has been delivered before it returns.
     assert [p for p, _ in seen] == list(range(3, 9))  # generated only
     for pos, tid in seen:
         assert out[pos] == tid
@@ -263,7 +277,6 @@ def test_on_token_streams_every_position():
         on_token=lambda pos, toks: seen_seq.append(
             (int(pos), int(np.asarray(toks).ravel()[0]))),
     ))
-    jax.effects_barrier()
     assert [p for p, _ in seen_seq] == list(range(1, 9))  # all written
     for pos, tid in seen_seq:
         assert out_seq[pos] == tid

@@ -312,9 +312,12 @@ class HttpApi:
         at the first generated EOS (the frozen tail repeats EOS).
 
         A disconnected client (GeneratorExit at a yield) sets the
-        cancel flag; the next io_callback raises, aborting the rest of
-        the compiled decode instead of burning device time on an
-        abandoned stream."""
+        cancel flag; cancellation is cooperative — later io_callbacks
+        just drop their tokens and the bounded scan runs out. (Raising
+        from inside a host callback is NOT a safe abort: JAX doesn't
+        define exception propagation out of callbacks on all backends —
+        on TPU it can surface at an undefined point or take down the
+        runtime, wedging the daemon over one impatient client.)"""
         import queue
 
         import numpy as np
@@ -325,27 +328,25 @@ class HttpApi:
 
         def on_token(pos, toks):
             if cancelled.is_set():
-                raise RuntimeError("client disconnected; decode cancelled")
+                return  # client gone: drop; the bounded scan drains
             q.put(("tok", int(pos), int(np.asarray(toks).ravel()[0])))
 
         def worker():
             try:
+                # generate() drains this request's token callbacks
+                # before returning (per-request sentinel in
+                # sampling.cached_decode_loop), so nothing can land
+                # after the 'done' sentinel below.
                 out = generate(prompt, steps, on_token=on_token, **kwargs)
-                # Token callbacks ride a separate host-callback thread;
-                # without the barrier the tail of them could land after
-                # the 'done' sentinel and be dropped by the drain loop.
-                import jax
-
-                jax.effects_barrier()
                 q.put(("done", out))
             except Exception as exc:  # noqa: BLE001 - relayed as SSE
                 q.put(("error", exc))
 
         threading.Thread(target=worker, daemon=True,
                          name="zest-generate-stream").start()
-        eos_id = getattr(generate, "eos_id", None)
+        eos_ids = getattr(generate, "eos_ids", None)
         if not kwargs.get("stop_at_eos", True):
-            eos_id = None
+            eos_ids = None
         ended = False
         gen_ids: list[int] = []
         sent_text = ""
@@ -373,7 +374,7 @@ class HttpApi:
                             ev["text"] = full[len(sent_text):]
                             sent_text = full
                     yield ev
-                    ended = eos_id is not None and tid == eos_id
+                    ended = bool(eos_ids) and tid in eos_ids
         finally:
             cancelled.set()
         yield self._done_event(model_type, out, tok)

@@ -98,7 +98,7 @@ def _build_swarm(cfg: Config, tracker: str | None = None, dht: bool = True):
 
 def cmd_pull(args) -> int:
     cfg = Config.load()
-    if args.http_port:
+    if args.http_port is not None:  # port 0 = ephemeral, keep it
         cfg.http_port = args.http_port
     if args.dtype:
         cfg.land_dtype = args.dtype
@@ -275,11 +275,12 @@ def cmd_seed(args) -> int:
 def cmd_serve(args) -> int:
     """Foreground seeding server + REST API (reference main.zig:403-469)."""
     cfg = Config.load()
-    if args.http_port:
+    # `is not None`, not truthiness: port 0 means "bind ephemeral" for
+    # every transport here, and a falsy check silently ignored it.
+    if args.http_port is not None:
         cfg.http_port = args.http_port
-    if args.listen_port:
+    if args.listen_port is not None:
         cfg.listen_port = args.listen_port
-
     if args.dcn_port is not None:
         cfg.dcn_port = args.dcn_port
 

@@ -86,13 +86,13 @@ def load_generator(snapshot_dir: str | Path):
         cfg = fam.LlamaConfig.from_hf(cfg_json)
     params = fam.params_from_hf(tensors, cfg)
     decode = fam.generate_cached
-    eos_id = _eos_token_id(cfg_json)
+    eos_ids = _eos_token_ids(cfg_json)
 
     def generate(prompt_ids, steps, temperature=0.0, top_k=None,
                  top_p=None, seed=0, stop_at_eos=True, on_token=None):
         import jax
 
-        eos = eos_id if stop_at_eos else None
+        eos = eos_ids if stop_at_eos else None
         out = np.asarray(decode(
             params, cfg, prompt_ids, steps, temperature=temperature,
             top_k=top_k, top_p=top_p, rng=jax.random.key(seed),
@@ -102,35 +102,41 @@ def load_generator(snapshot_dir: str | Path):
             out = trim_at_eos(out, np.shape(prompt_ids)[-1], eos)
         return out
 
-    generate.eos_id = eos_id  # callers (SSE streaming) filter on it
+    generate.eos_ids = eos_ids  # callers (SSE streaming) filter on it
     return model_type, generate
 
 
-def _eos_token_id(cfg_json: dict) -> int | None:
-    """config.json's ``eos_token_id`` as one int (HF allows a list —
-    multiple stop ids; the decode-loop freeze takes one, so use the
-    first) or None when absent."""
-    eos = cfg_json.get("eos_token_id")
-    if isinstance(eos, list):
-        eos = eos[0] if eos else None
-    return None if eos is None else int(eos)
+def _eos_token_ids(cfg_json: dict) -> tuple[int, ...] | None:
+    """config.json's ``eos_token_id`` as a tuple of stop ids (HF allows
+    a single int OR a list of several, e.g. Llama-3's two ids — all of
+    them stop generation) or None when absent."""
+    from zest_tpu.models.sampling import normalize_eos
+
+    return normalize_eos(cfg_json.get("eos_token_id"))
 
 
-def trim_at_eos(out: np.ndarray, n_prompt: int, eos_id: int) -> np.ndarray:
-    """Cut a decoded row just past its first *generated* EOS (prompt
-    occurrences don't count). Batched (B, T) input keeps its rectangular
-    shape — frozen rows already pad with EOS, so trimming to the longest
-    row loses nothing."""
+def trim_at_eos(out: np.ndarray, n_prompt: int,
+                eos_id: int | tuple[int, ...]) -> np.ndarray:
+    """Cut a decoded row just past its first *generated* stop id — one
+    id or several (prompt occurrences don't count). Batched (B, T)
+    input keeps its rectangular shape — frozen rows already pad with
+    the first stop id, so trimming to the longest row loses nothing."""
+    from zest_tpu.models.sampling import normalize_eos
+
+    eos_ids = normalize_eos(eos_id)
+    if eos_ids is None:
+        return out
     if out.ndim == 2:
         keep = 0
         for row in out:
-            keep = max(keep, _row_end(row, n_prompt, eos_id))
+            keep = max(keep, _row_end(row, n_prompt, eos_ids))
         return out[:, :keep]
-    return out[: _row_end(out, n_prompt, eos_id)]
+    return out[: _row_end(out, n_prompt, eos_ids)]
 
 
-def _row_end(row: np.ndarray, n_prompt: int, eos_id: int) -> int:
-    hits = np.nonzero(row[n_prompt:] == eos_id)[0]
+def _row_end(row: np.ndarray, n_prompt: int,
+             eos_ids: tuple[int, ...]) -> int:
+    hits = np.nonzero(np.isin(row[n_prompt:], eos_ids))[0]
     return len(row) if hits.size == 0 else n_prompt + int(hits[0]) + 1
 
 

@@ -686,7 +686,7 @@ def generate_cached(params, cfg: LlamaConfig, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     top_p: float | None = None,
                     rng: jax.Array | None = None,
-                    eos_id: int | None = None,
+                    eos_id: int | tuple[int, ...] | None = None,
                     on_token=None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop).
     Default greedy, token-identical to ``generate_greedy``."""

@@ -455,7 +455,7 @@ def generate_cached(params, cfg: MoEConfig, prompt_ids, steps: int,
                     temperature: float = 0.0, top_k: int | None = None,
                     top_p: float | None = None,
                     rng: jax.Array | None = None,
-                    eos_id: int | None = None,
+                    eos_id: int | tuple[int, ...] | None = None,
                     on_token=None):
     """KV-cached decode (O(T) per token; sampling.cached_decode_loop);
     greedy by default, sampling via ``temperature``/``top_k``."""

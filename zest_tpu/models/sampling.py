@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import threading
 from typing import Callable
 
 import jax
@@ -54,24 +55,55 @@ def sample_token(logits, key, temperature: float = 0.0,
 # brings its own on_token closure — baking the closure into the jit
 # signature would retrace per request. Instead the compiled program
 # always calls this stable relay with a *traced* request tag; the relay
-# routes to that request's registered callback, so any number of
-# streaming decodes run concurrently against one compiled program.
+# routes to that request's registered (callback, done-event) pair, so
+# any number of streaming decodes run concurrently against one compiled
+# program. After the last token the program emits a pos=-1 sentinel
+# through the same ordered callback; the relay turns it into the
+# request's done event — the per-request drain signal (a global
+# jax.effects_barrier() here would wait on every OTHER in-flight
+# stream's decode too, serializing concurrent requests).
 
-_STREAM_CBS: dict[int, Callable] = {}
+_STREAM_CBS: dict[int, tuple[Callable, threading.Event]] = {}
 _STREAM_SEQ = itertools.count(1)
 
 
 def _stream_relay(tag, pos, tokens):
-    cb = _STREAM_CBS.get(int(tag))
-    if cb is not None:
+    entry = _STREAM_CBS.get(int(tag))
+    if entry is None:
+        return
+    cb, done = entry
+    if int(pos) < 0:
+        done.set()
+    else:
         cb(pos, tokens)
+
+
+def normalize_eos(eos) -> tuple[int, ...] | None:
+    """The one EOS-id normalizer: HF's ``eos_token_id`` may be a single
+    int or a list of several stop ids — map any of that (or None, or an
+    empty list) to a tuple of ints or None. Shared by the decode loop,
+    config parsing, and trimming so they accept the identical domain."""
+    if eos is None:
+        return None
+    if isinstance(eos, (tuple, list)):
+        return tuple(int(e) for e in eos) or None
+    return (int(eos),)
+
+
+def _any_eos(tokens, eos_ids: tuple[int, ...]):
+    """(B,) bool: does each token match ANY of the stop ids? Static
+    tuple of comparisons — no gather, no dynamic shapes."""
+    hit = tokens == jnp.int32(eos_ids[0])
+    for e in eos_ids[1:]:
+        hit = hit | (tokens == jnp.int32(e))
+    return hit
 
 
 @functools.lru_cache(maxsize=64)
 def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
                prefill_step: Callable | None, cfg, steps: int,
                temperature: float, top_k: int | None, top_p: float | None,
-               eos_id: int | None, stream: bool) -> Callable:
+               eos_ids: tuple[int, ...] | None, stream: bool) -> Callable:
     """Build + jit the whole decode once per static signature.
 
     Eagerly re-running the loop re-traces its scan closures every call
@@ -85,6 +117,8 @@ def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
     """
 
     def run(params, prompt, key, tag):
+        if stream:
+            from jax.experimental import io_callback
         B, n0 = prompt.shape
         total = n0 + steps
         cache = init_kv_cache(cfg, B, total, dtype=params["wte"].dtype)
@@ -105,12 +139,10 @@ def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
             nxt = jax.vmap(
                 lambda l, k: sample_token(l, k, temperature, top_k, top_p)
             )(logits[:, -1, :], keys[n0 - 1])
-            if eos_id is not None:
-                done0 = nxt == eos_id
+            if eos_ids is not None:
+                done0 = _any_eos(nxt, eos_ids)
             buf = buf.at[:, n0].set(nxt)
             if stream:
-                from jax.experimental import io_callback
-
                 io_callback(_stream_relay, None, tag, jnp.int32(n0), nxt,
                             ordered=True)
             start = n0
@@ -123,12 +155,13 @@ def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
             nxt = jax.vmap(
                 lambda l, k: sample_token(l, k, temperature, top_k, top_p)
             )(logits, keys_b)
-            if eos_id is not None:
-                # Rows that already generated EOS keep emitting EOS; a
-                # row becomes done when a *generated* position produces
-                # EOS.
-                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
-                done = done | ((pos + 1 >= n0) & (nxt == eos_id))
+            if eos_ids is not None:
+                # Rows that already generated a stop id keep emitting
+                # the first one; a row becomes done when a *generated*
+                # position produces ANY stop id (HF allows a list, e.g.
+                # Llama-3's [128001, 128009]).
+                nxt = jnp.where(done, jnp.int32(eos_ids[0]), nxt)
+                done = done | ((pos + 1 >= n0) & _any_eos(nxt, eos_ids))
             # Prompt positions keep their token; past it we append.
             buf = jnp.where(
                 pos + 1 < n0, buf,
@@ -137,8 +170,6 @@ def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
                 ),
             )
             if stream:
-                from jax.experimental import io_callback
-
                 wrote = jnp.minimum(pos + 1, total - 1)
                 io_callback(
                     _stream_relay, None, tag, wrote,
@@ -152,6 +183,13 @@ def _decode_fn(init_kv_cache: Callable, decode_step: Callable,
             step, (buf, cache, done0),
             (jnp.arange(start, total - 1), keys[start:]),
         )
+        if stream:
+            # End-of-stream sentinel: rides the SAME ordered-callback
+            # chain as the tokens, so when the relay delivers it every
+            # token of THIS request has been delivered — the
+            # per-request drain signal cached_decode_loop waits on.
+            io_callback(_stream_relay, None, tag, jnp.int32(-1),
+                        jnp.zeros((B,), jnp.int32), ordered=True)
         return buf
 
     return jax.jit(run)
@@ -168,7 +206,7 @@ def cached_decode_loop(
     top_k: int | None = None,
     top_p: float | None = None,
     rng: jax.Array | None = None,
-    eos_id: int | None = None,
+    eos_id: int | tuple[int, ...] | list[int] | None = None,
     on_token: Callable | None = None,
     prefill_step: Callable | None = None,
 ) -> jax.Array:
@@ -189,10 +227,12 @@ def cached_decode_loop(
     or (B, T0) for a batch of equal-length prompts — returns
     (B, T0+steps), each row decoded independently (per-row sample keys).
 
-    ``eos_id`` gives HF stop semantics without dynamic shapes: once a
-    row *generates* ``eos_id`` (prompt occurrences don't count), every
-    later generated token in that row is forced to ``eos_id`` — the
-    scan's trip count never changes, callers trim at the first EOS.
+    ``eos_id`` gives HF stop semantics without dynamic shapes: one id
+    or a list/tuple of several (HF's ``eos_token_id`` may be a list,
+    e.g. Llama-3's two stop ids). Once a row *generates* any of them
+    (prompt occurrences don't count), every later generated token in
+    that row is forced to the first id — the scan's trip count never
+    changes, callers trim at the first stop id.
 
     ``on_token(pos, tokens)`` streams generation: an ordered
     ``io_callback`` fires after every step with the 0-based position
@@ -226,19 +266,30 @@ def cached_decode_loop(
         # after split — normalize to a typed key first.
         key = jax.random.wrap_key_data(key)
 
+    eos_ids = normalize_eos(eos_id)
     fn = _decode_fn(init_kv_cache, decode_step, prefill_step, cfg,
-                    int(steps), float(temperature), top_k, top_p, eos_id,
+                    int(steps), float(temperature), top_k, top_p, eos_ids,
                     on_token is not None)
     if on_token is None:
         buf = fn(params, prompt, key, jnp.int32(0))
     else:
         tag = next(_STREAM_SEQ)
-        _STREAM_CBS[tag] = on_token
+        done = threading.Event()
+        _STREAM_CBS[tag] = (on_token, done)
         try:
             buf = fn(params, prompt, key, jnp.int32(tag))
-            # Callbacks ride a separate host thread; drain them before
-            # unregistering or the tail of the stream would be dropped.
-            jax.effects_barrier()
+            # Callbacks ride a separate host thread; drain THIS
+            # request's before unregistering or the stream tail would
+            # be dropped. The compiled program ends with a pos=-1
+            # sentinel on the same ordered-callback chain, so waiting
+            # for it is a per-request drain; block_until_ready first so
+            # the wait only covers callback delivery, never compute.
+            # (A global jax.effects_barrier() would also wait for every
+            # other concurrent stream's decode — the fallback below
+            # fires only if sentinel delivery stalls.)
+            jax.block_until_ready(buf)
+            if not done.wait(timeout=30.0):
+                jax.effects_barrier()
         finally:
             _STREAM_CBS.pop(tag, None)
     return buf if batched else buf[0]
