@@ -53,7 +53,6 @@ def main() -> int:
     r = requests.post(f"http://127.0.0.1:{port}/v1/generate",
                       json=body, stream=True, timeout=600)
     r.raise_for_status()
-    saw_text = False
     pending_ids: list[int] = []
     for line in r.iter_lines(decode_unicode=True):
         if not line.startswith("data: "):
@@ -70,7 +69,6 @@ def main() -> int:
             # no-tokenizer case, or a stream truncated mid-sequence —
             # is flushed as trailing '<id>' markers.
             if "text" in ev:
-                saw_text = True
                 pending_ids.clear()  # their text arrived merged here
                 print(ev["text"], end="", flush=True)
             else:

@@ -98,7 +98,16 @@ def _build_swarm(cfg: Config, tracker: str | None = None, dht: bool = True):
 
 def cmd_pull(args) -> int:
     cfg = Config.load()
-    if args.http_port is not None:  # port 0 = ephemeral, keep it
+    if args.http_port is not None:
+        # Unlike `serve` (which binds the port and may take 0 =
+        # ephemeral), pull uses it to *reach* the daemon — 0 would
+        # health-check 127.0.0.1:0, never find the daemon, and spawn an
+        # unreachable orphan on every pull.
+        if args.http_port == 0:
+            print("error: --http-port 0 (ephemeral) is only valid for "
+                  "`serve`; pull needs the daemon's actual port",
+                  file=sys.stderr)
+            return 2
         cfg.http_port = args.http_port
     if args.dtype:
         cfg.land_dtype = args.dtype
