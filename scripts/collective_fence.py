@@ -1,0 +1,145 @@
+"""Virtual-mesh collective regression fence (VERDICT r3 item 6).
+
+Runs the three collective-path benches — ``ici_all_gather``,
+``ring_attention``, ``pipeline_gpipe`` — on the 8-device virtual CPU
+mesh and fails if any is more than 2x slower than the stored budget.
+Absolute ICI GB/s needs hardware this environment lacks; what a CPU
+mesh CAN catch is a *relative* regression in the collective code path
+(an accidental gather-materialize, a broken donation, a shape that
+stops fusing), which is exactly what the 2x fence is for.
+
+Usage:
+    python scripts/collective_fence.py [--update-budget] [OUT.json]
+
+The budget lives at tests/golden/collective_budget.json (regenerate
+with --update-budget on a quiet machine after an intentional change and
+commit it alongside). The measured numbers are written to OUT.json
+(default: collective_fence.json) for the round record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+# The fence always measures the virtual 8-device CPU mesh, never the
+# relay chip. sitecustomize may have imported jax (and registered the
+# axon TPU plugin) before this file runs, so setting the env var is not
+# enough — pin the config too, before any backend initializes (with a
+# dead chip tunnel, axon init hangs indefinitely).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BUDGET_PATH = REPO / "tests" / "golden" / "collective_budget.json"
+SLOWDOWN_LIMIT = 2.0
+
+
+def calibrate() -> float:
+    """Machine-speed yardstick: single-device f32 matmul GFLOP/s.
+
+    The budget file records the yardstick of the machine that wrote it;
+    a different (slower/faster) machine's floors are scaled by the
+    yardstick ratio, so the 2x fence keeps firing on CODE regressions
+    rather than on hardware differences between the budget machine and
+    the CI runner."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024
+    x = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return round(2 * n**3 / best / 1e9, 1)
+
+
+def measure() -> dict[str, float]:
+    from zest_tpu.bench_suite import (
+        bench_ici_all_gather,
+        bench_pipeline,
+        bench_ring_attention,
+    )
+
+    out = {}
+    for fn in (bench_ici_all_gather, bench_ring_attention, bench_pipeline):
+        r = fn()
+        out[r.name] = round(r.mb_per_s, 1)
+    return out
+
+
+def main() -> int:
+    argv = [a for a in sys.argv[1:]]
+    update = "--update-budget" in argv
+    if update:
+        argv.remove("--update-budget")
+    out_path = pathlib.Path(argv[0]) if argv else REPO / "collective_fence.json"
+
+    import jax
+
+    n = len(jax.devices())
+    cal = calibrate()
+    measured = measure()
+    record = {"devices": n, "calibration_gflops": cal,
+              "mb_per_s": measured}
+
+    if update or not BUDGET_PATH.exists():
+        BUDGET_PATH.write_text(json.dumps(
+            {"_comment": "virtual-8-device-mesh collective throughput "
+             "budget (MB/s) + the matmul GFLOP/s yardstick of the "
+             "machine that wrote it (floors scale by the yardstick "
+             "ratio on other machines). Regenerate: "
+             "python scripts/collective_fence.py --update-budget",
+             "_calibration_gflops": cal,
+             **measured}, indent=1))
+        print(f"budget written to {BUDGET_PATH}")
+
+    doc = json.loads(BUDGET_PATH.read_text())
+    budget = {k: v for k, v in doc.items() if not k.startswith("_")}
+    # Normalize for machine speed: a CI runner half as fast as the
+    # budget machine gets floors half as high, so the 2x fence stays a
+    # fence on the CODE. Clamped at 1.0 — a faster-looking yardstick
+    # never RAISES the floor (matmul speed and collective throughput
+    # don't co-vary tightly; on a noisy shared host an unclamped ratio
+    # turns yardstick jitter into false failures — observed).
+    budget_cal = doc.get("_calibration_gflops") or cal
+    machine_ratio = min(1.0, cal / budget_cal) if budget_cal else 1.0
+    record["machine_ratio"] = round(machine_ratio, 3)
+    failures = []
+    for name, mbps in measured.items():
+        floor = budget.get(name, 0) * machine_ratio / SLOWDOWN_LIMIT
+        record.setdefault("floor_mb_per_s", {})[name] = round(floor, 1)
+        if mbps < floor:
+            failures.append(f"{name}: {mbps} MB/s < floor {floor:.1f} "
+                            f"(budget {budget[name]} x machine "
+                            f"{machine_ratio:.2f} / {SLOWDOWN_LIMIT}x)")
+    record["ok"] = not failures
+    out_path.write_text(json.dumps(record, indent=1))
+    print(json.dumps(record))
+    if failures:
+        print("COLLECTIVE FENCE FAILED:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

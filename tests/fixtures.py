@@ -129,9 +129,14 @@ class FixtureHub:
             # session reuse. Every _send sets Content-Length, so 1.1
             # framing is already correct. The timeout bounds how long an
             # idle keep-alive connection pins its handler thread after
-            # the hub shuts down (threads are daemonic either way).
+            # the hub shuts down (threads are daemonic either way) — but
+            # it is a SOCKET timeout, so it also fires mid-transfer when
+            # a blocked send stalls: with 16 concurrent ~32 MB unit
+            # fetches on one contended core, 5 s truncated over half the
+            # responses (observed at the GB-scale bench). 120 s keeps the
+            # idle-reap property without strangling large transfers.
             protocol_version = "HTTP/1.1"
-            timeout = 5
+            timeout = 120
 
             def log_message(self, *args):  # quiet
                 pass

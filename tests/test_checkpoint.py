@@ -16,6 +16,7 @@ from zest_tpu.models.checkpoint import (
 from zest_tpu.models.training import adamw, create_state, make_train_step
 
 
+@pytest.mark.slow
 def test_save_restore_round_trip(tmp_path):
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(jax.random.key(0), cfg)
@@ -76,6 +77,7 @@ def test_export_hf_round_trip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_export_loads_in_transformers(tmp_path):
     """The full interchange oracle: exported file → torch state_dict →
     transformers forward must match the JAX forward."""

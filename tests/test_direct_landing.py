@@ -74,6 +74,7 @@ def _rec(hub):
     return repo.reconstructions[repo.files["model.safetensors"].xet_hash]
 
 
+@pytest.mark.slow
 def test_cached_file_reader_random_access(hub, tmp_path, ckpt):
     bridge = _bridge(hub, tmp_path)
     rec = _rec(hub)
@@ -169,6 +170,12 @@ def test_pull_device_tpu_lands_direct(hub, tmp_path, ckpt, monkeypatch):
                      no_p2p=True, device="tpu")
     assert res.stats["hbm"]["direct"] is True
     assert not disk_loads  # the disk staging path never ran
+    # The TPU path decomposes into the SURVEY §5 tracing stages.
+    stages = res.stats["stages"]
+    for stage in ("resolve", "cas_metadata", "fetch", "hbm_commit",
+                  "files"):
+        assert stages[stage] >= 0, stages
+    assert sum(stages.values()) <= res.stats["elapsed_s"] + 0.05
     want = _hf_tensors()
     assert set(res.params) == set(want)
     for name, arr in want.items():
@@ -200,6 +207,11 @@ def test_pull_device_tpu_resume_stages_from_disk(hub, tmp_path):
     pull_model(cfg, "acme/tiny-moe", no_p2p=True)
     res = pull_model(cfg, "acme/tiny-moe", no_p2p=True, device="tpu")
     assert res.stats["hbm"]["direct"] is False
+    # The late (disk-fallback) hbm_commit stage must keep the
+    # decomposition invariant: elapsed_s is refreshed with it.
+    stages = res.stats["stages"]
+    assert stages["hbm_commit"] >= 0
+    assert sum(stages.values()) <= res.stats["elapsed_s"] + 0.05
     want = _hf_tensors()
     assert set(res.params) == set(want)
 

@@ -537,6 +537,7 @@ def test_http_generate_streams_tokens(tmp_path):
         assert done["ids"][t["pos"]] == t["id"]
 
 
+@pytest.mark.slow
 def test_prefill_matches_sequential_decode():
     """The batched prefill (family decode_window) must be token-identical
     to the sequential replay path, greedy and sampled, single and

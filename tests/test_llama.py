@@ -313,6 +313,7 @@ def test_cp_forward_matches_dense():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_cp_train_step_matches_dense():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(jax.random.key(4), cfg)
@@ -333,6 +334,7 @@ def test_cp_train_step_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_cp_tp_train_step_matches_dense():
     """TP×CP composition: a {data, seq, model} mesh runs dp+sp+tp in one
     step — params Megatron-sharded, ring attention on local heads,
@@ -375,6 +377,7 @@ def test_cp_tp_forward_tied_embeddings():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_remat_train_step_matches_exact():
     """jax.checkpoint must change memory, not math: identical loss and
     gradients with remat on, for all three model families."""
@@ -420,6 +423,7 @@ def test_remat_train_step_matches_exact():
                                    atol=1e-6, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_cp_remat_matches_exact():
     """Remat through the shard_mapped ring: same loss and params."""
     cfg = llama.LlamaConfig.tiny()
@@ -464,6 +468,7 @@ def test_generate_cached_matches_torch_greedy():
     np.testing.assert_array_equal(np.asarray(got), want[0].numpy())
 
 
+@pytest.mark.slow
 def test_decode_step_single_token_positions():
     """decode_step at position p must reproduce column p of the full
     forward (cache correctness at every position)."""

@@ -279,6 +279,7 @@ def test_real_gpt2_vocab_lands_on_mesh(tmp_path):
     assert landed.shape == arr.shape
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 

@@ -29,6 +29,7 @@ def hasher():
     return PallasHasher(interpret=True)
 
 
+@pytest.mark.slow
 def test_plain_matches_reference(hasher):
     """All tree shapes plus a mixed-length tail in ONE kernel call —
     interpret-mode execution is lane-parallel, so batching every case
@@ -43,6 +44,7 @@ def test_plain_matches_reference(hasher):
         assert g == ref.blake3(c), f"mismatch at len {len(c)}"
 
 
+@pytest.mark.slow
 def test_keyed_matches_reference():
     # Small capacity on purpose: the key only changes per-compress flags,
     # orthogonal to tree shape, and each new capacity is a fresh ~60 s
@@ -55,6 +57,7 @@ def test_keyed_matches_reference():
         assert g == ref.blake3_keyed(key, c), f"mismatch at len {len(c)}"
 
 
+@pytest.mark.slow
 def test_batch_not_a_tile_multiple(hasher):
     # B=5 forces lane padding to 128; padded rows must not leak out
     chunks = [_RNG.bytes(100 + i) for i in range(5)]

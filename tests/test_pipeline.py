@@ -66,6 +66,7 @@ def test_pipeline_single_stage_degenerates_to_scan():
                                atol=1e-6, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_scan():
     """Reverse-mode must recover the unsharded gradients (the backward
     pipeline schedule falls out of scan/ppermute transposition)."""

@@ -50,6 +50,7 @@ def _recs(hub, bridge):
     ]
 
 
+@pytest.mark.slow
 def test_pod_round_fills_cache_and_verifies(hub, tmp_path):
     cfg = _cfg(hub, tmp_path)
     bridge = _authed_bridge(hub, cfg)

@@ -51,6 +51,12 @@ def test_cdn_only_pull(cfg, hub):
     assert result.stats["fetch"]["bytes"]["cdn"] > 0
     assert result.stats["fetch"]["bytes"]["peer"] == 0
     assert result.stats["files_downloaded"] == len(FILES)
+    # per-stage tracing: the plain pull times resolve + file writes, and
+    # the stage sum never exceeds the total (stages are non-overlapping
+    # sections of the one pull thread)
+    stages = result.stats["stages"]
+    assert stages["resolve"] >= 0 and stages["files"] >= 0
+    assert sum(stages.values()) <= result.stats["elapsed_s"] + 0.05
 
 
 def test_repull_skips_and_hits_cache(cfg):

@@ -157,6 +157,7 @@ def test_commit_tensors_dtype_skips_integers():
     assert str(out["w"].dtype) == "float32"
 
 
+@pytest.mark.slow
 def test_pull_lands_bf16(tmp_path):
     """--dtype bf16 halves landed bytes on both the direct path and the
     disk-resume path."""

@@ -43,6 +43,7 @@ def seq_mesh(n=8):
     return Mesh(np.asarray(jax.devices()[:n]), ("seq",))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_dense(causal):
     q, k, v = make_qkv()
@@ -62,6 +63,7 @@ def test_ring_full_heads_no_gqa():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_smaller_axis_and_uneven_heads():
     """4-device ring, 1 kv head, bf16 inputs (f32 accumulation inside)."""
     q, k, v = make_qkv(seed=5, T=16, H=4, Hkv=1, D=16, dtype=jnp.bfloat16)
@@ -74,6 +76,7 @@ def test_ring_smaller_axis_and_uneven_heads():
     )
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     """The scan/ppermute recurrence must transpose to the same gradients
     the dense formulation produces (ring-backward correctness)."""

@@ -9,6 +9,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zest_tpu.models import gpt2, llama, moe
+import pytest
+
 from zest_tpu.models.training import TrainState, adamw, create_state, \
     make_train_step
 
@@ -50,6 +52,7 @@ def test_loss_decreases_overfitting_one_batch():
     assert float(loss) < first * 0.7, (first, float(loss))
 
 
+@pytest.mark.slow
 def test_composes_with_all_families():
     rng = np.random.default_rng(1)
     cases = [

@@ -47,6 +47,11 @@ class CompressionError(ValueError):
 _MIN_MATCH = 4
 _HASH_LOG = 16
 _MAX_OFFSET = 0xFFFF
+# Incompressible-run acceleration (reference LZ4 "skip trigger"): after
+# every 2**_SKIP_TRIGGER consecutive misses the scan step grows by one,
+# so random data degenerates to a fast skip + one literal run instead of
+# a per-byte probe. Same schedule as the native codec (lz4.cc).
+_SKIP_TRIGGER = 6
 
 
 def _lz4_compress_py(data: bytes) -> bytes:
@@ -60,14 +65,17 @@ def _lz4_compress_py(data: bytes) -> bytes:
     # Spec end conditions: last 5 bytes are literals; last match starts
     # at least 12 bytes before the end.
     match_limit = n - 12
+    search = 1 << _SKIP_TRIGGER
     while pos < match_limit:
         seq = data[pos : pos + 4]
         key = int.from_bytes(seq, "little")
         cand = table.get(key)
         table[key] = pos
         if cand is None or pos - cand > _MAX_OFFSET or data[cand : cand + 4] != seq:
-            pos += 1
+            pos += search >> _SKIP_TRIGGER
+            search += 1
             continue
+        search = 1 << _SKIP_TRIGGER
         # Extend match forward (may run up to the 5-byte literal tail).
         mlen = 4
         limit = n - 5

@@ -189,6 +189,17 @@ def _print_pull_stats(stats: dict) -> None:
         print(f"  From CDN:   {nbytes.get('cdn', 0)} bytes")
         print(f"  P2P ratio:  {fetch.get('p2p_ratio', 0.0):.1%}")
     print(f"  Elapsed:    {stats.get('elapsed_s', 0)}s")
+    stages = stats.get("stages") or {}
+    if stages:
+        # SURVEY §5 per-stage tracing, in pipeline order (the reference
+        # prints only end-of-pull totals, swarm.zig:472-485).
+        order = ("resolve", "cas_metadata", "fetch", "hbm_commit",
+                 "files")
+        parts = [f"{name} {stages[name]:.2f}s"
+                 for name in order if name in stages]
+        parts += [f"{name} {val:.2f}s" for name, val in stages.items()
+                  if name not in order]
+        print(f"  Stages:     {'  '.join(parts)}")
     if "federated" in stats:
         f = stats["federated"]
         print(f"  Federated:  pod {f['pod']}/{f['pods']}: {f['own_units']} "

@@ -138,6 +138,24 @@ def _gear_as_array():
     return _gear_array
 
 
+_scratch_tls = threading.local()
+
+
+def _scratch(n: int) -> ctypes.Array:
+    """Reusable per-thread output buffer of >= n bytes.
+
+    ``ctypes.create_string_buffer`` zero-fills on every call — for 64 KiB
+    chunk codecs that memset (plus the allocation) costs as much as the
+    native codec itself. One geometrically-grown buffer per thread makes
+    the marshalling cost O(copy-out) only; per-thread keeps concurrent
+    fetch workers from sharing (and corrupting) one buffer."""
+    buf = getattr(_scratch_tls, "buf", None)
+    if buf is None or len(buf) < n:
+        size = max(128 * 1024, 1 << (n - 1).bit_length())
+        buf = _scratch_tls.buf = ctypes.create_string_buffer(size)
+    return buf
+
+
 class lib:
     """Namespace of native entry points with ctypes marshalling."""
 
@@ -191,24 +209,22 @@ class lib:
     def lz4_compress(data: bytes) -> bytes:
         dll = _load()
         cap = dll.zest_lz4_bound(len(data))
-        out = ctypes.create_string_buffer(cap)
+        out = _scratch(cap)
         n = dll.zest_lz4_compress(data, len(data), out, cap)
         if n == 0 and len(data) > 0:
             raise RuntimeError("native lz4 compress failed")
-        return out.raw[:n]
+        return ctypes.string_at(out, n)
 
     @staticmethod
     def frame_chunk_response(ext_id: int, req_id: int, chunk_offset: int,
                              data: bytes) -> bytes:
         """Complete framed BEP10+XET CHUNK_RESPONSE in one pass."""
         dll = _load()
-        out = ctypes.create_string_buffer(
-            dll.zest_wire_response_size(len(data))
-        )
+        out = _scratch(dll.zest_wire_response_size(len(data)))
         n = dll.zest_wire_frame_chunk_response(
             ext_id, req_id, chunk_offset, data, len(data), out
         )
-        return out.raw[:n]
+        return ctypes.string_at(out, n)
 
     @staticmethod
     def frame_chunk_request(ext_id: int, req_id: int, chunk_hash: bytes,
@@ -239,8 +255,8 @@ class lib:
             # from "malformed"; the pure path validates properly.
             return _lz4_decompress_py(data, 0)
         dll = _load()
-        out = ctypes.create_string_buffer(expected_len)
+        out = _scratch(expected_len)
         n = dll.zest_lz4_decompress(data, len(data), out, expected_len)
         if n != expected_len:
             raise CompressionError("native lz4: malformed input")
-        return out.raw[:expected_len]
+        return ctypes.string_at(out, expected_len)

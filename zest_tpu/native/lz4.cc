@@ -19,6 +19,12 @@ namespace {
 constexpr size_t MIN_MATCH = 4;
 constexpr size_t HASH_LOG = 16;
 constexpr size_t MAX_OFFSET = 0xFFFF;
+// Incompressible-run acceleration (the reference LZ4 "skip trigger"):
+// after every 2^SKIP_TRIGGER consecutive match misses the scan step
+// grows by one, so random data degenerates to a fast skip + one big
+// literal copy instead of a per-byte probe. The Python encoder
+// (_lz4_compress_py) applies the same schedule.
+constexpr size_t SKIP_TRIGGER = 6;
 
 inline uint32_t hash4(const uint8_t* p) {
   uint32_t v;
@@ -59,15 +65,17 @@ size_t zest_lz4_compress(const uint8_t* src, size_t n, uint8_t* dst,
   // bytes before the end.
   size_t match_limit = n >= 12 ? n - 12 : 0;
 
+  size_t search = 1u << SKIP_TRIGGER;
   while (pos < match_limit) {
     uint32_t h = hash4(src + pos);
     int32_t cand = table[h];
     table[h] = (int32_t)pos;
     if (cand < 0 || pos - (size_t)cand > MAX_OFFSET ||
         std::memcmp(src + cand, src + pos, 4) != 0) {
-      pos++;
+      pos += search++ >> SKIP_TRIGGER;
       continue;
     }
+    search = 1u << SKIP_TRIGGER;
     size_t mlen = 4;
     size_t limit = n - 5;
     while (pos + mlen < limit && src[cand + mlen] == src[pos + mlen]) mlen++;

@@ -137,12 +137,27 @@ def warm_units_parallel(
         _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)
         return len(data)
 
+    failed_units = []
     with ThreadPoolExecutor(max_workers=max_concurrent) as pool:
-        for result in pool.map(lambda u: _safe(fetch, u), wanted):
+        for unit, result in zip(wanted,
+                                pool.map(lambda u: _safe(fetch, u), wanted)):
             if result is None:
-                stats["failed"] += 1
+                failed_units.append(unit)
             else:
                 stats["bytes"] += result
+    # One sequential retry pass: under load, concurrent fetches can fail
+    # on timeouts the same transfer survives alone (observed: >half of
+    # 16-wide ~32 MB unit fetches truncated on a contended host). A
+    # unit that fails here too degrades to the landing waterfall — a
+    # sequential per-TERM refetch inside the commit stage — which is
+    # correct but far slower, so the retry is worth one more attempt.
+    for unit in failed_units:
+        n = _safe(fetch, unit)
+        if n is None:
+            stats["failed"] += 1
+        else:
+            stats["retried"] = stats.get("retried", 0) + 1
+            stats["bytes"] += n
     return stats
 
 

@@ -13,6 +13,7 @@ counterpart).
 
 from __future__ import annotations
 
+import contextlib
 import sys
 import time
 from pathlib import Path
@@ -39,6 +40,31 @@ class PullResult:
 
     def __str__(self) -> str:
         return str(self.snapshot_dir)
+
+
+class StageClock:
+    """Accumulating per-stage wall-clock for one pull — the tracing story
+    SURVEY.md §5 asks for (the reference only prints end-of-pull totals,
+    swarm.zig:472-485). ``with clock("fetch"):`` adds elapsed seconds to
+    that stage; totals land in ``stats["stages"]``. Stages are additive
+    and non-overlapping by construction (only the pull thread enters
+    them), so they decompose ``elapsed_s`` minus untimed glue."""
+
+    def __init__(self):
+        self.seconds: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, stage: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.seconds[stage] = (
+                self.seconds.get(stage, 0.0) + time.monotonic() - t0
+            )
+
+    def summary(self) -> dict[str, float]:
+        return {k: round(v, 4) for k, v in self.seconds.items()}
 
 
 def _is_complete(snapshot_dir: Path, entry) -> bool:
@@ -73,9 +99,11 @@ def pull_model(
 
         land_dtype = resolve_dtype(cfg.land_dtype)
     hub = HubClient(cfg)
+    clock = StageClock()
 
-    commit_sha = hub.resolve_revision(repo_id, revision)
-    files = hub.list_files(repo_id, revision)
+    with clock("resolve"):
+        commit_sha = hub.resolve_revision(repo_id, revision)
+        files = hub.list_files(repo_id, revision)
     snapshot_dir = cfg.model_snapshot_dir(repo_id, commit_sha)
 
     if swarm is None and not no_p2p:
@@ -101,10 +129,11 @@ def pull_model(
         ]
         if pending:
             try:
-                bridge.authenticate(repo_id, revision, hub=hub)
-                authenticated = True
-                recs = [bridge.get_reconstruction(e.xet_hash)
-                        for e in pending]
+                with clock("cas_metadata"):
+                    bridge.authenticate(repo_id, revision, hub=hub)
+                    authenticated = True
+                    recs = [bridge.get_reconstruction(e.xet_hash)
+                            for e in pending]
             except Exception as exc:  # noqa: BLE001 - round is an accelerator
                 log(f"distribution rounds unavailable ({exc}); "
                     "continuing with the per-host waterfall",
@@ -153,26 +182,28 @@ def pull_model(
             mesh = mesh_from_config(cfg.mesh)
         hbm_params, hbm_stats = _try_direct_stage(
             bridge, hub, repo_id, revision, files, snapshot_dir, mesh,
-            land_dtype, log,
+            land_dtype, log, clock,
         )
         authenticated = authenticated or bridge.cas is not None
 
     downloaded = skipped = 0
-    for entry in files:
-        dest = snapshot_dir / entry.path
-        if _is_complete(snapshot_dir, entry):
-            skipped += 1
-            continue
-        if entry.is_xet:
-            if not authenticated:
-                bridge.authenticate(repo_id, revision, hub=hub)
-                authenticated = True
-            _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
-                           entry, dest, log)
-        else:
-            dest.parent.mkdir(parents=True, exist_ok=True)
-            hub.download_regular_file(repo_id, revision, entry.path, dest)
-        downloaded += 1
+    with clock("files"):
+        for entry in files:
+            dest = snapshot_dir / entry.path
+            if _is_complete(snapshot_dir, entry):
+                skipped += 1
+                continue
+            if entry.is_xet:
+                if not authenticated:
+                    bridge.authenticate(repo_id, revision, hub=hub)
+                    authenticated = True
+                _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
+                               entry, dest, log)
+            else:
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                hub.download_regular_file(repo_id, revision, entry.path,
+                                          dest)
+            downloaded += 1
 
     storage.write_ref(cfg, repo_id, revision, commit_sha)
 
@@ -183,6 +214,7 @@ def pull_model(
         "files_downloaded": downloaded,
         "files_skipped": skipped,
         "elapsed_s": round(elapsed, 3),
+        "stages": clock.summary(),
         "fetch": bridge.stats.summary(),
     }
     if fed_stats is not None:
@@ -202,11 +234,16 @@ def pull_model(
         from zest_tpu.models.registry import shard_rules_for_snapshot
 
         try:
-            hbm_params, hbm_stats = stage_snapshot_to_hbm(
-                snapshot_dir, mesh=mesh,
-                rules=shard_rules_for_snapshot(snapshot_dir),
-                dtype=land_dtype,
-            )
+            with clock("hbm_commit"):
+                hbm_params, hbm_stats = stage_snapshot_to_hbm(
+                    snapshot_dir, mesh=mesh,
+                    rules=shard_rules_for_snapshot(snapshot_dir),
+                    dtype=land_dtype,
+                )
+            # The late stage must keep the decomposition invariant
+            # (sum(stages) <= elapsed_s): refresh BOTH.
+            stats["stages"] = clock.summary()
+            stats["elapsed_s"] = round(time.monotonic() - t0, 3)
         except Exception as exc:  # noqa: BLE001
             log(f"HBM staging failed ({exc}); files remain in "
                 f"{snapshot_dir}", file=sys.stderr)
@@ -218,7 +255,8 @@ def pull_model(
 
 
 def _try_direct_stage(
-    bridge, hub, repo_id, revision, files, snapshot_dir, mesh, dtype, log
+    bridge, hub, repo_id, revision, files, snapshot_dir, mesh, dtype, log,
+    clock: StageClock | None = None,
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
@@ -231,30 +269,39 @@ def _try_direct_stage(
         return None, None
     if any(_is_complete(snapshot_dir, e) for e in st):
         return None, None
+    if clock is None:
+        clock = StageClock()
     try:
         from zest_tpu.models.loader import stage_cached_to_hbm
         from zest_tpu.transfer.pod import fetch_file_header
 
-        if bridge.cas is None:
-            bridge.authenticate(repo_id, revision, hub=hub)
-        recs_with_headers = []
-        for e in st:
-            rec = bridge.get_reconstruction(e.xet_hash)
-            recs_with_headers.append((rec, fetch_file_header(bridge, rec)))
+        with clock("cas_metadata"):
+            if bridge.cas is None:
+                bridge.authenticate(repo_id, revision, hub=hub)
+            recs_with_headers = []
+            for e in st:
+                rec = bridge.get_reconstruction(e.xet_hash)
+                recs_with_headers.append(
+                    (rec, fetch_file_header(bridge, rec))
+                )
         # Whatever the distribution rounds didn't cache (single chip:
         # everything) arrives max_concurrent-wide, not term-by-term.
         from zest_tpu.transfer.federated import warm_units_parallel
 
-        warm = warm_units_parallel(bridge, [r for r, _h in recs_with_headers])
+        with clock("fetch"):
+            warm = warm_units_parallel(
+                bridge, [r for r, _h in recs_with_headers]
+            )
         if warm["failed"]:
             log(f"warm fetch: {warm['failed']}/{warm['units']} units "
                 "failed; landing falls back per-term", file=sys.stderr)
-        params, hbm_stats = stage_cached_to_hbm(
-            bridge, recs_with_headers, mesh=mesh,
-            rules=_landing_rules(hub, repo_id, revision, files,
-                                 snapshot_dir),
-            dtype=dtype,
-        )
+        with clock("hbm_commit"):
+            params, hbm_stats = stage_cached_to_hbm(
+                bridge, recs_with_headers, mesh=mesh,
+                rules=_landing_rules(hub, repo_id, revision, files,
+                                     snapshot_dir),
+                dtype=dtype,
+            )
         hbm_stats["warm"] = warm
         return params, hbm_stats
     except Exception as exc:  # noqa: BLE001 - landing is an accelerator
