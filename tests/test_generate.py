@@ -537,6 +537,45 @@ def test_http_generate_streams_tokens(tmp_path):
         assert done["ids"][t["pos"]] == t["id"]
 
 
+def test_http_generate_memoizes_pull(tmp_path):
+    """Warm /v1/generate requests skip the hub entirely: the resolved
+    snapshot is memoized for a short TTL, so the second request makes
+    ZERO hub round-trips (the pull idempotence re-check was the bulk of
+    warm-request latency)."""
+    import requests
+
+    from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.config import Config
+
+    files = gpt2_checkpoint_files(n_embd=64, n_layer=2)
+    repo = FixtureRepo("acme/api-memo", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                     hf_token="hf_test", endpoint=hub.url, http_port=0)
+        api = HttpApi(cfg)
+        port = api.start()
+        try:
+            body = {"repo_id": "acme/api-memo", "ids": [1, 2], "steps": 3}
+
+            def request():
+                r = requests.post(
+                    f"http://127.0.0.1:{port}/v1/generate", json=body,
+                    timeout=120, stream=True)
+                evs = [json.loads(l[len("data: "):])
+                       for l in r.iter_lines(decode_unicode=True)
+                       if l.startswith("data: ")]
+                assert evs[-1]["event"] == "done", evs[-1]
+                return evs[-1]
+
+            first = request()
+            n_before = len(hub.requests_seen)
+            second = request()
+            assert len(hub.requests_seen) == n_before  # memo hit: no hub
+            assert second["ids"] == first["ids"]
+        finally:
+            api.close()
+
+
 @pytest.mark.slow
 def test_prefill_matches_sequential_decode():
     """The batched prefill (family decode_window) must be token-identical

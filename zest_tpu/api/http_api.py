@@ -52,6 +52,8 @@ class HttpApi:
         self._generators: dict = {}
         self._gen_lock = threading.Lock()
         self._gen_loading: dict = {}
+        # (repo_id, revision) → (snapshot_dir, expiry); see _pull_memo.
+        self._pulled: dict = {}
 
     # ── Lifecycle ──
 
@@ -256,16 +258,14 @@ class HttpApi:
         drains (one host round-trip per token: serving UX; the
         non-streamed path stays single-dispatch)."""
         from zest_tpu.models.generate import try_tokenizer
-        from zest_tpu.transfer.pull import pull_model
 
         yield {"event": "start", "repo_id": repo_id}
         try:
-            res = pull_model(self.cfg, repo_id,
-                             revision=req.get("revision", "main"),
-                             swarm=self.swarm, log=lambda *a, **k: None)
-            yield {"event": "pulled",
-                   "snapshot_dir": str(res.snapshot_dir)}
-            tok = try_tokenizer(res.snapshot_dir)
+            snapshot_dir = self._pull_memo(
+                repo_id, req.get("revision", "main")
+            )
+            yield {"event": "pulled", "snapshot_dir": str(snapshot_dir)}
+            tok = try_tokenizer(snapshot_dir)
             if "ids" in req:
                 prompt = [int(t) for t in req["ids"]]
             elif "prompt" in req and tok is not None:
@@ -275,7 +275,7 @@ class HttpApi:
                        "message": "need ids, or prompt + a tokenizer "
                                   "in the snapshot"}
                 return
-            model_type, generate = self._generator_for(res.snapshot_dir)
+            model_type, generate = self._generator_for(snapshot_dir)
             top_k = req.get("top_k")
             top_p = req.get("top_p")
             kwargs = dict(
@@ -295,6 +295,37 @@ class HttpApi:
             yield self._done_event(model_type, out, tok)
         except Exception as exc:  # noqa: BLE001 - reported to client
             yield {"event": "error", "message": str(exc)}
+
+    _PULL_TTL_S = 30.0
+
+    def _pull_memo(self, repo_id: str, revision: str):
+        """Snapshot dir for (repo, revision), memoized for a short TTL.
+
+        pull_model is idempotent but not free: even a fully-cached pull
+        re-checks revision + file listing against the hub (several HTTP
+        round trips — the bulk of a warm /v1/generate request's
+        latency). Serving memoizes the resolved snapshot briefly; the
+        TTL bounds staleness for moving revisions (same 30 s figure as
+        swarm peer discovery, reference swarm.zig:252), and a snapshot
+        dir that vanished (cache eviction) is a miss regardless."""
+        import time
+
+        from zest_tpu.transfer.pull import pull_model
+
+        key = (repo_id, revision)
+        hit = self._pulled.get(key)
+        now = time.monotonic()
+        if hit is not None and hit[1] > now and hit[0].is_dir():
+            return hit[0]
+        res = pull_model(self.cfg, repo_id, revision=revision,
+                         swarm=self.swarm, log=lambda *a, **k: None)
+        # Evict expired entries on insert: a long-lived daemon serving
+        # many repos must not grow this dict forever (the generator
+        # cache above is LRU-capped for the same reason).
+        self._pulled = {k: v for k, v in self._pulled.items()
+                        if v[1] > now}
+        self._pulled[key] = (res.snapshot_dir, now + self._PULL_TTL_S)
+        return res.snapshot_dir
 
     @staticmethod
     def _done_event(model_type: str, out, tok) -> dict:

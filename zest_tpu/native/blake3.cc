@@ -72,34 +72,41 @@ inline void store32le(uint8_t* p, uint32_t v) {
     b = rotr32(b ^ c, 7);              \
   } while (0)
 
+// Per-round message-word schedules (the standard permutation advanced r
+// times): rounds index the message statically instead of materializing
+// a permuted copy per round — shared by the scalar core and (via the
+// same table under the SIMD section) the wide cores. Dropping the
+// per-round 16-word permute+copy measurably speeds the scalar core,
+// which also runs every parent fold in the tree.
+constexpr int SCHED[7][16] = {
+    { 0,  1,  2,  3,  4,  5,  6,  7,  8,  9, 10, 11, 12, 13, 14, 15},
+    { 2,  6,  3, 10,  7,  0,  4, 13,  1, 11, 12,  5,  9, 14, 15,  8},
+    { 3,  4, 10, 12, 13,  2,  7, 14,  6,  5,  9,  0, 11, 15,  8,  1},
+    {10,  7, 12,  9, 14,  3, 13, 15,  4,  0, 11,  2,  5,  8,  1,  6},
+    {12, 13,  9, 11, 15, 10, 14,  8,  7,  2,  5,  3,  0,  1,  6,  4},
+    { 9, 14, 11,  5,  8, 12, 15,  1, 13,  3,  0, 10,  2,  6,  4,  7},
+    {11, 15,  5,  0,  1,  9,  8,  6, 14, 10,  2, 12,  3,  4,  7, 13},
+};
+
 // One full compression. `out16` receives the 16-word extended output.
-void compress(const uint32_t cv[8], const uint32_t m_in[16], uint64_t counter,
+void compress(const uint32_t cv[8], const uint32_t m[16], uint64_t counter,
               uint32_t block_len, uint32_t flags, uint32_t out16[16]) {
-  static constexpr int P[16] = {2, 6, 3, 10, 7, 0, 4, 13,
-                                1, 11, 12, 5, 9, 14, 15, 8};
   uint32_t v0 = cv[0], v1 = cv[1], v2 = cv[2], v3 = cv[3];
   uint32_t v4 = cv[4], v5 = cv[5], v6 = cv[6], v7 = cv[7];
   uint32_t v8 = IV[0], v9 = IV[1], v10 = IV[2], v11 = IV[3];
   uint32_t v12 = (uint32_t)counter, v13 = (uint32_t)(counter >> 32);
   uint32_t v14 = block_len, v15 = flags;
 
-  uint32_t m[16];
-  std::memcpy(m, m_in, sizeof(m));
-
   for (int r = 0; r < 7; r++) {
-    G(v0, v4, v8, v12, m[0], m[1]);
-    G(v1, v5, v9, v13, m[2], m[3]);
-    G(v2, v6, v10, v14, m[4], m[5]);
-    G(v3, v7, v11, v15, m[6], m[7]);
-    G(v0, v5, v10, v15, m[8], m[9]);
-    G(v1, v6, v11, v12, m[10], m[11]);
-    G(v2, v7, v8, v13, m[12], m[13]);
-    G(v3, v4, v9, v14, m[14], m[15]);
-    if (r < 6) {
-      uint32_t t[16];
-      for (int i = 0; i < 16; i++) t[i] = m[P[i]];
-      std::memcpy(m, t, sizeof(m));
-    }
+    const int* s = SCHED[r];
+    G(v0, v4, v8, v12, m[s[0]], m[s[1]]);
+    G(v1, v5, v9, v13, m[s[2]], m[s[3]]);
+    G(v2, v6, v10, v14, m[s[4]], m[s[5]]);
+    G(v3, v7, v11, v15, m[s[6]], m[s[7]]);
+    G(v0, v5, v10, v15, m[s[8]], m[s[9]]);
+    G(v1, v6, v11, v12, m[s[10]], m[s[11]]);
+    G(v2, v7, v8, v13, m[s[12]], m[s[13]]);
+    G(v3, v4, v9, v14, m[s[14]], m[s[15]]);
   }
 
   out16[0] = v0 ^ v8;
@@ -123,19 +130,6 @@ void compress(const uint32_t cv[8], const uint32_t m_in[16], uint64_t counter,
 #if defined(__AVX2__)
 
 // ── 8-wide core: eight complete 1 KiB chunks per call, SoA in ymm ──
-
-// Per-round message-word schedules (the standard permutation advanced
-// r times), so rounds index the message table statically instead of
-// re-permuting 16 vectors per round.
-constexpr int SCHED[7][16] = {
-    { 0,  1,  2,  3,  4,  5,  6,  7,  8,  9, 10, 11, 12, 13, 14, 15},
-    { 2,  6,  3, 10,  7,  0,  4, 13,  1, 11, 12,  5,  9, 14, 15,  8},
-    { 3,  4, 10, 12, 13,  2,  7, 14,  6,  5,  9,  0, 11, 15,  8,  1},
-    {10,  7, 12,  9, 14,  3, 13, 15,  4,  0, 11,  2,  5,  8,  1,  6},
-    {12, 13,  9, 11, 15, 10, 14,  8,  7,  2,  5,  3,  0,  1,  6,  4},
-    { 9, 14, 11,  5,  8, 12, 15,  1, 13,  3,  0, 10,  2,  6,  4,  7},
-    {11, 15,  5,  0,  1,  9,  8,  6, 14, 10,  2, 12,  3,  4,  7, 13},
-};
 
 #if defined(__AVX512VL__)
 // AVX-512VL gives a native 32-bit rotate on 256-bit registers: 1 uop
@@ -291,6 +285,33 @@ void hash8_chunks(const uint32_t key[8], uint32_t base_flags,
     _mm256_storeu_si256((__m256i*)out_cvs[i], cv[i]);
 }
 
+// Fold 8 parent pairs at once. A parent's 64-byte message is exactly
+// its two children's CVs back-to-back, and `cvs_in` is a flat [2*8][8]
+// CV array — so pair i IS the 64 contiguous bytes at cvs_in + 16*i,
+// loaded lo/hi like one hash8 block. All inputs are read into
+// registers before any store, so out_cvs may alias cvs_in (the
+// level-order fold writes in place).
+void fold8_parents(const uint32_t key[8], uint32_t flags,
+                   const uint32_t (*cvs_in)[8], uint32_t (*out_cvs)[8]) {
+  __m256i cv[8];
+  for (int w = 0; w < 8; w++) cv[w] = _mm256_set1_epi32((int)key[w]);
+  __m256i lo[8], hi[8];
+  for (int i = 0; i < 8; i++) {
+    const uint8_t* p = (const uint8_t*)cvs_in[2 * i];
+    lo[i] = _mm256_loadu_si256((const __m256i*)p);
+    hi[i] = _mm256_loadu_si256((const __m256i*)(p + 32));
+  }
+  transpose8(lo);
+  transpose8(hi);
+  __m256i m[16];
+  for (int w = 0; w < 8; w++) { m[w] = lo[w]; m[8 + w] = hi[w]; }
+  __m256i zero = _mm256_setzero_si256();
+  compress8(cv, m, zero, zero, BLOCK_LEN, flags | PARENT);
+  transpose8(cv);
+  for (int i = 0; i < 8; i++)
+    _mm256_storeu_si256((__m256i*)out_cvs[i], cv[i]);
+}
+
 #endif  // __AVX2__
 
 #if defined(__AVX512F__)
@@ -422,6 +443,29 @@ void hash16_chunks(const uint32_t key[8], uint32_t base_flags,
   }
 }
 
+// Fold 16 parent pairs at once (see fold8_parents: pair i is the 64
+// contiguous bytes at cvs_in + 16*i; in-place safe).
+void fold16_parents(const uint32_t key[8], uint32_t flags,
+                    const uint32_t (*cvs_in)[8], uint32_t (*out_cvs)[8]) {
+  __m512i cv[8];
+  for (int w = 0; w < 8; w++) cv[w] = _mm512_set1_epi32((int)key[w]);
+  __m512i m[16];
+  for (int i = 0; i < 16; i++)
+    m[i] = _mm512_loadu_si512((const void*)cvs_in[2 * i]);
+  transpose16(m);
+  __m512i zero = _mm512_setzero_si512();
+  compress16(cv, m, zero, zero, BLOCK_LEN, flags | PARENT);
+  __m512i rows[16];
+  for (int w = 0; w < 8; w++) rows[w] = cv[w];
+  for (int w = 8; w < 16; w++) rows[w] = _mm512_setzero_si512();
+  transpose16(rows);
+  for (int i = 0; i < 16; i++) {
+    alignas(64) uint32_t tmp[16];
+    _mm512_store_si512((void*)tmp, rows[i]);
+    std::memcpy(out_cvs[i], tmp, 8 * sizeof(uint32_t));
+  }
+}
+
 #endif  // __AVX512F__
 
 void load_block(const uint8_t* data, size_t len, uint32_t m[16]) {
@@ -473,91 +517,74 @@ void blake3_full(const uint32_t key[8], uint32_t base_flags,
     return;
   }
 
-  uint32_t cv_stack[54][8];
-  size_t stack_len = 0;
-  uint64_t chunk_counter = 0;
-  size_t pos = 0;
-  uint32_t out16[16];
+  // Two phases, both SIMD-wide: (1) hash every leaf chunk 16/8 at a
+  // time, (2) fold the tree LEVEL-ORDER, pairing adjacent CVs and
+  // promoting a trailing odd CV unchanged — which builds exactly the
+  // canonical left-full BLAKE3 tree (the standard wide-fold identity;
+  // the previous incremental stack built the same tree but ran every
+  // parent compression through the scalar core, capping large-input
+  // throughput at the scalar rate).
+  size_t n_chunks = (len + CHUNK_LEN - 1) / CHUNK_LEN;
+  // CV workspace: a stack buffer covers every input up to 256 KiB —
+  // all CDC chunks (<= 128 KiB) and the 64 KiB headline shape — so the
+  // hot verification path never allocates; larger inputs (multi-MB
+  // xorb blobs) amortize one heap allocation over megabytes of hashing.
+  uint32_t stack_cvs[256][8];
+  uint32_t(*cvs)[8] =
+      n_chunks <= 256 ? stack_cvs : new uint32_t[n_chunks][8];
 
-  // Merge one finished chunk CV into the stack (standard post-order
-  // fold: merge while the completed-chunk count's trailing zeros last).
-  auto push_cv = [&](uint32_t cv[8]) {
-    chunk_counter++;
-    uint64_t total = chunk_counter;
-    while ((total & 1) == 0) {
-      uint32_t m[16];
-      std::memcpy(m, cv_stack[--stack_len], 8 * sizeof(uint32_t));
-      std::memcpy(m + 8, cv, 8 * sizeof(uint32_t));
-      compress(key, m, 0, BLOCK_LEN, base_flags | PARENT, out16);
-      std::memcpy(cv, out16, 8 * sizeof(uint32_t));
-      total >>= 1;
-    }
-    std::memcpy(cv_stack[stack_len++], cv, 8 * sizeof(uint32_t));
-  };
-
-  // In this multi-chunk branch no chunk carries ROOT (it lands on the
-  // top parent fold), so the final chunk is special only when partial.
-  uint32_t cv[8];
-  bool have_final = false;
-
+  // Leaves: every COMPLETE chunk rides the widest available path (an
+  // exact-multiple input has no partial tail, so even its last chunk
+  // does); only a partial final chunk needs the block-wise scalar
+  // hash_chunk.
+  size_t full = len / CHUNK_LEN;
+  size_t rem = len - full * CHUNK_LEN;
+  size_t i = 0;
 #if defined(__AVX512F__)
-  // Hottest path: 16 complete chunks per call. '>=' lets an exact
-  // 16-chunk tail ride it; its last CV becomes the final chunk.
-  while (len - pos >= 16 * CHUNK_LEN) {
-    uint32_t cvs16[16][8];
-    hash16_chunks(key, base_flags, data + pos, chunk_counter, cvs16);
-    pos += 16 * CHUNK_LEN;
-    if (pos == len) {
-      for (int i = 0; i < 15; i++) push_cv(cvs16[i]);
-      std::memcpy(cv, cvs16[15], sizeof(cv));
-      have_final = true;
-      break;
-    }
-    for (int i = 0; i < 16; i++) push_cv(cvs16[i]);
-  }
+  for (; full - i >= 16; i += 16)
+    hash16_chunks(key, base_flags, data + i * CHUNK_LEN, i, &cvs[i]);
 #endif
-
 #if defined(__AVX2__)
-  // Hot path: 8 complete chunks at a time. '>=' lets an exactly-8-chunk
-  // tail ride the wide path too; its last CV becomes the final chunk.
-  while (!have_final && len - pos >= 8 * CHUNK_LEN) {
-    uint32_t cvs[8][8];
-    hash8_chunks(key, base_flags, data + pos, chunk_counter, cvs);
-    pos += 8 * CHUNK_LEN;
-    if (pos == len) {
-      for (int i = 0; i < 7; i++) push_cv(cvs[i]);
-      std::memcpy(cv, cvs[7], sizeof(cv));
-      have_final = true;
-      break;
-    }
-    for (int i = 0; i < 8; i++) push_cv(cvs[i]);
-  }
+  for (; full - i >= 8; i += 8)
+    hash8_chunks(key, base_flags, data + i * CHUNK_LEN, i, &cvs[i]);
 #endif
+  for (; i < full; i++)
+    hash_chunk(key, data + i * CHUNK_LEN, CHUNK_LEN, i, base_flags,
+               cvs[i], nullptr);
+  if (rem)
+    hash_chunk(key, data + full * CHUNK_LEN, rem, full, base_flags,
+               cvs[full], nullptr);
 
-  // Remaining complete chunks, then the final (possibly partial) one.
-  if (!have_final) {
-    while (len - pos > CHUNK_LEN) {
-      uint32_t c[8];
-      hash_chunk(key, data + pos, CHUNK_LEN, chunk_counter, base_flags, c,
-                 nullptr);
-      pos += CHUNK_LEN;
-      push_cv(c);
+  // Level-order fold down to 2 CVs (the root fold is special-cased for
+  // the ROOT flag). The wide folds read a full register set before
+  // storing, so writing cvs[o] while reading cvs[2p] is safe (o <= 2p).
+  uint32_t out16[16];
+  size_t n = n_chunks;
+  while (n > 2) {
+    size_t pairs = n / 2;
+    size_t p = 0, o = 0;
+#if defined(__AVX512F__)
+    for (; pairs - p >= 16; p += 16, o += 16)
+      fold16_parents(key, base_flags, &cvs[2 * p], &cvs[o]);
+#endif
+#if defined(__AVX2__)
+    for (; pairs - p >= 8; p += 8, o += 8)
+      fold8_parents(key, base_flags, &cvs[2 * p], &cvs[o]);
+#endif
+    for (; p < pairs; p++, o++) {
+      compress(key, cvs[2 * p], 0, BLOCK_LEN, base_flags | PARENT, out16);
+      std::memcpy(cvs[o], out16, 8 * sizeof(uint32_t));
     }
-    hash_chunk(key, data + pos, len - pos, chunk_counter, base_flags, cv,
-               nullptr);
+    if (n & 1) {  // odd tail: promote unchanged
+      std::memcpy(cvs[o], cvs[n - 1], 8 * sizeof(uint32_t));
+      o++;
+    }
+    n = o;
   }
 
-  // Fold the stack; the topmost fold is the root.
-  while (stack_len > 0) {
-    uint32_t m[16];
-    std::memcpy(m, cv_stack[--stack_len], 8 * sizeof(uint32_t));
-    std::memcpy(m + 8, cv, 8 * sizeof(uint32_t));
-    uint32_t flags = base_flags | PARENT;
-    if (stack_len == 0) flags |= ROOT;
-    compress(key, m, 0, BLOCK_LEN, flags, out16);
-    std::memcpy(cv, out16, 8 * sizeof(uint32_t));
-  }
-  for (int i = 0; i < 8; i++) store32le(out + 4 * i, cv[i]);
+  compress(key, cvs[0], 0, BLOCK_LEN, base_flags | PARENT | ROOT, out16);
+  if (cvs != stack_cvs) delete[] cvs;
+  for (int k = 0; k < 8; k++) store32le(out + 4 * k, out16[k]);
 }
 
 }  // namespace
