@@ -487,3 +487,15 @@ def test_federated_round_two_processes(hub, tmp_path):
             proc.kill()
             rc = -1
     assert rc == 0, f"child exit {rc}:\n{proc.stdout.read()}"
+
+
+def test_bench_dcn_fetch_runs():
+    """The synthetic-suite DCN stage (SURVEY §2.1 row 17 "DCN fetch")
+    moves every payload byte over a real loopback socket and reports a
+    positive rate."""
+    from zest_tpu.bench_suite import bench_dcn_fetch
+
+    r = bench_dcn_fetch(n_chunks=8, window=4, repeats=2)
+    assert r.name == "dcn_fetch_pipelined"
+    assert r.bytes_per_iter == 8 * 64 * 1024
+    assert r.mb_per_s > 0

@@ -253,6 +253,72 @@ def bench_pipeline(layers: int = 8, width: int = 512,
                        x.nbytes)
 
 
+def bench_dcn_fetch(n_chunks: int = 64, chunk_bytes: int = CHUNK_64K,
+                    window: int = 16, repeats: int = 5) -> BenchResult:
+    """Loopback DCN chunk-RPC throughput — the cross-pod transport's
+    synthetic stage (SURVEY.md §2.1 row 17: "DCN fetch" alongside ICI
+    gather and HBM commit; the reference's closest analog is its
+    bt_wire_frame bench, which times framing without a socket).
+
+    One DcnServer serves a cached xorb of ``n_chunks`` incompressible
+    chunks; a single channel fetches it in ``window``-deep pipelined
+    sub-range requests (the pipelining discipline of bt_peer.zig:188-248
+    re-expressed over DCN). Measures payload bytes over wall time —
+    framing + socket + serve-loop + cache slice, everything a real
+    cross-pod fetch pays on loopback.
+    """
+    import pathlib
+    import tempfile
+
+    import numpy as np
+
+    from zest_tpu.cas import hashing
+    from zest_tpu.cas.xorb import XorbBuilder
+    from zest_tpu.config import Config
+    from zest_tpu.storage import XorbCache
+    from zest_tpu.transfer import dcn
+
+    rng = np.random.default_rng(0)
+    builder = XorbBuilder()
+    for _ in range(n_chunks):
+        builder.add_chunk(
+            rng.integers(0, 256, chunk_bytes, dtype=np.uint8).tobytes()
+        )
+    blob = builder.serialize_full()
+    with tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                     dcn_port=0)
+        cache = XorbCache(cfg)
+        xh = builder.xorb_hash()
+        cache.put(hashing.hash_to_hex(xh), blob)
+        server = dcn.DcnServer(cfg, cache)
+        server.start()
+        ch = None
+        try:
+            # Inside the try: a failed channel connect must still shut
+            # the server down (otherwise its accept thread + bound
+            # socket outlive the bench and its tempdir).
+            ch = dcn.DcnChannel("127.0.0.1", server.port)
+            step = max(1, n_chunks // window)
+            wants = [(xh, i, min(i + step, n_chunks))
+                     for i in range(0, n_chunks, step)]
+
+            def fetch_all():
+                replies = ch.request_many(wants)
+                for r in replies:
+                    if not isinstance(r, dcn.DcnResponse):
+                        raise RuntimeError(f"DCN bench got {type(r)}")
+
+            payload = n_chunks * chunk_bytes
+            return _time_fn("dcn_fetch_pipelined", fetch_all, payload,
+                            iters=3, repeats=repeats)
+        finally:
+            if ch is not None:
+                ch.close()
+            server.shutdown()
+
+
 def run_synthetic(device: bool = True) -> list[BenchResult]:
     results = bench_bencode()
     results += [bench_blake3_host(), bench_sha1_info_hash(),
@@ -265,6 +331,11 @@ def run_synthetic(device: bool = True) -> list[BenchResult]:
         results.append(bench_wire_frame_native())
     except RuntimeError:
         pass  # no native lib: the pure benches above still stand
+    try:
+        results.append(bench_dcn_fetch())
+    except OSError:
+        pass  # loopback sockets unavailable (sandboxes); a DCN
+        # protocol failure is NOT caught — it must fail the suite.
     if device:
         for bench in (bench_blake3_device, bench_ici_all_gather,
                       bench_ring_attention, bench_pipeline):
