@@ -302,3 +302,23 @@ def test_expert_round_mismatched_placement_raises(hub, tmp_path, ckpt):
             model_mesh({"data": 2, "expert": 4}),
             ExpertPlacement(n_experts=16, num_hosts=4),
         )
+
+
+def test_read_into_matches_read(hub, tmp_path, ckpt):
+    """read_into is the one-copy primitive under land_tensors: byte-equal
+    to read() across term boundaries, and strict about buffer size."""
+    bridge = _bridge(hub, tmp_path)
+    rec = _rec(hub)
+    pod_round(bridge, [rec])
+    reader = CachedFileReader(bridge.cache, rec)
+    for lo, hi in [(0, 100), (0, len(ckpt)), (131_000, 197_123),
+                   (len(ckpt) - 10, len(ckpt)), (5000, 5000)]:
+        buf = bytearray(hi - lo)
+        n = reader.read_into(lo, hi, memoryview(buf))
+        assert n == hi - lo
+        assert bytes(buf) == ckpt[lo:hi], (lo, hi)
+    with pytest.raises(DirectLandingError, match="out buffer"):
+        reader.read_into(0, 100, memoryview(bytearray(99)))
+    with pytest.raises(DirectLandingError):
+        reader.read_into(0, len(ckpt) + 1,
+                         memoryview(bytearray(len(ckpt) + 1)))

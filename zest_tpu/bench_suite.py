@@ -18,6 +18,11 @@ from dataclasses import dataclass
 CHUNK_64K = 64 * 1024
 
 
+class BenchUnavailable(RuntimeError):
+    """The environment can't run this bench (no native lib, no loopback
+    sockets); distinct from a bench FAILURE, which must propagate."""
+
+
 @dataclass
 class BenchResult:
     name: str
@@ -293,13 +298,22 @@ def bench_dcn_fetch(n_chunks: int = 64, chunk_bytes: int = CHUNK_64K,
         xh = builder.xorb_hash()
         cache.put(hashing.hash_to_hex(xh), blob)
         server = dcn.DcnServer(cfg, cache)
-        server.start()
+        try:
+            server.start()
+        except OSError as exc:  # sandbox without sockets: a skip
+            raise BenchUnavailable(f"loopback unavailable: {exc}") from exc
         ch = None
         try:
             # Inside the try: a failed channel connect must still shut
             # the server down (otherwise its accept thread + bound
-            # socket outlive the bench and its tempdir).
-            ch = dcn.DcnChannel("127.0.0.1", server.port)
+            # socket outlive the bench and its tempdir). Setup-stage
+            # socket errors are skips; anything during the timed fetch
+            # (protocol errors, timeouts) propagates as a failure.
+            try:
+                ch = dcn.DcnChannel("127.0.0.1", server.port)
+            except OSError as exc:
+                raise BenchUnavailable(
+                    f"loopback connect failed: {exc}") from exc
             step = max(1, n_chunks // window)
             wants = [(xh, i, min(i + step, n_chunks))
                      for i in range(0, n_chunks, step)]
@@ -333,9 +347,10 @@ def run_synthetic(device: bool = True) -> list[BenchResult]:
         pass  # no native lib: the pure benches above still stand
     try:
         results.append(bench_dcn_fetch())
-    except OSError:
-        pass  # loopback sockets unavailable (sandboxes); a DCN
-        # protocol failure is NOT caught — it must fail the suite.
+    except BenchUnavailable:
+        pass  # no loopback sockets (sandboxes). Protocol failures and
+        # timeouts during the timed fetch are NOT BenchUnavailable —
+        # they fail the suite, as a transport regression should.
     if device:
         for bench in (bench_blake3_device, bench_ici_all_gather,
                       bench_ring_attention, bench_pipeline):

@@ -99,21 +99,45 @@ class CachedFileReader:
         self._term_bytes[i] = data
         return data
 
-    def read(self, lo: int, hi: int) -> bytes:
-        """Bytes [lo, hi) of the reconstructed file."""
+    def _check_range(self, lo: int, hi: int) -> None:
         if not 0 <= lo <= hi <= self.size:
             raise DirectLandingError(
                 f"read [{lo},{hi}) outside file of {self.size} bytes"
             )
-        parts: list[bytes] = []
+
+    def read(self, lo: int, hi: int) -> bytes:
+        """Bytes [lo, hi) of the reconstructed file."""
+        self._check_range(lo, hi)  # before allocating hi-lo bytes
+        out = bytearray(hi - lo)
+        self.read_into(lo, hi, memoryview(out))
+        return bytes(out)
+
+    def read_into(self, lo: int, hi: int, out) -> int:
+        """Copy bytes [lo, hi) straight into ``out`` (any writable
+        buffer of exactly ``hi - lo`` bytes); returns the count.
+
+        One copy per byte — memoryview slices of the decoded terms land
+        in ``out`` directly, where ``read()``'s old slice-then-join
+        paid two. land_tensors decodes multi-GB checkpoints through
+        here, so the extra traversal of every byte was measurable."""
+        self._check_range(lo, hi)
+        view = memoryview(out).cast("B")
+        if view.nbytes != hi - lo:
+            raise DirectLandingError(
+                f"out buffer is {view.nbytes} bytes for a "
+                f"[{lo},{hi}) read"
+            )
+        written = 0
         for i, (t_lo, t_hi, _term) in enumerate(self._spans):
             if t_hi <= lo:
                 continue
             if t_lo >= hi:
                 break
-            data = self._decode_term(i)
-            parts.append(data[max(lo, t_lo) - t_lo : min(hi, t_hi) - t_lo])
-        return b"".join(parts)
+            src = memoryview(self._decode_term(i))  # zero-copy slice
+            piece = src[max(lo, t_lo) - t_lo : min(hi, t_hi) - t_lo]
+            view[written : written + len(piece)] = piece
+            written += len(piece)
+        return written
 
     def drop_memo(self) -> None:
         self._term_bytes.clear()
@@ -143,10 +167,11 @@ def land_tensors(
         if predicate is not None and not predicate(name):
             continue
         lo, hi = info.file_range(header.data_start)
-        raw = reader.read(lo, hi)
-        out[name] = np.frombuffer(raw, dtype=info.np_dtype).reshape(
-            info.shape
-        )
+        # Decode straight into the tensor's own buffer (read_into: one
+        # copy per byte), then view it at the right dtype/shape.
+        buf = np.empty(hi - lo, dtype=np.uint8)
+        reader.read_into(lo, hi, memoryview(buf))
+        out[name] = buf.view(info.np_dtype).reshape(info.shape)
     reader.drop_memo()
     return out
 
