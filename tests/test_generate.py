@@ -626,3 +626,66 @@ def test_prefill_respects_eos():
         llama.init_kv_cache, llama.decode_step, params, cfg,
         [3, 7, 1], 8, eos_id=first_gen)
     np.testing.assert_array_equal(out, np.asarray(seq))
+
+
+@pytest.mark.slow
+def test_concurrent_streams_do_not_serialize():
+    """A short streamed decode must complete while a long one is still
+    in flight. Under the old global jax.effects_barrier() drain, the
+    short request's return blocked on the long request's ENTIRE decode
+    (so by the time it returned, the long stream had delivered all its
+    tokens); the per-request pos=-1 sentinel drains only the caller's
+    own callbacks."""
+    import threading
+
+    import jax
+
+    from zest_tpu.models import llama
+
+    # The overlap assertion below is timing-based (a deterministic gate
+    # would need to block inside the long stream's callback, which runs
+    # on the shared io_callback relay thread and would wedge BOTH
+    # streams). 2048 tiny-model steps give a ~10 s in-flight window —
+    # the main thread would have to stall longer than that between two
+    # adjacent statements for the race to misfire.
+    cfg = llama.LlamaConfig.tiny(n_ctx=2100)
+    params = llama.init_params(jax.random.key(0), cfg)
+    long_steps, short_steps = 2048, 4
+
+    # Pre-compile BOTH streamed signatures so the timed phase measures
+    # decode, not tracing.
+    llama.generate_cached(params, cfg, [1, 2], short_steps,
+                          on_token=lambda *a: None)
+    llama.generate_cached(params, cfg, [1, 2], long_steps,
+                          on_token=lambda *a: None)
+
+    long_tokens: list[int] = []
+    first_token = threading.Event()
+
+    def long_cb(pos, toks):
+        long_tokens.append(int(pos))
+        first_token.set()
+
+    t = threading.Thread(
+        target=lambda: llama.generate_cached(
+            params, cfg, [1, 2], long_steps, on_token=long_cb),
+        daemon=True,
+    )
+    t.start()
+    assert first_token.wait(60.0), "long stream produced no tokens"
+
+    short_seen: list[int] = []
+    llama.generate_cached(params, cfg, [3, 4], short_steps,
+                          on_token=lambda pos, toks: short_seen.append(
+                              int(pos)))
+    # The short stream is fully drained (its own sentinel) ...
+    assert len(short_seen) == short_steps
+    # ... and returned while the long stream was still mid-flight: a
+    # global barrier would have waited for all long_steps callbacks.
+    assert len(long_tokens) < long_steps, (
+        f"short stream's drain waited for the long stream "
+        f"({len(long_tokens)}/{long_steps} tokens already delivered)"
+    )
+    t.join(120.0)
+    assert not t.is_alive()
+    assert len(long_tokens) == long_steps
