@@ -181,7 +181,11 @@ def bench_pull_gb() -> dict:
     # ZEST_BENCH_SCALE divides the geometry (smoke runs; 1 = real 8B
     # shapes — one layer is ~436 MB, so scale=1 floors near 1 GB).
     scale = int(os.environ.get("ZEST_BENCH_SCALE", "1"))
-    return bench_gb_pull(gb=gb, runs=runs, scale=scale)
+    # Wall-clock guard: on a slow chip tunnel the repeat runs are
+    # dropped (never the checkpoint size) once the budget is spent —
+    # one recorded GB-scale run beats a driver-window timeout with none.
+    budget = float(os.environ.get("ZEST_BENCH_BUDGET_S", "1200"))
+    return bench_gb_pull(gb=gb, runs=runs, scale=scale, budget_s=budget)
 
 
 def bench_decode(steps: int = 64) -> dict:

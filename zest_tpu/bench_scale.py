@@ -161,7 +161,8 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
 
 
 def bench_gb_pull(gb: float = 2.0, runs: int = 3,
-                  chunks_per_xorb: int = 512, scale: int = 1) -> dict:
+                  chunks_per_xorb: int = 512, scale: int = 1,
+                  budget_s: float | None = None) -> dict:
     """``runs`` cold GB-scale pulls; per-stage medians + relative spread.
 
     The hub (and the one-time checkpoint + xorb build) is shared across
@@ -170,6 +171,12 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
     above 0.20 the result is flagged ``"stable": false`` so an unstable
     number can't masquerade as a measurement (the fail-loudly rule the
     blake3 bench established).
+
+    ``budget_s`` bounds the whole bench (fixture build + warmup +
+    timed runs): once at least ONE timed run has landed, the loop stops
+    rather than blow the driver's bench window on a slow chip tunnel —
+    losing repeat runs (reported via ``"runs"``) beats losing the
+    entire recorded benchmark. The checkpoint size is never reduced.
     """
     import sys
 
@@ -180,6 +187,7 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
     from zest_tpu.config import Config
     from zest_tpu.transfer.pull import pull_model
 
+    t_bench0 = time.perf_counter()
     t0 = time.perf_counter()
     files = llama_checkpoint_files(gb, scale=scale)
     total = sum(len(b) for b in files.values())
@@ -192,9 +200,20 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
     n_xorbs = len(repo.xorbs)
     gc.collect()  # drop encode-time garbage before any timed run
 
+    # If the fixture build already ate most of the budget, the untimed
+    # warmup pull is a luxury: skip it (flagged below) so the budget
+    # overshoot is at most ONE pull — the single timed run that must
+    # happen for anything to be recorded at all.
+    warmup_runs = 1
+    if (budget_s is not None
+            and time.perf_counter() - t_bench0 > budget_s * 0.5):
+        warmup_runs = 0
     results = []
     with FixtureHub(repo) as hub:
-        for run_i in range(runs + 1):
+        for run_i in range(runs + warmup_runs):
+            if (budget_s is not None and results
+                    and time.perf_counter() - t_bench0 > budget_s):
+                break  # keep what's measured; see docstring
             with tempfile.TemporaryDirectory() as root:
                 rootp = pathlib.Path(root)
                 cfg = Config(hf_home=rootp / "hf",
@@ -208,12 +227,13 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
                 hbm = res.stats.get("hbm") or {}
                 if "error" in hbm:
                     raise RuntimeError(f"HBM commit failed: {hbm['error']}")
-                if run_i > 0:
-                    # Run 0 is an untimed warmup: the first pull of a
-                    # process pays one-off costs (native lib load,
-                    # allocator arena growth, page-cache state) measured
-                    # at 2-3x the steady state — a cold-CACHE number
-                    # should not smuggle in cold-PROCESS costs.
+                if run_i >= warmup_runs:
+                    # Run 0 is an untimed warmup (when the budget
+                    # affords one): the first pull of a process pays
+                    # one-off costs (native lib load, allocator arena
+                    # growth, page-cache state) measured at 2-3x the
+                    # steady state — a cold-CACHE number should not
+                    # smuggle in cold-PROCESS costs.
                     results.append({
                         "wall_s": wall,
                         "stages": res.stats.get("stages", {}),
@@ -252,19 +272,20 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
     return {
         "checkpoint_gb": round(total / 1e9, 3),
         "geometry": f"{geom} bf16",
-        "runs": runs,
+        "runs": len(results),
         "time_to_hbm_s": round(med_hbm, 3),
         "time_to_hbm_runs_s": [round(t, 3) for t in hbm_times],
         "total_pull_s": round(statistics.median(walls), 3),
         "pull_gbps": round(total / med_hbm / 1e9, 3),
         "spread": round(spread, 3),
-        "stable": spread <= 0.20,
+        "stable": spread <= 0.20 and len(results) >= 2,
         "stages": stages,
         "hbm_gbps": statistics.median(
             [r["hbm_gbps"] for r in results if r["hbm_gbps"]] or [0]
         ),
         "direct": all(r["direct"] for r in results),
         "xorbs": n_xorbs,
+        "warmup_skipped": warmup_runs == 0,
         "fixture_gen_s": round(t_gen, 1),
         "fixture_encode_s": round(t_encode, 1),
     }
