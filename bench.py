@@ -183,9 +183,11 @@ def bench_pull_gb() -> dict:
     scale = int(os.environ.get("ZEST_BENCH_SCALE", "1"))
     # Wall-clock guard: on a slow chip tunnel the repeat runs are
     # dropped (never the checkpoint size) once the budget is spent —
-    # one recorded GB-scale run beats a driver-window timeout with none.
+    # one recorded GB-scale run beats a driver-window timeout with
+    # none. <= 0 disables the budget (the conventional env-var "off").
     budget = float(os.environ.get("ZEST_BENCH_BUDGET_S", "1200"))
-    return bench_gb_pull(gb=gb, runs=runs, scale=scale, budget_s=budget)
+    return bench_gb_pull(gb=gb, runs=runs, scale=scale,
+                         budget_s=budget if budget > 0 else None)
 
 
 def bench_decode(steps: int = 64) -> dict:
