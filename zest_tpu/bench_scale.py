@@ -180,9 +180,20 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
     """
     import sys
 
-    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
-                           / "tests"))
-    from fixtures import FixtureHub, FixtureRepo
+    # The loopback hub lives in tests/ (it is a test double, not
+    # product code). Scope the path injection to the import so an
+    # installed package without the checkout fails with a clean
+    # ImportError here — and nothing named "fixtures" stays shadowed
+    # in the host process.
+    tests_dir = str(pathlib.Path(__file__).resolve().parent.parent / "tests")
+    sys.path.insert(0, tests_dir)
+    try:
+        from fixtures import FixtureHub, FixtureRepo
+    finally:
+        try:
+            sys.path.remove(tests_dir)
+        except ValueError:
+            pass
 
     from zest_tpu.config import Config
     from zest_tpu.transfer.pull import pull_model
