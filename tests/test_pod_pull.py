@@ -251,3 +251,60 @@ def test_get_reconstruction_memoized(hub, tmp_path):
     r2 = bridge.get_reconstruction(fhash)
     assert r1 is r2
     assert len(hub.requests_seen) == mid > before  # second call: no HTTP
+
+
+@pytest.mark.slow
+def test_expert_routed_pull_end_to_end(tmp_path):
+    """BASELINE config #4 through the production entry point: a
+    Mixtral-family ``pull_model(device="tpu")`` must dispatch to the
+    expert-routed round — expert-private xorbs fetched only by their
+    owner host, never all-gathered — and still produce a byte-identical
+    snapshot. The reference replicates every file to every asker
+    (src/swarm.zig:279-314); this is the behavior that beats it."""
+    import json
+
+    from tests.test_moe import _hf_mixtral_tensors
+    from zest_tpu.models import moe
+    from zest_tpu.models.safetensors_io import write_safetensors
+
+    cfg_m = moe.MoEConfig.tiny(n_layer=1, n_experts=4, n_embd=64,
+                               d_ff=512, vocab_size=64)
+    path = tmp_path / "model.safetensors"
+    write_safetensors(path, _hf_mixtral_tensors(cfg_m))
+    ckpt = path.read_bytes()
+    config = {"model_type": "mixtral", "num_local_experts": 4}
+    repo = FixtureRepo(
+        "acme/tiny-mixtral",
+        {"config.json": json.dumps(config).encode(),
+         "model.safetensors": ckpt},
+        chunks_per_xorb=2,
+    )
+    with FixtureHub(repo) as hub:
+        cfg = _cfg(hub, tmp_path)
+        res = pull_model(cfg, "acme/tiny-mixtral", device="tpu",
+                         no_p2p=True, log=lambda *a, **k: None)
+    pod = res.stats["pod"]
+    assert pod["expert_routed"] is True
+    assert pod["n_experts"] == 4
+    assert pod["expert_units_fetched"] > 0
+    assert pod["expert_units_failed"] == 0
+    # The gather moved strictly less than the checkpoint: expert bytes
+    # stayed private to their owners (the saving the plan promises).
+    assert pod["expert_bytes"] > 0
+    assert pod["ici_bytes_saved"] >= pod["expert_bytes"] * 7  # 8 slots
+    assert pod["shared"]["planned_bytes"] < len(ckpt)
+    # End-to-end integrity is unchanged by the routing split.
+    out = res.snapshot_dir / "model.safetensors"
+    assert out.read_bytes() == ckpt
+
+
+@pytest.mark.slow
+def test_dense_pull_takes_plain_round(hub, tmp_path):
+    """A non-MoE repo through the same dispatch must take the plain
+    all-gather round (no expert fields in stats)."""
+    cfg = _cfg(hub, tmp_path)
+    res = pull_model(cfg, "acme/pod-model", device="tpu", no_p2p=True,
+                     log=lambda *a, **k: None)
+    pod = res.stats["pod"]
+    assert "expert_routed" not in pod
+    assert pod["filled"] > 0

@@ -49,6 +49,18 @@ _FAMILIES: dict[str, Callable[[], ShardRules]] = {
 }
 
 
+# Families whose checkpoints carry per-expert weight tensors: their
+# pulls route expert-private xorbs to the owner host instead of
+# all-gathering every byte (BASELINE config #4, transfer.pod.
+# expert_pod_round). The reference replicates whole files to every
+# asker (src/swarm.zig:279-314); this set is what opts a family out.
+_EXPERT_SHARDED = {"mixtral"}
+
+
+def is_expert_sharded(model_type: str | None) -> bool:
+    return (model_type or "") in _EXPERT_SHARDED
+
+
 def shard_rules_for_model_type(model_type: str | None) -> ShardRules | None:
     factory = _FAMILIES.get(model_type or "")
     return factory() if factory else None

@@ -156,13 +156,9 @@ def pull_model(
                         file=sys.stderr)
             if recs and pod:
                 try:
-                    from zest_tpu.transfer.pod import pod_round
-
-                    # Byte distribution always runs over the 1-D pod mesh
-                    # (pod_round's default) — the N-D model mesh from
-                    # config is for checkpoint *landing*, not bytes.
-                    pod_stats = pod_round(bridge, recs,
-                                          log=lambda m: log(m))
+                    pod_stats = _pod_stage(
+                        bridge, pending, recs, hub, repo_id, revision,
+                        files, snapshot_dir, log)
                 except Exception as exc:  # noqa: BLE001
                     log(f"pod round unavailable ({exc}); "
                         "continuing with the per-host waterfall",
@@ -310,15 +306,15 @@ def _try_direct_stage(
         return None, None
 
 
-def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
-    """Family shard rules for direct landing (models.registry dispatch).
+def _early_config(hub, repo_id, revision, files, snapshot_dir) -> dict | None:
+    """config.json parsed before the file loop runs.
 
-    Direct landing runs before any file is written, so config.json may
-    not be on disk yet — download it early (the file loop will skip it
-    via ``_is_complete``). Returns None on any miss: the loader's
-    infer_spec fallback still lands the bytes balanced.
-    """
-    from zest_tpu.models.registry import shard_rules_for_snapshot
+    The pod pre-pass and direct landing both dispatch on the model
+    family, and both run before any file is written — so config.json is
+    downloaded early here (the file loop later skips it via
+    ``_is_complete``). Returns None on any miss: callers degrade to the
+    family-agnostic path."""
+    import json
 
     dest = snapshot_dir / "config.json"
     if not dest.exists():
@@ -328,9 +324,122 @@ def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
         try:
             dest.parent.mkdir(parents=True, exist_ok=True)
             hub.download_regular_file(repo_id, revision, entry.path, dest)
-        except Exception:  # noqa: BLE001 - rules are an optimization
+        except Exception:  # noqa: BLE001 - family dispatch is optional
             return None
-    return shard_rules_for_snapshot(snapshot_dir)
+    try:
+        cfg = json.loads(dest.read_text())
+    except (OSError, ValueError):
+        return None
+    return cfg if isinstance(cfg, dict) else None
+
+
+def _pod_stage(bridge, pending, recs, hub, repo_id, revision, files,
+               snapshot_dir, log):
+    """Collective byte distribution, family-dispatched.
+
+    Expert-sharded families (models.registry.is_expert_sharded — Mixtral)
+    route each expert's private xorbs to the one host whose shard
+    consumes them (BASELINE config #4); everything else — and any
+    failure inside the routing pre-pass — takes the plain all-gather
+    round (config #3). Byte distribution always runs over the 1-D pod
+    mesh (pod_round's default) — the N-D model mesh from config is for
+    checkpoint *landing*, not bytes.
+
+    **Multi-process safety**: the expert-vs-plain choice changes the
+    collective's plan (shapes and count of all-gather rows), so every
+    process MUST take the same branch — but the dispatch inputs
+    (config.json download, header fetches) can fail per-host. All
+    fallible pre-pass work therefore happens BEFORE any collective,
+    folded into one local ``ready`` bit, and multi-process runs agree
+    on ``all(ready)`` via a host-level allgather; a host with a
+    transient HTTP failure downgrades the whole pod to the plain round
+    instead of hanging it on mismatched collectives. The routing inputs
+    themselves are content-addressed (pinned revision), so successful
+    prep is identical everywhere by construction."""
+    from zest_tpu.models.registry import is_expert_sharded
+    from zest_tpu.parallel.mesh import num_slots, pod_mesh
+    from zest_tpu.transfer.pod import pod_round
+
+    import jax
+
+    cfg_json = _early_config(hub, repo_id, revision, files, snapshot_dir)
+    n_experts = int((cfg_json or {}).get("num_local_experts") or 0)
+    mesh = pod_mesh()
+    prepped = None
+    if (cfg_json and is_expert_sharded(cfg_json.get("model_type"))
+            and n_experts > 0 and num_slots(mesh) > 1):
+        try:
+            prepped = _expert_prep(bridge, pending, recs, n_experts, mesh)
+        except Exception as exc:  # noqa: BLE001 - routing is an accelerator
+            log(f"expert routing unavailable ({exc}); "
+                "falling back to the plain pod round", file=sys.stderr)
+    if jax.process_count() > 1:
+        # Unconditional when multi-process (a host that failed even the
+        # config download must still rendezvous here): one tiny
+        # host-level allgather of the local ready bit.
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            _np.asarray([prepped is not None]))
+        if not bool(flags.all()):
+            if prepped is not None:
+                log("expert routing disabled: another host's pre-pass "
+                    "failed; taking the plain pod round", file=sys.stderr)
+            prepped = None
+    if prepped is not None:
+        return _expert_stage(bridge, prepped, mesh, log)
+    return pod_round(bridge, recs, mesh=mesh, log=lambda m: log(m))
+
+
+def _expert_prep(bridge, pending, recs, n_experts, mesh):
+    """All fallible expert-routing inputs, fetched before any collective:
+    safetensors headers (through the waterfall) → per-file tensor→expert
+    maps + the placement. Returns (file_maps, other_recs, placement)."""
+    from zest_tpu.models import moe
+    from zest_tpu.parallel.expert import ExpertPlacement, classify_file
+    from zest_tpu.parallel.mesh import num_slots
+    from zest_tpu.transfer.pod import fetch_file_header
+
+    placement = ExpertPlacement(n_experts, num_hosts=num_slots(mesh))
+    file_maps, other = [], []
+    for entry, rec in zip(pending, recs):
+        if entry.path.endswith(".safetensors"):
+            header = fetch_file_header(bridge, rec)
+            file_maps.append(
+                classify_file(rec, header, moe.expert_of_tensor))
+        else:
+            other.append(rec)
+    if not file_maps:
+        raise ValueError("no safetensors files to expert-route")
+    return file_maps, other, placement
+
+
+def _expert_stage(bridge, prepped, mesh, log):
+    """Expert-routed distribution (transfer.pod.expert_pod_round) for
+    the safetensors files; any other xet files (tokenizers etc.) still
+    ride the plain round, reported under ``"other"``."""
+    from zest_tpu.transfer.pod import expert_pod_round, pod_round
+
+    file_maps, other, placement = prepped
+    stats = expert_pod_round(bridge, file_maps, placement, mesh=mesh,
+                             log=lambda m: log(m))
+    stats["expert_routed"] = True
+    stats["n_experts"] = placement.n_experts
+    if other:
+        stats["other"] = pod_round(bridge, other, mesh=mesh,
+                                   log=lambda m: log(m))
+    return stats
+
+
+def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
+    """Family shard rules for direct landing (models.registry dispatch).
+    Returns None on any miss: the loader's infer_spec fallback still
+    lands the bytes balanced."""
+    from zest_tpu.models.registry import shard_rules_for_model_type
+
+    cfg_json = _early_config(hub, repo_id, revision, files, snapshot_dir)
+    return shard_rules_for_model_type((cfg_json or {}).get("model_type"))
 
 
 def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
