@@ -93,10 +93,14 @@ class TestNativeCrossCheck:
         return lib
 
     # Exact multiples of 8/16 KiB pin the wide cores' have_final tails
-    # (a group whose last lane IS the final chunk, pushed N-1 + promoted).
+    # (a group whose last lane IS the final chunk, pushed N-1 + promoted);
+    # >256 KiB (n_chunks > 256) exercises the heap-allocation branch and
+    # the >128-chunk level-order tree shapes the SIMD fold rewrote —
+    # 524_288 is an exact 512-chunk tree, the others odd-promote.
     @pytest.mark.parametrize(
         "n", [0, 1, 31, 64, 65, 1023, 1024, 1025, 2048, 4096, 8192,
-              10_000, 16_384, 24_576, 32_768, 70_000, 131_072]
+              10_000, 16_384, 24_576, 32_768, 70_000, 131_072,
+              300_001, 524_288, 1_048_577]
     )
     def test_lengths(self, native, n):
         data = _pattern(n)

@@ -642,35 +642,48 @@ def test_concurrent_streams_do_not_serialize():
 
     from zest_tpu.models import llama
 
-    # The overlap assertion below is timing-based (a deterministic gate
-    # would need to block inside the long stream's callback, which runs
-    # on the shared io_callback relay thread and would wedge BOTH
-    # streams). 2048 tiny-model steps give a ~10 s in-flight window —
-    # the main thread would have to stall longer than that between two
-    # adjacent statements for the race to misfire.
-    cfg = llama.LlamaConfig.tiny(n_ctx=2100)
+    # DETERMINISTIC gate (no wall-clock window): the long stream's
+    # callback BLOCKS on `release` after its first token. Ordered
+    # io_callbacks serialize within one computation, so the long decode
+    # provably cannot advance past token 1 — and `release` is only set
+    # AFTER the short stream returns. If the short stream's drain used a
+    # global barrier (the old bug), it would wait on the long stream's
+    # wedged callback queue and deadlock here (caught by the callback's
+    # own timeout → loud failure), never falsely pass. Callbacks of
+    # DIFFERENT computations run independently (verified: the short
+    # stream's relay is not behind the long stream's blocked one).
+    cfg = llama.LlamaConfig.tiny(n_ctx=64)
     params = llama.init_params(jax.random.key(0), cfg)
-    long_steps, short_steps = 2048, 4
+    long_steps, short_steps = 8, 4
 
-    # Pre-compile BOTH streamed signatures so the timed phase measures
+    # Pre-compile BOTH streamed signatures so the gated phase exercises
     # decode, not tracing.
     llama.generate_cached(params, cfg, [1, 2], short_steps,
                           on_token=lambda *a: None)
     llama.generate_cached(params, cfg, [1, 2], long_steps,
                           on_token=lambda *a: None)
 
-    long_tokens: list[int] = []
+    release = threading.Event()
     first_token = threading.Event()
+    release_was_set_first = []
+    long_tokens: list[int] = []
 
     def long_cb(pos, toks):
         long_tokens.append(int(pos))
         first_token.set()
+        # Block the long stream's ordered-callback chain until the test
+        # releases it. The timeout turns a global-barrier deadlock into
+        # a loud assertion instead of a hung suite.
+        release_was_set_first.append(release.wait(120.0))
 
-    t = threading.Thread(
-        target=lambda: llama.generate_cached(
-            params, cfg, [1, 2], long_steps, on_token=long_cb),
-        daemon=True,
-    )
+    long_done = threading.Event()
+
+    def run_long():
+        llama.generate_cached(params, cfg, [1, 2], long_steps,
+                              on_token=long_cb)
+        long_done.set()
+
+    t = threading.Thread(target=run_long, daemon=True)
     t.start()
     assert first_token.wait(60.0), "long stream produced no tokens"
 
@@ -678,14 +691,24 @@ def test_concurrent_streams_do_not_serialize():
     llama.generate_cached(params, cfg, [3, 4], short_steps,
                           on_token=lambda pos, toks: short_seen.append(
                               int(pos)))
-    # The short stream is fully drained (its own sentinel) ...
+    # The short stream is fully drained (its own sentinel) while the
+    # long stream is PROVABLY incomplete — its callback chain is still
+    # blocked on `release`, which nothing has set yet.
     assert len(short_seen) == short_steps
-    # ... and returned while the long stream was still mid-flight: a
-    # global barrier would have waited for all long_steps callbacks.
-    assert len(long_tokens) < long_steps, (
-        f"short stream's drain waited for the long stream "
-        f"({len(long_tokens)}/{long_steps} tokens already delivered)"
+    assert not long_done.is_set(), (
+        "long stream completed while its callback was blocked — the "
+        "blocking gate is broken"
     )
+    assert len(long_tokens) <= 2, (
+        f"ordered callbacks ran past the block "
+        f"({len(long_tokens)}/{long_steps} delivered)"
+    )
+    release.set()
     t.join(120.0)
     assert not t.is_alive()
+    assert long_done.is_set()
     assert len(long_tokens) == long_steps
+    assert all(release_was_set_first), (
+        "a long-stream callback timed out waiting for release: the "
+        "short stream's drain serialized behind the long stream"
+    )

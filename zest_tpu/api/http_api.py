@@ -313,18 +313,26 @@ class HttpApi:
         from zest_tpu.transfer.pull import pull_model
 
         key = (repo_id, revision)
-        hit = self._pulled.get(key)
-        now = time.monotonic()
-        if hit is not None and hit[1] > now and hit[0].is_dir():
-            return hit[0]
+        # The memo dict is shared across request-handler threads; its
+        # read and its evict+insert hold the same lock the generator
+        # cache uses. The pull itself runs unlocked — a slow cold pull
+        # must not serialize every other request (worst case two
+        # threads pull the same repo; pull_model is idempotent).
+        with self._gen_lock:
+            hit = self._pulled.get(key)
+            now = time.monotonic()
+            if hit is not None and hit[1] > now and hit[0].is_dir():
+                return hit[0]
         res = pull_model(self.cfg, repo_id, revision=revision,
                          swarm=self.swarm, log=lambda *a, **k: None)
         # Evict expired entries on insert: a long-lived daemon serving
         # many repos must not grow this dict forever (the generator
         # cache above is LRU-capped for the same reason).
-        self._pulled = {k: v for k, v in self._pulled.items()
-                        if v[1] > now}
-        self._pulled[key] = (res.snapshot_dir, now + self._PULL_TTL_S)
+        with self._gen_lock:
+            now = time.monotonic()
+            self._pulled = {k: v for k, v in self._pulled.items()
+                            if v[1] > now}
+            self._pulled[key] = (res.snapshot_dir, now + self._PULL_TTL_S)
         return res.snapshot_dir
 
     @staticmethod

@@ -75,7 +75,17 @@ def _stream_relay(tag, pos, tokens):
     if int(pos) < 0:
         done.set()
     else:
-        cb(pos, tokens)
+        try:
+            cb(pos, tokens)
+        except Exception:  # noqa: BLE001
+            # An exception escaping a host callback is undefined
+            # behavior on TPU (can wedge the runtime) and would block
+            # every later token of this stream behind the 30 s done
+            # timeout — a third-party on_token must not reach either
+            # path. Dropped, not re-raised; the stream keeps flowing.
+            import traceback
+
+            traceback.print_exc()
 
 
 def normalize_eos(eos) -> tuple[int, ...] | None:

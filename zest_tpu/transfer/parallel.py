@@ -44,10 +44,16 @@ class ParallelDownloader:
 
         out_path = Path(out_path)
         out_path.parent.mkdir(parents=True, exist_ok=True)
-        tmp_path = out_path.with_name(f".tmp-{out_path.name}")
-        cancel = threading.Event()
+        # Unique per call (mkstemp), not a fixed ".tmp-<name>": two
+        # concurrent pulls of the same repo (the serving memo allows the
+        # race — pull_model is idempotent) must not truncate or replace
+        # each other's half-written file; both finish, last rename wins.
+        import tempfile
 
-        fd = os.open(tmp_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        fd, tmp_name = tempfile.mkstemp(dir=out_path.parent,
+                                        prefix=f".tmp-{out_path.name}.")
+        tmp_path = Path(tmp_name)
+        cancel = threading.Event()
         try:
             os.ftruncate(fd, total)
 
