@@ -112,18 +112,19 @@ test_all() {
 
 # ── report: parse + gate + summary.json ──
 report() {
-    python - "$RESULTS" "$NODES" <<'EOF'
+    python - "$RESULTS" "$NODES" "$MODEL_BYTES" <<'EOF'
 import json, pathlib, re, sys
 
 results = pathlib.Path(sys.argv[1])
 n_nodes = int(sys.argv[2])
+model_bytes = int(sys.argv[3])
 
 def parse(name):
     text = (results / name).read_text()
     def grab(pat, cast=float):
         m = re.search(pat, text)
         return cast(m.group(1)) if m else None
-    return {
+    out = {
         "wall_seconds": grab(r"wall_seconds: ([\d.]+)"),
         "elapsed_seconds": grab(r"Elapsed:\s+([\d.]+)s"),
         "bytes_from_cache": grab(r"From cache:\s+(\d+)", int),
@@ -131,6 +132,19 @@ def parse(name):
         "bytes_from_cdn": grab(r"From CDN:\s+(\d+)", int),
         "p2p_ratio": grab(r"P2P ratio:\s+([\d.]+)%"),
     }
+    # Per-stage decomposition + GB/s/host (reference tier-3 records
+    # only wall-clocks, p2p-test.sh:325-390; stages are this build's
+    # tracing story surfaced into the harness artifact).
+    stages = {}
+    m = re.search(r"Stages:\s+(.+)", text)
+    if m:
+        for sm in re.finditer(r"(\w+) ([\d.]+)s", m.group(1)):
+            stages[sm.group(1)] = float(sm.group(2))
+    out["stages"] = stages
+    el = out["elapsed_seconds"]
+    out["gbps_per_host"] = (
+        round(model_bytes / el / 1e9, 3) if el else None)
+    return out
 
 t1, t2, t3, t4 = (parse(f"test{i}_{n}.txt") for i, n in
                   ((1, "cdn_baseline"), (2, "p2p_1peer"),
@@ -149,13 +163,20 @@ def speedup(base, other):
 
 summary = {
     "nodes": n_nodes,
+    "model_bytes": model_bytes,
     "cdn_baseline": t1,
     "p2p_1peer": t2,
     "p2p_2peers": t3,
     "repull_cached": t4,
     "speedup_1peer": speedup(secs(t1), secs(t2)),
     "speedup_2peers": speedup(secs(t1), secs(t3)),
-    "speedup_repull": speedup(secs(t1), secs(t4)),
+    # In-process elapsed ONLY: a wall-clock repull is dominated by the
+    # ~4 s interpreter+jax import and compares apples-to-oranges with
+    # BASELINE.md's >300x target (a daemon pays the import once). The
+    # elapsed-less case surfaces as null, not a fake wall number.
+    "speedup_repull": speedup(t1["elapsed_seconds"],
+                              t4["elapsed_seconds"]),
+    "speedup_repull_wall": speedup(secs(t1), secs(t4)),
 }
 json.dump(summary, open(results / "summary.json", "w"), indent=1)
 print(json.dumps(summary, indent=1))
@@ -175,6 +196,8 @@ if t4["bytes_from_cdn"] is None or t4["bytes_from_peers"] is None:
     print("FAIL: re-pull output unparseable"); ok = False
 elif t4["bytes_from_cdn"] or t4["bytes_from_peers"]:
     print("FAIL: re-pull hit the network"); ok = False
+if t4["elapsed_seconds"] is None:
+    print("FAIL: re-pull in-process elapsed missing"); ok = False
 sys.exit(0 if ok else 1)
 EOF
 }
