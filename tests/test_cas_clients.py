@@ -158,3 +158,53 @@ def test_fixture_dedup_across_files():
             s |= {h for h, _ in XorbReader(repo.xorbs[xh].blob).chunk_hashes()}
         chunk_sets.append(s)
     assert chunk_sets[0] & chunk_sets[1], "no shared chunks despite shared content"
+
+
+class TestStreamingFetch:
+    """fetch_xorb_iter — the streaming shape the GB-scale warm path
+    writes straight into cache files (one memory pass fewer)."""
+
+    def test_iter_matches_bulk(self, cfg, hub):
+        from zest_tpu.cas.client import CasClient
+
+        cas = CasClient(hub.url, "hf_test")
+        xh_hex = next(iter(hub.repos["test-org/tiny-model"].xorbs))
+        xf = hub.repos["test-org/tiny-model"].xorbs[xh_hex]
+        url = hub.url + f"/xorbs/{xh_hex}"
+        assert b"".join(cas.fetch_xorb_iter(url)) == xf.full
+        rng = (2, xf.frame_offsets[1])
+        assert (b"".join(cas.fetch_xorb_iter(url, rng))
+                == xf.full[rng[0]:rng[1]])
+
+    def test_trims_when_origin_ignores_range(self):
+        """A 200 response to a ranged request must stream out exactly
+        the window (the old bulk path sliced locally; the iterator
+        trims as chunks pass)."""
+        import http.server
+        import threading
+
+        from zest_tpu.cas.client import CasClient
+
+        body = bytes(range(256)) * 8192  # 2 MiB, crosses chunk bounds
+
+        class NoRange(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)  # ignores Range on purpose
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), NoRange)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+            cas = CasClient(url, "hf_test")
+            for lo, hi in [(0, 10), (1000, 1_500_000), (2 * 1024 * 1024 - 7,
+                                                        2 * 1024 * 1024)]:
+                got = b"".join(cas.fetch_xorb_iter(url, (lo, hi)))
+                assert got == body[lo:hi], (lo, hi)
+        finally:
+            srv.shutdown()

@@ -144,3 +144,33 @@ class TestHostileInput:
         for start, end in [(-1, 1), (0, 2), (1, 1), (2, 1)]:
             with pytest.raises(XorbFormatError):
                 r.extract_chunk_range(start, end)
+
+
+def test_extract_range_into_matches_extract_chunk_range():
+    """The in-place decode (landing fast path) must be byte-identical to
+    the allocating path for stored and compressed chunks, with and
+    without a verifying footer, and reject wrong-size buffers."""
+    import numpy as np
+
+    from zest_tpu.cas.xorb import XorbBuilder, XorbFormatError, XorbReader
+
+    rng = np.random.default_rng(42)
+    builder = XorbBuilder()
+    chunks = [
+        rng.integers(0, 256, 70_000, dtype=np.uint8).tobytes(),  # stored
+        b"compress me " * 5000,                                  # LZ4
+        rng.integers(0, 256, 1024, dtype=np.uint8).tobytes(),
+        b"\x00" * 50_000,
+    ]
+    for c in chunks:
+        builder.add_chunk(c)
+    for blob in (builder.serialize(), builder.serialize_full()):
+        reader = XorbReader(blob)
+        for s, e in [(0, 4), (1, 3), (0, 1), (3, 4)]:
+            want = reader.extract_chunk_range(s, e)
+            out = bytearray(len(want))
+            n = reader.extract_range_into(s, e, out)
+            assert n == len(want)
+            assert bytes(out) == want, (s, e)
+        with pytest.raises(XorbFormatError, match="out buffer"):
+            reader.extract_range_into(0, 2, bytearray(3))

@@ -52,6 +52,17 @@ class CasClient:
         Presigned CDN URLs carry their own auth — the bearer header is only
         sent to the CAS origin itself (same-origin check on the URL).
         """
+        return b"".join(self.fetch_xorb_iter(url, byte_range))
+
+    def fetch_xorb_iter(self, url: str,
+                        byte_range: tuple[int, int] | None = None):
+        """Same fetch as :meth:`fetch_xorb_from_url`, yielded as ~1 MiB
+        chunks — the streaming shape the GB-scale warm path writes
+        straight into cache files (storage.atomic_write_stream) so no
+        whole-unit buffer is built. 1 MiB reads, not ``resp.content``:
+        requests accumulates bodies in 10 KiB chunks, which measures
+        ~2x slower on multi-MB xorb units (per-chunk allocation and
+        socket wakeups dominate)."""
         headers: dict[str, str] = {}
         if url.startswith(self.cas_url):
             headers.update(self._headers())
@@ -60,11 +71,29 @@ class CasClient:
             if not (0 <= start < end):
                 raise CasError(f"invalid byte range [{start},{end})")
             headers["Range"] = f"bytes={start}-{end - 1}"
-        resp = self.session.get(url, headers=headers, timeout=120)
-        if resp.status_code not in (200, 206):
-            raise CasError(f"GET {url} -> {resp.status_code}")
-        data = resp.content
-        if byte_range is not None and resp.status_code == 200:
-            # Origin ignored the Range header; slice locally.
-            data = data[byte_range[0] : byte_range[1]]
-        return data
+        resp = self.session.get(url, headers=headers, timeout=120,
+                                stream=True)
+        try:
+            if resp.status_code not in (200, 206):
+                raise CasError(f"GET {url} -> {resp.status_code}")
+            if byte_range is not None and resp.status_code == 200:
+                # Origin ignored the Range header; trim the full body to
+                # the window as it streams past.
+                lo, hi = byte_range
+                pos = 0
+                for chunk in resp.iter_content(1024 * 1024):
+                    a, b = max(lo - pos, 0), min(hi - pos, len(chunk))
+                    if a < b:
+                        yield (chunk[a:b] if (a, b) != (0, len(chunk))
+                               else chunk)
+                    pos += len(chunk)
+                    if pos >= hi:
+                        break
+                return
+            yield from resp.iter_content(1024 * 1024)
+        finally:
+            # Also runs when the CONSUMER abandons the generator (write
+            # error mid-stream → GeneratorExit lands at the yield):
+            # without the close, the pooled connection stays checked out
+            # with an unread body and every retry burns a new socket.
+            resp.close()

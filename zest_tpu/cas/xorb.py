@@ -328,6 +328,49 @@ class XorbReader:
             self.extract_chunk(i, verify=verify) for i in range(start, end)
         )
 
+    def extract_range_into(self, start: int, end: int, out) -> int:
+        """Decode chunks [start, end) directly into ``out`` (a writable
+        buffer of exactly the range's uncompressed size); returns the
+        byte count.
+
+        The GB-scale landing path decodes most bytes through here:
+        stored chunks (scheme NONE, the common case for incompressible
+        bf16 weights) copy frame→destination with no intermediate bytes
+        object, skipping the per-chunk allocation and the final join
+        that ``extract_chunk_range`` pays. Chunks that are compressed
+        or carry a footer hash take the verifying
+        :meth:`extract_chunk` path and are then copied in."""
+        self._check_range(start, end)
+        view = memoryview(out).cast("B")
+        total = sum(self.entries[i].uncompressed_len
+                    for i in range(start, end))
+        if view.nbytes != total:
+            raise XorbFormatError(
+                f"out buffer is {view.nbytes} bytes for a "
+                f"{total}-byte chunk range"
+            )
+        pos = 0
+        for i in range(start, end):
+            e = self.entries[i]
+            if e.scheme == compression.Scheme.NONE and e.hash is None:
+                if e.compressed_len != e.uncompressed_len:
+                    # Same contract as compression.decompress's stored
+                    # path — a hostile frame must raise the module's
+                    # error type, not a bare memoryview ValueError.
+                    raise XorbFormatError(
+                        f"stored chunk {i} claims {e.uncompressed_len} "
+                        f"bytes but frames {e.compressed_len}"
+                    )
+                p0 = e.frame_offset + FRAME_HEADER_LEN
+                view[pos:pos + e.uncompressed_len] = \
+                    self._data[p0:p0 + e.compressed_len]
+                pos += e.uncompressed_len
+            else:
+                data = self.extract_chunk(i)
+                view[pos:pos + len(data)] = data
+                pos += len(data)
+        return pos
+
     def slice_range(self, start: int, end: int) -> bytes:
         """Raw frame bytes for chunks [start, end) — what a seeder sends on
         the wire and what lands in a partial cache entry."""

@@ -46,26 +46,37 @@ class CachedFileReader:
         self.size = off
         self._term_bytes: dict[int, bytes] = {}
 
-    def _decode_term(self, i: int) -> bytes:
-        data = self._term_bytes.get(i)
-        if data is not None:
-            return data
-        _lo, _hi, term = self._spans[i]
+    def _locate(self, term):
+        """(fi, reader, local_start, local_end) for a cached term, or
+        (fi, None, 0, 0) on a cache miss — the one place the fetch-info
+        lookup, cache read, and chunk-index rebase live, shared by the
+        memoizing and in-place decode paths so their semantics cannot
+        drift. Raises DirectLandingError when no fetch_info covers the
+        term; decode errors propagate (ValueError family) for the
+        callers' self-heal."""
         fi = self.rec.find_fetch_info(term)
         if fi is None:
             raise DirectLandingError(
                 f"no fetch_info covers term {term.hash_hex}"
             )
         entry = self.cache.get_with_range(term.hash_hex, fi.range.start)
+        if entry is None:
+            return fi, None, 0, 0
+        return (fi, XorbReader(entry.data),
+                term.range.start - entry.chunk_offset,
+                term.range.end - entry.chunk_offset)
+
+    def _decode_term(self, i: int) -> bytes:
+        data = self._term_bytes.get(i)
+        if data is not None:
+            return data
+        _lo, _hi, term = self._spans[i]
         data = None
         decode_err: ValueError | None = None
-        if entry is not None:
+        fi, reader, local_start, local_end = self._locate(term)
+        if reader is not None:
             try:
-                local_start = term.range.start - entry.chunk_offset
-                local_end = term.range.end - entry.chunk_offset
-                data = XorbReader(entry.data).extract_chunk_range(
-                    local_start, local_end
-                )
+                data = reader.extract_chunk_range(local_start, local_end)
             except ValueError as exc:  # XorbFormatError / CompressionError
                 # Corrupt/short cached entry: with a bridge it costs one
                 # term refetch (which overwrites the bad cache key — the
@@ -98,6 +109,25 @@ class CachedFileReader:
             )
         self._term_bytes[i] = data
         return data
+
+    def _decode_term_into(self, i: int, dest) -> int:
+        """Decode term ``i`` straight into ``dest`` (exactly the term's
+        unpacked length) — the no-memo fast lane for terms wholly inside
+        one tensor's read: frame payloads land in the tensor's own
+        buffer (XorbReader.extract_range_into), no per-term bytes object
+        or join. Any miss or decode failure falls back to
+        :meth:`_decode_term` (waterfall + self-heal) and copies."""
+        _lo, _hi, term = self._spans[i]
+        try:
+            _fi, reader, local_start, local_end = self._locate(term)
+            if reader is not None:
+                return reader.extract_range_into(local_start, local_end,
+                                                 dest)
+        except ValueError:
+            pass  # corrupt entry: the slow path self-heals
+        data = self._decode_term(i)
+        dest[:] = data
+        return len(data)
 
     def _check_range(self, lo: int, hi: int) -> None:
         if not 0 <= lo <= hi <= self.size:
@@ -133,6 +163,16 @@ class CachedFileReader:
                 continue
             if t_lo >= hi:
                 break
+            if lo <= t_lo and t_hi <= hi and i not in self._term_bytes:
+                # Term wholly inside the read and not already decoded:
+                # land it in place (no memo — a term can be wholly
+                # inside at most one tensor, so nothing re-reads it;
+                # boundary terms shared by adjacent tensors take the
+                # memoized branch below both times).
+                written += self._decode_term_into(
+                    i, view[written : written + t_hi - t_lo]
+                )
+                continue
             src = memoryview(self._decode_term(i))  # zero-copy slice
             piece = src[max(lo, t_lo) - t_lo : min(hi, t_hi) - t_lo]
             view[written : written + len(piece)] = piece

@@ -23,11 +23,23 @@ from zest_tpu.config import Config
 
 def atomic_write(path: Path, data: bytes) -> None:
     """Write via tmp file + rename so readers never observe partial content."""
+    atomic_write_stream(path, (data,))
+
+
+def atomic_write_stream(path: Path, chunks) -> int:
+    """``atomic_write`` fed by an iterator of byte chunks; returns the
+    byte count. The GB-scale fetch path streams network bodies straight
+    to their cache file through this — each ~1 MiB chunk is written
+    while still cache-hot, and no whole-unit buffer is ever built
+    (one full memory pass fewer than fetch-then-put)."""
     path.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+    n = 0
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(data)
+            for chunk in chunks:
+                f.write(chunk)
+                n += len(chunk)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -35,6 +47,7 @@ def atomic_write(path: Path, data: bytes) -> None:
         except OSError:
             pass
         raise
+    return n
 
 
 # ── HF refs (reference: storage.zig:57-86) ──
@@ -119,6 +132,14 @@ class XorbCache:
 
     def put_partial(self, hash_hex: str, range_start: int, data: bytes) -> None:
         atomic_write(self._path(f"{hash_hex}.{range_start}"), data)
+
+    def put_stream(self, hash_hex: str, chunks) -> int:
+        return atomic_write_stream(self._path(hash_hex), chunks)
+
+    def put_partial_stream(self, hash_hex: str, range_start: int,
+                           chunks) -> int:
+        return atomic_write_stream(
+            self._path(f"{hash_hex}.{range_start}"), chunks)
 
 
 def list_cached_xorbs(cfg: Config) -> list[str]:

@@ -258,6 +258,26 @@ class XetBridge:
         self.stats.record("cdn", len(data))
         return data
 
+    def stream_unit_from_cdn(self, hash_hex: str, fi: recon.FetchInfo,
+                             full_key: bool) -> int:
+        """CDN tier streamed straight into the cache file — no
+        whole-unit buffer (storage.atomic_write_stream). The GB-scale
+        warm path's fast lane: callers have already checked the cache
+        and peer tiers. ``full_key`` follows the same whole-xorb
+        evidence rule as ``_cache_fetched``. Trust model unchanged:
+        cached bytes are BLAKE3-verified at extraction."""
+        if self.cas is None:
+            raise NotAuthenticated("no CAS client")
+        it = self.cas.fetch_xorb_iter(
+            self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
+        )
+        if full_key:
+            n = self.cache.put_stream(hash_hex, it)
+        else:
+            n = self.cache.put_partial_stream(hash_hex, fi.range.start, it)
+        self.stats.record("cdn", n)
+        return n
+
     def _cache_fetched(self, rec: recon.Reconstruction, hash_hex: str,
                        chunk_offset: int, data: bytes) -> None:
         """Persist a fetched blob so this host can seed it ("the package IS

@@ -117,10 +117,20 @@ def warm_units_parallel(
     direct-to-HBM landing would otherwise pull terms SEQUENTIALLY
     through the waterfall. Idempotent; respects cached entries.
     """
+    import os
     from concurrent.futures import ThreadPoolExecutor
 
     if max_concurrent is None:
         max_concurrent = bridge.cfg.max_concurrent_downloads
+        endpoint = getattr(bridge.cfg, "endpoint", "") or ""
+        if "127.0.0.1" in endpoint or "localhost" in endpoint:
+            # Loopback origin = bandwidth-bound on the local CPU: fetch
+            # threads beyond ~4x the cores only thrash the GIL
+            # (measured: 16-wide ~15% slower than 2-wide on 1 core). A
+            # remote CDN is latency-bound and keeps the configured
+            # width — more streams there hide RTT, not burn CPU.
+            max_concurrent = min(max_concurrent,
+                                 max(2, 4 * (os.cpu_count() or 1)))
     entries_map = _entries_by_hash(recs)
     wanted = [
         (hash_hex, fi)
@@ -133,6 +143,16 @@ def warm_units_parallel(
 
     def fetch(unit):
         hash_hex, fi = unit
+        if bridge.swarm is None and bridge.cas is not None:
+            # No peer tier to try and the cache was checked when
+            # building ``wanted``: stream the CDN body straight into
+            # the cache file — one full memory pass fewer than
+            # fetch-then-put, which is worth ~15% of the whole fetch
+            # stage at GB scale on one core.
+            entries = entries_map.get(hash_hex, [])
+            full = (fi.range.start == 0 and len(entries) == 1
+                    and entries[0].range.start == 0)
+            return bridge.stream_unit_from_cdn(hash_hex, fi, full)
         data = bridge.fetch_unit(hash_hex, fi)
         _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)
         return len(data)
