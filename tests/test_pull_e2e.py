@@ -114,3 +114,49 @@ def test_sequential_fallback_when_parallel_breaks(cfg, monkeypatch):
     snap = result.snapshot_dir
     assert (snap / "model.safetensors").read_bytes() == FILES["model.safetensors"]
     assert any("injected parallel failure" in str(line) for line in logged)
+
+
+def test_cache_direct_file_write(cfg, hub):
+    """The files-stage fast lane: with every unit cached (post-warm
+    state), the file is decoded straight from the cache into an mmapped
+    destination — byte-exact, counted as cache-tier bytes; with a cold
+    cache it reports False and leaves nothing behind."""
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.federated import warm_units_parallel
+    from zest_tpu.transfer.pull import _write_file_from_cache
+
+    bridge = XetBridge(cfg)
+    bridge.authenticate("acme/e2e-model")
+    entry = next(e for e in HubClient(cfg).list_files("acme/e2e-model")
+                 if e.path == "model.safetensors")
+    dest = cfg.hf_home / "out.safetensors"
+
+    # Cold cache: clean miss, no artifact, no exception.
+    assert _write_file_from_cache(bridge, entry.xet_hash, dest) is False
+    assert not dest.exists()
+    assert not list(dest.parent.glob(".tmp-*"))
+
+    rec = bridge.get_reconstruction(entry.xet_hash)
+    warm_units_parallel(bridge, [rec])
+    before_cache = bridge.stats.xorbs_from_cache
+    assert _write_file_from_cache(bridge, entry.xet_hash, dest) is True
+    assert dest.read_bytes() == FILES["model.safetensors"]
+    assert bridge.stats.xorbs_from_cache > before_cache
+
+
+def test_warm_pull_takes_cache_direct_lane(cfg, hub, monkeypatch):
+    """A device=tpu pull (warm stage fills the cache first) must write
+    its files through the fast lane — the parallel downloader is never
+    invoked — and still produce a byte-exact snapshot."""
+    import zest_tpu.transfer.parallel as par_mod
+
+    def boom(*a, **k):
+        raise AssertionError("waterfall chain ran despite warm cache")
+
+    monkeypatch.setattr(par_mod.ParallelDownloader,
+                        "reconstruct_to_file", boom)
+    result = pull_model(cfg, "acme/e2e-model", device="tpu", no_p2p=True,
+                        log=lambda *a, **k: None)
+    for name, data in FILES.items():
+        assert (result.snapshot_dir / name).read_bytes() == data

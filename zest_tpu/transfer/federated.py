@@ -120,23 +120,26 @@ def warm_units_parallel(
     import os
     from concurrent.futures import ThreadPoolExecutor
 
-    if max_concurrent is None:
-        max_concurrent = bridge.cfg.max_concurrent_downloads
-        endpoint = getattr(bridge.cfg, "endpoint", "") or ""
-        if "127.0.0.1" in endpoint or "localhost" in endpoint:
-            # Loopback origin = bandwidth-bound on the local CPU: fetch
-            # threads beyond ~4x the cores only thrash the GIL
-            # (measured: 16-wide ~15% slower than 2-wide on 1 core). A
-            # remote CDN is latency-bound and keeps the configured
-            # width — more streams there hide RTT, not burn CPU.
-            max_concurrent = min(max_concurrent,
-                                 max(2, 4 * (os.cpu_count() or 1)))
     entries_map = _entries_by_hash(recs)
     wanted = [
         (hash_hex, fi)
         for (hash_hex, _s), fi in collect_units(recs)
         if not _already_cached(bridge, hash_hex, fi)
     ]
+    if max_concurrent is None:
+        max_concurrent = bridge.cfg.max_concurrent_downloads
+        urls = {bridge._absolute_url(fi.url) for _h, fi in wanted[:8]}
+        if urls and all("127.0.0.1" in u or "localhost" in u
+                        for u in urls):
+            # Bytes verifiably flow from loopback (the units' OWN fetch
+            # URLs, not the control-plane endpoint — a local hub can
+            # hand out presigned remote-CDN URLs): bandwidth-bound on
+            # the local CPU, where threads beyond ~4x the cores only
+            # thrash the GIL (measured: 16-wide ~15% slower than 2-wide
+            # on 1 core). A remote CDN is latency-bound and keeps the
+            # configured width — more streams there hide RTT.
+            max_concurrent = min(max_concurrent,
+                                 max(2, 4 * (os.cpu_count() or 1)))
     stats = {"units": len(wanted), "bytes": 0, "failed": 0}
     if not wanted:
         return stats

@@ -442,8 +442,87 @@ def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
     return shard_rules_for_model_type((cfg_json or {}).get("model_type"))
 
 
+def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
+    """Decode cached units straight into the destination file (mmap +
+    in-place chunk decode, no per-term refetch loop, no join) — the fast
+    lane for files whose bytes a distribution round or warm fetch
+    already landed in the cache, i.e. the common state of the ``files``
+    stage. Returns False when any unit is missing or fails to decode,
+    so the 3-deep waterfall chain (which can reach peers/CDN and
+    self-heals corrupt cache keys) runs instead."""
+    import mmap
+    import os
+    import tempfile
+
+    from zest_tpu.models.direct import CachedFileReader, DirectLandingError
+
+    rec = bridge.get_reconstruction(xet_hash)
+    reader = CachedFileReader(bridge.cache, rec)  # cache-only: no bridge
+    size = reader.size
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dest.parent, prefix=f".tmp-{dest.name}.")
+    try:
+        ok = True
+        err: BaseException | None = None
+        if size:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+            try:
+                view = memoryview(mm)
+                try:
+                    reader.read_into(0, size, view)
+                except (DirectLandingError, ValueError):
+                    # Handled HERE, inside the view's lifetime: a
+                    # propagating traceback would pin read_into's frame
+                    # (and its cast of this view), making mm.close()
+                    # raise BufferError("exported pointers exist").
+                    # Covers cache misses and corrupt-entry decode
+                    # errors alike — both mean "let the waterfall do
+                    # it" (it self-heals bad cache keys).
+                    ok = False
+                except BaseException as exc:
+                    # Anything else (OSError, KeyboardInterrupt...) must
+                    # survive as ITSELF, not as the masking BufferError —
+                    # so detach its traceback (freeing the pinned view)
+                    # and re-raise once the mmap is closed.
+                    err = exc.with_traceback(None)
+                finally:
+                    view.release()
+            finally:
+                mm.close()
+        if err is not None:
+            raise err
+        if not ok:
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    finally:
+        os.close(fd)
+    # Per-source accounting: one cache-tier event per term, like the
+    # waterfall. Byte counts are the terms' UNPACKED lengths (sum =
+    # file size); the waterfall records packed cached-blob lengths, so
+    # the two lanes agree on counts and agree on bytes only up to
+    # compression (bf16 checkpoints are mostly stored uncompressed).
+    for term in rec.terms:
+        bridge.stats.record("cache", term.unpacked_length)
+    return True
+
+
 def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
-    """3-deep fallback chain (reference: main.zig:232-256)."""
+    """Cache-direct fast lane, then the 3-deep fallback chain
+    (reference: main.zig:232-256)."""
+    try:
+        if _write_file_from_cache(bridge, entry.xet_hash, dest):
+            return
+    except Exception as exc:  # noqa: BLE001 - fast lane is optional
+        log(f"cache-direct write of {entry.path} failed ({exc}); "
+            "taking the waterfall chain", file=sys.stderr)
     try:
         par.reconstruct_to_file(entry.xet_hash, dest)
         return
