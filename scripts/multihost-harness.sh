@@ -47,7 +47,19 @@ provision() {
     python scripts/fixture_hub.py --url-file "$WORK/hub.url" \
         --repo "$REPO_ID" --size "$MODEL_BYTES" &
     echo $! > "$WORK/hub.pid"
-    for _ in $(seq 1 50); do [ -s "$WORK/hub.url" ] && break; sleep 0.2; done
+    # GB-scale fixtures take the hub a while to generate and encode
+    # before it binds — scale the wait with the model size (~0.2 s per
+    # 4 MB on top of the 10 s floor).
+    local iters=$((50 + MODEL_BYTES / 4000000))
+    local hub_pid
+    hub_pid=$(cat "$WORK/hub.pid")
+    for _ in $(seq 1 "$iters"); do
+        [ -s "$WORK/hub.url" ] && break
+        # A crashed hub must fail in sub-seconds, not after the full
+        # size-scaled wait window.
+        kill -0 "$hub_pid" 2>/dev/null || break
+        sleep 0.2
+    done
     [ -s "$WORK/hub.url" ] || die "fixture hub did not start"
     log "origin (CDN analog): $(cat "$WORK/hub.url")"
 }
