@@ -160,3 +160,116 @@ def test_warm_pull_takes_cache_direct_lane(cfg, hub, monkeypatch):
                         log=lambda *a, **k: None)
     for name, data in FILES.items():
         assert (result.snapshot_dir / name).read_bytes() == data
+
+
+def test_direct_landing_pipelines_shards(tmp_path):
+    """Multi-shard direct landing: shard i+1's warm fetch overlaps
+    shard i's decode+commit (one-shard lookahead), every shard still
+    lands and writes byte-exact."""
+    import numpy as np
+
+    from zest_tpu.models.safetensors_io import write_safetensors
+
+    rng = np.random.default_rng(9)
+    shard_files = {}
+    for i in (1, 2, 3):
+        p = tmp_path / f"s{i}.safetensors"
+        # Big enough that each shard spans several xorbs — the header
+        # fetch caches only the head term, leaving real work for the
+        # pipelined warm fetch (tiny shards are fully cached by the
+        # header fetch and warm bytes is rightly 0).
+        write_safetensors(p, {f"t{i}.weight":
+                              rng.standard_normal((512, 512)).astype("f4")})
+        shard_files[f"model-{i:05d}-of-00003.safetensors"] = p.read_bytes()
+    repo = FixtureRepo("acme/sharded", {
+        "config.json": b'{"model_type": "test"}', **shard_files,
+    }, chunks_per_xorb=3)
+    with FixtureHub(repo) as hub:
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+        # pod=False: skip the collective pre-pass so the pipelined warm
+        # fetch is what actually moves the bytes (with the pod round on,
+        # everything is already cached and warm bytes is rightly 0).
+        res = pull_model(cfg, "acme/sharded", device="tpu", pod=False,
+                         no_p2p=True, log=lambda *a, **k: None)
+    warm = res.stats["hbm"]["warm"]
+    assert warm["pipelined_shards"] == 3
+    assert warm["failed"] == 0 and warm["bytes"] > 0
+    assert res.stats["hbm"]["direct"] is True
+    for name, data in shard_files.items():
+        assert (res.snapshot_dir / name).read_bytes() == data
+
+
+def test_cross_shard_dedup_keeps_partial_key(tmp_path):
+    """A xorb deduped across shards, warmed from the shard that covers
+    only its head chunks, must be cached under a PARTIAL key — a
+    truncated blob under the full key would shadow other shards'
+    entries and be announced as a seedable complete xorb.
+
+    The fixture encoder only emits whole-xorb references, so the
+    cross-shard topology (one shard's fetch_info = a head chunk range
+    of a xorb another shard reads past) is hand-built here, the way the
+    production CAS emits it for deduped prefixes."""
+    import numpy as np
+
+    from fixtures import _XorbFixture
+    from zest_tpu.cas import hashing, reconstruction as recon
+    from zest_tpu.cas.xorb import XorbBuilder
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.federated import warm_units_parallel
+
+    repo = FixtureRepo("acme/dedup-shards", {"f.bin": b"x" * 1000})
+    builder = XorbBuilder()
+    rng = np.random.default_rng(3)
+    chunks = [rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes()
+              for _ in range(6)]
+    for c in chunks:
+        builder.add_chunk(c)
+    xh = builder.xorb_hash()
+    xh_hex = hashing.hash_to_hex(xh)
+    offs = builder.frame_offsets()
+
+    def rec_for(n_chunks, salt):
+        fh = hashing.blake3_hash(salt)
+        return recon.Reconstruction(
+            file_hash=fh,
+            terms=[recon.Term(xorb_hash=xh,
+                              range=recon.ChunkRange(0, n_chunks),
+                              unpacked_length=sum(
+                                  len(c) for c in chunks[:n_chunks]))],
+            fetch_info={xh_hex: [recon.FetchInfo(
+                url=f"/xorbs/{xh_hex}", url_range_start=0,
+                url_range_end=offs[n_chunks],
+                range=recon.ChunkRange(0, n_chunks))]},
+        )
+
+    rec_pre, rec_full = rec_for(3, b"pre"), rec_for(6, b"full")
+    with FixtureHub(repo) as hub:
+        hub.repos["acme/dedup-shards"].xorbs[xh_hex] = _XorbFixture(
+            xh_hex, builder.serialize(), offs, builder.serialize_full())
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+        bridge = XetBridge(cfg)
+        bridge.authenticate("acme/dedup-shards")
+
+        # Warm ONLY the prefix shard — per-shard, as the pipelined
+        # landing does — with whole-checkpoint evidence: X has two
+        # entries there, so the 3-chunk blob must take a partial key.
+        warm_units_parallel(bridge, [rec_pre],
+                            evidence_recs=[rec_full, rec_pre])
+        assert not bridge.cache.has(xh_hex), \
+            "truncated blob cached under the full xorb key"
+        assert bridge.cache.get(f"{xh_hex}.0") is not None
+
+        # The full shard still fetches its 6 chunks and both shards
+        # extract byte-exact afterwards.
+        warm_units_parallel(bridge, [rec_full],
+                            evidence_recs=[rec_full, rec_pre])
+        got_pre = bridge.fetch_unit(xh_hex, rec_pre.fetch_info[xh_hex][0])
+        got_full = bridge.fetch_unit(xh_hex, rec_full.fetch_info[xh_hex][0])
+        from zest_tpu.cas.xorb import XorbReader
+
+        assert XorbReader(got_pre).extract_chunk_range(0, 3) == \
+            b"".join(chunks[:3])
+        assert XorbReader(got_full).extract_chunk_range(0, 6) == \
+            b"".join(chunks)

@@ -229,6 +229,7 @@ def stage_cached_to_hbm(
     mesh: Mesh | None = None,
     rules: ShardRules | None = None,
     dtype=None,
+    prefetch_next=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -237,14 +238,19 @@ def stage_cached_to_hbm(
     ``recs_with_headers`` is ``[(Reconstruction, SafetensorsHeader)]``,
     one per safetensors file (headers via transfer.pod.fetch_file_header).
     Units the distribution round missed are pulled through the bridge's
-    waterfall. Returns ``(params, stats)`` like stage_snapshot_to_hbm,
-    with ``stats["direct"] = True``.
+    waterfall. ``prefetch_next(i)``, when given, is called before shard
+    ``i`` lands — the pull path passes a one-shard-lookahead warm fetch
+    so shard ``i+1``'s network time hides under shard ``i``'s decode +
+    commit (see transfer.pull._PipelinedWarm). Returns ``(params,
+    stats)`` like stage_snapshot_to_hbm, with ``stats["direct"] = True``.
     """
     from zest_tpu.models.direct import land_tensors
 
     t0 = time.monotonic()
     params: dict[str, jax.Array] = {}
-    for rec, header in recs_with_headers:
+    for i, (rec, header) in enumerate(recs_with_headers):
+        if prefetch_next is not None:
+            prefetch_next(i)
         # One batched commit per checkpoint shard (see load_checkpoint's
         # note: amortized transfer setup, file-bounded host peak).
         host = land_tensors(bridge.cache, rec, header, bridge=bridge)

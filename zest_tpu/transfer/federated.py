@@ -107,6 +107,7 @@ def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
 
 def warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
+    evidence_recs: list[Reconstruction] | None = None,
 ) -> dict:
     """Fetch every uncached unit of ``recs`` into the local cache with
     ``max_concurrent`` waterfall fetches in flight (the reference's
@@ -116,11 +117,20 @@ def warm_units_parallel(
     collective or owner pod exists (one chip, pod round skipped), the
     direct-to-HBM landing would otherwise pull terms SEQUENTIALLY
     through the waterfall. Idempotent; respects cached entries.
+
+    ``evidence_recs`` (default: ``recs``) is the set the full-vs-partial
+    cache-key decision is judged against. A caller warming ONE shard of
+    a multi-shard checkpoint MUST pass the whole checkpoint here: a
+    xorb deduped across shards can look whole from one shard's
+    fetch_info (single entry at chunk 0) while another shard reads its
+    later chunks — caching the truncated blob under the full key would
+    shadow the other shard's partial entries and poison extraction.
     """
     import os
     from concurrent.futures import ThreadPoolExecutor
 
-    entries_map = _entries_by_hash(recs)
+    entries_map = _entries_by_hash(evidence_recs
+                                   if evidence_recs is not None else recs)
     wanted = [
         (hash_hex, fi)
         for (hash_hex, _s), fi in collect_units(recs)

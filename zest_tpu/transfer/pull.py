@@ -267,6 +267,7 @@ def _try_direct_stage(
         return None, None
     if clock is None:
         clock = StageClock()
+    pipeline = None
     try:
         from zest_tpu.models.loader import stage_cached_to_hbm
         from zest_tpu.transfer.pod import fetch_file_header
@@ -281,29 +282,114 @@ def _try_direct_stage(
                     (rec, fetch_file_header(bridge, rec))
                 )
         # Whatever the distribution rounds didn't cache (single chip:
-        # everything) arrives max_concurrent-wide, not term-by-term.
-        from zest_tpu.transfer.federated import warm_units_parallel
-
+        # everything) arrives max_concurrent-wide, not term-by-term —
+        # pipelined per shard: shard 0's fetch is the visible "fetch"
+        # stage, every later shard's network time hides under the
+        # previous shard's decode+commit inside "hbm_commit".
+        pipeline = _PipelinedWarm(bridge, [r for r, _h in recs_with_headers])
         with clock("fetch"):
-            warm = warm_units_parallel(
-                bridge, [r for r, _h in recs_with_headers]
-            )
-        if warm["failed"]:
-            log(f"warm fetch: {warm['failed']}/{warm['units']} units "
-                "failed; landing falls back per-term", file=sys.stderr)
+            pipeline.ensure(0)
         with clock("hbm_commit"):
             params, hbm_stats = stage_cached_to_hbm(
                 bridge, recs_with_headers, mesh=mesh,
                 rules=_landing_rules(hub, repo_id, revision, files,
                                      snapshot_dir),
                 dtype=dtype,
+                prefetch_next=pipeline.ensure,
             )
+        warm = pipeline.summary()
+        if warm["failed"] or warm.get("prefetch_errors"):
+            log(f"warm fetch: {warm['failed']} unit(s) + "
+                f"{warm.get('prefetch_errors', 0)} whole-shard "
+                "prefetch(es) failed; landing fell back per-term",
+                file=sys.stderr)
         hbm_stats["warm"] = warm
         return params, hbm_stats
     except Exception as exc:  # noqa: BLE001 - landing is an accelerator
+        if pipeline is not None:
+            pipeline.drain()
         log(f"direct HBM landing unavailable ({exc}); "
             "will stage from disk after download", file=sys.stderr)
         return None, None
+
+
+class _PipelinedWarm:
+    """One-shard-lookahead warm fetch for the direct landing.
+
+    ``ensure(i)`` joins shard ``i``'s warm fetch (spawning it if no one
+    has) and kicks off shard ``i+1``'s in a background thread — so while
+    shard ``i`` decodes and commits, shard ``i+1``'s bytes stream into
+    the cache. Exactly one fetch runs concurrently with the landing
+    (lookahead 1): deeper lookahead would pile cache writes onto the
+    landing's reads on hosts where both share a disk. A failed prefetch
+    is absorbed — the landing's per-term waterfall self-serves the
+    missing units — and reported in :meth:`summary`.
+    """
+
+    def __init__(self, bridge, recs):
+        import threading
+
+        self._threading = threading
+        self.bridge = bridge
+        self.recs = recs
+        self.threads: dict[int, object] = {}
+        self.stats: list[dict] = []
+        self.cancelled = False
+
+    def _spawn(self, i: int) -> None:
+        if (not self.cancelled and 0 <= i < len(self.recs)
+                and i not in self.threads):
+            t = self._threading.Thread(target=self._run, args=(i,),
+                                       daemon=True)
+            self.threads[i] = t
+            t.start()
+
+    def _run(self, i: int) -> None:
+        from zest_tpu.transfer.federated import warm_units_parallel
+
+        try:
+            # evidence_recs = ALL shards: the full-vs-partial cache-key
+            # decision must see cross-shard dedup, or a xorb shared
+            # between shards gets a truncated blob under its full key.
+            self.stats.append(warm_units_parallel(
+                self.bridge, [self.recs[i]], evidence_recs=self.recs))
+        except Exception:  # noqa: BLE001 - landing self-serves misses
+            self.stats.append({"units": 0, "bytes": 0, "failed": 0,
+                               "prefetch_error": True})
+
+    def drain(self) -> None:
+        """Stop spawning and wait out any in-flight prefetch (at most
+        one shard). The landing's failure path calls this before the
+        disk fallback runs — an orphaned prefetch racing the fallback's
+        waterfall would double-fetch units and could still be writing
+        cache entries after the pull returns."""
+        self.cancelled = True
+        for t in self.threads.values():
+            t.join()
+
+    def ensure(self, i: int) -> None:
+        """Block until shard ``i`` is warmed; then start shard ``i+1``.
+
+        The lookahead spawns only after the join so two shards never
+        fetch concurrently — units shared across shards (dedup) would
+        otherwise be double-fetched by racing `_already_cached` checks.
+        """
+        self._spawn(i)
+        t = self.threads.get(i)
+        if t is not None:
+            t.join()
+        self._spawn(i + 1)
+
+    def summary(self) -> dict:
+        out = {"units": 0, "bytes": 0, "failed": 0,
+               "pipelined_shards": len(self.threads)}
+        for s in self.stats:
+            out["units"] += s.get("units", 0)
+            out["bytes"] += s.get("bytes", 0)
+            out["failed"] += s.get("failed", 0)
+            if s.get("prefetch_error"):
+                out["prefetch_errors"] = out.get("prefetch_errors", 0) + 1
+        return out
 
 
 def _early_config(hub, repo_id, revision, files, snapshot_dir) -> dict | None:
