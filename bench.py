@@ -370,8 +370,13 @@ def bench_host_synthetics() -> dict:
         for r in (res if isinstance(res, list) else [res]):
             row = {"mb_per_s": round(r.mb_per_s, 1),
                    "median_ns": round(r.median_ns, 1)}
+            best = r.best_mb_per_s
+            if best is not None:
+                row["best_mb_per_s"] = round(best, 1)
             if r.name in ref:
                 row["vs_ref"] = round(r.mb_per_s / ref[r.name], 2)
+                if best is not None:
+                    row["best_vs_ref"] = round(best / ref[r.name], 2)
             results[r.name] = row
 
     benches = [
@@ -690,15 +695,21 @@ def child_main() -> None:
         primary_error = f"{type(exc).__name__}: {exc}"
 
     extra = {}
+    # Order matters on a one-vCPU host: pull_gb writes ~7 GB through the
+    # page cache and its writeback drains for minutes afterwards,
+    # polluting any CPU-bound measurement that follows (observed: the
+    # same blake3_64kb measured 1.7 GB/s right after pull_gb, 4.2 GB/s
+    # on a quiet host). Microbenches run first, the disk-heavy GB pull
+    # last.
     extras = [
-        ("pull_gb", bench_pull_gb),
-        ("mfu", bench_mfu),
         ("host_synthetics", bench_host_synthetics),
-        ("host_to_hbm", bench_host_to_hbm),
+        ("mfu", bench_mfu),
         ("decode", bench_decode),
+        ("host_to_hbm", bench_host_to_hbm),
         ("http_warm", bench_http_warm),
         ("http_warm_device", bench_http_warm_device),
         ("ici_all_gather", bench_ici_all_gather),
+        ("pull_gb", bench_pull_gb),
     ]
     skip = {s for s in os.environ.get("ZEST_BENCH_SKIP", "").split(",") if s}
     for name, fn in extras:
@@ -813,6 +824,14 @@ def main() -> None:
     for platform in attempts:
         label = platform or "default"
         plat_name, err = _probe_backend(platform, probe_timeout)
+        if err is not None and label != "cpu":
+            # The chip sits behind a tunnel that can hiccup transiently
+            # (observed: a probe hanging >180s while the very same chip
+            # answered minutes before and after). One retry is cheap
+            # next to losing the round's only on-chip artifact.
+            time.sleep(10)
+            plat_name, err2 = _probe_backend(platform, probe_timeout)
+            err = None if err2 is None else f"{err}; retry: {err2}"
         if err is not None:
             errors[label] = f"probe: {err}"
             non_cpu_failed = non_cpu_failed or label != "cpu"

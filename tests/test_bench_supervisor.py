@@ -57,3 +57,79 @@ def test_supervisor_survives_dead_backend():
     assert r["value"] > 0
     assert r["device"] == "cpu"  # fell back
     assert "bogus" in r["tpu_error"]
+
+
+def _load_bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_probe_retry_recovers_transient_outage(monkeypatch):
+    """The chip tunnel hiccups transiently (observed: a probe hanging
+    >180s minutes after the same chip answered). One failed probe must
+    cost a retry, not the round's on-chip artifact — and a SUCCESSFUL
+    retry must clear the failure, run the child on the chip, and leave
+    no tpu_error in the JSON."""
+    import contextlib
+    import io
+
+    m = _load_bench_module()
+    probes: list = []
+
+    def fake_probe(platform, timeout):
+        probes.append(platform)
+        if platform is None and probes.count(None) == 1:
+            return None, "backend init hung >1s"  # first attempt: outage
+        return ("tpu" if platform is None else platform), None
+
+    children: list = []
+
+    def fake_child(platform, timeout):
+        children.append(platform)
+        return {"metric": "x", "device": "tpu", "extra": {}}, None
+
+    monkeypatch.setattr(m, "_probe_backend", fake_probe)
+    monkeypatch.setattr(m, "_run_child", fake_child)
+    monkeypatch.setattr(m.time, "sleep", lambda s: None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        m.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert children == [None]  # the chip-capable attempt ran the child
+    assert out["device"] == "tpu"
+    assert "tpu_error" not in out
+
+
+def test_probe_retry_exhausted_falls_back(monkeypatch):
+    """Both probes of the chip-capable attempt fail -> the cpu attempt
+    runs instead and the JSON records both probe failures."""
+    import contextlib
+    import io
+
+    m = _load_bench_module()
+
+    def fake_probe(platform, timeout):
+        if platform is None:
+            return None, "backend init hung >1s"
+        return platform, None
+
+    def fake_child(platform, timeout):
+        return {"metric": "x", "device": "cpu", "extra": {}}, None
+
+    monkeypatch.setattr(m, "_probe_backend", fake_probe)
+    monkeypatch.setattr(m, "_run_child", fake_child)
+    monkeypatch.setattr(m.time, "sleep", lambda s: None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        m.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["device"] == "cpu"
+    assert "retry" in out["tpu_error"]

@@ -29,6 +29,11 @@ class BenchResult:
     iters: int
     median_ns: float
     bytes_per_iter: int
+    # Fastest repeat window. On this build's single shared vCPU a
+    # transient neighbor can slow EVERY window of a 5x3ms measurement;
+    # the median then reports the neighbor, the best window reports the
+    # code. Both are emitted so the artifact carries the distinction.
+    best_ns: float = 0.0
 
     @property
     def mb_per_s(self) -> float:
@@ -36,13 +41,25 @@ class BenchResult:
             return float("inf")
         return self.bytes_per_iter / (self.median_ns / 1e9) / 1e6
 
+    @property
+    def best_mb_per_s(self) -> float | None:
+        """None when no best window was recorded — emitting the median
+        as "best" would be indistinguishable from a genuinely
+        zero-variance measurement."""
+        if self.best_ns <= 0:
+            return None
+        return self.bytes_per_iter / (self.best_ns / 1e9) / 1e6
+
     def as_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "iters": self.iters,
             "median_ns": round(self.median_ns, 1),
             "mb_per_s": round(self.mb_per_s, 1),
         }
+        if self.best_mb_per_s is not None:
+            out["best_mb_per_s"] = round(self.best_mb_per_s, 1)
+        return out
 
 
 def _time_fn(name: str, fn, bytes_per_iter: int, iters: int,
@@ -54,7 +71,8 @@ def _time_fn(name: str, fn, bytes_per_iter: int, iters: int,
         for _ in range(iters):
             fn()
         medians.append((time.perf_counter_ns() - t0) / iters)
-    return BenchResult(name, iters, statistics.median(medians), bytes_per_iter)
+    return BenchResult(name, iters, statistics.median(medians),
+                       bytes_per_iter, best_ns=min(medians))
 
 
 # ── Host benches (reference parity, bench.zig:167-255) ──
