@@ -103,6 +103,20 @@ def _blob_covers(data: bytes, local_start: int, local_end: int) -> bool:
         return False
 
 
+def provably_whole(entries, chunk_offset: int) -> bool:
+    """Whole-xorb evidence for the full-vs-partial cache-key decision.
+
+    A blob fetched at ``chunk_offset`` is provably the whole xorb only
+    when every known reference to the hash (``entries``, ideally drawn
+    from ALL files' reconstructions) is the same single range starting
+    at chunk 0 — then the range demonstrably covers everything any
+    consumer reads. Any second distinct range means some reader sees
+    chunks this blob may not carry."""
+    ranges = {(e.range.start, e.range.end) for e in entries}
+    return (chunk_offset == 0 and len(ranges) == 1
+            and next(iter(ranges))[0] == 0)
+
+
 class XetBridge:
     def __init__(
         self,
@@ -281,14 +295,27 @@ class XetBridge:
     def _cache_fetched(self, rec: recon.Reconstruction, hash_hex: str,
                        chunk_offset: int, data: bytes) -> None:
         """Persist a fetched blob so this host can seed it ("the package IS
-        the seeder"). Full entry only when the reconstruction's fetch_info
-        shows a single range starting at 0 — i.e. the blob is provably the
-        whole xorb; otherwise a partial entry keyed by its chunk offset."""
-        entries = rec.fetch_info.get(hash_hex, [])
-        if chunk_offset == 0 and len(entries) == 1 and entries[0].range.start == 0:
+        the seeder"). Full entry only with whole-xorb evidence; otherwise
+        a partial entry keyed by its chunk offset.
+
+        Evidence is judged across EVERY reconstruction this bridge has
+        resolved (the memo), not just ``rec``: a xorb deduped across
+        files can look whole from one file's fetch_info (single entry at
+        chunk 0) while another file reads its later chunks — caching the
+        truncated blob under the full key would shadow those partial
+        entries and advertise an incomplete xorb as seedable."""
+        if provably_whole(self._known_entries(rec, hash_hex), chunk_offset):
             self.cache.put(hash_hex, data)
         else:
             self.cache.put_partial(hash_hex, chunk_offset, data)
+
+    def _known_entries(self, rec: recon.Reconstruction,
+                       hash_hex: str) -> list[recon.FetchInfo]:
+        entries = list(rec.fetch_info.get(hash_hex, []))
+        for other in self._recons.values():
+            if other is not rec:
+                entries.extend(other.fetch_info.get(hash_hex, []))
+        return entries
 
     def _absolute_url(self, url: str) -> str:
         if url.startswith(("http://", "https://")):

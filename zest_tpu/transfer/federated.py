@@ -38,6 +38,7 @@ from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.hierarchy import owner_pod_host
 from zest_tpu.parallel.plan import collect_units
+from zest_tpu.transfer.bridge import provably_whole
 from zest_tpu.transfer.dcn import DcnPool, DcnResponse
 
 
@@ -96,10 +97,10 @@ def _entries_by_hash(recs: list[Reconstruction]) -> dict[str, list[FetchInfo]]:
 def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
                 chunk_offset: int, data: bytes) -> None:
     """Cache a fetched unit under the same full-vs-partial rule as the
-    bridge (_cache_fetched): full key only with whole-xorb evidence."""
-    entries = entries_map.get(hash_hex, [])
-    if chunk_offset == 0 and len(entries) == 1 \
-            and entries[0].range.start == 0:
+    bridge (_cache_fetched): full key only with whole-xorb evidence.
+    ``provably_whole`` dedupes ranges, so the same whole-xorb reference
+    appearing in several files' fetch_info still counts as whole."""
+    if provably_whole(entries_map.get(hash_hex, []), chunk_offset):
         bridge.cache.put(hash_hex, data)
     else:
         bridge.cache.put_partial(hash_hex, chunk_offset, data)
@@ -107,7 +108,7 @@ def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
 
 def warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
-    evidence_recs: list[Reconstruction] | None = None,
+    entries_map: dict[str, list[FetchInfo]] | None = None,
 ) -> dict:
     """Fetch every uncached unit of ``recs`` into the local cache with
     ``max_concurrent`` waterfall fetches in flight (the reference's
@@ -118,19 +119,21 @@ def warm_units_parallel(
     direct-to-HBM landing would otherwise pull terms SEQUENTIALLY
     through the waterfall. Idempotent; respects cached entries.
 
-    ``evidence_recs`` (default: ``recs``) is the set the full-vs-partial
-    cache-key decision is judged against. A caller warming ONE shard of
-    a multi-shard checkpoint MUST pass the whole checkpoint here: a
-    xorb deduped across shards can look whole from one shard's
-    fetch_info (single entry at chunk 0) while another shard reads its
-    later chunks — caching the truncated blob under the full key would
-    shadow the other shard's partial entries and poison extraction.
+    ``entries_map`` (default: built from ``recs``) is the evidence the
+    full-vs-partial cache-key decision is judged against. A caller
+    warming ONE shard of a multi-shard checkpoint MUST pass a map built
+    over the whole checkpoint (``_entries_by_hash``, prebuilt once — it
+    is invariant across shards): a xorb deduped across shards can look
+    whole from one shard's fetch_info (single entry at chunk 0) while
+    another shard reads its later chunks — caching the truncated blob
+    under the full key would shadow the other shard's partial entries
+    and poison extraction.
     """
     import os
     from concurrent.futures import ThreadPoolExecutor
 
-    entries_map = _entries_by_hash(evidence_recs
-                                   if evidence_recs is not None else recs)
+    if entries_map is None:
+        entries_map = _entries_by_hash(recs)
     wanted = [
         (hash_hex, fi)
         for (hash_hex, _s), fi in collect_units(recs)
@@ -162,9 +165,8 @@ def warm_units_parallel(
             # the cache file — one full memory pass fewer than
             # fetch-then-put, which is worth ~15% of the whole fetch
             # stage at GB scale on one core.
-            entries = entries_map.get(hash_hex, [])
-            full = (fi.range.start == 0 and len(entries) == 1
-                    and entries[0].range.start == 0)
+            full = provably_whole(entries_map.get(hash_hex, []),
+                                  fi.range.start)
             return bridge.stream_unit_from_cdn(hash_hex, fi, full)
         data = bridge.fetch_unit(hash_hex, fi)
         _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)

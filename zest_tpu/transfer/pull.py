@@ -281,12 +281,28 @@ def _try_direct_stage(
                 recs_with_headers.append(
                     (rec, fetch_file_header(bridge, rec))
                 )
+            # Resolve every OTHER xet file's reconstruction too (KB-scale
+            # metadata, memoized for the file loop moments later): the
+            # full-vs-partial cache-key evidence must see ALL references
+            # to a xorb — a tokenizer packed into the tail of a shard's
+            # xorb would otherwise get that xorb full-keyed truncated.
+            # Best-effort: a miss here costs evidence (partial keys),
+            # never the landing.
+            evidence_recs = [r for r, _h in recs_with_headers]
+            for e in files:
+                if e.is_xet and not e.path.endswith(".safetensors"):
+                    try:
+                        evidence_recs.append(
+                            bridge.get_reconstruction(e.xet_hash))
+                    except Exception:  # noqa: BLE001
+                        pass
         # Whatever the distribution rounds didn't cache (single chip:
         # everything) arrives max_concurrent-wide, not term-by-term —
         # pipelined per shard: shard 0's fetch is the visible "fetch"
         # stage, every later shard's network time hides under the
         # previous shard's decode+commit inside "hbm_commit".
-        pipeline = _PipelinedWarm(bridge, [r for r, _h in recs_with_headers])
+        pipeline = _PipelinedWarm(bridge, [r for r, _h in recs_with_headers],
+                                  evidence_recs=evidence_recs)
         with clock("fetch"):
             pipeline.ensure(0)
         with clock("hbm_commit"):
@@ -326,12 +342,22 @@ class _PipelinedWarm:
     missing units — and reported in :meth:`summary`.
     """
 
-    def __init__(self, bridge, recs):
+    def __init__(self, bridge, recs, evidence_recs=None):
         import threading
+
+        from zest_tpu.transfer.federated import _entries_by_hash
 
         self._threading = threading
         self.bridge = bridge
         self.recs = recs
+        # Full-vs-partial evidence, built ONCE over every known xet
+        # reconstruction (``evidence_recs`` ⊇ the shards being warmed —
+        # aux xet files can share xorbs with shards): the map is
+        # invariant across shards, and per-shard rebuilds are
+        # O(shards^2) CPU stolen from the decode+commit the lookahead
+        # is trying to overlap.
+        self.entries_map = _entries_by_hash(
+            evidence_recs if evidence_recs is not None else recs)
         self.threads: dict[int, object] = {}
         self.stats: list[dict] = []
         self.cancelled = False
@@ -348,11 +374,11 @@ class _PipelinedWarm:
         from zest_tpu.transfer.federated import warm_units_parallel
 
         try:
-            # evidence_recs = ALL shards: the full-vs-partial cache-key
+            # entries_map = ALL shards: the full-vs-partial cache-key
             # decision must see cross-shard dedup, or a xorb shared
             # between shards gets a truncated blob under its full key.
             self.stats.append(warm_units_parallel(
-                self.bridge, [self.recs[i]], evidence_recs=self.recs))
+                self.bridge, [self.recs[i]], entries_map=self.entries_map))
         except Exception:  # noqa: BLE001 - landing self-serves misses
             self.stats.append({"units": 0, "bytes": 0, "failed": 0,
                                "prefetch_error": True})
@@ -381,14 +407,19 @@ class _PipelinedWarm:
         self._spawn(i + 1)
 
     def summary(self) -> dict:
+        """Aggregate of the per-shard warm stats. Sums EVERY numeric
+        counter the fetcher reports (units/bytes/failed/retried/...), so
+        a new counter in warm_units_parallel can't silently vanish from
+        the pull's telemetry here."""
         out = {"units": 0, "bytes": 0, "failed": 0,
                "pipelined_shards": len(self.threads)}
         for s in self.stats:
-            out["units"] += s.get("units", 0)
-            out["bytes"] += s.get("bytes", 0)
-            out["failed"] += s.get("failed", 0)
             if s.get("prefetch_error"):
                 out["prefetch_errors"] = out.get("prefetch_errors", 0) + 1
+            for k, v in s.items():
+                if k != "prefetch_error" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    out[k] = out.get(k, 0) + v
         return out
 
 
