@@ -25,7 +25,6 @@ loop waits for the hub to write its url file):
 """
 
 import json
-import os
 import sys
 
 import requests
@@ -35,8 +34,12 @@ import zest_tpu as zest
 
 def main() -> int:
     repo = sys.argv[1] if len(sys.argv) > 1 else "openai-community/gpt2"
-    port = int(os.environ.get("ZEST_HTTP_PORT", "9847"))
     zest.enable()  # start the daemon if it isn't running
+    # The daemon records its BOUND http port (ZEST_HTTP_PORT=0 binds an
+    # ephemeral one); effective_http_port resolves it either way.
+    from zest_tpu.config import Config
+
+    port = Config.load().effective_http_port()
 
     body = {
         "repo_id": repo,

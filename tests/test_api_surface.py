@@ -116,3 +116,35 @@ def test_sse_pull_streams_progress_and_completes(cfg):
             assert (snap / name).read_bytes() == data
     finally:
         api.close()
+
+
+def test_effective_http_port_resolves_ephemeral_daemon(tmp_path):
+    """A daemon started with http_port=0 binds an ephemeral port and
+    records it next to its pid file; status/stop/DaemonClient resolve it
+    via Config.effective_http_port. Regression: status used to dial
+    literal port 0 and report a live daemon as not running."""
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test", http_port=0)
+    # No daemon, no recorded port: the configured port is the answer.
+    assert cfg.effective_http_port() == 0
+
+    cfg.cache_dir.mkdir(parents=True, exist_ok=True)
+    cfg.http_port_file().write_text("41513")
+    assert cfg.effective_http_port() == 41513
+
+    from zest_tpu.api.daemon import ZestServer
+
+    assert ZestServer(cfg)._base.endswith(":41513")
+
+    # Garbage degrades to the configured port (pid-file staleness model).
+    cfg.http_port_file().write_text("not-a-port")
+    assert cfg.effective_http_port() == 0
+
+    # A CONCRETE configured port always wins: the record file must never
+    # shadow an explicit --http-port/ZEST_HTTP_PORT (documented
+    # precedence), even when a stale record from a crashed ephemeral
+    # daemon survives in the same cache dir.
+    cfg.http_port_file().write_text("41513")
+    cfg2 = Config(hf_home=cfg.hf_home, cache_dir=cfg.cache_dir,
+                  hf_token="hf_test", http_port=5000)
+    assert cfg2.effective_http_port() == 5000

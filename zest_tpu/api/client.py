@@ -41,7 +41,8 @@ class ZestClient:
     def status(self) -> dict:
         """Daemon status via ``GET /v1/status`` on the loopback REST API."""
         resp = requests.get(
-            f"http://127.0.0.1:{self.config.http_port}/v1/status", timeout=5
+            f"http://127.0.0.1:{self.config.effective_http_port()}"
+            "/v1/status", timeout=5
         )
         resp.raise_for_status()
         return resp.json()

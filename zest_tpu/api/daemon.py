@@ -27,7 +27,9 @@ class ZestServer:
 
     @property
     def _base(self) -> str:
-        return f"http://127.0.0.1:{self.config.http_port}"
+        # effective_http_port: a daemon started with http_port=0 binds an
+        # ephemeral port and records it next to its pid file.
+        return f"http://127.0.0.1:{self.config.effective_http_port()}"
 
     def is_running(self) -> bool:
         try:

@@ -32,10 +32,14 @@ def _write_pid_file(cfg: Config) -> None:
 
 
 def _remove_pid_file(cfg: Config) -> None:
-    try:
-        _pid_file(cfg).unlink()
-    except FileNotFoundError:
-        pass
+    """Remove BOTH daemon state files (pid + recorded http port) — a
+    surviving port record would keep ephemeral-port clients dialing a
+    dead daemon's port."""
+    for path in (_pid_file(cfg), cfg.http_port_file()):
+        try:
+            path.unlink()
+        except OSError:
+            pass
 
 
 def _server_running(cfg: Config) -> bool:
@@ -44,7 +48,8 @@ def _server_running(cfg: Config) -> bool:
 
     try:
         r = requests.get(
-            f"http://127.0.0.1:{cfg.http_port}/v1/health", timeout=1
+            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/health",
+            timeout=1,
         )
         return r.status_code == 200
     except requests.RequestException:
@@ -331,6 +336,9 @@ def cmd_serve(args) -> int:
     api = HttpApi(cfg, bt_server=bt, registry=registry,
                   dcn_server=dcn_server)
     api.start()
+    # Record the BOUND port (http_port=0 binds ephemeral): status/stop/
+    # the Python client resolve it via Config.effective_http_port.
+    cfg.http_port_file().write_text(str(api.port))
     print(f"dashboard: http://127.0.0.1:{api.port}/")
 
     def on_signal(_sig, _frm):
@@ -357,7 +365,7 @@ def cmd_start(_args) -> int:
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:
         if _server_running(cfg):
-            print(f"started (http :{cfg.http_port})")
+            print(f"started (http :{cfg.effective_http_port()})")
             return 0
         time.sleep(0.1)
     print("daemon failed to become healthy", file=sys.stderr)
@@ -370,11 +378,16 @@ def cmd_stop(_args) -> int:
     import requests
 
     try:
-        requests.post(
-            f"http://127.0.0.1:{cfg.http_port}/v1/stop", timeout=5
+        r = requests.post(
+            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/stop",
+            timeout=5,
         )
-        print("stopped")
-        return 0
+        # Only a 2xx proves the daemon acknowledged: anything else may
+        # be a foreign service on a reused port — fall through to the
+        # pid-file kill rather than reporting success.
+        if r.ok:
+            print("stopped")
+            return 0
     except requests.RequestException:
         pass
     pid_file = _pid_file(cfg)
@@ -397,7 +410,8 @@ def cmd_status(_args) -> int:
 
     try:
         r = requests.get(
-            f"http://127.0.0.1:{cfg.http_port}/v1/status", timeout=2
+            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/status",
+            timeout=2,
         )
         print(json.dumps(r.json(), indent=2))
         return 0

@@ -168,3 +168,27 @@ class Config:
 
     def pid_file(self) -> Path:
         return self.cache_dir / "zest.pid"
+
+    def http_port_file(self) -> Path:
+        """Where the daemon records the HTTP port it actually bound.
+
+        ``http_port`` may be 0 ("bind ephemeral" — the test/fixture
+        convention); status/stop/client must then discover the real
+        port from this file rather than dialing port 0."""
+        return self.cache_dir / "zest.http_port"
+
+    def effective_http_port(self) -> int:
+        """The daemon's actual HTTP port.
+
+        A concrete configured port always wins — the record file must
+        never shadow an explicit ``--http-port``/``ZEST_HTTP_PORT``
+        (documented precedence: defaults < env < flags). Only the
+        ephemeral convention (``http_port == 0``) consults the record
+        the daemon wrote; a stale record then degrades to a failed
+        health check — exactly the pid-file staleness model."""
+        if self.http_port != 0:
+            return self.http_port
+        try:
+            return int(self.http_port_file().read_text().strip())
+        except (OSError, ValueError):
+            return self.http_port
