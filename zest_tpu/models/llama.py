@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from zest_tpu.models.sampling import cached_decode_loop, sample_token
+from zest_tpu.models.sampling import cached_decode_loop
 from zest_tpu.parallel.ring import SEQ_AXIS, ring_self_attention
 
 DATA_AXIS = "data"

@@ -21,7 +21,7 @@ from __future__ import annotations
 import enum
 import socket
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 PROTOCOL_STRING = b"BitTorrent protocol"
 HANDSHAKE_SIZE = 68

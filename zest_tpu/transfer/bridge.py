@@ -19,7 +19,7 @@ import threading
 from dataclasses import dataclass, field
 
 from zest_tpu.cas import reconstruction as recon
-from zest_tpu.cas.client import CasClient, CasError
+from zest_tpu.cas.client import CasClient
 from zest_tpu.cas.hub import HubClient
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.config import Config

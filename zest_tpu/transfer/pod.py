@@ -22,7 +22,7 @@ from __future__ import annotations
 import time
 
 from zest_tpu.cas import hashing
-from zest_tpu.cas.xorb import XorbFormatError, XorbReader
+from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.collectives import PodDistributor
 from zest_tpu.parallel.mesh import num_slots, pod_mesh
 from zest_tpu.parallel.plan import DistributionPlan
