@@ -6,9 +6,16 @@ TrainState with orbax, and export the result back to HF safetensors —
 which loads with ``transformers.from_pretrained`` unchanged.
 
 Run against a real repo (network required), or point HF_ENDPOINT at the
-fixture hub (scripts/fixture_hub.py) for a no-network demo:
+fixture hub's Llama-shaped repo for a no-network demo:
 
     python examples/finetune_and_export.py meta-llama/Llama-3.2-1B
+
+    # offline (JAX_PLATFORMS=cpu keeps a dead TPU tunnel from hanging
+    # backend init — the guard below pins it):
+    python scripts/fixture_hub.py --url-file /tmp/hub.url --llama &
+    while [ ! -s /tmp/hub.url ]; do sleep 0.2; done
+    HF_ENDPOINT=$(cat /tmp/hub.url) HF_TOKEN=hf_test JAX_PLATFORMS=cpu \
+        python examples/finetune_and_export.py acme/loopback-model
 """
 
 import functools
@@ -16,7 +23,17 @@ import json
 import sys
 from pathlib import Path
 
+import os
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Belt-and-braces (see bench.py / the verify notes): sitecustomize
+    # registers the axon TPU plugin before this script runs, and with a
+    # dead chip tunnel the plugin can hang backend init even when
+    # JAX_PLATFORMS requests cpu — pinning the config makes the env var
+    # reliably win.
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
 import numpy as np
 

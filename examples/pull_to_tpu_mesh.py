@@ -16,7 +16,17 @@ import json
 import sys
 from pathlib import Path
 
+import os
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Belt-and-braces (see bench.py / the verify notes): sitecustomize
+    # registers the axon TPU plugin before this script runs, and with a
+    # dead chip tunnel the plugin can hang backend init even when
+    # JAX_PLATFORMS requests cpu — pinning the config makes the env var
+    # reliably win.
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
 
 import zest_tpu as zest

@@ -37,15 +37,26 @@ def main() -> int:
     ap.add_argument("--repo", default="acme/loopback-model")
     ap.add_argument("--size", type=int, default=1_000_000,
                     help="safetensors payload bytes")
-    ap.add_argument("--gpt2", action="store_true",
-                    help="serve a tiny valid GPT-2 checkpoint instead of "
-                         "random bytes (for the TPU landing example)")
+    kind = ap.add_mutually_exclusive_group()
+    kind.add_argument("--gpt2", action="store_true",
+                      help="serve a tiny valid GPT-2 checkpoint instead of "
+                           "random bytes (for the TPU landing example)")
+    kind.add_argument("--llama", action="store_true",
+                      help="serve a tiny valid Llama checkpoint (for the "
+                           "finetune/export lifecycle example)")
     args = ap.parse_args()
 
-    files = _gpt2_files() if args.gpt2 else {
-        "config.json": b'{"model_type": "loopback"}',
-        "model.safetensors": os.urandom(args.size),
-    }
+    if args.llama:
+        from tests.fixtures import llama_checkpoint_files
+
+        files = llama_checkpoint_files()
+    elif args.gpt2:
+        files = _gpt2_files()
+    else:
+        files = {
+            "config.json": b'{"model_type": "loopback"}',
+            "model.safetensors": os.urandom(args.size),
+        }
     repo = FixtureRepo(args.repo, files, chunks_per_xorb=2)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -356,6 +356,19 @@ class FixtureHub:
             handler._send(200, blob)
 
 
+def _safetensors_blob(tensors) -> bytes:
+    """Serialize a tensor dict to in-memory safetensors bytes (shared by
+    the checkpoint-fixture generators)."""
+    import pathlib
+    import tempfile
+
+    from zest_tpu.models.safetensors_io import write_safetensors
+
+    with tempfile.NamedTemporaryFile(suffix=".safetensors") as f:
+        write_safetensors(f.name, tensors)
+        return pathlib.Path(f.name).read_bytes()
+
+
 def gpt2_checkpoint_files(
     n_embd: int = 64,
     n_layer: int = 2,
@@ -368,12 +381,8 @@ def gpt2_checkpoint_files(
     the fixture hub CLI, the bench driver's end-to-end pull, and the TPU
     landing example. ~12·n_layer·n_embd² fp32 parameter bytes."""
     import json as _json
-    import pathlib
-    import tempfile
 
     import numpy as np
-
-    from zest_tpu.models.safetensors_io import write_safetensors
 
     cfg = dict(model_type="gpt2", vocab_size=vocab_size,
                n_positions=n_ctx, n_ctx=n_ctx, n_embd=n_embd,
@@ -400,10 +409,60 @@ def gpt2_checkpoint_files(
                     lambda s: rng.normal(0, 0.02, s))
             t[f"h.{layer}.{leaf}"] = np.asarray(init(shape))
     tensors = {k: v.astype(np.float32) for k, v in t.items()}
-    with tempfile.NamedTemporaryFile(suffix=".safetensors") as f:
-        write_safetensors(f.name, tensors)
-        blob = pathlib.Path(f.name).read_bytes()
     return {
         "config.json": _json.dumps(cfg).encode(),
-        "model.safetensors": blob,
+        "model.safetensors": _safetensors_blob(tensors),
+    }
+
+
+def llama_checkpoint_files(
+    hidden_size: int = 64,
+    n_layer: int = 2,
+    vocab_size: int = 256,
+    n_ctx: int = 64,
+    seed: int = 0,
+) -> dict[str, bytes]:
+    """A small but *valid* HF Llama checkpoint (HF tensor names + config),
+    the Llama-family counterpart of :func:`gpt2_checkpoint_files` —
+    feeds the no-network lifecycle demo (examples/finetune_and_export.py
+    via ``scripts/fixture_hub.py --llama``). GQA 4:2 heads, untied
+    embeddings, no attention/mlp biases (the Llama-3.x layout)."""
+    import json as _json
+
+    import numpy as np
+
+    E, L, V = hidden_size, n_layer, vocab_size
+    n_head, n_kv = 4, 2
+    head_dim = E // n_head
+    inter = 2 * E
+    cfg = dict(model_type="llama", architectures=["LlamaForCausalLM"],
+               vocab_size=V, hidden_size=E, intermediate_size=inter,
+               num_hidden_layers=L, num_attention_heads=n_head,
+               num_key_value_heads=n_kv, max_position_embeddings=n_ctx,
+               rms_norm_eps=1e-5, rope_theta=10000.0,
+               tie_word_embeddings=False, torch_dtype="float32")
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        return rng.normal(0, 0.02, shape).astype(np.float32)
+
+    t = {
+        "model.embed_tokens.weight": w(V, E),
+        "model.norm.weight": np.ones(E, np.float32),
+        "lm_head.weight": w(V, E),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.ones(E, np.float32)
+        t[p + "post_attention_layernorm.weight"] = np.ones(E, np.float32)
+        t[p + "self_attn.q_proj.weight"] = w(n_head * head_dim, E)
+        t[p + "self_attn.k_proj.weight"] = w(n_kv * head_dim, E)
+        t[p + "self_attn.v_proj.weight"] = w(n_kv * head_dim, E)
+        t[p + "self_attn.o_proj.weight"] = w(E, n_head * head_dim)
+        t[p + "mlp.gate_proj.weight"] = w(inter, E)
+        t[p + "mlp.up_proj.weight"] = w(inter, E)
+        t[p + "mlp.down_proj.weight"] = w(E, inter)
+    return {
+        "config.json": _json.dumps(cfg).encode(),
+        "model.safetensors": _safetensors_blob(t),
     }

@@ -526,3 +526,36 @@ def test_checkpoint_shard_rules_match_hf_names():
     assert not any(
         re.search(pat, "model.embed_tokens.weight") for pat, _ in rules
     )
+
+
+def test_fixture_llama_checkpoint_loads_everywhere(tmp_path):
+    """The fixture-hub Llama checkpoint (fixtures.llama_checkpoint_files,
+    the offline lifecycle demo's input) must stay loadable by BOTH
+    consumers: this package's params_from_hf -> forward, and
+    transformers.LlamaForCausalLM.load_state_dict (strict)."""
+    import json
+
+    from fixtures import llama_checkpoint_files
+    from zest_tpu.models.generate import snapshot_tensors
+
+    files = llama_checkpoint_files()
+    for name, blob in files.items():
+        (tmp_path / name).write_bytes(blob)
+    cfg_json = json.loads(files["config.json"])
+
+    cfg = llama.LlamaConfig.from_hf(cfg_json)
+    tensors = snapshot_tensors(tmp_path)
+    params = llama.params_from_hf(tensors, cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    logits = llama.forward(params, ids, cfg)
+    assert logits.shape == (1, 8, cfg.vocab_size)
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.LlamaForCausalLM(
+        transformers.LlamaConfig(**{k: v for k, v in cfg_json.items()
+                                    if k not in ("model_type",
+                                                 "architectures",
+                                                 "torch_dtype")}))
+    state = {k: torch.from_numpy(v.copy()) for k, v in tensors.items()}
+    hf.load_state_dict(state, strict=True)
