@@ -71,12 +71,16 @@ def main() -> int:
         state, loss = step(state, batch)
         print(f"step {int(state.step)}: loss {float(loss):.4f}")
 
-    ckpt = snapshot.parent / f"trainstate_step{int(state.step)}"
+    # Outputs live in the model's cache dir but OUTSIDE snapshots/ —
+    # entries there are HF revisions, and cache introspection treats the
+    # newest snapshots/ dir as the current revision.
+    out_dir = snapshot.parent.parent
+    ckpt = out_dir / f"trainstate_step{int(state.step)}"
     save_train_state(ckpt, state)
     state = restore_train_state(ckpt, state)
     print(f"checkpointed + restored at step {int(state.step)} -> {ckpt}")
 
-    out = snapshot.parent / "finetuned.safetensors"
+    out = out_dir / "finetuned.safetensors"
     export_hf_safetensors(out, state.params, cfg)
     print(f"exported HF-format weights -> {out}")
     print("load with: transformers.LlamaForCausalLM + load_state_dict")

@@ -89,3 +89,29 @@ class TestRegistry:
         (d / ".tmp-partial").write_bytes(b"junk")
         reg = XorbRegistry()
         assert reg.scan(tmp_config) == 0
+
+
+def test_list_models_ignores_stray_snapshot_files(tmp_path):
+    """Cache introspection (storage.list_models — /v1/models and the
+    ``models`` CLI): one row per models--*/ dir, revision = newest
+    snapshots/ DIRECTORY; stray files dropped next to snapshots (e.g.
+    an exported safetensors) must not masquerade as a revision."""
+    import time
+
+    from zest_tpu.config import Config
+    from zest_tpu.storage import list_models
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test")
+    snap = cfg.model_snapshot_dir("acme/m", "shaAAA")
+    snap.mkdir(parents=True)
+    (snap / "config.json").write_text("{}")
+    (snap / "model.safetensors").write_bytes(b"x")
+    time.sleep(0.01)
+    stray = snap.parent / "finetuned.safetensors"
+    stray.write_bytes(b"y")  # newer mtime than the revision dir
+
+    models = list_models(cfg)
+    assert models == [
+        {"repo_id": "acme/m", "revision": "shaAAA", "files": 2}
+    ]

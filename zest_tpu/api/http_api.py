@@ -131,33 +131,10 @@ class HttpApi:
         return payload
 
     def models_payload(self) -> dict:
-        """Scan the HF hub cache for models--*/ dirs (http_api.zig:152-210)."""
-        models = []
-        hub = self.cfg.hf_home / "hub"
-        if hub.is_dir():
-            for d in sorted(hub.iterdir()):
-                if not d.name.startswith("models--") or not d.is_dir():
-                    continue
-                repo_id = d.name[len("models--"):].replace("--", "/", 1)
-                snapshots = d / "snapshots"
-                n_files = 0
-                revision = None
-                if snapshots.is_dir():
-                    revs = sorted(
-                        snapshots.iterdir(),
-                        key=lambda p: p.stat().st_mtime,
-                    )
-                    if revs:
-                        revision = revs[-1].name
-                        n_files = sum(
-                            1 for f in revs[-1].rglob("*") if f.is_file()
-                        )
-                models.append({
-                    "repo_id": repo_id,
-                    "revision": revision,
-                    "files": n_files,
-                })
-        return {"models": models}
+        """Pulled models in the HF hub cache (http_api.zig:152-210)."""
+        from zest_tpu.storage import list_models
+
+        return {"models": list_models(self.cfg)}
 
     def pull_events(self, repo_id: str, revision: str, device: str | None):
         """Generator of SSE progress events for one pull."""

@@ -42,18 +42,31 @@ def _remove_pid_file(cfg: Config) -> None:
             pass
 
 
-def _server_running(cfg: Config) -> bool:
-    """Health-check the daemon (reference isServerRunning, main.zig:532-548)."""
-    import requests
-
+def _daemon_get(cfg: Config, path: str, timeout: float = 2.0) -> dict | None:
+    """GET a daemon endpoint; None on ANY failure — daemon down, requests
+    missing (not a core dependency; every local-only path must still
+    work), or a foreign service on a stale recorded port answering
+    something that isn't the daemon's JSON-dict shape."""
+    try:
+        import requests
+    except ImportError:
+        return None
     try:
         r = requests.get(
-            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/health",
-            timeout=1,
+            f"http://127.0.0.1:{cfg.effective_http_port()}{path}",
+            timeout=timeout,
         )
-        return r.status_code == 200
-    except requests.RequestException:
-        return False
+        if not r.ok:
+            return None
+        payload = r.json()
+    except (requests.RequestException, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def _server_running(cfg: Config) -> bool:
+    """Health-check the daemon (reference isServerRunning, main.zig:532-548)."""
+    return _daemon_get(cfg, "/v1/health", timeout=1.0) is not None
 
 
 def auto_start_server(cfg: Config) -> bool:
@@ -406,18 +419,45 @@ def cmd_stop(_args) -> int:
 
 def cmd_status(_args) -> int:
     cfg = Config.load()
-    import requests
-
-    try:
-        r = requests.get(
-            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/status",
-            timeout=2,
-        )
-        print(json.dumps(r.json(), indent=2))
-        return 0
-    except requests.RequestException:
+    payload = _daemon_get(cfg, "/v1/status")
+    if payload is None:
         print("daemon not running")
         return 1
+    print(json.dumps(payload, indent=2))
+    return 0
+
+
+def cmd_models(args) -> int:
+    """Cache introspection: pulled models + xorb cache totals. Asks the
+    daemon (/v1/models) when one is running — same payload the dashboard
+    shows — else scans the caches directly; ``--json`` prints the raw
+    payload either way."""
+    from zest_tpu import storage
+
+    cfg = Config.load()
+    payload = _daemon_get(cfg, "/v1/models")
+    models = payload.get("models") if payload is not None else None
+    if not isinstance(models, list):
+        models = storage.list_models(cfg)
+
+    xorbs = storage.list_cached_xorbs(cfg)
+    xorb_bytes = 0
+    for hex_key in xorbs:
+        try:
+            xorb_bytes += cfg.xorb_cache_path(hex_key).stat().st_size
+        except OSError:
+            pass
+    if args.json:
+        print(json.dumps({"models": models, "xorbs": len(xorbs),
+                          "xorb_bytes": xorb_bytes}))
+        return 0
+    if not models:
+        print("no models pulled")
+    for m in models:
+        rev = (m.get("revision") or "?")[:12]
+        print(f"{m['repo_id']}  rev {rev}  {m.get('files', 0)} files")
+    print(f"xorb cache: {len(xorbs)} xorbs, {xorb_bytes / 1e6:.1f} MB")
+    return 0
 
 
 def cmd_bench(args) -> int:
@@ -520,6 +560,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("stop", help="stop the daemon").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="print daemon status") \
         .set_defaults(fn=cmd_status)
+    models_p = sub.add_parser(
+        "models", help="list pulled models and xorb cache totals")
+    models_p.add_argument("--json", action="store_true")
+    models_p.set_defaults(fn=cmd_models)
 
     bench = sub.add_parser("bench", help="run the synthetic benchmark suite")
     bench.add_argument("--json", action="store_true")

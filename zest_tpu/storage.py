@@ -66,6 +66,42 @@ def read_ref(cfg: Config, repo_id: str, ref: str) -> str | None:
         return None
 
 
+def list_models(cfg: Config) -> list[dict]:
+    """Scan the HF hub cache for pulled models (reference:
+    http_api.zig:152-210): one row per ``models--*`` dir with its
+    latest snapshot revision and file count. Shared by the REST
+    ``/v1/models`` payload and the ``zest-tpu models`` CLI."""
+    models = []
+    hub = cfg.hf_home / "hub"
+    if hub.is_dir():
+        for d in sorted(hub.iterdir()):
+            if not d.name.startswith("models--") or not d.is_dir():
+                continue
+            repo_id = d.name[len("models--"):].replace("--", "/", 1)
+            snapshots = d / "snapshots"
+            n_files = 0
+            revision = None
+            if snapshots.is_dir():
+                # Dirs only: tools drop sibling FILES next to snapshots
+                # (e.g. the lifecycle example's exported safetensors) and
+                # a file must not masquerade as the latest revision.
+                revs = sorted(
+                    (p for p in snapshots.iterdir() if p.is_dir()),
+                    key=lambda p: p.stat().st_mtime,
+                )
+                if revs:
+                    revision = revs[-1].name
+                    n_files = sum(
+                        1 for f in revs[-1].rglob("*") if f.is_file()
+                    )
+            models.append({
+                "repo_id": repo_id,
+                "revision": revision,
+                "files": n_files,
+            })
+    return models
+
+
 # ── Chunk cache (reference: storage.zig:102-143; plain-hex keys) ──
 
 
