@@ -802,7 +802,11 @@ def _run_child(platform: str | None, timeout_s: float) -> tuple[dict | None, str
 
 
 def main() -> None:
-    probe_timeout = float(os.environ.get("ZEST_BENCH_PROBE_TIMEOUT_S", "180"))
+    # 120s is 3-6x the observed live-tunnel init time (~20-40s); on a
+    # DEAD tunnel the probe always burns the full timeout twice (retry),
+    # so a tighter default keeps the whole fallback path well inside the
+    # driver's window while still never cutting off a live chip.
+    probe_timeout = float(os.environ.get("ZEST_BENCH_PROBE_TIMEOUT_S", "120"))
     child_timeout = float(os.environ.get("ZEST_BENCH_CHILD_TIMEOUT_S", "2700"))
 
     requested = os.environ.get("JAX_PLATFORMS") or None
