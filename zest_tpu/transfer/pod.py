@@ -115,8 +115,7 @@ def expert_pod_round(
                 failed += 1
                 continue
             fi = a.fetch_info
-            if fi.range.start == 0 and \
-                    _is_whole_xorb(file_maps, a.hash_hex, fi):
+            if _is_whole_xorb(file_maps, a.hash_hex, fi):
                 bridge.cache.put(a.hash_hex, data)
             else:
                 bridge.cache.put_partial(a.hash_hex, fi.range.start, data)
@@ -135,13 +134,16 @@ def expert_pod_round(
 
 
 def _is_whole_xorb(file_maps, hash_hex: str, fi) -> bool:
-    """Full-cache-key evidence: the hash has exactly one fetch_info entry
-    across the files and it starts at chunk 0 (same rule as
-    bridge._cache_fetched)."""
+    """Full-cache-key evidence across the files (same rule as
+    bridge._cache_fetched — provably_whole dedupes identical ranges, so
+    the one whole-xorb reference repeated by several files still counts
+    as whole)."""
+    from zest_tpu.transfer.bridge import provably_whole
+
     entries = []
     for fm in file_maps:
         entries.extend(fm.rec.fetch_info.get(hash_hex, []))
-    return len(entries) == 1 and entries[0].range.start == 0
+    return provably_whole(entries, fi.range.start)
 
 
 def pod_round(
