@@ -26,6 +26,7 @@ from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.collectives import PodDistributor
 from zest_tpu.parallel.mesh import num_slots, pod_mesh
 from zest_tpu.parallel.plan import DistributionPlan
+from zest_tpu.transfer.bridge import provably_whole
 
 
 def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher) -> bool:
@@ -106,6 +107,11 @@ def expert_pod_round(
             s for s in PodDistributor(mesh).local_slots()
             if s < placement.num_hosts
         ]
+    # Whole-checkpoint full-vs-partial evidence, built ONCE (the per-unit
+    # rebuild would be O(units x files) on the fetch hot loop).
+    from zest_tpu.transfer.federated import _entries_by_hash
+
+    entries_map = _entries_by_hash([fm.rec for fm in file_maps])
     fetched = failed = expert_bytes = 0
     for h in my_hosts:
         for a in routed.expert_units.get(h, []):
@@ -115,7 +121,8 @@ def expert_pod_round(
                 failed += 1
                 continue
             fi = a.fetch_info
-            if _is_whole_xorb(file_maps, a.hash_hex, fi):
+            if provably_whole(entries_map.get(a.hash_hex, []),
+                              fi.range.start):
                 bridge.cache.put(a.hash_hex, data)
             else:
                 bridge.cache.put_partial(a.hash_hex, fi.range.start, data)
@@ -131,19 +138,6 @@ def expert_pod_round(
         "ici_bytes_saved": s["ici_bytes_saved"],
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
-
-
-def _is_whole_xorb(file_maps, hash_hex: str, fi) -> bool:
-    """Full-cache-key evidence across the files (same rule as
-    bridge._cache_fetched — provably_whole dedupes identical ranges, so
-    the one whole-xorb reference repeated by several files still counts
-    as whole)."""
-    from zest_tpu.transfer.bridge import provably_whole
-
-    entries = []
-    for fm in file_maps:
-        entries.extend(fm.rec.fetch_info.get(hash_hex, []))
-    return provably_whole(entries, fi.range.start)
 
 
 def pod_round(
