@@ -26,9 +26,13 @@ TPU-native build"):
 
 - ``pull_gb``       — END-TO-END at GB scale: a Llama-8B-geometry bf16
   checkpoint (default 2 GB; ``ZEST_BENCH_GB`` overrides) pulled from a
-  loopback hub straight into device HBM, 3 cold runs, per-stage medians
-  (resolve / cas_metadata / fetch / hbm_commit / files) and a loud
-  ``stable`` flag when the spread exceeds ±20% (zest_tpu.bench_scale).
+  loopback hub straight into device HBM, 3 cold runs (plus one untimed
+  warmup), per-stage medians (resolve / cas_metadata / fetch /
+  hbm_commit / files, each with wall AND busy thread-seconds — the pull
+  pipelines `files` under `hbm_commit`, so walls no longer sum to the
+  total), an ``overlap`` block attributing the pipelining win, and a
+  loud ``stable`` flag when the spread exceeds ±20%
+  (zest_tpu.bench_scale).
 - ``mfu``           — model-compute efficiency: analytic flops for one
   jitted train step at real-ish geometry vs chained-dispatch device
   time; achieved TFLOP/s and fraction of chip peak.

@@ -22,7 +22,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # Newer jax spells the virtual-device count as a config option; older
+    # builds (<=0.4.x) only honor the XLA_FLAGS form set above.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import pytest  # noqa: E402
 

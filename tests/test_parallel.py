@@ -144,6 +144,22 @@ def test_hbm_cache_oversized_blob_skipped():
     assert not hbm.has("a" * 64)
 
 
+def test_hbm_cache_counters_count_every_get_once():
+    """hits + misses == number of gets, across BOTH get paths — the
+    counters are bumped inside the same lock acquisition as the probe,
+    so concurrent-pipeline stats can't drift."""
+    hbm = HbmStagingCache(budget_bytes=1 << 20)
+    hbm.put("a" * 64, b"full")
+    hbm.put_partial("b" * 64, 7, b"part")
+    assert hbm.get_with_range("a" * 64, 0) is not None   # hit
+    assert hbm.get_with_range("b" * 64, 7) is not None   # partial hit
+    assert hbm.get_with_range("b" * 64, 9) is None       # one miss, not two
+    assert hbm.get_device("a" * 64) is not None          # hit (counted too)
+    assert hbm.get_device("c" * 64) is None              # miss
+    s = hbm.summary()
+    assert (s["hits"], s["misses"]) == (3, 2)
+
+
 def test_tiered_cache_promotion(tmp_config):
     disk = XorbCache(tmp_config)
     hbm = HbmStagingCache(budget_bytes=1 << 20)

@@ -1,0 +1,74 @@
+"""PeerPool eviction discipline: true LRU among idle connections.
+
+Pins the satellite fix for the `max_peers` cap: eviction used to drop
+an *arbitrary* (insertion-ordered) idle entry, throwing away hot peers
+while week-old idle sockets survived. Every pool access now touches its
+key, and eviction walks least-recently-used first.
+"""
+
+import threading
+
+import pytest
+
+from zest_tpu.p2p.pool import PeerPool
+
+
+class FakePeer:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def pool(monkeypatch):
+    import zest_tpu.p2p.pool as pool_mod
+
+    monkeypatch.setattr(
+        pool_mod.BtPeer, "connect",
+        staticmethod(lambda *a, **k: FakePeer()),
+    )
+    return PeerPool(max_peers=2)
+
+
+def _get(pool, host):
+    return pool.get_or_connect(host, 6881, b"i" * 20, b"p" * 20)
+
+
+def test_eviction_drops_least_recently_used(pool):
+    a = _get(pool, "a")
+    b = _get(pool, "b")
+    assert _get(pool, "a") is a  # touch refreshes recency
+    c = _get(pool, "c")  # at cap: evicts b (LRU), never a (just touched)
+    assert len(pool) == 2
+    assert b.closed and not a.closed and not c.closed
+    assert _get(pool, "a") is a  # a survived
+    assert _get(pool, "c") is c
+
+
+def test_eviction_skips_busy_peer_even_if_lru(pool):
+    a = _get(pool, "a")
+    b = _get(pool, "b")
+    assert _get(pool, "a") is a  # b is now LRU...
+    with b.lock:  # ...but mid-request: closing it would kill a transfer
+        _get(pool, "c")
+    assert not b.closed
+    assert a.closed  # the next-least-recent idle peer went instead
+
+
+def test_all_busy_soft_caps_instead_of_closing(pool):
+    a = _get(pool, "a")
+    b = _get(pool, "b")
+    with a.lock, b.lock:
+        c = _get(pool, "c")  # admitted over the cap; nothing closed
+    assert len(pool) == 3
+    assert not a.closed and not b.closed and not c.closed
+
+
+def test_remove_and_reconnect(pool):
+    a = _get(pool, "a")
+    pool.remove("a", 6881)
+    assert a.closed and len(pool) == 0
+    assert _get(pool, "a") is not a  # fresh connection after removal

@@ -1,0 +1,336 @@
+"""Pipelined-pull contracts: bounded-memory file pipeline, stage-clock
+overlap accounting, interrupt/resume idempotence, and the CPU guard
+keeping the concurrency knobs deadlock-free for the fast suite.
+
+The tentpole under test (ISSUE 1): `files` reassembly runs on a worker
+pool bounded by a byte budget, overlapping the direct HBM landing —
+bytes must stay identical to the sequential path, in-flight memory must
+respect the budget, and a mid-pull failure must leave a resumable
+snapshot (the ``_is_complete`` contract).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zest_tpu.bench_scale import llama_checkpoint_files
+from zest_tpu.config import Config
+from zest_tpu.transfer.pull import (
+    ByteBudget,
+    StageClock,
+    pull_model,
+)
+
+from fixtures import FixtureHub, FixtureRepo
+
+# Multi-shard llama-shaped repo (~15 MB over ~4 shards): small enough
+# for the fast suite, sharded enough that the file pipeline and the
+# landing's decode-ahead both actually pipeline.
+FILES = llama_checkpoint_files(0.012, shard_bytes=3 * 1024 * 1024,
+                               scale=8)
+SHARDS = sorted(n for n in FILES if n.endswith(".safetensors"))
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo("acme/pipelined", FILES, chunks_per_xorb=8)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+def _cfg(hub, root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+# ── ByteBudget ──
+
+
+def test_byte_budget_blocks_at_cap_and_tracks_peak():
+    budget = ByteBudget(100)
+    budget.acquire(60)
+    budget.acquire(40)  # exactly at cap
+    state = {"acquired": False}
+
+    def blocked():
+        budget.acquire(10)
+        state["acquired"] = True
+        budget.release(10)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.05)
+    assert not state["acquired"], "acquire must block past the budget"
+    budget.release(60)
+    budget.release(40)
+    t.join(timeout=5)
+    assert state["acquired"]
+    assert budget.peak_bytes == 100
+
+
+def test_byte_budget_admits_oversized_item_alone():
+    budget = ByteBudget(10)
+    # An item larger than the whole budget must not deadlock: it is
+    # admitted when nothing else is in flight, and runs alone.
+    budget.acquire(50)
+    state = {"acquired": False}
+
+    def second():
+        budget.acquire(5)
+        state["acquired"] = True
+
+    t = threading.Thread(target=second)
+    t.start()
+    time.sleep(0.05)
+    assert not state["acquired"], "oversized item must run alone"
+    budget.release(50)
+    t.join(timeout=5)
+    assert state["acquired"]
+
+
+# ── StageClock: busy vs wall vs span ──
+
+
+def test_stage_clock_busy_exceeds_wall_under_concurrency():
+    clock = StageClock()
+    barrier = threading.Barrier(2)
+
+    def worker():
+        with clock("files"):
+            barrier.wait(timeout=5)
+            time.sleep(0.08)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = clock.summary()["files"]
+    busy = clock.busy_summary()["files"]
+    # Two workers inside the stage simultaneously: busy ~= 2x wall.
+    assert busy >= wall * 1.5
+    # summary() rounds to 4 decimals; span() is exact.
+    assert clock.span("files") == pytest.approx(wall, abs=1e-3)
+
+
+def test_stage_clock_span_unions_disjoint_stages():
+    clock = StageClock()
+    with clock("a"):
+        time.sleep(0.03)
+    with clock("b"):
+        time.sleep(0.03)
+    s = clock.summary()
+    combined = clock.span("a", "b")
+    # Disjoint stages: the union span equals the sum of the walls.
+    assert combined == pytest.approx(s["a"] + s["b"], abs=5e-3)
+
+
+def test_stage_clock_gbps_and_ensure():
+    clock = StageClock()
+    clock.ensure("files")
+    assert clock.summary()["files"] == 0.0
+    with clock("hbm_commit"):
+        time.sleep(0.02)
+    clock.note_bytes("hbm_commit", 10_000_000)
+    gbps = clock.gbps_summary()
+    assert "hbm_commit" in gbps and gbps["hbm_commit"] > 0
+    assert "files" not in gbps  # no bytes noted, no rate invented
+
+
+# ── The pipeline itself ──
+
+
+def test_pipelined_bytes_identical_to_sequential(hub, tmp_path):
+    seq = pull_model(
+        _cfg(hub, tmp_path / "seq", pull_pipeline_width=1),
+        "acme/pipelined", no_p2p=True)
+    par = pull_model(
+        _cfg(hub, tmp_path / "par", pull_pipeline_width=4),
+        "acme/pipelined", no_p2p=True)
+    for name, data in FILES.items():
+        a = (seq.snapshot_dir / name).read_bytes()
+        b = (par.snapshot_dir / name).read_bytes()
+        assert a == data and b == data, f"{name} corrupt"
+    assert par.stats["files_downloaded"] == len(FILES)
+    assert par.stats["files_pipeline"]["width"] == 4
+
+
+def test_inflight_bytes_stay_under_budget(hub, tmp_path):
+    # Budget sized to the largest shard: wide pipeline, but only one
+    # shard's bytes may be in flight at a time — the acceptance bound.
+    budget = max(len(b) for b in FILES.values()) + 1024
+    res = pull_model(
+        _cfg(hub, tmp_path, pull_pipeline_width=4,
+             pull_inflight_bytes=budget),
+        "acme/pipelined", no_p2p=True)
+    pipe = res.stats["files_pipeline"]
+    assert pipe["budget_bytes"] == budget
+    assert 0 < pipe["inflight_peak_bytes"] <= budget
+    for name, data in FILES.items():
+        assert (res.snapshot_dir / name).read_bytes() == data
+
+
+def test_tiny_budget_serializes_but_never_deadlocks(hub, tmp_path):
+    # Every file is "oversized" for a 1-byte budget: the pipeline must
+    # degrade to one-file-at-a-time, not deadlock the suite.
+    res = pull_model(
+        _cfg(hub, tmp_path, pull_pipeline_width=4,
+             pull_inflight_bytes=1),
+        "acme/pipelined", no_p2p=True)
+    assert res.stats["files_downloaded"] == len(FILES)
+    # Oversized admissions run alone: peak is one file, not a pile-up.
+    assert (res.stats["files_pipeline"]["inflight_peak_bytes"]
+            <= max(len(b) for b in FILES.values()))
+
+
+def test_mid_pull_failure_resumes_idempotently(hub, tmp_path, monkeypatch):
+    """First error cancels the pipeline; completed files survive as
+    complete (atomic rename), the victim is absent, and a re-pull
+    resumes via ``_is_complete`` — downloading only what's missing."""
+    import zest_tpu.transfer.pull as pull_mod
+
+    victim = SHARDS[-1]
+    orig = pull_mod._pull_xet_file
+
+    def sabotaged(bridge, par, hub_, cfg, repo_id, revision, entry, dest,
+                  log):
+        if entry.path == victim:
+            raise RuntimeError("injected mid-pull failure")
+        return orig(bridge, par, hub_, cfg, repo_id, revision, entry,
+                    dest, log)
+
+    monkeypatch.setattr(pull_mod, "_pull_xet_file", sabotaged)
+    cfg = _cfg(hub, tmp_path, pull_pipeline_width=2)
+    with pytest.raises(RuntimeError, match="injected mid-pull failure"):
+        pull_model(cfg, "acme/pipelined", no_p2p=True)
+
+    snap_root = cfg.model_cache_dir("acme/pipelined") / "snapshots"
+    snap = next(snap_root.iterdir())
+    assert not (snap / victim).exists(), "failed file must not be partial"
+    # No half-written tmp litter survives the cancellation.
+    assert not list(snap.glob(".tmp-*"))
+    done_before = {p.name for p in snap.iterdir()}
+    for name in done_before:
+        assert (snap / name).read_bytes() == FILES[name]
+
+    monkeypatch.setattr(pull_mod, "_pull_xet_file", orig)
+    res = pull_model(cfg, "acme/pipelined", no_p2p=True)
+    assert res.stats["files_skipped"] == len(done_before)
+    assert res.stats["files_downloaded"] == len(FILES) - len(done_before)
+    for name, data in FILES.items():
+        assert (res.snapshot_dir / name).read_bytes() == data
+
+
+def test_prepared_budget_holder_cannot_deadlock_blocked_workers():
+    """Regression: a write-behind job acquires budget at enqueue time.
+    If it shared the worker pool, it could queue behind workers blocked
+    in acquire() on the very bytes it holds — a deadlock. The dedicated
+    writer thread guarantees the budget holder always runs."""
+    from types import SimpleNamespace
+
+    from zest_tpu.transfer.pull import _FilePipeline
+
+    clock = StageClock()
+    release_prepared = threading.Event()
+
+    def slow_prepared(entry):
+        release_prepared.wait(timeout=5)
+        return "downloaded"
+
+    pipe = _FilePipeline(1, 100, clock, work=lambda e: "downloaded")
+    # Prepared B holds 60 of 100 budget and occupies the writer...
+    pipe.submit_prepared(SimpleNamespace(path="b", size=60), slow_prepared)
+    # ...while plain A (80 bytes) blocks its only worker in acquire().
+    pipe.submit(SimpleNamespace(path="a", size=80))
+    time.sleep(0.1)
+    release_prepared.set()
+    joiner = threading.Thread(target=pipe.join, daemon=True)
+    joiner.start()
+    joiner.join(timeout=10)
+    assert not joiner.is_alive(), "pipeline deadlocked"
+    assert pipe.downloaded == 2
+
+
+def test_abort_releases_budget_of_cancelled_prepared_jobs():
+    """A queued write-behind job holds pre-acquired budget bytes; if
+    abort() cancels it before it runs, those bytes must be released —
+    a leak would park future acquirers forever."""
+    from types import SimpleNamespace
+
+    from zest_tpu.transfer.pull import _FilePipeline
+
+    clock = StageClock()
+    gate = threading.Event()
+    pipe = _FilePipeline(1, 100, clock, work=lambda e: "downloaded")
+    # First prepared job occupies the single writer thread...
+    pipe.submit_prepared(SimpleNamespace(path="a", size=10),
+                         lambda e: gate.wait(timeout=5) or "downloaded")
+    # ...second one queues behind it, holding 50 budget bytes.
+    pipe.submit_prepared(SimpleNamespace(path="b", size=50),
+                         lambda e: "downloaded")
+    # Abort while `a` is mid-write: `b` is still queued, so abort
+    # CANCELS it — its 50 bytes must be released by the done-callback.
+    threading.Timer(0.2, gate.set).start()
+    pipe.abort()
+    assert pipe.budget._inflight == 0, "cancelled prepared job leaked budget"
+
+
+# ── Overlap with the HBM landing (device="tpu") ──
+
+
+def test_tpu_pull_overlap_schema_and_decode_ahead(hub, tmp_path):
+    res = pull_model(_cfg(hub, tmp_path), "acme/pipelined",
+                     no_p2p=True, device="tpu")
+    stats = res.stats
+    assert stats["hbm"]["direct"] is True
+    # Multi-shard landing: the decode-ahead staging thread engaged.
+    assert stats["hbm"]["decode_ahead"] is True
+    assert stats["time_to_hbm_s"] <= stats["elapsed_s"] + 0.05
+    # Overlap accounting present and coherent: busy >= wall per stage,
+    # and the files∪hbm span never exceeds the whole pull.
+    assert stats["files_hbm_span_s"] <= stats["elapsed_s"] + 0.05
+    for stage, wall in stats["stages"].items():
+        assert stats["stages_busy"][stage] >= wall - 0.05
+    assert stats["stages_gbps"].get("files", 0) >= 0
+
+
+def test_decode_ahead_lands_identical_params(hub, tmp_path):
+    serial = pull_model(
+        _cfg(hub, tmp_path / "s", land_decode_ahead=0),
+        "acme/pipelined", no_p2p=True, device="tpu")
+    ahead = pull_model(
+        _cfg(hub, tmp_path / "a", land_decode_ahead=1),
+        "acme/pipelined", no_p2p=True, device="tpu")
+    assert serial.stats["hbm"]["decode_ahead"] is False
+    assert ahead.stats["hbm"]["decode_ahead"] is True
+    assert set(serial.params) == set(ahead.params)
+    for name in serial.params:
+        # Bitwise compare: random bf16 fixtures contain NaN patterns,
+        # and NaN != NaN would flag identical bytes as a mismatch.
+        a = np.asarray(serial.params[name]).view(np.uint16)
+        b = np.asarray(ahead.params[name]).view(np.uint16)
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+# ── CI guard: the knobs must default sanely on CPU ──
+
+
+def test_pipeline_knobs_default_sane_for_cpu_suite():
+    """Tier-1 deadlock guard: defaults must yield a live pipeline
+    (width >= 1, positive byte budget, at least one decode worker) so
+    the fast CPU suite can never stall on a zero-width pool or a
+    zero-byte budget."""
+    from zest_tpu.models.direct import resolve_decode_workers
+
+    cfg = Config.load({})
+    assert cfg.pull_pipeline_width >= 1
+    assert cfg.pull_inflight_bytes >= 64 << 20
+    assert cfg.land_decode_ahead >= 0
+    assert resolve_decode_workers(cfg.decode_workers) >= 1
+    # Env overrides cannot configure a dead pipeline either.
+    floor = Config.load({"ZEST_PULL_WIDTH": "0",
+                         "ZEST_PULL_INFLIGHT": "0"})
+    assert floor.pull_pipeline_width >= 1
+    assert floor.pull_inflight_bytes >= 1

@@ -218,6 +218,14 @@ def _print_pull_stats(stats: dict) -> None:
         parts += [f"{name} {val:.2f}s" for name, val in stages.items()
                   if name not in order]
         print(f"  Stages:     {'  '.join(parts)}")
+        # Pipelined stages: busy (thread-seconds) above the stage wall
+        # means work ran concurrently — show only where it did.
+        busy = stats.get("stages_busy") or {}
+        pipelined = [f"{name} {busy[name]:.2f}s" for name in stages
+                     if busy.get(name, 0.0) > stages[name] + 0.05]
+        if pipelined:
+            print(f"  Busy:       {'  '.join(pipelined)} "
+                  "(thread-seconds > stage wall: pipelined)")
     if "federated" in stats:
         f = stats["federated"]
         print(f"  Federated:  pod {f['pod']}/{f['pods']}: {f['own_units']} "
@@ -610,7 +618,16 @@ def _provision_virtual_devices() -> None:
               "already initialized", file=sys.stderr)
         return
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", count)
+    try:
+        jax.config.update("jax_num_cpu_devices", count)
+    except AttributeError:
+        # Older jax spells it via XLA_FLAGS only; the backend is not
+        # initialized yet (checked above), so the flag still applies.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={count}"
+            ).strip()
 
 
 def main(argv: list[str] | None = None) -> int:

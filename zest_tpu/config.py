@@ -26,6 +26,13 @@ DEFAULT_BATCH_MULTIPLIER = 8        # terms per batch = 16 * 8 = 128
 DEFAULT_DCN_PORT = 6991             # host-to-host chunk RPC listener
 DEFAULT_HBM_STAGING_BYTES = 2 << 30  # per-device staging buffer budget
 
+# Pull-pipeline defaults (the pipelined pull: file reconstruction,
+# verification, and HBM commit overlap; see transfer.pull).
+DEFAULT_PULL_PIPELINE_WIDTH = 4     # concurrent file reassemblies
+DEFAULT_PULL_INFLIGHT_BYTES = 2 << 30  # in-flight reassembly byte budget
+DEFAULT_DECODE_WORKERS = 0          # term-decode pool; 0 = auto, 1 = serial
+DEFAULT_LAND_DECODE_AHEAD = 1       # shards decoded ahead of the commit
+
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
 
 
@@ -88,6 +95,17 @@ class Config:
     max_peers: int = DEFAULT_MAX_PEERS
     max_concurrent_downloads: int = DEFAULT_MAX_CONCURRENT_DOWNLOADS
     hbm_staging_bytes: int = DEFAULT_HBM_STAGING_BYTES
+    # Pipelined-pull knobs (transfer.pull / models.direct / models.loader):
+    # how many HF-cache files reassemble concurrently, the byte budget
+    # bounding their in-flight blobs, the term-decode pool size
+    # (0 = auto: min(4, cpu); 1 = serial), and whether the landing
+    # decodes one shard ahead of the device commit (0 = off, nonzero =
+    # on; the lookahead depth is fixed at one shard — deeper would only
+    # grow the host peak past the double-buffer bound).
+    pull_pipeline_width: int = DEFAULT_PULL_PIPELINE_WIDTH
+    pull_inflight_bytes: int = DEFAULT_PULL_INFLIGHT_BYTES
+    decode_workers: int = DEFAULT_DECODE_WORKERS
+    land_decode_ahead: int = DEFAULT_LAND_DECODE_AHEAD
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
@@ -129,6 +147,14 @@ class Config:
             hbm_staging_bytes=int(
                 env.get("ZEST_TPU_HBM_STAGING", DEFAULT_HBM_STAGING_BYTES)
             ),
+            pull_pipeline_width=max(1, int(
+                env.get("ZEST_PULL_WIDTH", DEFAULT_PULL_PIPELINE_WIDTH))),
+            pull_inflight_bytes=max(1, int(
+                env.get("ZEST_PULL_INFLIGHT", DEFAULT_PULL_INFLIGHT_BYTES))),
+            decode_workers=max(0, int(
+                env.get("ZEST_DECODE_WORKERS", DEFAULT_DECODE_WORKERS))),
+            land_decode_ahead=max(0, int(
+                env.get("ZEST_LAND_AHEAD", DEFAULT_LAND_DECODE_AHEAD))),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,

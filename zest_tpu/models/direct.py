@@ -16,6 +16,10 @@ it to materialize other experts' bytes just to write a complete file.
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
 from zest_tpu.cas import reconstruction as recon
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.models.safetensors_io import SafetensorsHeader
@@ -25,6 +29,44 @@ class DirectLandingError(RuntimeError):
     pass
 
 
+_pool_lock = threading.Lock()
+_decode_pool: ThreadPoolExecutor | None = None
+_decode_pool_width = 0
+
+
+def resolve_decode_workers(workers: int | None = None) -> int:
+    """Term-decode parallelism: explicit value, else ``ZEST_DECODE_WORKERS``,
+    else auto. 0 means auto (min(4, cpus)); 1 means serial. The LZ4/BLAKE3
+    hot loops run in the native lib with the GIL released, so a small pool
+    gets real speedup without oversubscribing the landing's own threads."""
+    if workers is None:
+        try:
+            workers = int(os.environ.get("ZEST_DECODE_WORKERS", "0"))
+        except ValueError:
+            workers = 0
+    if workers <= 0:
+        workers = min(4, os.cpu_count() or 1)
+    return max(1, workers)
+
+
+def _shared_decode_pool(workers: int) -> ThreadPoolExecutor | None:
+    """One process-wide decode pool shared by every reader — concurrent
+    file-pipeline workers must not each spawn their own (width x workers
+    threads thrashing two cores). Grows to the widest request seen: a
+    later reader asking for more workers than the first caller must not
+    silently run at the smaller width. The replaced pool drains its
+    in-flight tasks and its idle threads exit on collection."""
+    if workers <= 1:
+        return None
+    global _decode_pool, _decode_pool_width
+    with _pool_lock:
+        if _decode_pool is None or _decode_pool_width < workers:
+            _decode_pool = ThreadPoolExecutor(
+                workers, thread_name_prefix="zest-term-decode")
+            _decode_pool_width = workers
+        return _decode_pool
+
+
 class CachedFileReader:
     """Random-access byte reads over a file that exists only as cached
     xorb units + a reconstruction.
@@ -32,9 +74,17 @@ class CachedFileReader:
     Decoded terms are memoized (most tensors span few terms, and adjacent
     tensors share boundary terms — without memoization every boundary
     chunk would be decompressed twice).
+
+    Term decode is parallel across a small shared pool (``workers`` > 1;
+    see :func:`resolve_decode_workers`): terms of one read land in
+    disjoint slices of the destination, so they decode independently.
+    The memo stays the dedup point — two threads racing the same
+    boundary term at worst both decode it (identical bytes; last write
+    wins), never corrupt it.
     """
 
-    def __init__(self, cache, rec: recon.Reconstruction, bridge=None):
+    def __init__(self, cache, rec: recon.Reconstruction, bridge=None,
+                 workers: int | None = None):
         self.cache = cache
         self.rec = rec
         self.bridge = bridge
@@ -45,6 +95,8 @@ class CachedFileReader:
             off += t.unpacked_length
         self.size = off
         self._term_bytes: dict[int, bytes] = {}
+        self._memo_lock = threading.Lock()
+        self.workers = resolve_decode_workers(workers)
 
     def _locate(self, term):
         """(fi, reader, local_start, local_end) for a cached term, or
@@ -67,7 +119,8 @@ class CachedFileReader:
                 term.range.end - entry.chunk_offset)
 
     def _decode_term(self, i: int) -> bytes:
-        data = self._term_bytes.get(i)
+        with self._memo_lock:
+            data = self._term_bytes.get(i)
         if data is not None:
             return data
         _lo, _hi, term = self._spans[i]
@@ -107,7 +160,8 @@ class CachedFileReader:
                 f"term decoded to {len(data)} bytes, expected "
                 f"{term.unpacked_length}"
             )
-        self._term_bytes[i] = data
+        with self._memo_lock:
+            self._term_bytes[i] = data
         return data
 
     def _decode_term_into(self, i: int, dest) -> int:
@@ -157,30 +211,81 @@ class CachedFileReader:
                 f"out buffer is {view.nbytes} bytes for a "
                 f"[{lo},{hi}) read"
             )
-        written = 0
+        # Each overlapping term owns a disjoint slice of the output, so
+        # decode order is free — serial on one worker, else fanned over
+        # the shared pool (multi-GB tensors span hundreds of terms; the
+        # native decompress releases the GIL, so the fan-out is real).
+        jobs = []  # (term index, dest offset in view, dest end)
         for i, (t_lo, t_hi, _term) in enumerate(self._spans):
             if t_hi <= lo:
                 continue
             if t_lo >= hi:
                 break
+            jobs.append((i, max(lo, t_lo) - lo, min(hi, t_hi) - lo))
+
+        def decode_into_view(i: int, d_lo: int, d_hi: int) -> int:
+            t_lo, t_hi, _term = self._spans[i]
             if lo <= t_lo and t_hi <= hi and i not in self._term_bytes:
                 # Term wholly inside the read and not already decoded:
                 # land it in place (no memo — a term can be wholly
                 # inside at most one tensor, so nothing re-reads it;
                 # boundary terms shared by adjacent tensors take the
                 # memoized branch below both times).
-                written += self._decode_term_into(
-                    i, view[written : written + t_hi - t_lo]
-                )
-                continue
+                return self._decode_term_into(i, view[d_lo:d_hi])
             src = memoryview(self._decode_term(i))  # zero-copy slice
             piece = src[max(lo, t_lo) - t_lo : min(hi, t_hi) - t_lo]
-            view[written : written + len(piece)] = piece
-            written += len(piece)
+            view[d_lo:d_hi] = piece
+            return len(piece)
+
+        def decode_group(group: list[tuple[int, int, int]]) -> int:
+            try:
+                return sum(decode_into_view(*j) for j in group)
+            except BaseException as exc:
+                # Detach worker frames before the exception crosses the
+                # future boundary: a pinned frame would hold its view
+                # slice (and, via closure cells, the whole destination
+                # buffer) until a gc pass.
+                raise exc.with_traceback(None) from None
+
+        pool = (_shared_decode_pool(self.workers)
+                if len(jobs) > 1 else None)
+        if pool is None:
+            return sum(decode_into_view(*j) for j in jobs)
+        # One future per CONTIGUOUS job group, not per term: a multi-GB
+        # tensor spans hundreds of terms, and per-term submit/result
+        # overhead would eat the fan-out's win. Contiguity keeps each
+        # worker streaming through adjacent cache entries.
+        n_groups = min(len(jobs), self.workers)
+        per = (len(jobs) + n_groups - 1) // n_groups
+        groups = [jobs[k : k + per] for k in range(0, len(jobs), per)]
+        futures = [pool.submit(decode_group, g) for g in groups]
+        written = 0
+        first_error: BaseException | None = None
+        for f in futures:
+            # Wait out EVERY job even after a failure — a still-running
+            # decode writing into ``view`` while the caller unwinds (and
+            # possibly frees the destination) would be a straight
+            # use-after-free.
+            try:
+                written += f.result()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                if first_error is None:
+                    first_error = exc
+        # NOTE: buffer-lifetime discipline. Even with the precautions
+        # above, a captured exception forms a tb→frame→exception cycle
+        # only gc can break, so a failing parallel read can keep ``out``
+        # alive briefly. That is fine for np-buffer callers (the landing
+        # path); callers that must deterministically close their buffer
+        # (the mmap fast lane in transfer.pull) construct the reader
+        # with workers=1 and never enter this branch.
+        futures.clear()
+        if first_error is not None:
+            raise first_error
         return written
 
     def drop_memo(self) -> None:
-        self._term_bytes.clear()
+        with self._memo_lock:
+            self._term_bytes.clear()
 
 
 def land_tensors(
@@ -189,6 +294,7 @@ def land_tensors(
     header: SafetensorsHeader,
     predicate=None,
     bridge=None,
+    workers: int | None = None,
 ):
     """Decode selected tensors of one safetensors file from the cache.
 
@@ -196,12 +302,13 @@ def land_tensors(
     cache). ``predicate(name)`` filters — the expert-sharded landing
     passes "is this tensor shared or one of my experts?". With a
     ``bridge``, units missing from the cache are pulled through the
-    waterfall instead of failing. Callers commit the arrays with
+    waterfall instead of failing. ``workers`` sizes the term-decode pool
+    (see :func:`resolve_decode_workers`). Callers commit the arrays with
     models.loader.land_tensor / jax.device_put.
     """
     import numpy as np
 
-    reader = CachedFileReader(cache, rec, bridge=bridge)
+    reader = CachedFileReader(cache, rec, bridge=bridge, workers=workers)
     out: dict[str, np.ndarray] = {}
     for name, info in header.tensors.items():
         if predicate is not None and not predicate(name):

@@ -230,6 +230,9 @@ def stage_cached_to_hbm(
     rules: ShardRules | None = None,
     dtype=None,
     prefetch_next=None,
+    decode_ahead: int | None = None,
+    decode_workers: int | None = None,
+    on_host_ready=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -241,22 +244,80 @@ def stage_cached_to_hbm(
     waterfall. ``prefetch_next(i)``, when given, is called before shard
     ``i`` lands — the pull path passes a one-shard-lookahead warm fetch
     so shard ``i+1``'s network time hides under shard ``i``'s decode +
-    commit (see transfer.pull._PipelinedWarm). Returns ``(params,
-    stats)`` like stage_snapshot_to_hbm, with ``stats["direct"] = True``.
+    commit (see transfer.pull._PipelinedWarm).
+
+    The decode and the device transfer are double-buffered (the
+    ``decode_ahead`` knob, default on, ``Config.land_decode_ahead``): a
+    single staging thread decodes shard ``i+1``'s host tensors while
+    shard ``i``'s batched ``jax.device_put`` is in flight — JAX's async
+    dispatch returns before the transfer drains, so the CPU-bound term
+    decode hides under it. Host peak stays bounded at ~two checkpoint
+    shards (the decoded-ahead shard plus the committing one).
+    ``decode_workers`` sizes the per-shard term-decode pool
+    (models.direct.resolve_decode_workers). Both default from
+    ``bridge.cfg``.
+
+    ``on_host_ready(i, host)``, when given, fires right after shard
+    ``i``'s host tensors are decoded (before the commit, in the staging
+    thread when pipelined) — the pull's write-behind hands the decoded
+    bytes to the file pipeline there, so the HF-cache file is written
+    without decoding the shard a second time. The callback may retain
+    ``host``'s arrays (the commit never mutates them; a dtype cast
+    copies) and may block, which backpressures the decode-ahead.
+    Returns ``(params, stats)`` like stage_snapshot_to_hbm, with
+    ``stats["direct"] = True``.
     """
+    from concurrent.futures import ThreadPoolExecutor
+
     from zest_tpu.models.direct import land_tensors
+
+    cfg = getattr(bridge, "cfg", None)
+    if decode_ahead is None:
+        decode_ahead = getattr(cfg, "land_decode_ahead", 1)
+    if decode_workers is None:
+        decode_workers = getattr(cfg, "decode_workers", None)
 
     t0 = time.monotonic()
     params: dict[str, jax.Array] = {}
-    for i, (rec, header) in enumerate(recs_with_headers):
+    n = len(recs_with_headers)
+
+    def decode(i: int) -> dict:
         if prefetch_next is not None:
             prefetch_next(i)
-        # One batched commit per checkpoint shard (see load_checkpoint's
-        # note: amortized transfer setup, file-bounded host peak).
-        host = land_tensors(bridge.cache, rec, header, bridge=bridge)
-        params.update(commit_tensors(host, mesh, rules, dtype=dtype))
-        del host
+        rec, header = recs_with_headers[i]
+        host = land_tensors(bridge.cache, rec, header, bridge=bridge,
+                            workers=decode_workers)
+        if on_host_ready is not None:
+            on_host_ready(i, host)
+        return host
+
+    pipelined = bool(decode_ahead) and n > 1
+    if pipelined:
+        # One staging thread, one shard of lookahead: deeper lookahead
+        # would only grow the host peak — the commit is the narrower
+        # pipe and a single buffered shard already keeps it fed.
+        with ThreadPoolExecutor(
+                1, thread_name_prefix="zest-land-decode") as staging:
+            pending = staging.submit(decode, 0)
+            for i in range(n):
+                host = pending.result()
+                if i + 1 < n:
+                    pending = staging.submit(decode, i + 1)
+                # One batched commit per checkpoint shard (see
+                # load_checkpoint's note: amortized transfer setup,
+                # file-bounded host peak); async dispatch means this
+                # returns while the transfer is still draining.
+                params.update(commit_tensors(host, mesh, rules,
+                                             dtype=dtype))
+                del host
+    else:
+        for i in range(n):
+            host = decode(i)
+            params.update(commit_tensors(host, mesh, rules, dtype=dtype))
+            del host
     for arr in params.values():
         arr.block_until_ready()
     dt = time.monotonic() - t0
-    return params, _commit_stats(params, dt, mesh, direct=True)
+    stats = _commit_stats(params, dt, mesh, direct=True)
+    stats["decode_ahead"] = pipelined
+    return params, stats

@@ -5,12 +5,15 @@ land on the same peer. Discipline mirrors the reference (peer_pool.zig:49-95):
 connect + handshake happen *outside* the lock (slow I/O must not serialize
 the pool), with a re-check on insert — the loser of a connect race closes
 its duplicate. Broken connections are removed so the next attempt
-reconnects; at ``max_peers`` an arbitrary idle entry is evicted.
+reconnects; at ``max_peers`` the least-recently-used *idle* entry is
+evicted (every pool access touches its key, so iteration order IS
+recency order).
 """
 
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 
 from zest_tpu.p2p.peer import BtPeer
 
@@ -18,7 +21,7 @@ from zest_tpu.p2p.peer import BtPeer
 class PeerPool:
     def __init__(self, max_peers: int = 50):
         self.max_peers = max_peers
-        self._peers: dict[tuple[str, int], BtPeer] = {}
+        self._peers: OrderedDict[tuple[str, int], BtPeer] = OrderedDict()
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -37,6 +40,7 @@ class PeerPool:
         with self._lock:
             existing = self._peers.get(key)
             if existing is not None:
+                self._peers.move_to_end(key)  # LRU touch
                 return existing
 
         # Slow path outside the lock.
@@ -46,6 +50,7 @@ class PeerPool:
             raced = self._peers.get(key)
             if raced is not None:
                 # Lost the race; keep the established one.
+                self._peers.move_to_end(key)
                 loser = peer
                 peer = raced
             else:
@@ -71,8 +76,13 @@ class PeerPool:
             p.close()
 
     def _evict_one_locked(self) -> None:
-        # Only evict a peer whose stream lock is free — closing a socket
-        # another thread is mid-request on turns healthy transfers into
+        # True LRU among idle peers: the OrderedDict iterates least-
+        # recently-touched first (get_or_connect touches on every hit),
+        # so the first idle entry is the coldest connection — evicting
+        # an arbitrary (insertion-ordered) entry used to throw away hot
+        # peers while week-old idle sockets survived. Only a peer whose
+        # stream lock is free is evicted — closing a socket another
+        # thread is mid-request on turns healthy transfers into
         # spurious failures. (A thread that fetched the peer but hasn't
         # locked yet can still lose it; that surfaces as one retried
         # request, which the waterfall absorbs.) All busy -> soft cap:

@@ -241,9 +241,20 @@ def pack_global_rows(
 
 
 @partial(jax.jit, static_argnames=("mesh",))
-def _replicate(mesh: Mesh, pool: jax.Array) -> jax.Array:
+def _replicate_jit(mesh: Mesh, pool: jax.Array) -> jax.Array:
     """sharded-over-pod → replicated: XLA lowers this to an ICI all-gather."""
     return jax.lax.with_sharding_constraint(pool, replicated(mesh))
+
+
+def _replicate(mesh: Mesh, pool: jax.Array) -> jax.Array:
+    out = _replicate_jit(mesh, pool)
+    if not out.sharding.is_fully_replicated:
+        # Older jax (observed on 0.4.37 CPU) drops the output constraint
+        # and returns the input sharding; an explicit resharding
+        # device_put restores the replication contract. No-op (never
+        # taken) on versions where the jitted constraint holds.
+        out = jax.device_put(out, replicated(mesh))
+    return out
 
 
 class GatheredPool:

@@ -19,7 +19,6 @@ from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from zest_tpu.storage import CacheResult
@@ -55,10 +54,13 @@ class HbmStagingCache:
     # ── Core ops ──
 
     def _device_put(self, data: bytes) -> jax.Array:
-        arr = jnp.asarray(np.frombuffer(data, dtype=np.uint8))
-        if self.device is not None:
-            arr = jax.device_put(arr, self.device)
-        return arr
+        # np.frombuffer is a zero-copy view of the blob; hand it straight
+        # to device_put so the only copy is host→device. (The old
+        # jnp.asarray(...) materialized a committed default-device array
+        # FIRST, then device_put copied it again — a full extra
+        # traversal of every staged byte.)
+        return jax.device_put(np.frombuffer(data, dtype=np.uint8),
+                              self.device)
 
     def _insert(self, key: str, data: bytes, chunk_offset: int) -> None:
         if len(data) > self.budget_bytes:
@@ -81,40 +83,34 @@ class HbmStagingCache:
     def put_partial(self, hash_hex: str, range_start: int, data: bytes) -> None:
         self._insert(f"{hash_hex}.{range_start}", data, range_start)
 
-    def _lookup(self, key: str, count: bool = False) -> HbmEntry | None:
-        """Locked lookup; ``count=True`` also updates hit/miss counters
-        (inside the same lock — they feed concurrent-pipeline stats)."""
+    def _lookup(self, hash_hex: str,
+                range_start: int | None = None) -> HbmEntry | None:
+        """One locked critical section per logical get: full-key probe,
+        optional partial-key probe, LRU touch AND the hit/miss counter
+        bump all happen under the same lock acquisition — concurrent
+        pipeline workers can't interleave a probe with someone else's
+        count, so hits+misses always equals the number of gets."""
         with self._lock:
+            key = hash_hex
             entry = self._entries.get(key)
+            if entry is None and range_start is not None:
+                key = f"{hash_hex}.{range_start}"
+                entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
-            if count:
-                if entry is None:
-                    self.misses += 1
-                else:
-                    self.hits += 1
+                self.hits += 1
+            else:
+                self.misses += 1
             return entry
 
     def get_device(self, hash_hex: str, range_start: int = 0) -> HbmEntry | None:
         """Device-resident lookup — the input to collectives/ops paths."""
-        entry = self._lookup(hash_hex)
-        if entry is not None:
-            return entry
-        if range_start:
-            return self._lookup(f"{hash_hex}.{range_start}")
-        return None
+        return self._lookup(hash_hex, range_start if range_start else None)
 
     def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
         """Waterfall-compatible lookup: full entry first, then the partial
         keyed by ``range_start`` — bytes come back to host for extraction."""
-        entry = self._lookup(hash_hex)
-        if entry is None:
-            entry = self._lookup(f"{hash_hex}.{range_start}")
-        with self._lock:
-            if entry is None:
-                self.misses += 1
-            else:
-                self.hits += 1
+        entry = self._lookup(hash_hex, range_start)
         if entry is None:
             return None
         return CacheResult(bytes(np.asarray(entry.array)), entry.chunk_offset)

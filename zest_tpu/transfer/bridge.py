@@ -130,6 +130,10 @@ class XetBridge:
         self.cas: CasClient | None = None
         self.stats = FetchStats()
         self._recons: dict[str, recon.Reconstruction] = {}
+        # Guards the reconstruction memo: the pipelined pull resolves
+        # and fetches from several file workers at once, and an unlocked
+        # dict would let _known_entries iterate mid-insert.
+        self._recons_lock = threading.Lock()
 
     # ── Auth (reference: xet_bridge.zig:76-130) ──
 
@@ -146,10 +150,15 @@ class XetBridge:
         so each file costs one CAS round-trip total."""
         if self.cas is None:
             raise NotAuthenticated("call authenticate() first")
-        cached = self._recons.get(file_hash_hex)
+        with self._recons_lock:
+            cached = self._recons.get(file_hash_hex)
         if cached is None:
+            # CAS round-trip outside the lock (slow I/O must not
+            # serialize the memo); a racing double-fetch is benign —
+            # reconstructions are content-addressed, last write wins.
             cached = self.cas.get_reconstruction(file_hash_hex)
-            self._recons[file_hash_hex] = cached
+            with self._recons_lock:
+                cached = self._recons.setdefault(file_hash_hex, cached)
         return cached
 
     # ── The waterfall (reference: xet_bridge.zig:149-218) ──
@@ -312,7 +321,9 @@ class XetBridge:
     def _known_entries(self, rec: recon.Reconstruction,
                        hash_hex: str) -> list[recon.FetchInfo]:
         entries = list(rec.fetch_info.get(hash_hex, []))
-        for other in self._recons.values():
+        with self._recons_lock:
+            others = list(self._recons.values())
+        for other in others:
             if other is not rec:
                 entries.extend(other.fetch_info.get(hash_hex, []))
         return entries

@@ -22,11 +22,20 @@ from zest_tpu.transfer.bridge import XetBridge
 
 
 class ParallelDownloader:
-    def __init__(self, bridge: XetBridge, max_concurrent: int | None = None):
+    def __init__(self, bridge: XetBridge, max_concurrent: int | None = None,
+                 executor: ThreadPoolExecutor | None = None):
+        """``executor``, when given, is a SHARED term-fetch pool: the
+        pipelined pull reconstructs several files concurrently, and one
+        pool across all of them bounds total in-flight fetch threads at
+        the pool's size instead of files x max_concurrent. The caller
+        owns its lifetime (it is never shut down here). Term tasks never
+        block on other term tasks, so sharing cannot deadlock — worst
+        case is queueing."""
         self.bridge = bridge
         self.max_concurrent = (
             max_concurrent or bridge.cfg.max_concurrent_downloads
         )
+        self._executor = executor
 
     def reconstruct_to_file(self, file_hash_hex: str, out_path: Path) -> int:
         rec = self.bridge.get_reconstruction(file_hash_hex)
@@ -66,7 +75,8 @@ class ParallelDownloader:
                     return
                 os.pwrite(fd, data, offsets[i])
 
-            with ThreadPoolExecutor(self.max_concurrent) as pool:
+            pool = self._executor or ThreadPoolExecutor(self.max_concurrent)
+            try:
                 futures = [
                     pool.submit(fetch_one, i) for i in range(len(rec.terms))
                 ]
@@ -78,7 +88,11 @@ class ParallelDownloader:
                     cancel.set()
                     for f in not_done:
                         f.cancel()
+                    wait(not_done)  # cancelled-or-done before fd closes
                     raise first_error
+            finally:
+                if pool is not self._executor:
+                    pool.shutdown(wait=True)
         except BaseException:
             os.close(fd)
             try:

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import contextlib
 import sys
+import threading
 import time
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from pathlib import Path
 
 from zest_tpu import storage
@@ -43,15 +45,32 @@ class PullResult:
 
 
 class StageClock:
-    """Accumulating per-stage wall-clock for one pull — the tracing story
-    SURVEY.md §5 asks for (the reference only prints end-of-pull totals,
-    swarm.zig:472-485). ``with clock("fetch"):`` adds elapsed seconds to
-    that stage; totals land in ``stats["stages"]``. Stages are additive
-    and non-overlapping by construction (only the pull thread enters
-    them), so they decompose ``elapsed_s`` minus untimed glue."""
+    """Per-stage timing for one pull — the tracing story SURVEY.md §5
+    asks for (the reference only prints end-of-pull totals,
+    swarm.zig:472-485).
+
+    The pipelined pull broke the old "stages are additive and
+    non-overlapping" invariant on purpose: several worker threads can sit
+    inside ``files`` at once, and ``files`` runs concurrently with
+    ``hbm_commit``. The clock therefore records raw ``(start, end)``
+    intervals (thread-safe) and reports two views:
+
+    - :meth:`summary` — per-stage *wall* time: union coverage of the
+      stage's intervals. Concurrent entries into the same stage count
+      once, so a stage's wall never exceeds the pull's elapsed time.
+    - :meth:`busy_summary` — per-stage *busy* time: summed thread-seconds.
+      ``busy > wall`` is the direct evidence of intra-stage parallelism;
+      ``busy(a) + busy(b) > span(a, b)`` is the evidence two stages
+      overlapped (the bench's attribution for pipelining wins).
+
+    ``note_bytes`` attributes payload bytes to a stage so
+    :meth:`gbps_summary` can report per-stage effective throughput.
+    """
 
     def __init__(self):
-        self.seconds: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._intervals: dict[str, list[tuple[float, float]]] = {}
+        self._bytes: dict[str, int] = {}
 
     @contextlib.contextmanager
     def __call__(self, stage: str):
@@ -59,12 +78,62 @@ class StageClock:
         try:
             yield
         finally:
-            self.seconds[stage] = (
-                self.seconds.get(stage, 0.0) + time.monotonic() - t0
-            )
+            t1 = time.monotonic()
+            with self._lock:
+                self._intervals.setdefault(stage, []).append((t0, t1))
+
+    def ensure(self, stage: str) -> None:
+        """Materialize a stage key even when nothing entered it (an
+        all-skipped ``files`` stage must still report 0.0, not vanish)."""
+        with self._lock:
+            self._intervals.setdefault(stage, [])
+
+    def note_bytes(self, stage: str, nbytes: int) -> None:
+        with self._lock:
+            self._bytes[stage] = self._bytes.get(stage, 0) + int(nbytes)
+
+    @staticmethod
+    def _coverage(intervals: list[tuple[float, float]]) -> float:
+        total = 0.0
+        end = float("-inf")
+        for s, e in sorted(intervals):
+            if s > end:
+                total += e - s
+                end = e
+            elif e > end:
+                total += e - end
+                end = e
+        return total
+
+    def span(self, *stages: str) -> float:
+        """Union wall-clock coverage across several stages combined —
+        the denominator of the overlap attribution."""
+        with self._lock:
+            ivs = [iv for s in stages for iv in self._intervals.get(s, [])]
+        return self._coverage(ivs)
 
     def summary(self) -> dict[str, float]:
-        return {k: round(v, 4) for k, v in self.seconds.items()}
+        with self._lock:
+            items = {k: list(v) for k, v in self._intervals.items()}
+        return {k: round(self._coverage(v), 4) for k, v in items.items()}
+
+    def busy_summary(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                k: round(sum(e - s for s, e in v), 4)
+                for k, v in self._intervals.items()
+            }
+
+    def gbps_summary(self) -> dict[str, float]:
+        """Effective GB/s for stages with noted bytes (wall-based)."""
+        walls = self.summary()
+        with self._lock:
+            noted = dict(self._bytes)
+        return {
+            k: round(n / walls[k] / 1e9, 3)
+            for k, n in noted.items()
+            if walls.get(k, 0.0) > 1e-3
+        }
 
 
 def _is_complete(snapshot_dir: Path, entry) -> bool:
@@ -73,6 +142,222 @@ def _is_complete(snapshot_dir: Path, entry) -> bool:
     eligibility check, so the three never disagree about resume state."""
     dest = snapshot_dir / entry.path
     return dest.exists() and dest.stat().st_size == entry.size
+
+
+class ByteBudget:
+    """Counting byte-semaphore bounding in-flight reassembly bytes.
+
+    ``acquire(n)`` blocks while admitting ``n`` more bytes would push the
+    in-flight total past the budget — except when nothing is in flight,
+    where an oversized item (n > budget) is admitted alone rather than
+    deadlocking (the classic bounded-buffer starvation case: a file
+    larger than the whole budget must still be pullable, serially).
+    ``peak_bytes`` records the high-watermark for the bench/tests to
+    assert the bound held."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = max(1, int(budget_bytes))
+        self._cv = threading.Condition(threading.Lock())
+        self._inflight = 0
+        self.peak_bytes = 0
+
+    def acquire(self, nbytes: int) -> None:
+        nbytes = max(0, int(nbytes))
+        with self._cv:
+            while (self._inflight > 0
+                   and self._inflight + nbytes > self.budget_bytes):
+                self._cv.wait()
+            self._inflight += nbytes
+            self.peak_bytes = max(self.peak_bytes, self._inflight)
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._inflight -= max(0, int(nbytes))
+            self._cv.notify_all()
+
+
+class _FilePipeline:
+    """Bounded worker pool writing the HF-cache files concurrently.
+
+    Files are independent by construction (per-file work is offset-
+    addressed into a private tmp file, committed by atomic rename), so
+    the old per-file serial loop becomes ``width`` workers fed by
+    ``submit``; a :class:`ByteBudget` bounds in-flight blob bytes so a
+    wide pipeline cannot hold every shard's working set at once (the
+    bounded-memory producer/consumer argument from "Bounded-Memory
+    Parallel Image Pulling", PAPERS.md). ``submit`` dedups by path —
+    the direct landing hands each shard over via ``submit_prepared``
+    the moment its host tensors are decoded (write-behind), and the
+    tail submit-everything pass catches the rest.
+
+    First error wins: it cancels queued work, ``join`` drains in-flight
+    workers (each file is atomic, so a cancelled pull leaves only
+    complete files — the ``_is_complete`` resume contract), then
+    re-raises."""
+
+    def __init__(self, width: int, budget_bytes: int, clock: StageClock,
+                 work, term_executor: ThreadPoolExecutor | None = None,
+                 skip_check=None):
+        self.width = max(1, int(width))
+        self.budget = ByteBudget(budget_bytes)
+        self.clock = clock
+        self.work = work  # work(entry) -> "downloaded" | "skipped"
+        # Cheap completeness probe run BEFORE the budget acquire: a
+        # resume pull of already-complete multi-GiB shards must not
+        # serialize its no-op skips through the byte budget.
+        self.skip_check = skip_check
+        # The shared term-fetch pool the per-file ParallelDownloader
+        # rides (bounds total fetch streams across concurrent files);
+        # owned here, torn down by join().
+        self.term_executor = term_executor
+        self.downloaded = 0
+        self.skipped = 0
+        self._lock = threading.Lock()
+        self._cancel = threading.Event()
+        self._futures: dict[str, object] = {}
+        self._pool = ThreadPoolExecutor(
+            self.width, thread_name_prefix="zest-pull-file")
+        # Prepared (write-behind) jobs hold budget bytes from enqueue
+        # time, so they must NEVER queue behind budget-waiting plain
+        # workers: a dedicated writer thread guarantees the oldest
+        # budget holder can always run — the holder always progresses,
+        # releases, and unblocks any workers parked in acquire().
+        # (Sharing self._pool would deadlock: all workers blocked in
+        # acquire while the only releaser sits queued behind them.)
+        self._prepared_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="zest-pull-writeback")
+
+    def submit(self, entry) -> None:
+        with self._lock:
+            if entry.path in self._futures:
+                return
+            self._futures[entry.path] = self._pool.submit(self._run, entry)
+
+    def submit_prepared(self, entry, prepared) -> None:
+        """Submit a file whose payload the caller already holds in
+        memory (the landing's write-behind: decoded host tensors).
+
+        The byte budget is acquired HERE, in the caller's thread, before
+        the job is queued — so a producer decoding ahead of the file
+        writers blocks instead of queueing unbounded in-memory payload
+        closures (the bounded-memory backpressure). ``prepared(entry)``
+        returns a status or None/raises to decline, in which case the
+        worker falls back to the normal waterfall ``work``."""
+        with self._lock:
+            if entry.path in self._futures:
+                return
+        self.budget.acquire(entry.size)
+        with self._lock:
+            if entry.path in self._futures:  # raced with a plain submit
+                self.budget.release(entry.size)
+                return
+            fut = self._prepared_pool.submit(
+                self._run_prepared, entry, prepared)
+            # A queued prepared future cancelled by join()/abort() never
+            # runs _run_prepared's finally — its pre-acquired bytes must
+            # be released here or the budget leaks and acquire()-parked
+            # workers hang the shutdown itself.
+            fut.add_done_callback(
+                lambda f, n=entry.size:
+                self.budget.release(n) if f.cancelled() else None)
+            self._futures[entry.path] = fut
+
+    def _run_prepared(self, entry, prepared) -> None:
+        try:
+            if self._cancel.is_set():
+                return
+            with self.clock("files"):
+                status = None
+                try:
+                    status = prepared(entry)
+                except Exception:  # noqa: BLE001 - fast lane is optional
+                    status = None
+                if status is None:
+                    status = self.work(entry)
+        finally:
+            self.budget.release(entry.size)
+        with self._lock:
+            if status == "skipped":
+                self.skipped += 1
+            else:
+                self.downloaded += 1
+
+    def _run(self, entry) -> None:
+        if self._cancel.is_set():
+            return
+        if self.skip_check is not None and self.skip_check(entry):
+            with self._lock:
+                self.skipped += 1
+            return
+        # The budget wait is queueing, not work: acquired OUTSIDE the
+        # stage clock so a starved worker doesn't inflate `files` busy.
+        self.budget.acquire(entry.size)
+        try:
+            if self._cancel.is_set():
+                return
+            with self.clock("files"):
+                status = self.work(entry)
+        finally:
+            self.budget.release(entry.size)
+        with self._lock:
+            if status == "skipped":
+                self.skipped += 1
+            else:
+                self.downloaded += 1
+
+    def join(self) -> tuple[int, int]:
+        """Wait for every submitted file; (downloaded, skipped) counts.
+        Raises the first worker error after cancelling queued work and
+        draining in-flight workers."""
+        with self._lock:
+            futures = list(self._futures.values())
+        try:
+            done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+            first_error = next(
+                (f.exception() for f in done if f.exception()), None)
+            if first_error is not None:
+                self._cancel.set()
+                for f in not_done:
+                    f.cancel()
+                wait(not_done)
+                raise first_error
+        except BaseException:
+            # KeyboardInterrupt (or any waiter-side failure) must not
+            # leave the whole queued repo downloading: cancel first so
+            # the shutdown below only drains in-flight files, not the
+            # full submission backlog.
+            self._cancel.set()
+            for f in futures:
+                f.cancel()
+            raise
+        finally:
+            self._pool.shutdown(wait=True)
+            self._prepared_pool.shutdown(wait=True)
+            if self.term_executor is not None:
+                self.term_executor.shutdown(wait=True)
+        return self.downloaded, self.skipped
+
+    def abort(self) -> None:
+        """Cancel queued work and tear the pools down without raising —
+        the cleanup path for exceptions that bypass :meth:`join` (e.g. a
+        bad mesh config before the tail pass). Idempotent; in-flight
+        files drain (each is atomic), queued ones are dropped."""
+        self._cancel.set()
+        with self._lock:
+            futures = list(self._futures.values())
+        for f in futures:
+            f.cancel()
+        self._pool.shutdown(wait=True)
+        self._prepared_pool.shutdown(wait=True)
+        if self.term_executor is not None:
+            self.term_executor.shutdown(wait=True)
+
+    def summary(self) -> dict:
+        return {
+            "width": self.width,
+            "budget_bytes": self.budget.budget_bytes,
+            "inflight_peak_bytes": self.budget.peak_bytes,
+        }
 
 
 def pull_model(
@@ -109,97 +394,145 @@ def pull_model(
     if swarm is None and not no_p2p:
         swarm = _default_swarm(cfg)
     bridge = XetBridge(cfg, swarm=swarm)
-    par = ParallelDownloader(bridge)
+    width = max(1, getattr(cfg, "pull_pipeline_width", 1))
+    # ONE term-fetch pool shared by every concurrent file reassembly:
+    # total in-flight fetch streams stay at the configured budget no
+    # matter how wide the file pipeline runs (width x per-file pools
+    # would oversubscribe it). Owned by the file pipeline below.
+    term_pool = ThreadPoolExecutor(
+        max(1, cfg.max_concurrent_downloads),
+        thread_name_prefix="zest-term-fetch")
+    par = ParallelDownloader(bridge, executor=term_pool)
     authenticated = False
+    auth_lock = threading.Lock()
 
-    # Pod pre-pass (BASELINE config #3): one collective round fills the
-    # cache so the per-file loop below hits tier 1 for planned bytes.
-    # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
-    if pod is None:
-        import os
+    def ensure_auth() -> None:
+        """Idempotent, thread-safe CAS auth — file workers and the
+        landing thread can both demand it; exactly one authenticates."""
+        nonlocal authenticated
+        with auth_lock:
+            if not authenticated and bridge.cas is None:
+                bridge.authenticate(repo_id, revision, hub=hub)
+            authenticated = True
 
-        env = os.environ.get("ZEST_TPU_POD")
-        pod = env == "1" if env in ("0", "1") else device == "tpu"
-    fed = pods is not None and pods > 1 and pod_index is not None
-    pod_stats = fed_stats = None
-    if pod or fed:
-        pending = [
-            e for e in files
-            if e.is_xet and not _is_complete(snapshot_dir, e)
-        ]
-        if pending:
-            try:
-                with clock("cas_metadata"):
-                    bridge.authenticate(repo_id, revision, hub=hub)
-                    authenticated = True
-                    recs = [bridge.get_reconstruction(e.xet_hash)
-                            for e in pending]
-            except Exception as exc:  # noqa: BLE001 - round is an accelerator
-                log(f"distribution rounds unavailable ({exc}); "
-                    "continuing with the per-host waterfall",
-                    file=sys.stderr)
-                recs = None
-            # Cross-pod stage first (pods that are separate processes —
-            # DCN chunk RPC), so the in-pod collective spreads a warm
-            # cache. Either round failing degrades to the waterfall.
-            if recs and fed:
+    def file_work(entry) -> str:
+        dest = snapshot_dir / entry.path
+        if _is_complete(snapshot_dir, entry):
+            return "skipped"
+        if entry.is_xet:
+            ensure_auth()
+            _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
+                           entry, dest, log)
+        else:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            hub.download_regular_file(repo_id, revision, entry.path, dest)
+        clock.note_bytes("files", entry.size)
+        return "downloaded"
+
+    file_pipeline = _FilePipeline(
+        width, getattr(cfg, "pull_inflight_bytes", 2 << 30), clock,
+        file_work, term_executor=term_pool,
+        skip_check=lambda e: _is_complete(snapshot_dir, e))
+
+    try:
+        # Pod pre-pass (BASELINE config #3): one collective round fills the
+        # cache so the per-file loop below hits tier 1 for planned bytes.
+        # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
+        if pod is None:
+            import os
+
+            env = os.environ.get("ZEST_TPU_POD")
+            pod = env == "1" if env in ("0", "1") else device == "tpu"
+        fed = pods is not None and pods > 1 and pod_index is not None
+        pod_stats = fed_stats = None
+        if pod or fed:
+            pending = [
+                e for e in files
+                if e.is_xet and not _is_complete(snapshot_dir, e)
+            ]
+            if pending:
                 try:
-                    from zest_tpu.transfer.federated import federated_round
-
-                    fed_stats = federated_round(
-                        bridge, recs, pod_index, pods, pod_addrs or {},
-                        log=lambda m: log(m),
-                    )
-                except Exception as exc:  # noqa: BLE001
-                    log(f"federated round unavailable ({exc}); "
+                    with clock("cas_metadata"):
+                        bridge.authenticate(repo_id, revision, hub=hub)
+                        authenticated = True
+                        recs = [bridge.get_reconstruction(e.xet_hash)
+                                for e in pending]
+                except Exception as exc:  # noqa: BLE001 - round is an accelerator
+                    log(f"distribution rounds unavailable ({exc}); "
                         "continuing with the per-host waterfall",
                         file=sys.stderr)
-            if recs and pod:
-                try:
-                    pod_stats = _pod_stage(
-                        bridge, pending, recs, hub, repo_id, revision,
-                        files, snapshot_dir, log)
-                except Exception as exc:  # noqa: BLE001
-                    log(f"pod round unavailable ({exc}); "
-                        "continuing with the per-host waterfall",
-                        file=sys.stderr)
+                    recs = None
+                # Cross-pod stage first (pods that are separate processes —
+                # DCN chunk RPC), so the in-pod collective spreads a warm
+                # cache. Either round failing degrades to the waterfall.
+                if recs and fed:
+                    try:
+                        from zest_tpu.transfer.federated import federated_round
 
-    # Direct-to-HBM landing (SURVEY.md §7 hard part #2, the north star):
-    # land tensors straight from cached units BEFORE any file is written,
-    # so the landing path never reads a reassembled file. The HF-cache
-    # files are still written by the loop below — served from the
-    # now-warm cache, not refetched.
-    hbm_params = hbm_stats = None
-    mesh = None
-    if device == "tpu":
-        if cfg.mesh.mesh_axes:
-            from zest_tpu.parallel.mesh import mesh_from_config
+                        fed_stats = federated_round(
+                            bridge, recs, pod_index, pods, pod_addrs or {},
+                            log=lambda m: log(m),
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        log(f"federated round unavailable ({exc}); "
+                            "continuing with the per-host waterfall",
+                            file=sys.stderr)
+                if recs and pod:
+                    try:
+                        pod_stats = _pod_stage(
+                            bridge, pending, recs, hub, repo_id, revision,
+                            files, snapshot_dir, log)
+                    except Exception as exc:  # noqa: BLE001
+                        log(f"pod round unavailable ({exc}); "
+                            "continuing with the per-host waterfall",
+                            file=sys.stderr)
 
-            mesh = mesh_from_config(cfg.mesh)
-        hbm_params, hbm_stats = _try_direct_stage(
-            bridge, hub, repo_id, revision, files, snapshot_dir, mesh,
-            land_dtype, log, clock,
-        )
-        authenticated = authenticated or bridge.cas is not None
+        # Direct-to-HBM landing (SURVEY.md §7 hard part #2, the north star):
+        # land tensors straight from cached units BEFORE any file is written,
+        # so the landing path never reads a reassembled file. The HF-cache
+        # files are still written by the loop below — served from the
+        # now-warm cache, not refetched.
+        hbm_params = hbm_stats = None
+        mesh = None
+        time_to_hbm = None
+        if device == "tpu":
+            if cfg.mesh.mesh_axes:
+                from zest_tpu.parallel.mesh import mesh_from_config
 
-    downloaded = skipped = 0
-    with clock("files"):
+                mesh = mesh_from_config(cfg.mesh)
+            # Aux files (config/tokenizer/regular files) don't depend on the
+            # landing's warm fetch — submit them now so they ride the
+            # pipeline UNDER the landing's metadata + warm phase. The
+            # safetensors shards are submitted by the landing itself, each
+            # the moment its host tensors are decoded (write-behind, see
+            # _try_direct_stage), so file writes overlap decode + HBM commit
+            # without decoding any byte twice.
+            for entry in files:
+                if not entry.path.endswith(".safetensors"):
+                    file_pipeline.submit(entry)
+            hbm_params, hbm_stats = _try_direct_stage(
+                bridge, hub, repo_id, revision, files, snapshot_dir, mesh,
+                land_dtype, log, clock,
+                file_pipeline=file_pipeline, ensure_auth=ensure_auth,
+            )
+            authenticated = authenticated or bridge.cas is not None
+            if hbm_stats is not None:
+                time_to_hbm = time.monotonic() - t0
+                clock.note_bytes("hbm_commit", hbm_stats.get("bytes", 0))
+
+        # Tail pass: everything not already riding the pipeline (the whole
+        # repo, for a plain pull) — submit is path-deduped, then the join is
+        # the stage barrier. Workers time themselves under clock("files").
         for entry in files:
-            dest = snapshot_dir / entry.path
-            if _is_complete(snapshot_dir, entry):
-                skipped += 1
-                continue
-            if entry.is_xet:
-                if not authenticated:
-                    bridge.authenticate(repo_id, revision, hub=hub)
-                    authenticated = True
-                _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
-                               entry, dest, log)
-            else:
-                dest.parent.mkdir(parents=True, exist_ok=True)
-                hub.download_regular_file(repo_id, revision, entry.path,
-                                          dest)
-            downloaded += 1
+            file_pipeline.submit(entry)
+        clock.ensure("files")
+        downloaded, skipped = file_pipeline.join()
+    except BaseException:
+        # Any failure escaping this window (bad mesh config, Ctrl-C
+        # inside the pre-pass or landing) must not leak the pools or
+        # leave queued downloads running unsupervised.
+        file_pipeline.abort()
+        raise
 
     storage.write_ref(cfg, repo_id, revision, commit_sha)
 
@@ -211,8 +544,14 @@ def pull_model(
         "files_skipped": skipped,
         "elapsed_s": round(elapsed, 3),
         "stages": clock.summary(),
+        "stages_busy": clock.busy_summary(),
+        "stages_gbps": clock.gbps_summary(),
+        "files_pipeline": file_pipeline.summary(),
+        "files_hbm_span_s": round(clock.span("files", "hbm_commit"), 4),
         "fetch": bridge.stats.summary(),
     }
+    if time_to_hbm is not None:
+        stats["time_to_hbm_s"] = round(time_to_hbm, 3)
     if fed_stats is not None:
         stats["federated"] = fed_stats
     if pod_stats is not None:
@@ -236,10 +575,16 @@ def pull_model(
                     rules=shard_rules_for_snapshot(snapshot_dir),
                     dtype=land_dtype,
                 )
-            # The late stage must keep the decomposition invariant
-            # (sum(stages) <= elapsed_s): refresh BOTH.
+            # The late stage must keep every timing view coherent:
+            # refresh the stage summaries AND the wall clocks together.
+            clock.note_bytes("hbm_commit", hbm_stats.get("bytes", 0))
             stats["stages"] = clock.summary()
+            stats["stages_busy"] = clock.busy_summary()
+            stats["stages_gbps"] = clock.gbps_summary()
+            stats["files_hbm_span_s"] = round(
+                clock.span("files", "hbm_commit"), 4)
             stats["elapsed_s"] = round(time.monotonic() - t0, 3)
+            stats["time_to_hbm_s"] = stats["elapsed_s"]
         except Exception as exc:  # noqa: BLE001
             log(f"HBM staging failed ({exc}); files remain in "
                 f"{snapshot_dir}", file=sys.stderr)
@@ -253,13 +598,19 @@ def pull_model(
 def _try_direct_stage(
     bridge, hub, repo_id, revision, files, snapshot_dir, mesh, dtype, log,
     clock: StageClock | None = None,
+    file_pipeline: _FilePipeline | None = None,
+    ensure_auth=None,
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
     safetensors (no reconstruction to land from) or files already on
     disk (the resume case: reading local disk beats refetching) — or on
     any failure, in which case the disk fallback runs after the file
-    loop."""
+    loop. With a ``file_pipeline``, each shard's HF-cache file write is
+    submitted the moment its host tensors are decoded (write-behind
+    from the landing's own buffers — no second decode), so file writes
+    run concurrently with the decode + HBM commit of the same (and
+    later) shards — the pull's tentpole overlap."""
     st = [e for e in files if e.path.endswith(".safetensors")]
     if not st or not all(e.is_xet for e in st):
         return None, None
@@ -273,7 +624,9 @@ def _try_direct_stage(
         from zest_tpu.transfer.pod import fetch_file_header
 
         with clock("cas_metadata"):
-            if bridge.cas is None:
+            if ensure_auth is not None:
+                ensure_auth()
+            elif bridge.cas is None:
                 bridge.authenticate(repo_id, revision, hub=hub)
             recs_with_headers = []
             for e in st:
@@ -301,6 +654,30 @@ def _try_direct_stage(
         # pipelined per shard: shard 0's fetch is the visible "fetch"
         # stage, every later shard's network time hides under the
         # previous shard's decode+commit inside "hbm_commit".
+        on_host_ready = None
+        if file_pipeline is not None:
+            # Write-behind: the moment shard i's host tensors are
+            # decoded, hand them to the file pipeline — the HF-cache
+            # file is assembled from the decoded bytes (no second
+            # decode) while the same shard's commit and the next
+            # shard's decode proceed. submit_prepared blocks on the
+            # byte budget, backpressuring the decode-ahead.
+            def on_host_ready(i, host, _st=st, _rwh=recs_with_headers):
+                rec, header = _rwh[i]
+                entry = _st[i]
+
+                def write(entry, _rec=rec, _h=header, _host=host):
+                    dest = snapshot_dir / entry.path
+                    if _is_complete(snapshot_dir, entry):
+                        return "skipped"
+                    if _write_file_from_tensors(bridge, _rec, _h, _host,
+                                                dest):
+                        clock.note_bytes("files", entry.size)
+                        return "downloaded"
+                    return None  # decline → worker runs the waterfall
+
+                file_pipeline.submit_prepared(entry, write)
+
         pipeline = _PipelinedWarm(bridge, [r for r, _h in recs_with_headers],
                                   evidence_recs=evidence_recs)
         with clock("fetch"):
@@ -312,6 +689,7 @@ def _try_direct_stage(
                                      snapshot_dir),
                 dtype=dtype,
                 prefetch_next=pipeline.ensure,
+                on_host_ready=on_host_ready,
             )
         warm = pipeline.summary()
         if warm["failed"] or warm.get("prefetch_errors"):
@@ -559,6 +937,75 @@ def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
     return shard_rules_for_model_type((cfg_json or {}).get("model_type"))
 
 
+def _write_file_from_tensors(bridge, rec, header, host, dest: Path) -> bool:
+    """Write-behind fast lane: assemble a safetensors file from the
+    landing's already-decoded host tensors — zero re-decode of the data
+    section (the ``files`` stage used to decode every byte a second
+    time, right after ``hbm_commit`` decoded it the first).
+
+    Byte-exactness is guaranteed by construction, and only attempted
+    when provable: the tensors' file ranges must tile the data section
+    exactly (no gaps, no overlap — true for every writer we know of,
+    but a file with padding would assemble wrong, so it falls back).
+    The header prefix ([0, data_start)) is decoded from the cache (the
+    warm fetch has those terms). Returns False to decline — the caller
+    then runs the normal cache-decode/waterfall path."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from zest_tpu.models.direct import CachedFileReader
+
+    data_start = header.data_start
+    size = rec.total_bytes
+    spans = sorted(
+        (info.file_range(data_start) + (name,)
+         for name, info in header.tensors.items()),
+        key=lambda s: s[0],
+    )
+    pos = data_start
+    for lo, hi, name in spans:
+        if lo != pos or name not in host:
+            return False
+        pos = hi
+    if pos != size:
+        return False
+
+    reader = CachedFileReader(bridge.cache, rec, bridge=bridge, workers=1)
+    head = reader.read(0, data_start) if data_start else b""
+
+    def write_all(fd: int, buf) -> None:
+        # os.write may be short (Linux caps one write(2) at ~2 GiB) —
+        # a >2 GiB tensor written unchecked would silently truncate and
+        # then be COMMITTED by the atomic rename below.
+        view = memoryview(buf).cast("B")
+        while view.nbytes:
+            view = view[os.write(fd, view):]
+
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dest.parent, prefix=f".tmp-{dest.name}.")
+    try:
+        write_all(fd, head)
+        for _lo, _hi, name in spans:
+            arr = np.ascontiguousarray(host[name])
+            write_all(fd, arr.reshape(-1).view(np.uint8))
+    except BaseException:
+        os.close(fd)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.close(fd)
+    os.replace(tmp, dest)
+    # Same per-source accounting as the cache-decode lane: the bytes
+    # were served from cached units (decoded once, by the landing).
+    for term in rec.terms:
+        bridge.stats.record("cache", term.unpacked_length)
+    return True
+
+
 def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
     """Decode cached units straight into the destination file (mmap +
     in-place chunk decode, no per-term refetch loop, no join) — the fast
@@ -574,7 +1021,13 @@ def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
     from zest_tpu.models.direct import CachedFileReader, DirectLandingError
 
     rec = bridge.get_reconstruction(xet_hash)
-    reader = CachedFileReader(bridge.cache, rec)  # cache-only: no bridge
+    # cache-only (no bridge), and SERIAL term decode (workers=1): the
+    # decode lands in an mmap view, and a worker exception's traceback
+    # cycle can pin a view export past gc's reach — mm.close() would
+    # then raise BufferError on a healthy fallback path. Concurrency
+    # for the files stage comes from the file-level pipeline instead;
+    # the parallel term decode serves the np-buffer landing path.
+    reader = CachedFileReader(bridge.cache, rec, workers=1)
     size = reader.size
     dest.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dest.parent, prefix=f".tmp-{dest.name}.")
