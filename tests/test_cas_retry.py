@@ -1,0 +1,206 @@
+"""CasClient resilience: idempotent retries with backoff, mid-stream
+resume via adjusted Range headers, xet-token refresh on 401/403, and
+deadline-capped retry budgets."""
+
+import pytest
+import requests
+
+from zest_tpu.cas.client import CasClient, CasError
+from zest_tpu.resilience import Deadline, DeadlineExceeded
+
+
+class FakeResp:
+    def __init__(self, status, body=b"", doc=None, die_after=None):
+        self.status_code = status
+        self._body = body
+        self._doc = doc
+        self._die_after = die_after  # bytes to yield before "reset"
+        self.closed = False
+
+    def json(self):
+        return self._doc
+
+    def iter_content(self, chunk_size):
+        body = self._body
+        sent = 0
+        for i in range(0, len(body), chunk_size):
+            piece = body[i : i + chunk_size]
+            if self._die_after is not None \
+                    and sent + len(piece) > self._die_after:
+                keep = self._die_after - sent
+                if keep > 0:
+                    yield piece[:keep]
+                raise requests.exceptions.ChunkedEncodingError(
+                    "connection reset mid-body")
+            sent += len(piece)
+            yield piece
+
+    def close(self):
+        self.closed = True
+
+
+class FakeSession:
+    """Pops one scripted outcome per GET; records (url, headers)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def get(self, url, headers=None, timeout=None, stream=False):
+        self.calls.append((url, dict(headers or {})))
+        step = self.script.pop(0)
+        if isinstance(step, BaseException):
+            raise step
+        return step
+
+
+def _client(script, **kw):
+    kw.setdefault("backoff_base_s", 0.001)
+    session = FakeSession(script)
+    return CasClient("http://cas.test", "tok0", session=session, **kw), \
+        session
+
+
+BODY = bytes(range(256)) * 64  # 16 KiB
+
+
+class TestFetchRetries:
+    def test_5xx_then_success(self):
+        events = []
+        client, session = _client(
+            [FakeResp(503), FakeResp(200, BODY)], on_event=events.append)
+        assert client.fetch_xorb_from_url("http://cdn.test/x") == BODY
+        assert events == ["cdn_retries"]
+
+    def test_connection_error_then_success(self):
+        client, _ = _client([
+            requests.exceptions.ConnectionError("reset"),
+            FakeResp(200, BODY),
+        ])
+        assert client.fetch_xorb_from_url("http://cdn.test/x") == BODY
+
+    def test_retries_exhausted_raises(self):
+        client, session = _client([FakeResp(503)] * 3, retries=2)
+        with pytest.raises(CasError, match="after 3 attempts"):
+            client.fetch_xorb_from_url("http://cdn.test/x")
+        assert len(session.calls) == 3
+
+    def test_non_retryable_status_fails_fast(self):
+        client, session = _client([FakeResp(418)])
+        with pytest.raises(CasError, match="418"):
+            client.fetch_xorb_from_url("http://cdn.test/x")
+        assert len(session.calls) == 1
+
+    def test_mid_stream_reset_resumes_from_offset(self):
+        """A reset after N bytes re-requests bytes N.. — the consumer
+        sees one seamless, byte-exact stream."""
+        cut = 5000
+        client, session = _client([
+            FakeResp(206, BODY[:8192], die_after=cut),
+            FakeResp(206, BODY[cut:]),
+        ])
+        got = client.fetch_xorb_from_url("http://cdn.test/x",
+                                         byte_range=(0, len(BODY)))
+        assert got == BODY
+        assert session.calls[0][1]["Range"] == f"bytes=0-{len(BODY) - 1}"
+        assert session.calls[1][1]["Range"] == f"bytes={cut}-{len(BODY) - 1}"
+
+    def test_unranged_fetch_resumes_with_range_header(self):
+        cut = 1024
+        client, session = _client([
+            FakeResp(200, BODY, die_after=cut),
+            FakeResp(206, BODY[cut:]),
+        ])
+        assert client.fetch_xorb_from_url("http://cdn.test/x") == BODY
+        assert "Range" not in session.calls[0][1]
+        assert session.calls[1][1]["Range"] == f"bytes={cut}-"
+
+    def test_resume_when_origin_ignores_range(self):
+        """Second attempt answers 200-whole-body despite the resume
+        Range; the client must trim the already-delivered prefix."""
+        cut = 3000
+        client, _ = _client([
+            FakeResp(200, BODY, die_after=cut),
+            FakeResp(200, BODY),
+        ])
+        assert client.fetch_xorb_from_url("http://cdn.test/x") == BODY
+
+
+class TestTokenRefresh:
+    def test_401_refreshes_once_and_retries(self):
+        events = []
+        client, session = _client(
+            [FakeResp(401), FakeResp(200, BODY)],
+            token_refresher=lambda: ("http://cas.test", "tok1"),
+            on_event=events.append,
+        )
+        assert client.fetch_xorb_from_url("http://cas.test/v1/x") == BODY
+        assert session.calls[0][1]["Authorization"] == "Bearer tok0"
+        assert session.calls[1][1]["Authorization"] == "Bearer tok1"
+        assert events == ["token_refreshes"]
+
+    def test_second_401_is_fatal(self):
+        client, _ = _client(
+            [FakeResp(401), FakeResp(401)],
+            token_refresher=lambda: ("http://cas.test", "tok1"),
+        )
+        with pytest.raises(CasError, match="401"):
+            client.fetch_xorb_from_url("http://cas.test/v1/x")
+
+    def test_403_without_refresher_is_fatal(self):
+        client, session = _client([FakeResp(403)])
+        with pytest.raises(CasError, match="403"):
+            client.fetch_xorb_from_url("http://cas.test/v1/x")
+        assert len(session.calls) == 1
+
+    def test_presigned_url_403_not_refreshed(self):
+        """Off-origin (presigned) URLs don't carry our bearer token, so
+        a 403 there is not a token problem — fail, don't refresh."""
+        called = []
+        client, _ = _client(
+            [FakeResp(403)],
+            token_refresher=lambda: called.append(1) or ("", "t"),
+        )
+        with pytest.raises(CasError, match="403"):
+            client.fetch_xorb_from_url("http://cdn.elsewhere/x")
+        assert not called
+
+    def test_reconstruction_retries_and_refreshes(self):
+        doc = {"terms": [], "fetch_info": {}}
+        client, session = _client(
+            [FakeResp(503), FakeResp(401), FakeResp(200, doc=doc)],
+            token_refresher=lambda: ("http://cas.test", "tok1"),
+        )
+        rec = client.get_reconstruction("ab" * 32)
+        assert rec.terms == []
+        assert session.calls[-1][1]["Authorization"] == "Bearer tok1"
+
+    def test_reconstruction_404_fails_fast(self):
+        client, session = _client([FakeResp(404)])
+        with pytest.raises(CasError, match="no reconstruction"):
+            client.get_reconstruction("ab" * 32)
+        assert len(session.calls) == 1
+
+
+class TestDeadline:
+    def test_expired_deadline_stops_retrying(self):
+        client, session = _client([FakeResp(503)] * 10, retries=9,
+                                  deadline=Deadline(0.05))
+        with pytest.raises((DeadlineExceeded, CasError)):
+            client.fetch_xorb_from_url("http://cdn.test/x")
+        assert len(session.calls) < 10
+
+    def test_deadline_caps_request_timeout(self):
+        captured = {}
+
+        class TimeoutSession(FakeSession):
+            def get(self, url, headers=None, timeout=None, stream=False):
+                captured["timeout"] = timeout
+                return super().get(url, headers=headers, timeout=timeout,
+                                   stream=stream)
+
+        session = TimeoutSession([FakeResp(200, BODY)])
+        client = CasClient("http://cas.test", session=session,
+                           deadline=Deadline(5.0))
+        client.fetch_xorb_from_url("http://cdn.test/x")
+        assert captured["timeout"] <= 5.0

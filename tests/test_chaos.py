@@ -1,0 +1,221 @@
+"""The chaos matrix: deterministic fault injection against full pulls.
+
+Acceptance for the resilience layer (ISSUE 2): under injected peer
+timeouts, corrupt chunks, CDN 503s, connection resets, and a slow peer,
+``pull_model`` must complete with bytes identical to the fault-free
+path, wall time bounded by the configured deadline (no legacy 60 s
+single-peer stall), and a peer that serves corrupt chunks must be
+quarantined after K strikes while its traffic shifts to healthy tiers.
+
+Every scenario pins the injection seed (``SEED``), so the firing
+sequence of each fault is reproducible run-to-run — a chaos failure
+replays exactly.
+"""
+
+import hashlib
+import time
+
+import pytest
+
+from zest_tpu import faults
+from zest_tpu.config import Config
+from zest_tpu.transfer.pull import pull_model
+from zest_tpu.transfer.server import BtServer
+from zest_tpu.transfer.swarm import SwarmDownloader
+
+from fixtures import FixtureHub, FixtureRepo
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1337
+
+# Deterministic, NON-periodic payload (a repeating pattern would dedup
+# into one xorb and starve the matrix of requests to inject into).
+_RNG_BYTES = b"".join(
+    hashlib.blake2b(i.to_bytes(4, "little"), digest_size=64).digest()
+    for i in range(16384)
+)  # 1 MiB -> ~8 distinct chunks -> ~8 xorbs at chunks_per_xorb=1
+FILES = {
+    "config.json": b'{"model_type": "chaos"}',
+    "model.safetensors": _RNG_BYTES,
+    "tokenizer.json": b'{"tok": 1}' * 40,
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    # One chunk per xorb: the ~600 KB model splits into 5 xorbs, so a
+    # pull makes enough peer/CDN requests to accumulate K strikes and
+    # to give the pinned fault sequences trials to fire on.
+    repo = FixtureRepo("acme/chaos-model", FILES, chunks_per_xorb=1)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def _pinned_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _cfg(hub, root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+@pytest.fixture(scope="module")
+def seeder(hub, tmp_path_factory):
+    """A warm host serving its cache over the BT wire."""
+    cfg = _cfg(hub, tmp_path_factory.mktemp("seeder"), listen_port=0)
+    pull_model(cfg, "acme/chaos-model", no_p2p=True)
+    server = BtServer(cfg)
+    port = server.start()
+    yield port
+    server.shutdown()
+
+
+def _pull_with_peer(cfg, seeder_port):
+    swarm = SwarmDownloader(cfg)
+    swarm.add_direct_peer("127.0.0.1", seeder_port)
+    try:
+        result = pull_model(cfg, "acme/chaos-model", swarm=swarm,
+                            log=lambda *a, **k: None)
+    finally:
+        swarm.close()
+    return result
+
+
+def _assert_bytes_identical(result):
+    for name, data in FILES.items():
+        assert (result.snapshot_dir / name).read_bytes() == data, \
+            f"{name} differs from the fault-free bytes"
+
+
+def test_peer_timeouts_bounded_quarantined_healed(hub, seeder, tmp_path):
+    """Every connect to the (only) peer times out: the pull must fall
+    to CDN without stalling, and the dead peer must be quarantined so
+    later xorbs stop paying for it at all."""
+    faults.install(f"peer_timeout:1.0@127.0.0.1:{seeder}", seed=SEED)
+    t0 = time.monotonic()
+    result = _pull_with_peer(_cfg(hub, tmp_path), seeder)
+    elapsed = time.monotonic() - t0
+
+    _assert_bytes_identical(result)
+    swarm_stats = result.stats["swarm"]
+    assert result.stats["fetch"]["bytes"]["cdn"] > 0
+    assert result.stats["fetch"]["bytes"]["peer"] == 0
+    assert swarm_stats["peer_failures"] > 0
+    # K strikes (default 3) quarantine the dead peer; the repo has more
+    # xorbs than that, so attempts stop short of one-per-xorb.
+    assert swarm_stats["peers_quarantined"] >= 1
+    assert swarm_stats["health"]["quarantined_now"] >= 1
+    # Injected timeouts fail instantly; the bound proves no tier ever
+    # waited out a legacy 5 s connect / 60 s IO timeout per xorb.
+    assert elapsed < 30.0
+
+
+def test_corrupt_peer_attributed_quarantined_healed(hub, seeder, tmp_path):
+    """The seeder answers every chunk request with a flipped byte: the
+    bridge must attribute the corruption to that peer (strikes →
+    quarantine), refetch from CDN, and still produce exact bytes —
+    including healing any poisoned cache entry."""
+    faults.install(f"chunk_corrupt:1.0@127.0.0.1:{seeder}", seed=SEED)
+    result = _pull_with_peer(_cfg(hub, tmp_path), seeder)
+
+    _assert_bytes_identical(result)
+    swarm_stats = result.stats["swarm"]
+    res = result.stats["fetch"]["resilience"]
+    assert swarm_stats["corrupt_from_peer"] >= 1, "corruption unattributed"
+    assert res["corrupt_from_peer"] >= 1
+    # Traffic shifted to the healthy tier (CDN) after quarantine.
+    assert swarm_stats["peers_quarantined"] >= 1
+    assert result.stats["fetch"]["bytes"]["cdn"] > 0
+
+
+def _serial_cfg(hub, root, **kw):
+    """Single-threaded pull: the fault trial sequence maps to requests
+    deterministically, so the pinned seed replays exactly."""
+    return _cfg(hub, root, pull_pipeline_width=1,
+                max_concurrent_downloads=1, decode_workers=1, **kw)
+
+
+def test_cdn_503s_retried(hub, tmp_path):
+    faults.install("cdn_503:0.4", seed=SEED)
+    result = pull_model(_serial_cfg(hub, tmp_path), "acme/chaos-model",
+                        no_p2p=True, log=lambda *a, **k: None)
+    _assert_bytes_identical(result)
+    assert result.stats["fetch"]["resilience"]["cdn_retries"] >= 1
+
+
+def test_cdn_connection_resets_retried(hub, tmp_path):
+    faults.install("cdn_reset:0.4", seed=SEED)
+    result = pull_model(_serial_cfg(hub, tmp_path), "acme/chaos-model",
+                        no_p2p=True, log=lambda *a, **k: None)
+    _assert_bytes_identical(result)
+    assert result.stats["fetch"]["resilience"]["cdn_retries"] >= 1
+
+
+def test_slow_peer_hedged_under_deadline(hub, seeder, tmp_path):
+    """The peer serves correct bytes but sleeps 4 s per request; with an
+    8 s pull deadline the bridge must hedge to CDN instead of waiting —
+    the wall time stays inside the deadline, nowhere near the legacy
+    60 s per-xorb stall."""
+    faults.install(f"peer_slow:1.0@4.0@127.0.0.1:{seeder}", seed=SEED)
+    deadline_s = 8.0
+    cfg = _cfg(hub, tmp_path, pull_deadline_s=deadline_s)
+    t0 = time.monotonic()
+    result = _pull_with_peer(cfg, seeder)
+    elapsed = time.monotonic() - t0
+
+    _assert_bytes_identical(result)
+    res = result.stats["fetch"]["resilience"]
+    assert res["hedges"] >= 1, "deadline at risk but no hedge fired"
+    assert res["hedges_won"] >= 1, "CDN racer never beat the slow peer"
+    assert elapsed < deadline_s + 2.0, (
+        f"pull took {elapsed:.1f}s against a {deadline_s}s deadline"
+    )
+    assert result.stats["deadline"]["budget_s"] == deadline_s
+
+
+def test_full_matrix_combined(hub, seeder, tmp_path, monkeypatch):
+    """Everything at once — flaky connects, corrupt chunks, CDN
+    hiccups, a sluggish peer — under a deadline. The pull still lands
+    exact bytes inside the budget."""
+    import zest_tpu.cas.client as cas_client
+
+    # Generous retry budget: overlapping fault streams can stack more
+    # consecutive CDN failures onto one request than the default 3.
+    monkeypatch.setattr(cas_client, "DEFAULT_RETRIES", 8)
+    faults.install(
+        f"peer_timeout:0.3@127.0.0.1:{seeder},"
+        f"chunk_corrupt:0.3@127.0.0.1:{seeder},"
+        f"peer_slow:0.3@1.0@127.0.0.1:{seeder},"
+        "cdn_503:0.1,cdn_reset:0.1",
+        seed=SEED,
+    )
+    deadline_s = 25.0
+    cfg = _cfg(hub, tmp_path, pull_deadline_s=deadline_s)
+    t0 = time.monotonic()
+    result = _pull_with_peer(cfg, seeder)
+    elapsed = time.monotonic() - t0
+
+    _assert_bytes_identical(result)
+    assert elapsed < deadline_s + 2.0
+    fired = faults.counters()
+    assert fired, "matrix ran but nothing injected"
+
+
+def test_faultfree_pull_records_zero_resilience_events(hub, seeder,
+                                                      tmp_path):
+    """Control arm: with injection disabled the resilience layer is
+    silent — no retries, no hedges, no strikes — and the peer tier
+    serves the bytes as before."""
+    result = _pull_with_peer(_cfg(hub, tmp_path), seeder)
+    _assert_bytes_identical(result)
+    res = result.stats["fetch"]["resilience"]
+    assert res == {k: 0 for k in res}
+    swarm_stats = result.stats["swarm"]
+    assert swarm_stats["peers_quarantined"] == 0
+    assert swarm_stats["corrupt_from_peer"] == 0
+    assert result.stats["fetch"]["bytes"]["peer"] > 0

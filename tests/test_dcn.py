@@ -268,6 +268,69 @@ def test_pool_reuses_channels(served_cache):
     assert server.stats.connections == 2
 
 
+def test_pool_request_many_retries_idle_closed_channel(served_cache):
+    """A pooled channel the server idle-closed looks live until the
+    first request fails; DcnPool.request_many must reconnect and retry
+    the window once, transparently — the caller never sees the corpse."""
+    _cfg, _server, port, _builder, chunks, xh_hex = served_cache
+    pool = dcn.DcnPool(timeout=5.0)
+    wants = [(hashing.hex_to_hash(xh_hex), 0, len(chunks))]
+    try:
+        stale = pool.channel("127.0.0.1", port)
+        calls = []
+
+        def dies_once(w):
+            calls.append(w)
+            raise ConnectionError("server idle-closed this channel")
+
+        stale.request_many = dies_once  # instance shadow: fails once
+        replies = pool.request_many("127.0.0.1", port, wants)
+        assert calls, "stale channel was never tried"
+        assert isinstance(replies[0], dcn.DcnResponse)
+        assert XorbReader(replies[0].data).extract_chunk(0) == chunks[0]
+        fresh = pool.channel("127.0.0.1", port)
+        assert fresh is not stale, "dead channel must have been replaced"
+    finally:
+        pool.close()
+
+
+def test_pool_request_many_recovers_injected_dcn_reset(served_cache):
+    """The chaos hook end-to-end: an injected dcn_reset kills the pooled
+    channel mid-send; the pool's reconnect-retry absorbs it."""
+    from zest_tpu import faults
+
+    def fires(seed, trial):
+        inj = faults.FaultInjector(faults.parse_spec("dcn_reset:0.5"), seed)
+        return inj._fires("dcn_reset", trial, 0.5)
+
+    # A seed whose pattern opens fire-then-clear: the pooled channel's
+    # send dies, the retried fresh channel's send survives.
+    seed = next(s for s in range(200) if fires(s, 0) and not fires(s, 1))
+    _cfg, _server, port, _builder, chunks, xh_hex = served_cache
+    pool = dcn.DcnPool(timeout=5.0)
+    faults.install("dcn_reset:0.5", seed=seed)
+    try:
+        stale = pool.channel("127.0.0.1", port)
+        replies = pool.request_many(
+            "127.0.0.1", port, [(hashing.hex_to_hash(xh_hex), 0, 1)])
+        assert isinstance(replies[0], dcn.DcnResponse)
+        assert stale.dead, "injected reset never hit the pooled channel"
+    finally:
+        faults.reset()
+        pool.close()
+
+
+def test_pool_request_many_fresh_failure_propagates(tmp_path):
+    """A fresh connection failing is a real peer problem — no silent
+    retry loop against a dead host."""
+    pool = dcn.DcnPool(timeout=0.5)
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            pool.request_many("127.0.0.1", 1, [(b"h" * 32, 0, 1)])
+    finally:
+        pool.close()
+
+
 # ── Federated round, single process (ownership + fallback paths) ──
 
 

@@ -1,0 +1,129 @@
+"""The fault-injection registry itself: spec grammar, seeded
+determinism, scoping, and the disabled fast path."""
+
+import pytest
+
+from zest_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _pattern(inj, name, n=64):
+    return [bool(inj.roll(name)) for _ in range(n)]
+
+
+class TestSpecGrammar:
+    def test_parse_basic(self):
+        specs = faults.parse_spec("peer_timeout:0.1,cdn_503:0.25")
+        assert specs["peer_timeout"].prob == 0.1
+        assert specs["cdn_503"].prob == 0.25
+
+    def test_parse_args(self):
+        specs = faults.parse_spec("peer_slow:1.0@2.5@127.0.0.1:7001")
+        spec = specs["peer_slow"]
+        assert spec.float_arg(1.0) == 2.5
+        assert spec.scope() == "127.0.0.1:7001"
+
+    def test_scope_only_arg(self):
+        spec = faults.parse_spec("chunk_corrupt:1.0@10.0.0.2:6881")[
+            "chunk_corrupt"]
+        assert spec.scope() == "10.0.0.2:6881"
+        assert spec.float_arg(3.0) == 3.0  # no numeric arg -> default
+
+    def test_malformed_specs_fail_loud(self):
+        for bad in ("peer_timeout", "x:notanumber", "x:1.5", ":0.1"):
+            with pytest.raises(faults.FaultSpecError):
+                faults.parse_spec(bad)
+
+    def test_empty_clauses_ignored(self):
+        assert faults.parse_spec(" , ,cdn_503:1.0,").keys() == {"cdn_503"}
+
+
+class TestDeterminism:
+    def test_same_seed_same_pattern(self):
+        a = faults.FaultInjector(faults.parse_spec("f:0.3"), seed=7)
+        b = faults.FaultInjector(faults.parse_spec("f:0.3"), seed=7)
+        assert _pattern(a, "f") == _pattern(b, "f")
+
+    def test_different_seed_different_pattern(self):
+        a = faults.FaultInjector(faults.parse_spec("f:0.5"), seed=1)
+        b = faults.FaultInjector(faults.parse_spec("f:0.5"), seed=2)
+        assert _pattern(a, "f", 128) != _pattern(b, "f", 128)
+
+    def test_faults_draw_independent_trials(self):
+        """Two faults never perturb each other's sequence: interleaving
+        draws of g between draws of f leaves f's pattern unchanged."""
+        spec = "f:0.4,g:0.4"
+        a = faults.FaultInjector(faults.parse_spec(spec), seed=3)
+        solo = _pattern(a, "f")
+        b = faults.FaultInjector(faults.parse_spec(spec), seed=3)
+        mixed = []
+        for _ in range(64):
+            b.roll("g")
+            mixed.append(bool(b.roll("f")))
+        assert mixed == solo
+
+    def test_prob_extremes(self):
+        inj = faults.FaultInjector(
+            faults.parse_spec("always:1.0,never:0.0"), seed=0)
+        assert all(_pattern(inj, "always"))
+        assert not any(_pattern(inj, "never"))
+        assert inj.counters() == {"always": 64}
+
+
+class TestScoping:
+    def test_scoped_fault_only_fires_on_matching_key(self):
+        inj = faults.FaultInjector(
+            faults.parse_spec("f:1.0@10.0.0.2:6881"), seed=0)
+        assert inj.roll("f", key="10.0.0.2:6881") is not None
+        assert inj.roll("f", key="10.0.0.3:6881") is None
+        assert inj.roll("f") is None  # site passes no key -> no fire
+
+    def test_non_matching_key_consumes_no_trial(self):
+        inj = faults.FaultInjector(faults.parse_spec("f:1.0@peerA"), seed=0)
+        for _ in range(10):
+            inj.roll("f", key="peerB")
+        assert inj._trials.get("f", 0) == 0
+
+
+class TestModuleSwitchboard:
+    def test_disabled_by_default(self):
+        assert faults.fire("anything") is None
+        assert faults.counters() == {}
+
+    def test_env_configuration(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_SPEC, "f:1.0")
+        monkeypatch.setenv(faults.ENV_SEED, "9")
+        faults.reset()
+        assert faults.fire("f") is not None
+        assert faults.active().seed == 9
+
+    def test_install_and_reset(self):
+        faults.install("f:1.0", seed=1)
+        assert faults.fire("f") is not None
+        faults.install(None)
+        assert faults.fire("f") is None
+
+    def test_sleep_if_returns_slept_seconds(self):
+        faults.install("slow:1.0@0.01", seed=0)
+        assert faults.sleep_if("slow") == pytest.approx(0.01)
+        faults.install(None)
+        assert faults.sleep_if("slow") == 0.0
+
+
+class TestCorrupt:
+    def test_deterministic_single_byte_flip(self):
+        data = bytes(range(256))
+        bad = faults.corrupt(data)
+        assert bad != data and len(bad) == len(data)
+        assert faults.corrupt(data) == bad
+        diff = [i for i in range(256) if bad[i] != data[i]]
+        assert diff == [128]
+
+    def test_empty_payload_passthrough(self):
+        assert faults.corrupt(b"") == b""

@@ -72,3 +72,41 @@ def test_remove_and_reconnect(pool):
     pool.remove("a", 6881)
     assert a.closed and len(pool) == 0
     assert _get(pool, "a") is not a  # fresh connection after removal
+
+
+def _lease(pool, host):
+    return pool.lease(host, 6881, b"i" * 20, b"p" * 20)
+
+
+def test_lease_reports_reuse(pool):
+    a, reused = _lease(pool, "a")
+    assert not reused  # fresh connect
+    a2, reused2 = _lease(pool, "a")
+    assert a2 is a and reused2  # pooled
+
+
+def test_eviction_race_closes_leased_but_unlocked_peer(pool):
+    """The race _evict_one_locked concedes: a thread that leased a peer
+    but hasn't taken its stream lock yet can lose the connection to an
+    eviction. The contract is (1) the evicted socket is observably
+    closed — the victim's request fails rather than hanging — and
+    (2) the lease carried reused=True, which is exactly the signal the
+    swarm uses to retry once on a fresh connection instead of failing
+    the pull (pinned end-to-end by
+    test_swarm_health.test_stale_pooled_socket_gets_one_reconnect_retry).
+    """
+    a = _get(pool, "a")
+    _get(pool, "b")
+    leased, reused = _lease(pool, "a")  # victim thread's lease...
+    assert leased is a and reused
+    # ...then, before the victim locks, a third connect evicts at cap.
+    # The lease touched `a`, so LRU order protects it — hold b's lock to
+    # force the eviction onto `a` (the leased-but-unlocked peer).
+    b2, _ = _lease(pool, "b")
+    with b2.lock:
+        _get(pool, "c")
+    assert leased.closed, "evicted peer must be closed, not leaked"
+    # The victim's request on the closed peer now fails fast; the swarm
+    # turns (reused=True, IO error) into exactly one reconnect retry.
+    fresh, fresh_reused = _lease(pool, "a")
+    assert fresh is not leased and not fresh_reused

@@ -1,0 +1,328 @@
+"""Peer health scoring, quarantine, candidate ordering, negative
+discovery TTL, and the stale-pooled-socket retry.
+
+These pin the resilience semantics the chaos matrix exercises
+end-to-end: strikes accumulate across connect failures / IO errors /
+corruption attributions, K strikes quarantine with a decaying re-admit,
+candidates order by observed latency, and one dead DHT round can't
+blank discovery for a full TTL.
+"""
+
+import threading
+import time
+
+import pytest
+
+import zest_tpu.transfer.swarm as swarm_mod
+from zest_tpu.config import Config
+from zest_tpu.p2p.health import HealthRegistry
+from zest_tpu.transfer.swarm import SwarmDownloader
+
+
+# ── HealthRegistry unit behavior (fake clock) ──
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+@pytest.fixture
+def reg(clock):
+    return HealthRegistry(strikes_to_quarantine=3, quarantine_base_s=10.0,
+                          time_fn=clock)
+
+
+A, B, C = ("a", 1), ("b", 2), ("c", 3)
+
+
+def test_strikes_trip_quarantine(reg):
+    assert not reg.record_failure(A)
+    assert not reg.record_failure(A)
+    assert reg.record_failure(A)  # third strike trips the breaker
+    assert reg.is_quarantined(A)
+    assert reg.summary()["quarantine_events"] == 1
+
+
+def test_success_resets_strikes(reg):
+    reg.record_failure(A)
+    reg.record_failure(A)
+    reg.record_success(A, rtt_s=0.05)
+    assert not reg.record_failure(A)  # back to strike 1 of 3
+    assert not reg.is_quarantined(A)
+
+
+def test_readmit_on_probation_with_doubled_window(reg, clock):
+    for _ in range(3):
+        reg.record_failure(A)
+    assert reg.is_quarantined(A)
+    clock.t += 10.1  # base window expires
+    assert not reg.is_quarantined(A)
+    # Probation: ONE more strike re-quarantines, window doubled.
+    assert reg.record_failure(A)
+    assert reg.is_quarantined(A)
+    clock.t += 10.1
+    assert reg.is_quarantined(A), "second window must be longer than base"
+    clock.t += 10.0
+    assert not reg.is_quarantined(A)
+
+
+def test_partition_orders_by_latency_and_drops_quarantined(reg):
+    reg.record_success(A, rtt_s=0.5)    # known slow
+    reg.record_success(B, rtt_s=0.01)   # known fast
+    for _ in range(3):
+        reg.record_failure(C)           # quarantined
+    healthy, shunned = reg.partition([A, B, C])
+    assert healthy == [B, A]
+    assert shunned == [C]
+    # Unknown peers slot between known-fast and known-slow.
+    D = ("d", 4)
+    healthy, _ = reg.partition([A, B, D])
+    assert healthy == [B, D, A]
+
+
+def test_partition_is_stable_for_unknowns(reg):
+    healthy, _ = reg.partition([A, B, C])
+    assert healthy == [A, B, C]  # caller priority preserved on ties
+
+
+def test_adaptive_timeouts_track_ewma(reg):
+    assert reg.connect_timeout(A) == 3.0      # tight default, no history
+    assert reg.io_timeout(A) == 20.0
+    reg.record_success(A, rtt_s=0.02, connect_s=0.01)
+    assert reg.connect_timeout(A) == 0.75     # 4x ewma clamped to floor
+    assert reg.io_timeout(A) == 2.0           # 8x ewma clamped to floor
+    reg.record_success(B, rtt_s=30.0, connect_s=30.0)
+    assert reg.connect_timeout(B) == 5.0      # never past legacy ceiling
+    assert reg.io_timeout(B) == 60.0
+
+
+# ── Swarm-level behavior with scripted peers ──
+
+
+class FakePeer:
+    def __init__(self, behavior):
+        self.behavior = behavior
+        self.lock = threading.Lock()
+        self.closed = False
+        self.io_timeouts = []
+
+    def request_chunk(self, chunk_hash, start, end, io_timeout=None):
+        self.io_timeouts.append(io_timeout)
+        return self.behavior(chunk_hash, start, end)
+
+    def close(self):
+        self.closed = True
+
+
+class ScriptedPool:
+    """lease() serves a pre-pooled peer once (reused=True), then pops
+    scripted connect outcomes (a FakePeer, or an exception to raise)."""
+
+    def __init__(self):
+        self.pooled: dict[tuple, FakePeer] = {}
+        self.scripts: dict[tuple, list] = {}
+        self.leases: list[tuple] = []
+
+    def lease(self, host, port, info_hash, peer_id, listen_port=None,
+              connect_timeout=None, io_timeout=None):
+        addr = (host, port)
+        self.leases.append(addr)
+        peer = self.pooled.get(addr)
+        if peer is not None:
+            return peer, True
+        outcome = self.scripts.get(addr, [ConnectionRefusedError("no route")])
+        step = outcome.pop(0) if len(outcome) > 1 else outcome[0]
+        if isinstance(step, BaseException):
+            raise step
+        self.pooled[addr] = step
+        return step, False
+
+    def remove(self, host, port):
+        peer = self.pooled.pop((host, port), None)
+        if peer is not None:
+            peer.close()
+
+    def close_all(self):
+        for addr in list(self.pooled):
+            self.remove(*addr)
+
+
+def _result(data=b"blob", offset=0):
+    class R:
+        pass
+
+    r = R()
+    r.data = data
+    r.chunk_offset = offset
+    return r
+
+
+def _swarm(tmp_path, pool, clock=None, strikes=3):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    health = HealthRegistry(strikes_to_quarantine=strikes,
+                            quarantine_base_s=10.0,
+                            time_fn=clock or time.monotonic)
+    return SwarmDownloader(cfg, peer_sources=[], pool=pool, health=health)
+
+
+XH = b"x" * 32
+
+
+def test_dead_peer_quarantined_and_skipped(tmp_path, clock):
+    pool = ScriptedPool()
+    pool.scripts[("dead", 1)] = [ConnectionRefusedError("refused")]
+    swarm = _swarm(tmp_path, pool, clock=clock, strikes=2)
+    swarm.add_direct_peer("dead", 1)
+
+    for _ in range(2):
+        assert swarm.try_peer_download(XH, "aa", 0, 1) is None
+    assert swarm.stats.peers_quarantined == 1
+    attempts_before = swarm.stats.peer_attempts
+    # Quarantined: the candidate is skipped outright, no new attempts.
+    assert swarm.try_peer_download(XH, "aa", 0, 1) is None
+    assert swarm.stats.peer_attempts == attempts_before
+    summary = swarm.summary()
+    assert summary["health"]["quarantined_now"] == 1
+
+
+def test_corruption_reports_strike_toward_quarantine(tmp_path, clock):
+    pool = ScriptedPool()
+    swarm = _swarm(tmp_path, pool, clock=clock, strikes=2)
+    addr = ("corrupt", 9)
+    swarm.report_corrupt(addr)
+    assert not swarm.health.is_quarantined(addr)
+    swarm.report_corrupt(addr)
+    assert swarm.health.is_quarantined(addr)
+    assert swarm.stats.corrupt_from_peer == 2
+    assert swarm.stats.peers_quarantined == 1
+    assert swarm.summary()["health"]["corrupt_strikes"] == 2
+
+
+def test_stale_pooled_socket_gets_one_reconnect_retry(tmp_path):
+    """The PeerPool eviction race / server idle-close contract: an IO
+    failure on a REUSED pooled connection surfaces as exactly one
+    retried request on a fresh connection — never a failed download,
+    never a strike against the innocent peer."""
+    pool = ScriptedPool()
+    addr = ("peer", 7)
+
+    def stale(*a):
+        raise ConnectionResetError("socket closed under us (evicted)")
+
+    pool.pooled[addr] = FakePeer(stale)
+    pool.scripts[addr] = [FakePeer(lambda *a: _result(b"payload"))]
+    swarm = _swarm(tmp_path, pool, strikes=1)
+    swarm.add_direct_peer(*addr)
+
+    got = swarm.try_peer_download(XH, "aa", 0, 1)
+    assert got is not None and got.data == b"payload"
+    assert got.addr == addr
+    assert swarm.stats.peer_retries == 1
+    assert swarm.stats.peer_failures == 1
+    # With strikes_to_quarantine=1 ANY strike would quarantine: the
+    # stale socket must not have been blamed on the peer.
+    assert not swarm.health.is_quarantined(addr)
+    assert swarm.health._peers[addr].successes == 1
+
+
+def test_fresh_connection_failure_strikes_without_retry(tmp_path):
+    pool = ScriptedPool()
+    pool.scripts[("down", 3)] = [ConnectionRefusedError("refused")]
+    swarm = _swarm(tmp_path, pool, strikes=1)
+    swarm.add_direct_peer("down", 3)
+    assert swarm.try_peer_download(XH, "aa", 0, 1) is None
+    assert swarm.stats.peer_retries == 0
+    assert swarm.health.is_quarantined(("down", 3))
+
+
+def test_candidates_ordered_by_observed_health(tmp_path):
+    pool = ScriptedPool()
+    fast, slow = ("fast", 1), ("slow", 2)
+    pool.scripts[fast] = [FakePeer(lambda *a: _result(b"f"))]
+    pool.scripts[slow] = [FakePeer(lambda *a: _result(b"s"))]
+    swarm = _swarm(tmp_path, pool)
+    swarm.add_direct_peer(*slow)  # direct order: slow first
+    swarm.add_direct_peer(*fast)
+    swarm.health.record_success(slow, rtt_s=0.8)
+    swarm.health.record_success(fast, rtt_s=0.01)
+
+    got = swarm.try_peer_download(XH, "aa", 0, 1)
+    assert got is not None and got.data == b"f"
+    assert pool.leases[0] == fast  # health ordering beat direct order
+
+
+def test_deadline_starved_timeout_does_not_strike(tmp_path):
+    """A connect/IO timeout the deadline squeezed below the health-
+    derived budget is the BUDGET's failure, not the peer's: no strike,
+    or healthy peers would start the next pull quarantined."""
+    from zest_tpu.resilience import Deadline
+
+    pool = ScriptedPool()
+    pool.scripts[("p", 1)] = [ConnectionRefusedError("budget ran out")]
+    swarm = _swarm(tmp_path, pool, strikes=1)
+    swarm.add_direct_peer("p", 1)
+    tight = Deadline(0.5)  # remaining << default 3s connect budget
+    assert swarm.try_peer_download(XH, "aa", 0, 1, deadline=tight) is None
+    assert swarm.stats.peer_failures == 1
+    assert not swarm.health.is_quarantined(("p", 1))
+
+
+def test_deadline_abandons_peer_tier(tmp_path):
+    from zest_tpu.resilience import Deadline
+
+    pool = ScriptedPool()
+    pool.scripts[("p", 1)] = [FakePeer(lambda *a: _result())]
+    swarm = _swarm(tmp_path, pool)
+    swarm.add_direct_peer("p", 1)
+    expired = Deadline(0.0)
+    assert swarm.try_peer_download(XH, "aa", 0, 1, deadline=expired) is None
+    assert swarm.stats.peer_attempts == 0
+
+
+# ── Discovery TTLs ──
+
+
+class CountingSource:
+    def __init__(self, results):
+        self.results = results  # list of lists, popped per call
+        self.calls = 0
+
+    def find_peers(self, info_hash):
+        self.calls += 1
+        return self.results.pop(0) if len(self.results) > 1 \
+            else self.results[0]
+
+    def announce(self, info_hash, port):
+        pass
+
+
+def test_empty_discovery_uses_short_negative_ttl(tmp_path, monkeypatch):
+    monkeypatch.setattr(swarm_mod, "NEGATIVE_DISCOVERY_TTL_S", 0.05)
+    source = CountingSource([[], [("peer", 1)]])
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    swarm = SwarmDownloader(cfg, peer_sources=[source], pool=ScriptedPool())
+
+    assert swarm.discover_peers(b"i" * 20) == []
+    assert swarm.discover_peers(b"i" * 20) == []  # within negative TTL
+    assert source.calls == 1
+    time.sleep(0.06)
+    assert swarm.discover_peers(b"i" * 20) == [("peer", 1)]
+    assert source.calls == 2
+
+
+def test_successful_discovery_keeps_full_ttl(tmp_path):
+    source = CountingSource([[("peer", 1)]])
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    swarm = SwarmDownloader(cfg, peer_sources=[source], pool=ScriptedPool())
+    for _ in range(3):
+        assert swarm.discover_peers(b"i" * 20) == [("peer", 1)]
+    assert source.calls == 1

@@ -5,17 +5,59 @@ requests against the CAS endpoint obtained from the xet-read-token
 exchange, returning reconstruction plans and raw xorb bytes (full or HTTP
 byte-range). Every byte that leaves this client is still untrusted until
 chunk hashes verify during extraction.
+
+Unlike the reference's single-shot client, every GET here is treated as
+the idempotent request it is: transient failures (5xx, 429, connection
+reset, timeout) retry with capped exponential backoff + jitter, a
+mid-stream drop resumes from the byte where it died via an adjusted
+``Range`` header, and a 401/403 against the CAS origin refreshes the
+xet-read token once and retries — tokens expire during long pulls.
+An optional per-pull :class:`~zest_tpu.resilience.Deadline` caps both
+the per-request timeouts and the retry sleeps.
 """
 
 from __future__ import annotations
 
+import os
+import threading
+
 import requests
 
+from zest_tpu import faults
 from zest_tpu.cas import reconstruction as recon
+from zest_tpu.resilience import Backoff, Deadline, DeadlineExceeded
 
 
 class CasError(RuntimeError):
     pass
+
+
+class CasTransientError(CasError):
+    """A failure worth retrying (server hiccup, connection reset)."""
+
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status
+
+
+class _RefreshNeeded(Exception):
+    """Internal: CAS origin said 401/403 — try a token refresh."""
+
+    def __init__(self, status: int):
+        super().__init__(f"status {status}")
+        self.status = status
+
+
+_RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+_NETWORK_ERRORS = (
+    requests.exceptions.ConnectionError,
+    requests.exceptions.Timeout,
+    requests.exceptions.ChunkedEncodingError,
+)
+
+DEFAULT_RETRIES = int(os.environ.get("ZEST_CDN_RETRIES", "3"))
+DEFAULT_BACKOFF_BASE_S = float(os.environ.get("ZEST_CDN_BACKOFF_S", "0.2"))
+_BACKOFF_CAP_S = 5.0
 
 
 class CasClient:
@@ -24,25 +66,109 @@ class CasClient:
         cas_url: str,
         access_token: str | None = None,
         session: requests.Session | None = None,
+        retries: int | None = None,
+        backoff_base_s: float | None = None,
+        token_refresher=None,
+        deadline: Deadline | None = None,
+        on_event=None,
     ):
+        """``token_refresher`` is ``() -> (cas_url, access_token)`` — the
+        hub's xet-read-token exchange, re-run at most once per request on
+        401/403. ``on_event(name)`` is the caller's counter hook (the
+        bridge feeds ``FetchStats.bump``); ``deadline`` caps timeouts and
+        retry sleeps."""
         self.cas_url = cas_url.rstrip("/")
         self.access_token = access_token
         self.session = session or requests.Session()
+        self.retries = DEFAULT_RETRIES if retries is None else max(0, retries)
+        self.backoff_base_s = (DEFAULT_BACKOFF_BASE_S if backoff_base_s is None
+                               else backoff_base_s)
+        self.token_refresher = token_refresher
+        self.deadline = deadline
+        self._on_event = on_event
+        self._refresh_lock = threading.Lock()
 
     def _headers(self) -> dict[str, str]:
         if self.access_token:
             return {"Authorization": f"Bearer {self.access_token}"}
         return {}
 
+    def _bump(self, name: str) -> None:
+        if self._on_event is not None:
+            self._on_event(name)
+
+    def _timeout(self, base_s: float) -> float:
+        if self.deadline is not None:
+            self.deadline.check("CDN request")
+            return self.deadline.cap(base_s)
+        return base_s
+
+    def _get(self, url: str, headers: dict, timeout: float,
+             stream: bool = False):
+        """The one chokepoint every CAS/CDN GET goes through — where the
+        chaos harness injects server hiccups and connection resets."""
+        if faults.fire("cdn_503"):
+            raise CasTransientError(f"GET {url} -> 503 (injected)", 503)
+        if faults.fire("cdn_reset"):
+            raise requests.exceptions.ConnectionError(
+                f"injected cdn_reset for {url}")
+        return self.session.get(url, headers=headers, timeout=timeout,
+                                stream=stream)
+
+    def _refresh_token(self) -> bool:
+        """Re-run the xet-read-token exchange; True when a new token was
+        installed. Serialized: concurrent 401s from parallel term fetches
+        must not stampede the hub."""
+        if self.token_refresher is None:
+            return False
+        with self._refresh_lock:
+            try:
+                cas_url, token = self.token_refresher()
+            except Exception:
+                return False
+            if cas_url:
+                self.cas_url = cas_url.rstrip("/")
+            self.access_token = token
+        self._bump("token_refreshes")
+        return True
+
     def get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
         """GET /v1/reconstructions/{hex} -> terms + fetch_info."""
         url = f"{self.cas_url}/v1/reconstructions/{file_hash_hex}"
-        resp = self.session.get(url, headers=self._headers(), timeout=30)
-        if resp.status_code == 404:
-            raise CasError(f"no reconstruction for {file_hash_hex}")
-        if resp.status_code != 200:
-            raise CasError(f"GET {url} -> {resp.status_code}")
-        return recon.from_json(file_hash_hex, resp.json())
+        backoff = Backoff(self.backoff_base_s, _BACKOFF_CAP_S)
+        attempt = 0
+        refreshed = False
+        while True:
+            try:
+                resp = self._get(url, self._headers(),
+                                 timeout=self._timeout(30))
+            except CasTransientError as exc:
+                err = exc
+            except _NETWORK_ERRORS as exc:
+                err = CasTransientError(f"GET {url}: {exc}")
+            else:
+                status = resp.status_code
+                if status == 200:
+                    return recon.from_json(file_hash_hex, resp.json())
+                if status == 404:
+                    raise CasError(f"no reconstruction for {file_hash_hex}")
+                if status in (401, 403) and not refreshed:
+                    refreshed = True
+                    if self._refresh_token():
+                        continue
+                if status in _RETRYABLE_STATUS:
+                    err = CasTransientError(f"GET {url} -> {status}", status)
+                else:
+                    raise CasError(f"GET {url} -> {status}")
+            attempt += 1
+            if attempt > self.retries:
+                raise CasError(
+                    f"GET {url} failed after {attempt} attempts: {err}"
+                ) from err
+            self._bump("cdn_retries")
+            if not backoff.sleep(deadline=self.deadline):
+                raise DeadlineExceeded(
+                    f"pull deadline exhausted retrying {url}") from err
 
     def fetch_xorb_from_url(
         self, url: str, byte_range: tuple[int, int] | None = None
@@ -62,32 +188,83 @@ class CasClient:
         whole-unit buffer is built. 1 MiB reads, not ``resp.content``:
         requests accumulates bodies in 10 KiB chunks, which measures
         ~2x slower on multi-MB xorb units (per-chunk allocation and
-        socket wakeups dominate)."""
-        headers: dict[str, str] = {}
-        if url.startswith(self.cas_url):
-            headers.update(self._headers())
+        socket wakeups dominate).
+
+        Resumable: a transient failure after N yielded bytes re-requests
+        from byte N (the GET is idempotent and ranged), so a multi-GB
+        unit doesn't restart from zero on a mid-stream reset — and the
+        consumer sees one uninterrupted byte stream either way."""
         if byte_range is not None:
             start, end = byte_range
             if not (0 <= start < end):
                 raise CasError(f"invalid byte range [{start},{end})")
-            headers["Range"] = f"bytes={start}-{end - 1}"
-        resp = self.session.get(url, headers=headers, timeout=120,
-                                stream=True)
+        backoff = Backoff(self.backoff_base_s, _BACKOFF_CAP_S)
+        attempt = 0
+        refreshed = False
+        yielded = 0
+        while True:
+            try:
+                for chunk in self._stream_once(url, byte_range, yielded):
+                    yielded += len(chunk)
+                    yield chunk
+                return
+            except _RefreshNeeded as exc:
+                if not refreshed:
+                    refreshed = True
+                    if self._refresh_token():
+                        continue
+                raise CasError(f"GET {url} -> {exc.status}") from exc
+            except (CasTransientError, *_NETWORK_ERRORS) as exc:
+                attempt += 1
+                if attempt > self.retries:
+                    raise CasError(
+                        f"GET {url} failed after {attempt} attempts: {exc}"
+                    ) from exc
+                self._bump("cdn_retries")
+                if not backoff.sleep(deadline=self.deadline):
+                    raise DeadlineExceeded(
+                        f"pull deadline exhausted retrying {url}") from exc
+
+    def _stream_once(self, url: str, byte_range: tuple[int, int] | None,
+                     skip: int):
+        """One streaming GET of the requested window minus its first
+        ``skip`` bytes (already delivered by a previous attempt)."""
+        headers: dict[str, str] = {}
+        same_origin = url.startswith(self.cas_url)
+        if same_origin:
+            headers.update(self._headers())
+        if byte_range is not None:
+            lo, hi = byte_range[0] + skip, byte_range[1]
+            if lo >= hi:
+                return  # previous attempts already delivered the window
+            headers["Range"] = f"bytes={lo}-{hi - 1}"
+        else:
+            lo, hi = skip, None
+            if skip:
+                headers["Range"] = f"bytes={skip}-"
+        resp = self._get(url, headers, timeout=self._timeout(120),
+                         stream=True)
         try:
-            if resp.status_code not in (200, 206):
-                raise CasError(f"GET {url} -> {resp.status_code}")
-            if byte_range is not None and resp.status_code == 200:
+            status = resp.status_code
+            if status in (401, 403) and same_origin:
+                raise _RefreshNeeded(status)
+            if status in _RETRYABLE_STATUS:
+                raise CasTransientError(f"GET {url} -> {status}", status)
+            if status not in (200, 206):
+                raise CasError(f"GET {url} -> {status}")
+            if status == 200 and (byte_range is not None or skip):
                 # Origin ignored the Range header; trim the full body to
                 # the window as it streams past.
-                lo, hi = byte_range
                 pos = 0
                 for chunk in resp.iter_content(1024 * 1024):
-                    a, b = max(lo - pos, 0), min(hi - pos, len(chunk))
+                    a = max(lo - pos, 0)
+                    b = len(chunk) if hi is None else min(hi - pos,
+                                                          len(chunk))
                     if a < b:
                         yield (chunk[a:b] if (a, b) != (0, len(chunk))
                                else chunk)
                     pos += len(chunk)
-                    if pos >= hi:
+                    if hi is not None and pos >= hi:
                         break
                 return
             yield from resp.iter_content(1024 * 1024)

@@ -106,6 +106,13 @@ class Config:
     pull_inflight_bytes: int = DEFAULT_PULL_INFLIGHT_BYTES
     decode_workers: int = DEFAULT_DECODE_WORKERS
     land_decode_ahead: int = DEFAULT_LAND_DECODE_AHEAD
+    # Per-pull wall-clock budget in seconds (ZEST_PULL_DEADLINE_S;
+    # None/0 = off). When armed, every tier's timeouts and retry sleeps
+    # are capped by the remaining budget and the bridge hedges slow
+    # peer fetches against CDN (transfer.bridge). Off by default: an
+    # unattended pull should keep trying, an interactive/serving pull
+    # wants a bound.
+    pull_deadline_s: float | None = None
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
@@ -155,6 +162,10 @@ class Config:
                 env.get("ZEST_DECODE_WORKERS", DEFAULT_DECODE_WORKERS))),
             land_decode_ahead=max(0, int(
                 env.get("ZEST_LAND_AHEAD", DEFAULT_LAND_DECODE_AHEAD))),
+            pull_deadline_s=(
+                float(env["ZEST_PULL_DEADLINE_S"])
+                if float(env.get("ZEST_PULL_DEADLINE_S") or 0) > 0
+                else None),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,

@@ -1,0 +1,200 @@
+"""Per-peer health: latency EWMA, strike circuit breaker, quarantine.
+
+The reference's swarm walks candidates in a fixed order with no memory
+of who failed last time (swarm.zig:398-437) — one dead direct peer
+costs a full connect timeout on *every* xorb. This registry is the
+memory: each peer accumulates a latency EWMA on success and strikes on
+failure (connect failure, IO timeout, and corrupt-chunk attribution
+from the bridge all count); ``strikes_to_quarantine`` strikes trip a
+circuit breaker that removes the peer from candidate ordering for a
+quarantine window. Windows double on consecutive quarantines (capped)
+and decay again on good behavior — a flapping peer is re-admitted on
+probation (one strike from re-quarantine), not with a clean slate.
+
+Ordering: healthy peers sort by observed EWMA round-trip (fast first);
+peers with no history slot at a neutral prior so known-fast peers beat
+strangers and strangers beat known-slow ones. The sort is stable, so
+ties preserve the caller's priority (direct peers before discovered).
+
+The EWMAs also drive adaptive timeouts: connect/IO deadlines start at a
+tight default and track a multiple of the observed latency, clamped to
+a floor and the legacy ceiling — a peer that answers in 30 ms gets a
+sub-second IO timeout instead of the reference's fixed 60 s stall.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+Addr = tuple[str, int]
+
+DEFAULT_STRIKES_TO_QUARANTINE = 3
+DEFAULT_QUARANTINE_BASE_S = 15.0
+QUARANTINE_CAP_S = 240.0
+EWMA_ALPHA = 0.3
+# Neutral prior RTT for never-observed peers (seconds): sorts strangers
+# between known-fast and known-slow.
+PRIOR_RTT_S = 0.25
+
+
+@dataclass
+class PeerHealth:
+    ewma_rtt_s: float | None = None
+    ewma_connect_s: float | None = None
+    strikes: int = 0
+    quarantines: int = 0          # consecutive-quarantine depth (backoff)
+    quarantined_until: float = 0.0
+    successes: int = 0
+    failures: int = 0
+    corruptions: int = 0
+
+
+def _ewma(prev: float | None, sample: float) -> float:
+    if prev is None:
+        return sample
+    return (1.0 - EWMA_ALPHA) * prev + EWMA_ALPHA * sample
+
+
+class HealthRegistry:
+    """Thread-safe per-address health book, shared by one swarm."""
+
+    def __init__(
+        self,
+        strikes_to_quarantine: int | None = None,
+        quarantine_base_s: float | None = None,
+        time_fn=time.monotonic,
+    ):
+        if strikes_to_quarantine is None:
+            strikes_to_quarantine = int(
+                os.environ.get("ZEST_PEER_STRIKES",
+                               DEFAULT_STRIKES_TO_QUARANTINE))
+        if quarantine_base_s is None:
+            quarantine_base_s = float(
+                os.environ.get("ZEST_PEER_QUARANTINE_S",
+                               DEFAULT_QUARANTINE_BASE_S))
+        self.strikes_to_quarantine = max(1, strikes_to_quarantine)
+        self.quarantine_base_s = quarantine_base_s
+        self._time = time_fn
+        self._peers: dict[Addr, PeerHealth] = {}
+        self._lock = threading.Lock()
+        self.quarantine_events = 0
+
+    def _peer_locked(self, addr: Addr) -> PeerHealth:
+        peer = self._peers.get(addr)
+        if peer is None:
+            peer = self._peers[addr] = PeerHealth()
+        return peer
+
+    # ── Recording ──
+
+    def record_success(self, addr: Addr, rtt_s: float | None = None,
+                       connect_s: float | None = None) -> None:
+        with self._lock:
+            p = self._peer_locked(addr)
+            p.successes += 1
+            p.strikes = 0
+            # Good behavior decays the quarantine backoff depth, so a
+            # recovered peer that trips again serves a short window, not
+            # the doubled one its bad week earned.
+            if p.quarantines:
+                p.quarantines -= 1
+            if rtt_s is not None:
+                p.ewma_rtt_s = _ewma(p.ewma_rtt_s, rtt_s)
+            if connect_s is not None:
+                p.ewma_connect_s = _ewma(p.ewma_connect_s, connect_s)
+
+    def record_failure(self, addr: Addr, kind: str = "error") -> bool:
+        """One strike; True when this strike tripped the breaker."""
+        with self._lock:
+            p = self._peer_locked(addr)
+            p.failures += 1
+            if kind == "corrupt":
+                p.corruptions += 1
+            p.strikes += 1
+            if p.strikes < self.strikes_to_quarantine:
+                return False
+            p.quarantines += 1
+            window = min(
+                QUARANTINE_CAP_S,
+                self.quarantine_base_s * (2.0 ** (p.quarantines - 1)),
+            )
+            p.quarantined_until = self._time() + window
+            # Probation: on re-admit one more strike re-quarantines
+            # (with the doubled window); a success clears it.
+            p.strikes = self.strikes_to_quarantine - 1
+            self.quarantine_events += 1
+            return True
+
+    # ── Queries ──
+
+    def is_quarantined(self, addr: Addr) -> bool:
+        now = self._time()
+        with self._lock:
+            p = self._peers.get(addr)
+            return p is not None and now < p.quarantined_until
+
+    def _score_locked(self, addr: Addr) -> float:
+        p = self._peers.get(addr)
+        if p is None:
+            return PRIOR_RTT_S
+        rtt = p.ewma_rtt_s if p.ewma_rtt_s is not None else PRIOR_RTT_S
+        # Each outstanding strike pushes the peer behind clean ones of
+        # equal speed without hiding it entirely.
+        return rtt + 0.5 * p.strikes
+
+    def partition(self, addrs: list[Addr]) -> tuple[list[Addr], list[Addr]]:
+        """(healthy ordered best-first, currently-quarantined). Stable
+        sort: equal scores keep the caller's priority order."""
+        now = self._time()
+        with self._lock:
+            healthy, shunned = [], []
+            for addr in addrs:
+                p = self._peers.get(addr)
+                if p is not None and now < p.quarantined_until:
+                    shunned.append(addr)
+                else:
+                    healthy.append(addr)
+            healthy.sort(key=self._score_locked)
+            return healthy, shunned
+
+    # ── Adaptive timeouts ──
+
+    def connect_timeout(self, addr: Addr, default_s: float = 3.0,
+                        floor_s: float = 0.75, ceiling_s: float = 5.0,
+                        mult: float = 4.0) -> float:
+        with self._lock:
+            p = self._peers.get(addr)
+            observed = p.ewma_connect_s if p is not None else None
+        if observed is None:
+            return min(default_s, ceiling_s)
+        return min(max(mult * observed, floor_s), ceiling_s)
+
+    def io_timeout(self, addr: Addr, default_s: float = 20.0,
+                   floor_s: float = 2.0, ceiling_s: float = 60.0,
+                   mult: float = 8.0) -> float:
+        with self._lock:
+            p = self._peers.get(addr)
+            observed = p.ewma_rtt_s if p is not None else None
+        if observed is None:
+            return min(default_s, ceiling_s)
+        return min(max(mult * observed, floor_s), ceiling_s)
+
+    # ── Telemetry ──
+
+    def summary(self) -> dict:
+        now = self._time()
+        with self._lock:
+            return {
+                "tracked": len(self._peers),
+                "quarantined_now": sum(
+                    1 for p in self._peers.values()
+                    if now < p.quarantined_until
+                ),
+                "quarantine_events": self.quarantine_events,
+                "corrupt_strikes": sum(
+                    p.corruptions for p in self._peers.values()
+                ),
+            }

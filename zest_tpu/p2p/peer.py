@@ -17,11 +17,15 @@ import socket
 import threading
 from dataclasses import dataclass
 
+from zest_tpu import faults
 from zest_tpu.p2p import bep_xet, wire
 
 # Our local id for the ut_xet extension, advertised in the ext handshake.
 LOCAL_UT_XET_ID = 3
 
+# Legacy ceilings. The swarm passes adaptive (EWMA-derived, deadline-
+# capped) timeouts per connection; these remain the defaults for direct
+# protocol use and the upper bound the adaptive path never exceeds.
 _CONNECT_TIMEOUT_S = 5.0
 _IO_TIMEOUT_S = 60.0
 
@@ -44,10 +48,12 @@ class BtPeer:
     """One outgoing peer connection bound to a single swarm (info_hash)."""
 
     def __init__(self, stream: wire.SocketStream, peer_ut_xet_id: int,
-                 remote_peer_id: bytes):
+                 remote_peer_id: bytes,
+                 address: tuple[str, int] | None = None):
         self.stream = stream
         self.peer_ut_xet_id = peer_ut_xet_id
         self.remote_peer_id = remote_peer_id
+        self.address = address
         self.lock = threading.Lock()
         self._next_request_id = 1
 
@@ -61,9 +67,13 @@ class BtPeer:
         info_hash: bytes,
         peer_id: bytes,
         listen_port: int | None = None,
+        connect_timeout: float = _CONNECT_TIMEOUT_S,
+        io_timeout: float = _IO_TIMEOUT_S,
     ) -> "BtPeer":
-        sock = socket.create_connection((host, port), timeout=_CONNECT_TIMEOUT_S)
-        sock.settimeout(_IO_TIMEOUT_S)
+        if faults.fire("peer_timeout", key=f"{host}:{port}"):
+            raise TimeoutError(f"injected peer_timeout for {host}:{port}")
+        sock = socket.create_connection((host, port), timeout=connect_timeout)
+        sock.settimeout(io_timeout)
         stream = wire.SocketStream(sock)
         try:
             stream.send_handshake(info_hash, peer_id)
@@ -81,7 +91,8 @@ class BtPeer:
             if caps.ut_xet_id is None:
                 raise PeerError("peer does not support ut_xet")
             stream.send_message(wire.MessageId.INTERESTED)
-            return cls(stream, caps.ut_xet_id, their_hs.peer_id)
+            return cls(stream, caps.ut_xet_id, their_hs.peer_id,
+                       address=(host, port))
         except BaseException:
             stream.close()
             raise
@@ -104,6 +115,20 @@ class BtPeer:
     def close(self) -> None:
         self.stream.close()
 
+    def _arm_io_timeout_locked(self, timeout_s: float) -> None:
+        """Re-arm the socket's per-op timeout — a pooled connection
+        carries the timeout of the request that *created* it, and the
+        adaptive/deadline-capped budget of the current request may be
+        tighter. MUST be called with ``self.lock`` held: the socket is
+        shared across the pull's concurrent term workers, and an
+        unlocked settimeout would clobber another thread's in-flight
+        recv budget. Best-effort: a torn-down socket surfaces on the
+        next recv either way."""
+        try:
+            self.stream.sock.settimeout(timeout_s)
+        except OSError:
+            pass
+
     # ── Requesting (reference: bt_peer.zig:125-248) ──
 
     def _alloc_request_id(self) -> int:
@@ -112,10 +137,19 @@ class BtPeer:
         return rid
 
     def request_chunk(
-        self, chunk_hash: bytes, range_start: int, range_end: int
+        self, chunk_hash: bytes, range_start: int, range_end: int,
+        io_timeout: float | None = None,
     ) -> ChunkResult:
-        """Single request/response; holds the stream lock end-to-end."""
+        """Single request/response; holds the stream lock end-to-end.
+        ``io_timeout`` re-arms the socket budget for THIS request, under
+        the lock so concurrent requests on the shared connection never
+        clobber each other's in-flight recv."""
+        if self.address is not None:
+            faults.sleep_if("peer_slow",
+                            key=f"{self.address[0]}:{self.address[1]}")
         with self.lock:
+            if io_timeout is not None:
+                self._arm_io_timeout_locked(io_timeout)
             rid = self._alloc_request_id()
             self._send_request(rid, chunk_hash, range_start, range_end)
             return self._recv_response(rid)

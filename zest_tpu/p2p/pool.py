@@ -36,15 +36,38 @@ class PeerPool:
         peer_id: bytes,
         listen_port: int | None = None,
     ) -> BtPeer:
+        return self.lease(host, port, info_hash, peer_id, listen_port)[0]
+
+    def lease(
+        self,
+        host: str,
+        port: int,
+        info_hash: bytes,
+        peer_id: bytes,
+        listen_port: int | None = None,
+        connect_timeout: float | None = None,
+        io_timeout: float | None = None,
+    ) -> tuple[BtPeer, bool]:
+        """``(peer, reused)`` — ``reused`` tells the caller whether the
+        connection predates this request. A reused socket can be stale
+        (evicted mid-lease, idle-closed by the remote): an IO failure on
+        it warrants one fresh-reconnect retry before the peer itself is
+        blamed, which the swarm implements on top of this flag."""
         key = (host, port)
         with self._lock:
             existing = self._peers.get(key)
             if existing is not None:
                 self._peers.move_to_end(key)  # LRU touch
-                return existing
+                return existing, True
 
         # Slow path outside the lock.
-        peer = BtPeer.connect(host, port, info_hash, peer_id, listen_port)
+        kwargs = {}
+        if connect_timeout is not None:
+            kwargs["connect_timeout"] = connect_timeout
+        if io_timeout is not None:
+            kwargs["io_timeout"] = io_timeout
+        peer = BtPeer.connect(host, port, info_hash, peer_id, listen_port,
+                              **kwargs)
 
         with self._lock:
             raced = self._peers.get(key)
@@ -53,14 +76,16 @@ class PeerPool:
                 self._peers.move_to_end(key)
                 loser = peer
                 peer = raced
+                reused = True
             else:
                 if len(self._peers) >= self.max_peers:
                     self._evict_one_locked()
                 self._peers[key] = peer
                 loser = None
+                reused = False
         if loser is not None:
             loser.close()
-        return peer
+        return peer, reused
 
     def remove(self, host: str, port: int) -> None:
         with self._lock:

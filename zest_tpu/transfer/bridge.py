@@ -16,6 +16,8 @@ frame-stream blob shape, so extraction code is tier-agnostic.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 
 from zest_tpu.cas import reconstruction as recon
@@ -24,6 +26,14 @@ from zest_tpu.cas.hub import HubClient
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.config import Config
 from zest_tpu.storage import XorbCache
+
+# Hedging: with a pull deadline armed, the peer tier gets at most this
+# fraction of the remaining budget (capped) as a head start before a
+# CDN fetch races it — the bound that turns "one slow peer stalls a
+# term for 60 s" into "one slow peer costs a bounded head start".
+_HEDGE_PEER_FRACTION = 0.3
+_HEDGE_PEER_WAIT_CAP_S = 10.0
+_HEDGE_PEER_WAIT_FLOOR_S = 0.05
 
 
 class BridgeError(RuntimeError):
@@ -51,6 +61,18 @@ class FetchStats:
     bytes_from_cache: int = 0
     bytes_from_peer: int = 0
     bytes_from_cdn: int = 0
+    # Resilience counters: CDN retry/backoff rounds, xet-token
+    # refreshes, deadline hedges (won = the CDN racer delivered, lost =
+    # it failed and the peer tier finished after all), and corruption
+    # attributions (a peer-served blob failed structural or BLAKE3
+    # verification and was refetched).
+    cdn_retries: int = 0
+    token_refreshes: int = 0
+    hedges: int = 0
+    hedges_won: int = 0
+    hedges_lost: int = 0
+    corrupt_from_peer: int = 0
+    corrupt_healed: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record(self, source: str, nbytes: int) -> None:
@@ -59,6 +81,10 @@ class FetchStats:
                     getattr(self, f"xorbs_from_{source}") + 1)
             setattr(self, f"bytes_from_{source}",
                     getattr(self, f"bytes_from_{source}") + nbytes)
+
+    def bump(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + amount)
 
     @property
     def p2p_ratio(self) -> float:
@@ -78,16 +104,32 @@ class FetchStats:
                 "cdn": self.bytes_from_cdn,
             },
             "p2p_ratio": round(self.p2p_ratio, 4),
+            "resilience": {
+                "cdn_retries": self.cdn_retries,
+                "token_refreshes": self.token_refreshes,
+                "hedges": self.hedges,
+                "hedges_won": self.hedges_won,
+                "hedges_lost": self.hedges_lost,
+                "corrupt_from_peer": self.corrupt_from_peer,
+                "corrupt_healed": self.corrupt_healed,
+            },
         }
 
 
 @dataclass(frozen=True)
 class XorbFetchResult:
-    """Blob + the term's chunk range rebased into it."""
+    """Blob + the term's chunk range rebased into it.
+
+    ``source``/``peer_addr`` let extraction-time verification failures
+    route back to their origin: a corrupt blob from a peer strikes that
+    peer's health, and anything not already CDN-sourced self-heals with
+    a forced CDN refetch (overwriting the poisoned cache key)."""
 
     data: bytes
     local_start: int
     local_end: int
+    source: str = "cache"                      # cache | peer | cdn
+    peer_addr: tuple[str, int] | None = None
 
 
 def _blob_covers(data: bytes, local_start: int, local_end: int) -> bool:
@@ -129,11 +171,26 @@ class XetBridge:
         self.swarm = swarm
         self.cas: CasClient | None = None
         self.stats = FetchStats()
+        # Per-pull wall-clock budget (resilience.Deadline | None), set by
+        # transfer.pull before any fetch; flows into the CAS client at
+        # authenticate() and into the swarm per call.
+        self.deadline = None
         self._recons: dict[str, recon.Reconstruction] = {}
         # Guards the reconstruction memo: the pipelined pull resolves
         # and fetches from several file workers at once, and an unlocked
         # dict would let _known_entries iterate mid-insert.
         self._recons_lock = threading.Lock()
+        # Lazy: only a deadline-armed pull ever hedges.
+        self._hedge_pool: ThreadPoolExecutor | None = None
+        self._hedge_lock = threading.Lock()
+
+    def close(self) -> None:
+        """Release the hedge pool's threads (per-pull bridges in a
+        long-lived daemon must not accumulate idle workers)."""
+        with self._hedge_lock:
+            pool, self._hedge_pool = self._hedge_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # ── Auth (reference: xet_bridge.zig:76-130) ──
 
@@ -141,7 +198,14 @@ class XetBridge:
                      hub: HubClient | None = None) -> None:
         hub = hub or HubClient(self.cfg)
         cas_url, access_token = hub.xet_read_token(repo_id, revision)
-        self.cas = CasClient(cas_url, access_token)
+        self.cas = CasClient(
+            cas_url, access_token,
+            # Tokens expire during long pulls: a 401/403 mid-pull re-runs
+            # the exchange once and retries instead of failing the file.
+            token_refresher=lambda: hub.xet_read_token(repo_id, revision),
+            deadline=self.deadline,
+            on_event=self.stats.bump,
+        )
 
     def get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
         """Memoized per bridge: the pod pre-pass plans from the same
@@ -181,20 +245,25 @@ class XetBridge:
             local_end = term.range.end - cached.chunk_offset
             if _blob_covers(cached.data, local_start, local_end):
                 self.stats.record("cache", len(cached.data))
-                return XorbFetchResult(cached.data, local_start, local_end)
+                return XorbFetchResult(cached.data, local_start, local_end,
+                                       source="cache")
             # Corrupt/short entry: fall through — a CDN refetch overwrites
             # the bad cache key, so the tier self-heals.
 
         # 2. Swarm (peers) — request fi's full chunk range so the cached
         #    result can serve future terms that share this fetch_info.
+        #    With a deadline armed this tier is hedged: the peer fetch
+        #    gets a bounded head start, then races a CDN fetch.
         if self.swarm is not None:
-            peer_result = self.swarm.try_peer_download(
-                term.xorb_hash, hash_hex, fi.range.start, fi.range.end
-            )
+            peer_result = self._peer_tier(term, rec, fi, hash_hex)
+            if isinstance(peer_result, XorbFetchResult):
+                return peer_result  # the CDN hedge won; already cached
             if peer_result is not None:
                 local_start = term.range.start - peer_result.chunk_offset
                 local_end = term.range.end - peer_result.chunk_offset
-                if _blob_covers(peer_result.data, local_start, local_end):
+                if _blob_covers(peer_result.data, local_start, local_end) \
+                        and self._peer_blob_verifies(term, rec, hash_hex,
+                                                     peer_result):
                     self.stats.record("peer", len(peer_result.data))
                     # Cache for seeding (reference: swarm.zig:414-420).
                     # Unlike the reference, "full" requires fetch-info
@@ -206,11 +275,45 @@ class XetBridge:
                         peer_result.data,
                     )
                     return XorbFetchResult(
-                        peer_result.data, local_start, local_end
+                        peer_result.data, local_start, local_end,
+                        source="peer", peer_addr=peer_result.addr,
                     )
-                # Malformed/short peer blob: never cache it; fall to CDN.
+                # Malformed/short/hash-mismatched peer blob: never cache
+                # it; attribute the strike and fall to CDN.
+                if peer_result.addr is not None:
+                    self.stats.bump("corrupt_from_peer")
+                    self.swarm.report_corrupt(peer_result.addr)
 
         # 3. CDN byte-range; cache everything for seeding.
+        return self._cdn_fetch_for_term(term, rec, fi, hash_hex)
+
+    def _peer_blob_verifies(self, term: recon.Term,
+                            rec: recon.Reconstruction, hash_hex: str,
+                            peer_result) -> bool:
+        """Content-verify a peer-served blob at the P2P trust boundary,
+        when provable: a blob that is (by fetch-info evidence) the whole
+        xorb must hash back to the xorb's merkle root. This catches
+        corrupt bytes BEFORE they are cached or extracted — crucial for
+        wire blobs, which are footerless frame streams carrying no
+        per-chunk hashes for extraction to check. (A blob that keeps a
+        forged footer consistent with the root is still caught at
+        extraction, where payloads verify against the footer hashes.)
+        Partial blobs can't be proven against the root here and stay
+        under the extraction-time checks."""
+        if not provably_whole(self._known_entries(rec, hash_hex),
+                              peer_result.chunk_offset):
+            return True
+        try:
+            return XorbReader(peer_result.data).xorb_hash() == term.xorb_hash
+        except Exception:
+            return False
+
+    def _cdn_fetch_for_term(self, term: recon.Term, rec: recon.Reconstruction,
+                            fi: recon.FetchInfo,
+                            hash_hex: str) -> XorbFetchResult:
+        """Tier 3, callable directly: the hedge racer and the corruption
+        self-heal both force it regardless of cache/peer state (the
+        cache write overwrites any poisoned key)."""
         if self.cas is None:
             raise NotAuthenticated("no CAS client and no peers had the xorb")
         data = self.cas.fetch_xorb_from_url(
@@ -224,7 +327,75 @@ class XetBridge:
             data,
             term.range.start - fi.range.start,
             term.range.end - fi.range.start,
+            source="cdn",
         )
+
+    def _peer_tier(self, term: recon.Term, rec: recon.Reconstruction,
+                   fi: recon.FetchInfo, hash_hex: str):
+        """The swarm attempt, hedged when a deadline is armed.
+
+        Returns the swarm's result (or None) in the common case. With a
+        deadline, the peer fetch runs in a side thread with a head start
+        of ``_HEDGE_PEER_FRACTION`` of the remaining budget (capped);
+        if it hasn't delivered by then, a CDN fetch races it from this
+        thread and the winner's :class:`XorbFetchResult` is returned —
+        no single slow peer can spend more of the budget than its
+        fraction."""
+        deadline = self.deadline
+        if deadline is None or self.cas is None:
+            return self.swarm.try_peer_download(
+                term.xorb_hash, hash_hex, fi.range.start, fi.range.end,
+                deadline=deadline,
+            )
+        remaining = deadline.remaining()
+        if remaining <= 0:
+            return None  # budget gone: tier 3 fails fast with its own check
+        wait_s = min(max(remaining * _HEDGE_PEER_FRACTION,
+                         _HEDGE_PEER_WAIT_FLOOR_S), _HEDGE_PEER_WAIT_CAP_S)
+        fut = self._ensure_hedge_pool().submit(
+            self.swarm.try_peer_download,
+            term.xorb_hash, hash_hex, fi.range.start, fi.range.end, deadline,
+        )
+        try:
+            # Swarm-internal failures (peer errors, timeouts) are already
+            # absorbed inside try_peer_download; an exception surfacing
+            # here is a real bug and must propagate exactly as it would
+            # on the unhedged path.
+            return fut.result(timeout=wait_s)
+        except FutureTimeoutError:
+            pass
+        # Peer still in flight with the deadline at risk: hedge to CDN.
+        self.stats.bump("hedges")
+        try:
+            result = self._cdn_fetch_for_term(term, rec, fi, hash_hex)
+        except Exception:
+            # The CDN racer failed; the in-flight peer fetch is the last
+            # hope — wait it out, bounded by the deadline.
+            self.stats.bump("hedges_lost")
+            try:
+                return fut.result(timeout=max(deadline.remaining(), 0.001))
+            except FutureTimeoutError:
+                return None
+        self.stats.bump("hedges_won")
+        # A STARTED straggler runs to completion (its result is dropped,
+        # its connection returns to the pool); a still-QUEUED one — the
+        # saturated-pool case — is cancelled so it never burns peer
+        # bandwidth on bytes the CDN already delivered.
+        fut.cancel()
+        return result
+
+    def _ensure_hedge_pool(self) -> ThreadPoolExecutor:
+        with self._hedge_lock:
+            if self._hedge_pool is None:
+                # Sized to the term-fetch concurrency: a smaller pool
+                # would queue hedged peer fetches behind each other, and
+                # a queued fetch that times out its head start counts as
+                # a hedge without the peer ever being tried.
+                width = max(4, getattr(self.cfg, "max_concurrent_downloads",
+                                       4))
+                self._hedge_pool = ThreadPoolExecutor(
+                    width, thread_name_prefix="zest-hedge")
+            return self._hedge_pool
 
     def fetch_unit(self, hash_hex: str, fi: recon.FetchInfo) -> bytes:
         """Raw blob for one fetch unit (a fetch_info chunk range) through
@@ -264,14 +435,22 @@ class XetBridge:
                 pass
             if xorb_hash is not None:
                 peer_result = self.swarm.try_peer_download(
-                    xorb_hash, hash_hex, fi.range.start, fi.range.end
+                    xorb_hash, hash_hex, fi.range.start, fi.range.end,
+                    deadline=self.deadline,
                 )
-                if peer_result is not None \
-                        and peer_result.chunk_offset == fi.range.start \
-                        and _blob_covers(peer_result.data, 0,
-                                         fi.range.end - fi.range.start):
-                    self.stats.record("peer", len(peer_result.data))
-                    return peer_result.data
+                if peer_result is not None:
+                    if peer_result.chunk_offset == fi.range.start \
+                            and _blob_covers(peer_result.data, 0,
+                                             fi.range.end - fi.range.start):
+                        self.stats.record("peer", len(peer_result.data))
+                        return peer_result.data
+                    if peer_result.chunk_offset == fi.range.start \
+                            and peer_result.addr is not None:
+                        # Right frame, bad bytes: structural failure is
+                        # attributable (an off-offset blob may just be a
+                        # differently-framed tier answer, not corruption).
+                        self.stats.bump("corrupt_from_peer")
+                        self.swarm.report_corrupt(peer_result.addr)
 
         if self.cas is None:
             raise NotAuthenticated("no CAS client and no peers had the xorb")
@@ -349,7 +528,29 @@ class XetBridge:
         return data
 
     def fetch_term(self, term: recon.Term, rec: recon.Reconstruction) -> bytes:
-        return self.extract_term(term, self.fetch_xorb_for_term(term, rec))
+        result = self.fetch_xorb_for_term(term, rec)
+        try:
+            return self.extract_term(term, result)
+        except Exception:
+            # Content-level corruption: the blob parsed structurally but
+            # BLAKE3/length verification failed at extraction. The old
+            # behavior let the bad blob sit in the cache (peer blobs are
+            # cached before extraction) and every retry refail. Now:
+            # attribute peer-served corruption to the serving peer (a
+            # strike toward quarantine), then force a CDN refetch that
+            # overwrites the poisoned cache key, and verify again.
+            if result.source == "cdn":
+                raise  # CDN bytes failing verification is not healable here
+            if result.peer_addr is not None and self.swarm is not None:
+                self.stats.bump("corrupt_from_peer")
+                self.swarm.report_corrupt(result.peer_addr)
+            fi = rec.find_fetch_info(term)
+            if fi is None or self.cas is None:
+                raise
+            healed = self._cdn_fetch_for_term(term, rec, fi, term.hash_hex)
+            data = self.extract_term(term, healed)
+            self.stats.bump("corrupt_healed")
+            return data
 
     def reconstruct_to_file(self, file_hash_hex: str, out_path) -> int:
         """Sequential fallback path (reference: xet_bridge.zig:231-264).

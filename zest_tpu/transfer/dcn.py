@@ -41,6 +41,7 @@ import struct
 import threading
 from dataclasses import dataclass
 
+from zest_tpu import faults
 from zest_tpu.cas import hashing
 from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
 from zest_tpu.config import Config
@@ -496,6 +497,10 @@ class DcnChannel:
     ) -> "_Waiter":
         """Fire one request; returns a waiter to collect later — callers
         batch N sends then collect N waits to pipeline."""
+        if faults.fire("dcn_reset",
+                       key=f"{self.address[0]}:{self.address[1]}"):
+            self.dead = True
+            raise ConnectionError("injected dcn_reset")
         if self.dead:
             raise ConnectionError("DCN channel is dead")
         with self._send_lock:
@@ -562,6 +567,14 @@ class DcnPool:
         self._lock = threading.Lock()
 
     def channel(self, host: str, port: int) -> DcnChannel:
+        return self._lease(host, port)[0]
+
+    def _lease(self, host: str, port: int) -> tuple[DcnChannel, bool]:
+        """``(channel, reused)``: whether the channel predates this call.
+        A reused channel can be silently stale — the server idle-closes
+        after IDLE_TIMEOUT_S and the reader may not have observed the
+        FIN yet — which is why :meth:`request_many` treats a reused
+        channel's failure as retryable and a fresh one's as real."""
         key = (host, port)
         with self._lock:
             ch = self._channels.get(key)
@@ -573,16 +586,39 @@ class DcnPool:
                 ch.close()
                 ch = None
         if ch is not None:
-            return ch
+            return ch, True
         ch = DcnChannel(host, port, timeout=self.timeout)
         with self._lock:
             # connect raced: keep the first live one, close ours
             existing = self._channels.get(key)
             if existing is not None and not existing.dead:
                 ch.close()
-                return existing
+                return existing, True
             self._channels[key] = ch
-            return ch
+            return ch, False
+
+    def request_many(
+        self, host: str, port: int, wants: list[tuple[bytes, int, int]]
+    ) -> list[DcnMessage]:
+        """Pipelined batch through a pooled channel, transparently
+        reconnecting and retrying ONCE when a previously pooled channel
+        turns out to be dead (the server's IDLE_TIMEOUT_S drop lands
+        exactly here: the pool believed the channel was live, the first
+        send/response proves otherwise). A *fresh* connection's failure
+        propagates — that's a real peer problem, not staleness."""
+        ch, reused = self._lease(host, port)
+        try:
+            return ch.request_many(wants)
+        except (ConnectionError, TimeoutError, OSError):
+            self.drop(host, port)
+            if not reused:
+                raise
+            ch, _ = self._lease(host, port)
+            try:
+                return ch.request_many(wants)
+            except (ConnectionError, TimeoutError, OSError):
+                self.drop(host, port)
+                raise
 
     def drop(self, host: str, port: int) -> None:
         with self._lock:

@@ -283,39 +283,24 @@ def federated_round(
         if not units:
             continue
         addr = pod_addrs.get(pod)
-
-        def connect():
-            if addr is None:
-                return None
-            try:
-                return pool.channel(*addr)
-            except (OSError, ConnectionError):
-                return None
-
-        channel = connect()
-        if channel is None:
+        if addr is None:
             fallback(units)
             continue
         i = 0
-        retried = False
         while i < len(units):
             window = units[i : i + pipeline_depth]
             missed = []
             try:
-                replies = channel.request_many([
+                # The pool transparently reconnects and retries a window
+                # once when a pooled channel was idle-closed (a stale
+                # channel after a long gap, a blip mid-transfer) — so a
+                # failure surfacing here is a hard one, and the pod's
+                # remaining units degrade to CDN.
+                replies = pool.request_many(*addr, [
                     (hashing.hex_to_hash(hh), fi.range.start, fi.range.end)
                     for hh, fi in window
                 ])
             except (ConnectionError, TimeoutError, OSError):
-                # One reconnect per pod: a transient failure (stale
-                # channel after a long idle gap, a blip mid-transfer)
-                # shouldn't push the pod's remaining gigabytes to CDN.
-                pool.drop(*addr)
-                if not retried:
-                    retried = True
-                    channel = connect()
-                    if channel is not None:
-                        continue  # retry the same window
                 fallback(units[i:])
                 break
             for (hash_hex, fi), reply in zip(window, replies):

@@ -394,6 +394,13 @@ def pull_model(
     if swarm is None and not no_p2p:
         swarm = _default_swarm(cfg)
     bridge = XetBridge(cfg, swarm=swarm)
+    # Per-pull wall-clock budget (ZEST_PULL_DEADLINE_S; off by default).
+    # Armed BEFORE authenticate() so the CAS client inherits it; the
+    # swarm receives it per call from the bridge.
+    from zest_tpu.resilience import Deadline
+
+    deadline = Deadline.after(getattr(cfg, "pull_deadline_s", None))
+    bridge.deadline = deadline
     width = max(1, getattr(cfg, "pull_pipeline_width", 1))
     # ONE term-fetch pool shared by every concurrent file reassembly:
     # total in-flight fetch streams stay at the configured budget no
@@ -532,7 +539,9 @@ def pull_model(
         # inside the pre-pass or landing) must not leak the pools or
         # leave queued downloads running unsupervised.
         file_pipeline.abort()
+        bridge.close()
         raise
+    bridge.close()  # release hedge threads (no-op unless a deadline hedged)
 
     storage.write_ref(cfg, repo_id, revision, commit_sha)
 
@@ -556,8 +565,16 @@ def pull_model(
         stats["federated"] = fed_stats
     if pod_stats is not None:
         stats["pod"] = pod_stats
+    if deadline is not None:
+        stats["deadline"] = {
+            "budget_s": deadline.total_s,
+            "remaining_s": round(max(0.0, deadline.remaining()), 3),
+        }
     if swarm is not None:
-        stats["swarm"] = swarm.stats.summary()
+        # SwarmDownloader.summary() folds in the health registry's view;
+        # injected test doubles may only carry bare stats.
+        stats["swarm"] = (swarm.summary() if hasattr(swarm, "summary")
+                          else swarm.stats.summary())
 
     if device == "tpu" and hbm_stats is None:
         # Disk fallback: direct landing was ineligible or failed; the

@@ -6,11 +6,20 @@ pool, discovery cache, and per-session stats. Discovery is pluggable
 discovered peers — DHT and tracker sources on the interop plane
 (zest_tpu.p2p.dht / .tracker), the JAX-coordinator registry on the pod
 plane (zest_tpu.parallel.coordinator). Discovery results are cached for
-30 s per swarm under a lock (reference: swarm.zig:320-355).
+30 s per swarm under a lock (reference: swarm.zig:320-355); an
+*all-sources-failed* round caches for only ~2 s, so one DHT blip can't
+blank peer discovery for a whole TTL.
 
-Failure semantics match the reference (swarm.zig:398-437): a connection
-error evicts the peer from the pool; CHUNK_NOT_FOUND keeps the connection
-(the peer is healthy, it just lacks this xorb).
+Failure semantics improve on the reference (swarm.zig:398-437), which
+forgot failures between calls: every candidate carries per-peer health
+(zest_tpu.p2p.health) — a latency EWMA orders candidates fast-first and
+drives adaptive connect/IO timeouts, while connect failures, IO
+timeouts, and corrupt-chunk attributions from the bridge each count a
+strike toward a quarantine circuit breaker. CHUNK_NOT_FOUND still keeps
+the connection (the peer is healthy, it just lacks this xorb), and an
+IO failure on a *reused* pooled socket gets one fresh-reconnect retry
+before the peer is blamed — the pool's eviction race and server-side
+idle closes both look exactly like that.
 """
 
 from __future__ import annotations
@@ -20,12 +29,18 @@ import time
 from dataclasses import dataclass, field
 from typing import Protocol
 
+from zest_tpu import faults
 from zest_tpu.config import Config
 from zest_tpu.p2p import peer_id as peer_id_mod
+from zest_tpu.p2p.health import HealthRegistry
 from zest_tpu.p2p.peer import ChunkNotFoundError, PeerError
 from zest_tpu.p2p.pool import PeerPool
 
 DISCOVERY_TTL_S = 30.0
+# An empty discovery round (all sources failed or no peers yet) is
+# renegotiated quickly: caching the blank list for the full TTL would
+# silence the peer tier for 30 s after one transient DHT/tracker blip.
+NEGATIVE_DISCOVERY_TTL_S = 2.0
 
 
 class PeerSource(Protocol):
@@ -43,6 +58,9 @@ class SwarmStats:
     peers_discovered: int = 0
     peer_attempts: int = 0
     peer_failures: int = 0
+    peer_retries: int = 0          # stale-pooled-socket reconnect retries
+    peers_quarantined: int = 0     # circuit-breaker trips
+    corrupt_from_peer: int = 0     # corruption attributions from the bridge
     chunks_from_peers: int = 0
     bytes_from_peers: int = 0
     announces: int = 0
@@ -57,6 +75,9 @@ class SwarmStats:
             "peers_discovered": self.peers_discovered,
             "peer_attempts": self.peer_attempts,
             "peer_failures": self.peer_failures,
+            "peer_retries": self.peer_retries,
+            "peers_quarantined": self.peers_quarantined,
+            "corrupt_from_peer": self.corrupt_from_peer,
             "chunks_from_peers": self.chunks_from_peers,
             "bytes_from_peers": self.bytes_from_peers,
             "announces": self.announces,
@@ -67,6 +88,9 @@ class SwarmStats:
 class PeerResult:
     data: bytes
     chunk_offset: int
+    # Which peer served the bytes — the bridge's corruption-attribution
+    # handle (a BLAKE3 mismatch at extraction strikes this address).
+    addr: tuple[str, int] | None = None
 
 
 class SwarmDownloader:
@@ -75,6 +99,7 @@ class SwarmDownloader:
         cfg: Config,
         peer_sources: list[PeerSource] | None = None,
         pool: PeerPool | None = None,
+        health: HealthRegistry | None = None,
     ):
         self.cfg = cfg
         self.peer_id = peer_id_mod.generate()
@@ -82,7 +107,10 @@ class SwarmDownloader:
         self.peer_sources = peer_sources or []
         self.direct_peers: list[tuple[str, int]] = []
         self.stats = SwarmStats()
-        self._discovery_cache: dict[bytes, tuple[float, list[tuple[str, int]]]] = {}
+        self.health = health or HealthRegistry()
+        self._discovery_cache: dict[
+            bytes, tuple[float, list[tuple[str, int]], float]
+        ] = {}
         self._discovery_lock = threading.Lock()
 
     def add_direct_peer(self, host: str, port: int) -> None:
@@ -94,13 +122,19 @@ class SwarmDownloader:
     def close(self) -> None:
         self.pool.close_all()
 
+    def summary(self) -> dict:
+        """Session stats plus the health registry's live view."""
+        out = self.stats.summary()
+        out["health"] = self.health.summary()
+        return out
+
     # ── Discovery (reference: swarm.zig:320-355) ──
 
     def discover_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
         now = time.monotonic()
         with self._discovery_lock:
             cached = self._discovery_cache.get(info_hash)
-            if cached is not None and now - cached[0] < DISCOVERY_TTL_S:
+            if cached is not None and now - cached[0] < cached[2]:
                 return cached[1]
 
         found: list[tuple[str, int]] = []
@@ -113,8 +147,9 @@ class SwarmDownloader:
                 continue  # a dead source must not break the waterfall
         self.stats.bump("peers_discovered", len(found))
 
+        ttl = DISCOVERY_TTL_S if found else NEGATIVE_DISCOVERY_TTL_S
         with self._discovery_lock:
-            self._discovery_cache[info_hash] = (now, found)
+            self._discovery_cache[info_hash] = (now, found, ttl)
         return found
 
     # ── Download (reference: swarm.zig:363-437) ──
@@ -125,9 +160,17 @@ class SwarmDownloader:
         hash_hex: str,
         range_start: int,
         range_end: int,
+        deadline=None,  # zest_tpu.resilience.Deadline | None
     ) -> PeerResult | None:
         """Fetch chunk range [range_start, range_end) of a xorb from the
-        swarm; None when no peer could serve it (bridge falls to CDN)."""
+        swarm; None when no peer could serve it (bridge falls to CDN).
+
+        Candidates are health-ordered (fast, clean peers first);
+        quarantined peers are skipped outright, so a peer that kept
+        timing out or serving corrupt bytes stops taxing every xorb.
+        ``deadline`` caps each attempt's connect/IO timeouts — when the
+        budget runs dry the remaining candidates are abandoned and the
+        caller's CDN tier takes over."""
         info_hash = peer_id_mod.compute_info_hash(xorb_hash)
         candidates = list(self.direct_peers)
         for addr in self.discover_peers(info_hash):
@@ -135,29 +178,106 @@ class SwarmDownloader:
                 candidates.append(addr)
         if not candidates:
             return None
+        ready, _shunned = self.health.partition(candidates)
 
-        for host, port in candidates:
+        for host, port in ready:
+            if deadline is not None and deadline.expired():
+                return None
             self.stats.bump("peer_attempts")
-            try:
-                peer = self.pool.get_or_connect(
-                    host, port, info_hash, self.peer_id,
-                    listen_port=self.cfg.listen_port,
-                )
-                result = peer.request_chunk(xorb_hash, range_start, range_end)
-            except ChunkNotFoundError:
-                # Peer healthy, xorb absent: keep the connection
-                # (swarm.zig:406-413).
-                self.stats.bump("peer_failures")
-                continue
-            except (PeerError, OSError) as _exc:
-                self.stats.bump("peer_failures")
-                self.pool.remove(host, port)
+            result = self._attempt(
+                host, port, info_hash, xorb_hash, range_start, range_end,
+                deadline,
+            )
+            if result is None:
                 continue
             self.stats.bump("chunks_from_peers")
             self.stats.bump("bytes_from_peers", len(result.data))
             self.announce_available(xorb_hash, hash_hex)
-            return PeerResult(result.data, result.chunk_offset)
+            return result
         return None
+
+    def _attempt(
+        self,
+        host: str,
+        port: int,
+        info_hash: bytes,
+        xorb_hash: bytes,
+        range_start: int,
+        range_end: int,
+        deadline,
+    ) -> PeerResult | None:
+        """One candidate, at most two tries: an IO failure on a REUSED
+        pooled connection earns a single fresh-reconnect retry (the
+        eviction race / server idle-close case — the socket was stale,
+        not the peer), then failures strike the peer's health."""
+        addr = (host, port)
+        for attempt in (0, 1):
+            reused = False
+            connect_s = None
+            starved = False
+            t_req = t0 = time.monotonic()
+            try:
+                connect_t = self.health.connect_timeout(addr)
+                io_t = self.health.io_timeout(addr)
+                if deadline is not None:
+                    capped_c, capped_io = (deadline.cap(connect_t),
+                                           deadline.cap(io_t))
+                    # A timeout the deadline squeezed below the health-
+                    # derived budget can fail for budget reasons alone.
+                    starved = capped_c < connect_t or capped_io < io_t
+                    connect_t, io_t = capped_c, capped_io
+                peer, reused = self.pool.lease(
+                    host, port, info_hash, self.peer_id,
+                    listen_port=self.cfg.listen_port,
+                    connect_timeout=connect_t, io_timeout=io_t,
+                )
+                t_req = time.monotonic()
+                if not reused:
+                    connect_s = t_req - t0
+                result = peer.request_chunk(xorb_hash, range_start, range_end,
+                                            io_timeout=io_t)
+            except ChunkNotFoundError:
+                # Peer healthy, xorb absent: keep the connection
+                # (swarm.zig:406-413); counts toward the latency EWMA.
+                self.stats.bump("peer_failures")
+                self.health.record_success(
+                    addr, rtt_s=time.monotonic() - t_req,
+                    connect_s=connect_s)
+                return None
+            except (PeerError, OSError) as _exc:
+                self.stats.bump("peer_failures")
+                self.pool.remove(host, port)
+                if reused and attempt == 0:
+                    # Stale pooled socket, not a peer verdict: exactly
+                    # one reconnect retry, no strike yet.
+                    self.stats.bump("peer_retries")
+                    continue
+                if starved:
+                    # The pull budget, not the peer, set this timeout:
+                    # quarantining a healthy peer over the deadline's
+                    # tail would poison the NEXT pull's candidate list.
+                    return None
+                if self.health.record_failure(addr):
+                    self.stats.bump("peers_quarantined")
+                return None
+            self.health.record_success(
+                addr, rtt_s=time.monotonic() - t_req, connect_s=connect_s)
+            data = result.data
+            if faults.fire("chunk_corrupt", key=f"{host}:{port}"):
+                data = faults.corrupt(data)
+            return PeerResult(data, result.chunk_offset, addr=addr)
+        return None
+
+    def report_corrupt(self, addr: tuple[str, int]) -> None:
+        """Corruption attribution from the bridge: the blob this peer
+        served failed structural or BLAKE3 verification. Drop the
+        connection and strike the peer — K strikes quarantine it, so a
+        corrupting peer's traffic shifts to healthy tiers instead of
+        poisoning every retry."""
+        self.stats.bump("corrupt_from_peer")
+        self.pool.remove(*addr)
+        if self.health.record_failure(addr, kind="corrupt"):
+            self.stats.bump("peers_quarantined")
 
     # ── Seeding announcements (reference: swarm.zig:458-470) ──
 
