@@ -38,6 +38,10 @@ TPU-native build"):
   time; achieved TFLOP/s and fraction of chip peak.
 - ``host_synthetics``— the host-side table directly comparable to the
   reference's published synthetic suite (blake3, LZ4, CDC, framing).
+- ``decode_batch``  — the ISSUE-3 batch decode engine: a realistic
+  frame stream through ``extract_range_into`` (native descriptor
+  batches), 1-core vs N-core GB/s, ``vs_ref`` against the r05
+  landing-decode 0.67 GB/s.
 - ``host_to_hbm``   — raw ``jax.device_put`` staging bandwidth swept to
   its asymptote (the upper bound for the commit stage).
 - ``decode``        — KV-cached decode tok/s, whole-scan dispatch.
@@ -432,6 +436,64 @@ def bench_host_synthetics() -> dict:
     return results
 
 
+def bench_decode_batch() -> dict:
+    """Host batch-decode synthetic (ISSUE 3 acceptance): a realistic
+    frame stream — mostly stored bf16-like chunks with a compressible
+    BG4/LZ4 tail — decoded through ``XorbReader.extract_range_into``
+    (i.e. the native descriptor-batch engine when built), 1-core vs
+    N-core. ``vs_ref`` divides by the r05 landing-decode figure
+    (0.67 GB/s, SCALING.md §2 — the single-scalar-core wall this engine
+    exists to break)."""
+    from zest_tpu.cas.xorb import XorbBuilder, XorbReader
+    from zest_tpu.models.direct import resolve_decode_workers
+
+    ref_gbps = 0.67
+    rng = np.random.default_rng(11)
+    builder = XorbBuilder()
+    chunk = 64 * 1024
+    n_chunks = 24 if _SMOKE else 512  # 32 MiB uncompressed at full size
+    for i in range(n_chunks):
+        if i % 8 == 7:
+            # Compressible planar-friendly chunk → BG4/LZ4 scheme.
+            base = np.repeat(
+                rng.integers(0, 256, chunk // 4, dtype=np.uint8), 4)
+            builder.add_chunk(bytes(base))
+        else:
+            # Incompressible (bf16 weights) → stored.
+            builder.add_chunk(
+                bytes(rng.integers(0, 256, chunk, dtype=np.uint8)))
+    blob = builder.serialize()
+    reader = XorbReader(blob)
+    total = builder.uncompressed_total
+    out = bytearray(total)
+    workers = resolve_decode_workers(None)
+    reps = 2 if _SMOKE else 8
+
+    def measure(w: int) -> float:
+        reader.extract_range_into(0, len(reader), out, workers=w)  # warm
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                reader.extract_range_into(0, len(reader), out, workers=w)
+            times.append((time.perf_counter() - t0) / reps)
+        return total / min(times) / 1e9
+
+    from zest_tpu.cas.compression import native_batch_available
+
+    gbps_1 = measure(1)
+    gbps_n = measure(workers) if workers > 1 else gbps_1
+    return {
+        "gbps_1core": round(gbps_1, 3),
+        "gbps_multicore": round(gbps_n, 3),
+        "workers": workers,
+        "bytes": total,
+        "native": native_batch_available(),
+        "vs_ref": round(gbps_n / ref_gbps, 2),
+        "ref_gbps": ref_gbps,
+    }
+
+
 def bench_pull_gb() -> dict:
     """End-to-end GB-scale pull: loopback hub → CAS client → verified
     cache → HBM, at real Llama-8B tensor geometry, three cold runs with
@@ -707,6 +769,7 @@ def child_main() -> None:
     # last.
     extras = [
         ("host_synthetics", bench_host_synthetics),
+        ("decode_batch", bench_decode_batch),
         ("mfu", bench_mfu),
         ("decode", bench_decode),
         ("host_to_hbm", bench_host_to_hbm),

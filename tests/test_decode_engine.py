@@ -1,0 +1,490 @@
+"""ISSUE 3 decode engine: native batch decode, columnar reader, fused
+Pallas decode→verify, and the satellites that rode along.
+
+Byte identity is the contract everywhere: the native engine, the pure-
+Python fallback, and the per-chunk legacy path must be indistinguishable
+to every caller — the engine is a performance tier, never a new trust
+model."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from zest_tpu.cas import compression as comp
+from zest_tpu.cas import hashing
+from zest_tpu.cas.compression import CompressionError, Scheme
+from zest_tpu.cas.xorb import XorbBuilder, XorbFormatError, XorbReader
+
+
+def _native_available() -> bool:
+    return comp.native_batch_available()
+
+
+def _chunk(rng, n, compressible=False):
+    if compressible:
+        return bytes(np.repeat(
+            rng.integers(0, 256, n // 4 + 1, dtype=np.uint8), 4)[:n])
+    return bytes(rng.integers(0, 256, n, dtype=np.uint8))
+
+
+# Odd tails matter: BG4 planes of a length-n chunk are (n-k+3)//4 bytes,
+# bitslice planes (n+7)//8 — every boundary case below exercises a
+# different tail shape.
+ODD_LENGTHS = (1, 2, 3, 5, 7, 17, 1001, 65537)
+
+
+class TestBatchDecodeIdentity:
+    """decode_batch_into: native vs pure Python, all schemes."""
+
+    def _cases(self):
+        rng = np.random.default_rng(7)
+        cases = []
+        for n in ODD_LENGTHS:
+            for compressible in (False, True):
+                data = _chunk(rng, n, compressible)
+                for scheme in Scheme:
+                    cases.append((data, scheme,
+                                  comp.compress(data, scheme)))
+        return cases
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_all_schemes_byte_identity(self, use_native):
+        if use_native and not _native_available():
+            pytest.skip("native lib unavailable")
+        cases = self._cases()
+        src = bytearray()
+        descs = []
+        pos = 0
+        for data, scheme, payload in cases:
+            descs.append((None, len(src), len(payload), int(scheme),
+                          pos, len(data)))
+            src += payload
+            pos += len(data)
+        src = bytes(src)
+        descs = [(src, *d[1:]) for d in descs]
+        out = bytearray(pos)
+        wrote = comp.decode_batch_into(descs, out, workers=3,
+                                       use_native=use_native)
+        assert wrote == pos
+        cursor = 0
+        for data, scheme, _payload in cases:
+            assert bytes(out[cursor:cursor + len(data)]) == data, \
+                (len(data), scheme, use_native)
+            cursor += len(data)
+
+    def test_empty_batch_is_a_noop(self):
+        assert comp.decode_batch_into([], bytearray(0)) == 0
+        assert comp.decode_columns_into([], bytearray(4)) == 0
+
+    def test_overlapping_dst_ranges_rejected(self):
+        payload = b"abcd"
+        descs = [(payload, 0, 4, int(Scheme.NONE), 0, 4),
+                 (payload, 0, 4, int(Scheme.NONE), 2, 4)]
+        with pytest.raises(CompressionError, match="overlap"):
+            comp.decode_batch_into(descs, bytearray(8))
+
+    def test_dst_out_of_bounds_rejected(self):
+        descs = [(b"abcd", 0, 4, int(Scheme.NONE), 6, 4)]
+        with pytest.raises(CompressionError):
+            comp.decode_batch_into(descs, bytearray(8))
+
+    def test_src_out_of_bounds_rejected(self):
+        descs = [(b"ab", 0, 4, int(Scheme.NONE), 0, 4)]
+        with pytest.raises(CompressionError):
+            comp.decode_batch_into(descs, bytearray(4), use_native=False)
+
+    def test_readonly_destination_rejected(self):
+        with pytest.raises(CompressionError, match="read-only"):
+            comp.decode_batch_into(
+                [(b"ab", 0, 2, int(Scheme.NONE), 0, 2)], b"\x00\x00")
+
+    def test_corrupt_payload_raises_precise_error(self):
+        # A malformed LZ4 frame must raise CompressionError through BOTH
+        # paths (the native engine falls back to the pure loop for the
+        # precise error).
+        descs = [(b"\xff" * 16, 0, 16, int(Scheme.LZ4), 0, 100)]
+        for use_native in (False, True):
+            if use_native and not _native_available():
+                continue
+            with pytest.raises(CompressionError):
+                comp.decode_batch_into(descs, bytearray(100),
+                                       use_native=use_native)
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_columnar_identity(self, use_native):
+        if use_native and not _native_available():
+            pytest.skip("native lib unavailable")
+        cases = self._cases()
+        src = bytearray()
+        rows = []
+        pos = 0
+        for data, scheme, payload in cases:
+            rows.append((len(src), len(payload), int(scheme), pos,
+                         len(data)))
+            src += payload
+            pos += len(data)
+        src = bytes(src)
+        group = (src,
+                 np.asarray([r[0] for r in rows], dtype=np.uint64),
+                 np.asarray([r[1] for r in rows], dtype=np.uint64),
+                 np.asarray([r[2] for r in rows], dtype=np.uint8),
+                 np.asarray([r[3] for r in rows], dtype=np.uint64),
+                 np.asarray([r[4] for r in rows], dtype=np.uint64))
+        out = bytearray(pos)
+        wrote = comp.decode_columns_into([group], out, workers=2,
+                                         use_native=use_native)
+        assert wrote == pos
+        cursor = 0
+        for data, _scheme, _payload in cases:
+            assert bytes(out[cursor:cursor + len(data)]) == data
+            cursor += len(data)
+
+    def test_columnar_overlap_rejected(self):
+        group = (b"abcdefgh",
+                 np.asarray([0, 0], dtype=np.uint64),
+                 np.asarray([4, 4], dtype=np.uint64),
+                 np.asarray([0, 0], dtype=np.uint8),
+                 np.asarray([0, 2], dtype=np.uint64),
+                 np.asarray([4, 4], dtype=np.uint64))
+        with pytest.raises(CompressionError, match="overlap"):
+            comp.decode_columns_into([group], bytearray(8))
+
+
+class TestReaderColumnarCore:
+    """XorbReader's columnar chunk table and range decode."""
+
+    def _build(self, n_chunks=9, seed=5):
+        rng = np.random.default_rng(seed)
+        b = XorbBuilder()
+        originals = []
+        for i in range(n_chunks):
+            data = _chunk(rng, 900 + 257 * i, compressible=i % 3 == 0)
+            b.add_chunk(data)
+            originals.append(data)
+        return b, originals
+
+    def test_extract_range_into_matches_extract_chunk_range(self):
+        b, originals = self._build()
+        reader = XorbReader(b.serialize())
+        want = b"".join(originals)
+        for workers in (1, 3):
+            out = bytearray(len(want))
+            n = reader.extract_range_into(0, len(reader), out,
+                                          workers=workers)
+            assert n == len(want)
+            assert bytes(out) == want
+        assert reader.extract_chunk_range(0, len(reader)) == want
+
+    def test_subrange_decode(self):
+        b, originals = self._build()
+        reader = XorbReader(b.serialize())
+        want = b"".join(originals[2:5])
+        out = bytearray(len(want))
+        reader.extract_range_into(2, 5, out)
+        assert bytes(out) == want
+
+    def test_entries_object_view_matches_columns(self):
+        b, _ = self._build()
+        reader = XorbReader(b.serialize())
+        entries = reader.entries
+        assert len(entries) == len(reader)
+        for i, e in enumerate(entries):
+            assert e.frame_offset == int(reader._frame_offs[i])
+            assert e.compressed_len == int(reader._comp_lens[i])
+            assert e.uncompressed_len == int(reader._unc_lens[i])
+            assert int(e.scheme) == int(reader._schemes[i])
+
+    def test_native_and_python_parse_agree(self):
+        if not _native_available():
+            pytest.skip("native lib unavailable")
+        from zest_tpu.cas.xorb import _parse_frames_py
+        from zest_tpu.native import lib
+
+        b, _ = self._build(n_chunks=17)
+        blob = b.serialize()
+        native_cols = lib.parse_frames(memoryview(blob), len(blob),
+                                       8 * 1024)
+        py_cols = _parse_frames_py(memoryview(blob), len(blob))
+        for a, c in zip(native_cols, py_cols):
+            assert np.array_equal(a, c)
+
+    def test_footer_blob_still_verifies_per_chunk(self):
+        b, originals = self._build(n_chunks=4)
+        full = bytearray(b.serialize_full())
+        reader = XorbReader(bytes(full))
+        assert reader.decode_columns(0, len(reader)) is None
+        out = bytearray(sum(len(o) for o in originals))
+        reader.extract_range_into(0, len(reader), out)
+        assert bytes(out) == b"".join(originals)
+        # Corrupt one payload byte: the footer-hash verify must fire.
+        payload_off = int(reader._frame_offs[1]) + 8
+        full[payload_off] ^= 0x01
+        bad = XorbReader(bytes(full))
+        with pytest.raises(XorbFormatError, match="hash mismatch"):
+            bad.extract_range_into(0, len(bad), out)
+
+    def test_hostile_stored_chunk_raises_format_error(self):
+        data = os.urandom(64)
+        frame = (bytes([0]) + len(data).to_bytes(3, "little")
+                 + bytes([0]) + (len(data) + 9).to_bytes(3, "little")
+                 + data)
+        reader = XorbReader(frame)
+        out = bytearray(len(data) + 9)
+        with pytest.raises(XorbFormatError, match="claims"):
+            reader.extract_range_into(0, 1, out)
+
+
+class TestCachedReaderBatchLane:
+    """The landing-side whole-read batch: entry-read amortization and
+    self-heal through the new lane."""
+
+    def _fixture(self, tmp_path):
+        from zest_tpu.cas import reconstruction as recon
+
+        rng = np.random.default_rng(11)
+        b = XorbBuilder()
+        data = b"".join(
+            _chunk(rng, 2048, compressible=i % 2 == 0) for i in range(16))
+        chunk_hashes = b.add_data(data)
+        blob = b.serialize()
+        xorb_hash = b.xorb_hash()
+        hash_hex = hashing.hash_to_hex(xorb_hash)
+        n = len(chunk_hashes)
+        offs = b.frame_offsets()
+        doc = {
+            "terms": [
+                {"hash": hash_hex, "unpacked_length": len(data),
+                 "range": {"start": 0, "end": n}},
+            ],
+            "fetch_info": {
+                hash_hex: [
+                    {"range": {"start": 0, "end": n},
+                     "url": "http://unused.invalid/x",
+                     "url_range": {"start": offs[0], "end": offs[-1] - 1}},
+                ]
+            },
+        }
+        rec = recon.from_json(hashing.hash_to_hex(
+            hashing.blake3_hash(data)), doc)
+        return rec, hash_hex, blob, data
+
+    class _CountingCache:
+        def __init__(self, blob, hash_hex):
+            self.blob = blob
+            self.hash_hex = hash_hex
+            self.reads = 0
+
+        def get_with_range(self, hash_hex, range_start):
+            from zest_tpu.storage import CacheResult
+
+            assert hash_hex == self.hash_hex
+            self.reads += 1
+            return CacheResult(self.blob, 0)
+
+    def test_whole_read_decodes_and_amortizes_entry_reads(self, tmp_path):
+        from zest_tpu.models.direct import CachedFileReader
+
+        rec, hash_hex, blob, data = self._fixture(tmp_path)
+        cache = self._CountingCache(blob, hash_hex)
+        reader = CachedFileReader(cache, rec, workers=2)
+        out = bytearray(len(data))
+        assert reader.read_into(0, len(data), out) == len(data)
+        assert bytes(out) == data
+        # One entry read total — not one per term/tensor read.
+        assert cache.reads == 1
+        out2 = bytearray(1000)
+        reader.read_into(512, 1512, out2)
+        assert bytes(out2) == data[512:1512]
+        assert cache.reads == 1
+
+    def test_corrupt_entry_falls_back_and_heals(self, tmp_path):
+        from zest_tpu.models.direct import CachedFileReader
+
+        rec, hash_hex, blob, data = self._fixture(tmp_path)
+        # Corrupt a compressed chunk's payload so the batch decode
+        # fails; the reader must fall back per term, refetch through the
+        # bridge, and still produce exact bytes.
+        bad = bytearray(blob)
+        bad[int(XorbReader(blob)._frame_offs[0]) + 8] ^= 0xFF
+        cache = self._CountingCache(bytes(bad), hash_hex)
+
+        class _Bridge:
+            fetched = 0
+
+            def fetch_term(self, term, rec):
+                _Bridge.fetched += 1
+                return data
+
+        reader = CachedFileReader(cache, rec, bridge=_Bridge(), workers=2)
+        out = bytearray(len(data))
+        reader.read_into(0, len(data), out)
+        assert bytes(out) == data
+        assert _Bridge.fetched == 1
+
+
+class TestFusedPallasDecodeVerify:
+    """BG4 regroup + BLAKE3 fused on device (interpret mode on CPU) vs
+    the host reference — the ISSUE 3 device-front acceptance test."""
+
+    def test_identity_vs_host_reference(self):
+        from zest_tpu.ops.decode_pallas import FusedBg4Verifier
+
+        rng = np.random.default_rng(3)
+        chunks = [
+            _chunk(rng, n, compressible=n % 2 == 0)
+            for n in (1, 2, 3, 5, 17, 1000, 1023, 1024, 1025, 2048, 3000)
+        ]
+        payloads = [comp._bg4(c) for c in chunks]
+        v = FusedBg4Verifier(hashing.CHUNK_KEY, interpret=True)
+        got = v.hash_planar_batch(payloads, [len(c) for c in chunks])
+        want = [hashing.chunk_hash(c) for c in chunks]
+        assert got == want
+
+    def test_pod_verify_uses_fused_lane_and_rejects_corruption(self):
+        from zest_tpu.ops import DeviceHasher, FusedBg4Verifier
+        from zest_tpu.transfer.pod import _device_verify_full_xorb
+
+        rng = np.random.default_rng(0)
+        b = XorbBuilder()
+        for i in range(3):
+            b.add_chunk(_chunk(rng, 3000 + 7 * i, compressible=True))
+        b.add_chunk(_chunk(rng, 5000))
+        blob = b.serialize()
+        assert any(int(s) == int(Scheme.BG4_LZ4)
+                   for s in XorbReader(blob)._schemes), \
+            "fixture lost its BG4 chunks"
+        hh = hashing.hash_to_hex(b.xorb_hash())
+        hasher = DeviceHasher(hashing.CHUNK_KEY)
+        fused = FusedBg4Verifier(hashing.CHUNK_KEY, interpret=True)
+        assert _device_verify_full_xorb(blob, hh, hasher, fused=fused)
+        bad = bytearray(blob)
+        bad[40] ^= 0x01
+        assert not _device_verify_full_xorb(bytes(bad), hh, hasher,
+                                            fused=fused)
+
+    def test_planar_length_mismatch_rejected(self):
+        from zest_tpu.ops.decode_pallas import FusedBg4Verifier
+
+        v = FusedBg4Verifier(interpret=True)
+        with pytest.raises(ValueError, match="planar"):
+            v.hash_planar_batch([b"abc"], [100])
+
+
+class TestSatellites:
+    def test_warm_summary_sums_only_allowlisted_counters(self):
+        from zest_tpu.transfer.pull import _PipelinedWarm
+
+        warm = _PipelinedWarm.__new__(_PipelinedWarm)
+        warm.threads = {0: object(), 1: object()}
+        warm.stats = [
+            {"units": 3, "bytes": 100, "failed": 0, "retried": 1,
+             "gbps": 1.5, "started_at": 1721212121.0},
+            {"units": 2, "bytes": 50, "failed": 1, "gbps": 2.5},
+        ]
+        out = warm.summary()
+        assert out["units"] == 5 and out["bytes"] == 150
+        assert out["failed"] == 1 and out["retried"] == 1
+        # Non-counter numerics are surfaced, never summed.
+        assert "gbps" not in out and "started_at" not in out
+        assert out["unsummed_keys"] == ["gbps", "started_at"]
+
+    def test_evidence_incomplete_forces_partial_cache_keys(self, tmp_path):
+        from zest_tpu.cas import reconstruction as recon
+        from zest_tpu.config import Config
+        from zest_tpu.transfer.bridge import XetBridge
+
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+        bridge = XetBridge(cfg)
+        hash_hex = "ab" * 32
+        rec = _evidence_rec(hash_hex)
+        entries = rec.fetch_info[hash_hex]
+        assert bridge.whole_xorb_provable(entries, 0)
+        bridge._cache_fetched(rec, hash_hex, 0, b"blob-bytes")
+        assert bridge.cache.get(hash_hex) == b"blob-bytes"
+
+        bridge2 = XetBridge(Config(hf_home=tmp_path / "hf2",
+                                   cache_dir=tmp_path / "zest2"))
+        bridge2.mark_evidence_incomplete()
+        assert not bridge2.whole_xorb_provable(entries, 0)
+        bridge2._cache_fetched(rec, hash_hex, 0, b"blob-bytes")
+        assert bridge2.cache.get(hash_hex) is None
+        assert bridge2.cache.get_with_range(hash_hex, 0).data \
+            == b"blob-bytes"
+
+
+def _evidence_rec(hash_hex):
+    from zest_tpu.cas import reconstruction as recon
+
+    return recon.from_json(
+        "cd" * 32,
+        {"terms": [{"hash": hash_hex, "unpacked_length": 10,
+                    "range": {"start": 0, "end": 4}}],
+         "fetch_info": {hash_hex: [
+             {"range": {"start": 0, "end": 4},
+              "url": "http://unused.invalid/x",
+              "url_range": {"start": 0, "end": 99}}]}},
+    )
+
+
+# ── Chaos: corruption attribution through the NEW decode path ──
+
+_RNG_BYTES = b"".join(
+    hashlib.blake2b(i.to_bytes(4, "little"), digest_size=64).digest()
+    for i in range(16384)
+)
+_FILES = {
+    "config.json": b'{"model_type": "chaos"}',
+    "model.safetensors": _RNG_BYTES,
+}
+
+
+@pytest.mark.chaos
+def test_chunk_corrupt_attribution_through_batch_decode(tmp_path):
+    """A peer serving flipped bytes, pulled through the rewired decode
+    path (columnar batch + mmap readers): corruption must still be
+    attributed to the serving peer and healed from CDN, with the final
+    bytes exact — proof the engine changed no trust boundary."""
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu import faults
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.pull import pull_model
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    repo = FixtureRepo("acme/decode-chaos", _FILES, chunks_per_xorb=1)
+    faults.reset()
+    with FixtureHub(repo) as hub:
+        def cfg_for(name):
+            return Config(hf_home=tmp_path / name / "hf",
+                          cache_dir=tmp_path / name / "zest",
+                          hf_token="hf_test", endpoint=hub.url,
+                          listen_port=0)
+
+        seed_cfg = cfg_for("seeder")
+        pull_model(seed_cfg, "acme/decode-chaos", no_p2p=True,
+                   log=lambda *a, **k: None)
+        server = BtServer(seed_cfg)
+        port = server.start()
+        try:
+            faults.install(f"chunk_corrupt:1.0@127.0.0.1:{port}",
+                           seed=1337)
+            cfg = cfg_for("leecher")
+            swarm = SwarmDownloader(cfg)
+            swarm.add_direct_peer("127.0.0.1", port)
+            try:
+                result = pull_model(cfg, "acme/decode-chaos", swarm=swarm,
+                                    log=lambda *a, **k: None)
+            finally:
+                swarm.close()
+        finally:
+            server.shutdown()
+            faults.reset()
+
+    for name, data in _FILES.items():
+        assert (result.snapshot_dir / name).read_bytes() == data
+    res = result.stats["fetch"]["resilience"]
+    assert result.stats["swarm"]["corrupt_from_peer"] >= 1
+    assert res["corrupt_from_peer"] >= 1
+    assert result.stats["fetch"]["bytes"]["cdn"] > 0

@@ -47,6 +47,8 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass
 
+import numpy as np
+
 from zest_tpu.cas import chunking, compression, hashing
 
 FRAME_HEADER_LEN = 8
@@ -223,6 +225,37 @@ def parse_footer(data: bytes | memoryview) -> tuple[int, bytes, list[bytes]]:
     return start, xorb_hash, hashes
 
 
+def _parse_frames_py(data: memoryview, frames_end: int):
+    """Pure-Python frame-table parse (the native fallback — and the
+    precise-error path when the native pass reports a malformed
+    stream). Returns the same columnar arrays as
+    ``native.lib.parse_frames``."""
+    offs, comps, uncs, schemes = [], [], [], []
+    pos = 0
+    while pos < frames_end:
+        if pos + FRAME_HEADER_LEN > frames_end:
+            raise XorbFormatError("truncated frame header")
+        if data[pos] != 0:
+            raise XorbFormatError(
+                f"unknown chunk frame version {data[pos]}"
+            )
+        compressed_len = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        end = pos + FRAME_HEADER_LEN + compressed_len
+        if end > frames_end:
+            raise XorbFormatError("frame payload extends past end")
+        if len(offs) >= MAX_CHUNKS:
+            raise XorbFormatError("too many chunks")
+        offs.append(pos)
+        comps.append(compressed_len)
+        uncs.append(int.from_bytes(data[pos + 5 : pos + 8], "little"))
+        schemes.append(data[pos + 4])
+        pos = end
+    return (np.asarray(offs, dtype=np.uint64),
+            np.asarray(comps, dtype=np.uint32),
+            np.asarray(uncs, dtype=np.uint32),
+            np.asarray(schemes, dtype=np.uint8))
+
+
 class XorbReader:
     """Parses a frame stream and extracts chunk ranges.
 
@@ -247,74 +280,89 @@ class XorbReader:
                 parse_footer(data)
         except XorbFormatError:
             pass
-        self.entries: list[ChunkEntry] = []
-        pos = 0
-        while pos < frames_end:
-            if pos + FRAME_HEADER_LEN > frames_end:
-                raise XorbFormatError("truncated frame header")
-            if data[pos] != 0:
+        self._data = data
+        self._footer_hashes = footer_hashes
+        # The chunk table is COLUMNAR (numpy arrays), parsed by one
+        # native pass when available: a GB-scale shard walks tens of
+        # thousands of frames, and the old per-chunk Python loop (plus
+        # a ChunkEntry object per frame) cost more than the decode it
+        # was setting up. ``entries`` materializes lazily for the
+        # object-shaped consumers.
+        cols = None
+        if frames_end:
+            native = compression._get_native()
+            if native is not None and hasattr(native, "parse_frames"):
+                cols = native.parse_frames(data, frames_end, MAX_CHUNKS)
+        if cols is None:
+            cols = _parse_frames_py(data, frames_end)
+        self._frame_offs, self._comp_lens, self._unc_lens, self._schemes \
+            = cols
+        self._n = len(self._frame_offs)
+        if self._n:
+            # Vectorized hostile-header checks (same contracts as the
+            # old per-chunk loop; the native parse validates structure
+            # only). Untrusted headers must not dictate allocations.
+            if int(self._schemes.max()) > int(max(compression.Scheme)):
+                bad = int(self._schemes.max())
+                raise XorbFormatError(f"unknown scheme {bad}")
+            if int(self._unc_lens.max()) > MAX_CHUNK_BYTES:
                 raise XorbFormatError(
-                    f"unknown chunk frame version {data[pos]}"
+                    f"chunk claims {int(self._unc_lens.max())} bytes "
+                    f"(cap {MAX_CHUNK_BYTES})"
                 )
-            compressed_len = int.from_bytes(data[pos + 1 : pos + 4], "little")
-            scheme_raw = data[pos + 4]
-            uncompressed_len = int.from_bytes(
-                data[pos + 5 : pos + 8], "little"
-            )
-            try:
-                scheme = compression.Scheme(scheme_raw)
-            except ValueError as exc:
-                raise XorbFormatError(f"unknown scheme {scheme_raw}") from exc
-            if uncompressed_len > MAX_CHUNK_BYTES:
-                # Untrusted header must not dictate our allocations.
-                raise XorbFormatError(
-                    f"chunk claims {uncompressed_len} bytes (cap "
-                    f"{MAX_CHUNK_BYTES})"
-                )
-            end = pos + FRAME_HEADER_LEN + compressed_len
-            if end > frames_end:
-                raise XorbFormatError("frame payload extends past end")
-            if len(self.entries) >= MAX_CHUNKS:
-                raise XorbFormatError("too many chunks")
-            i = len(self.entries)
-            h = footer_hashes[i] if footer_hashes and i < len(footer_hashes) \
-                else None
-            self.entries.append(
-                ChunkEntry(pos, compressed_len, uncompressed_len, scheme, h)
-            )
-            pos = end
-        if footer_hashes is not None and len(footer_hashes) != len(self.entries):
+        if footer_hashes is not None and len(footer_hashes) != self._n:
             raise XorbFormatError(
                 f"footer lists {len(footer_hashes)} chunks, "
-                f"frames hold {len(self.entries)}"
+                f"frames hold {self._n}"
             )
-        self._data = data
+        self._entries_cache: list[ChunkEntry] | None = None
+
+    @property
+    def entries(self) -> list[ChunkEntry]:
+        """Object view of the chunk table, built on first access (the
+        decode hot paths stay on the columnar arrays)."""
+        if self._entries_cache is None:
+            fh = self._footer_hashes
+            self._entries_cache = [
+                ChunkEntry(o, c, u, compression.Scheme(s),
+                           fh[i] if fh else None)
+                for i, (o, c, u, s) in enumerate(zip(
+                    self._frame_offs.tolist(), self._comp_lens.tolist(),
+                    self._unc_lens.tolist(), self._schemes.tolist()))
+            ]
+        return self._entries_cache
 
     def __len__(self) -> int:
-        return len(self.entries)
+        return self._n
 
     def chunk_hashes(self) -> list[tuple[bytes, int]]:
         """(hash, uncompressed length) per chunk — from the footer when
         present, else computed by decoding (the authoritative source)."""
+        fh = self._footer_hashes
+        sizes = self._unc_lens.tolist()
         out = []
-        for i, e in enumerate(self.entries):
-            h = e.hash if e.hash is not None else hashing.chunk_hash(
+        for i in range(self._n):
+            h = fh[i] if fh else hashing.chunk_hash(
                 self.extract_chunk(i, verify=False)
             )
-            out.append((h, e.uncompressed_len))
+            out.append((h, sizes[i]))
         return out
 
     def xorb_hash(self) -> bytes:
         return hashing.xorb_hash(self.chunk_hashes())
 
     def extract_chunk(self, index: int, verify: bool = True) -> bytes:
-        e = self.entries[index]
-        payload_start = e.frame_offset + FRAME_HEADER_LEN
+        payload_start = int(self._frame_offs[index]) + FRAME_HEADER_LEN
         payload = bytes(
-            self._data[payload_start : payload_start + e.compressed_len]
+            self._data[payload_start
+                       : payload_start + int(self._comp_lens[index])]
         )
-        data = compression.decompress(payload, e.scheme, e.uncompressed_len)
-        if verify and e.hash is not None and hashing.chunk_hash(data) != e.hash:
+        data = compression.decompress(
+            payload, compression.Scheme(int(self._schemes[index])),
+            int(self._unc_lens[index]),
+        )
+        h = self._footer_hashes[index] if self._footer_hashes else None
+        if verify and h is not None and hashing.chunk_hash(data) != h:
             raise XorbFormatError(f"chunk {index} hash mismatch")
         return data
 
@@ -328,63 +376,127 @@ class XorbReader:
             self.extract_chunk(i, verify=verify) for i in range(start, end)
         )
 
-    def extract_range_into(self, start: int, end: int, out) -> int:
+    @property
+    def chunk_sizes(self):
+        """Uncompressed chunk lengths as a numpy u32 column — the
+        object-free view for consumers that only need sizes (the
+        entries list costs a ChunkEntry per frame)."""
+        return self._unc_lens
+
+    @property
+    def chunk_schemes(self):
+        """Per-chunk compression.Scheme values as a numpy u8 column."""
+        return self._schemes
+
+    def decode_columns(self, start: int, end: int):
+        """Columnar batch-decode descriptors for chunks [start, end):
+        ``(src_offs u64, src_lens u64, schemes u8, dst_lens u64)`` numpy
+        views/arrays, payload offsets view-relative to this reader's
+        buffer — the zero-Python-per-chunk shape
+        ``compression.decode_columns_into`` consumes. Returns ``None``
+        when the blob carries footer hashes (those chunks must verify
+        through :meth:`extract_chunk`); raises the usual
+        :class:`XorbFormatError` for hostile stored-chunk frames."""
+        self._check_range(start, end)
+        if self._footer_hashes is not None:
+            return None
+        comp = self._comp_lens[start:end]
+        unc = self._unc_lens[start:end]
+        schemes = self._schemes[start:end]
+        bad = (schemes == int(compression.Scheme.NONE)) & (comp != unc)
+        if bad.any():
+            i = start + int(np.argmax(bad))
+            # Same contract as compression.decompress's stored path — a
+            # hostile frame must raise the module's error type, not a
+            # bare memoryview ValueError.
+            raise XorbFormatError(
+                f"stored chunk {i} claims {int(self._unc_lens[i])} "
+                f"bytes but frames {int(self._comp_lens[i])}"
+            )
+        src_offs = self._frame_offs[start:end] + np.uint64(FRAME_HEADER_LEN)
+        return (src_offs, comp.astype(np.uint64), schemes,
+                unc.astype(np.uint64))
+
+    def extract_range_into(self, start: int, end: int, out,
+                           workers: int = 1) -> int:
         """Decode chunks [start, end) directly into ``out`` (a writable
         buffer of exactly the range's uncompressed size); returns the
         byte count.
 
-        The GB-scale landing path decodes most bytes through here:
-        stored chunks (scheme NONE, the common case for incompressible
-        bf16 weights) copy frame→destination with no intermediate bytes
-        object, skipping the per-chunk allocation and the final join
-        that ``extract_chunk_range`` pays. Chunks that are compressed
-        or carry a footer hash take the verifying
+        The GB-scale landing path decodes most bytes through here. The
+        whole range is submitted as ONE columnar batch
+        (``compression.decode_columns_into``): with the native engine,
+        that is a single GIL-released call decoding every chunk — LZ4,
+        BG4, and stored alike — straight into ``out`` across ``workers``
+        native threads; without it, stored chunks still copy
+        frame→destination with no intermediate bytes object. Chunks
+        that carry a footer hash take the verifying
         :meth:`extract_chunk` path and are then copied in."""
         self._check_range(start, end)
         view = memoryview(out).cast("B")
-        total = sum(self.entries[i].uncompressed_len
-                    for i in range(start, end))
+        total = int(self._unc_lens[start:end].sum(dtype=np.uint64))
         if view.nbytes != total:
             raise XorbFormatError(
                 f"out buffer is {view.nbytes} bytes for a "
                 f"{total}-byte chunk range"
             )
+        cols = self.decode_columns(start, end)
+        if cols is not None:
+            src_offs, src_lens, schemes, dst_lens = cols
+            dst_offs = _exclusive_cumsum(dst_lens)
+            return compression.decode_columns_into(
+                [(self._data, src_offs, src_lens, schemes, dst_offs,
+                  dst_lens)],
+                view, workers=workers,
+            )
         pos = 0
         for i in range(start, end):
-            e = self.entries[i]
-            if e.scheme == compression.Scheme.NONE and e.hash is None:
-                if e.compressed_len != e.uncompressed_len:
-                    # Same contract as compression.decompress's stored
-                    # path — a hostile frame must raise the module's
-                    # error type, not a bare memoryview ValueError.
-                    raise XorbFormatError(
-                        f"stored chunk {i} claims {e.uncompressed_len} "
-                        f"bytes but frames {e.compressed_len}"
-                    )
-                p0 = e.frame_offset + FRAME_HEADER_LEN
-                view[pos:pos + e.uncompressed_len] = \
-                    self._data[p0:p0 + e.compressed_len]
-                pos += e.uncompressed_len
-            else:
-                data = self.extract_chunk(i)
-                view[pos:pos + len(data)] = data
-                pos += len(data)
+            data = self.extract_chunk(i)
+            view[pos:pos + len(data)] = data
+            pos += len(data)
         return pos
+
+    def extract_chunk_planar(self, index: int) -> bytes:
+        """A BG4 chunk's PLANAR bytes: the LZ4 frame decoded but the
+        byte-grouping inverse NOT applied — the staging form the fused
+        on-device decode→verify pass consumes (ops.decode_pallas): the
+        regroup happens on the accelerator, chained in front of the
+        BLAKE3 verify kernel, so the host never materializes the
+        interleaved bytes. For a stored BG4 frame this is a straight
+        payload slice — the wire bytes ARE the device input."""
+        scheme = compression.Scheme(int(self._schemes[index]))
+        if scheme != compression.Scheme.BG4_LZ4:
+            raise XorbFormatError(
+                f"chunk {index} is scheme {scheme!s}, not BG4"
+            )
+        p0 = int(self._frame_offs[index]) + FRAME_HEADER_LEN
+        payload = bytes(self._data[p0:p0 + int(self._comp_lens[index])])
+        return compression.lz4_frame_decompress(
+            payload, int(self._unc_lens[index]))
 
     def slice_range(self, start: int, end: int) -> bytes:
         """Raw frame bytes for chunks [start, end) — what a seeder sends on
         the wire and what lands in a partial cache entry."""
         self._check_range(start, end)
-        first = self.entries[start].frame_offset
-        last = self.entries[end - 1]
-        return bytes(self._data[first : last.frame_offset + last.frame_len])
+        first = int(self._frame_offs[start])
+        last_end = (int(self._frame_offs[end - 1]) + FRAME_HEADER_LEN
+                    + int(self._comp_lens[end - 1]))
+        return bytes(self._data[first:last_end])
 
     def _check_range(self, start: int, end: int) -> None:
-        if not (0 <= start < end <= len(self.entries)):
+        if not (0 <= start < end <= self._n):
             raise XorbFormatError(
                 f"chunk range [{start},{end}) out of bounds for "
-                f"{len(self.entries)} chunks"
+                f"{self._n} chunks"
             )
+
+
+def _exclusive_cumsum(lens) -> "np.ndarray":
+    out = np.empty(len(lens), dtype=np.uint64)
+    if len(lens):
+        out[0] = 0
+        np.cumsum(lens[:-1], dtype=np.uint64, out=out[1:])
+    return out
 
 
 def build_from_data(data: bytes) -> tuple[bytes, bytes, list[tuple[bytes, int]]]:

@@ -398,19 +398,25 @@ def cmd_stop(_args) -> int:
     cfg = Config.load()
     import requests
 
-    try:
-        r = requests.post(
-            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/stop",
-            timeout=5,
-        )
-        # Only a 2xx proves the daemon acknowledged: anything else may
-        # be a foreign service on a reused port — fall through to the
-        # pid-file kill rather than reporting success.
-        if r.ok:
-            print("stopped")
-            return 0
-    except requests.RequestException:
-        pass
+    # Health-check FIRST (ADVICE r5): with the http_port=0 convention a
+    # stale zest.http_port record can point at whatever foreign loopback
+    # service reused the port, and a blind POST /v1/stop would land on
+    # it. Only a responder answering the daemon's /v1/health JSON shape
+    # gets the stop POST; anything else falls to the pid-file kill.
+    if _daemon_get(cfg, "/v1/health", timeout=1.0) is not None:
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{cfg.effective_http_port()}/v1/stop",
+                timeout=5,
+            )
+            # Only a 2xx proves the daemon acknowledged: anything else
+            # may still be a foreign service — fall through to the
+            # pid-file kill rather than reporting success.
+            if r.ok:
+                print("stopped")
+                return 0
+        except requests.RequestException:
+            pass
     pid_file = _pid_file(cfg)
     if pid_file.exists():
         try:
@@ -445,7 +451,13 @@ def cmd_models(args) -> int:
     cfg = Config.load()
     payload = _daemon_get(cfg, "/v1/models")
     models = payload.get("models") if payload is not None else None
-    if not isinstance(models, list):
+    if not isinstance(models, list) or any(
+            not isinstance(m, dict) or not m.get("repo_id")
+            for m in models):
+        # Row-shape defense (ADVICE r5): an older/foreign daemon on a
+        # stale recorded port can pass the envelope checks yet key rows
+        # differently (the reference uses 'name') — scan the caches
+        # directly rather than KeyError-crashing the CLI.
         models = storage.list_models(cfg)
 
     xorbs = storage.list_cached_xorbs(cfg)
@@ -463,7 +475,7 @@ def cmd_models(args) -> int:
         print("no models pulled")
     for m in models:
         rev = (m.get("revision") or "?")[:12]
-        print(f"{m['repo_id']}  rev {rev}  {m.get('files', 0)} files")
+        print(f"{m.get('repo_id')}  rev {rev}  {m.get('files', 0)} files")
     print(f"xorb cache: {len(xorbs)} xorbs, {xorb_bytes / 1e6:.1f} MB")
     return 0
 

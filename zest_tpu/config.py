@@ -30,8 +30,16 @@ DEFAULT_HBM_STAGING_BYTES = 2 << 30  # per-device staging buffer budget
 # verification, and HBM commit overlap; see transfer.pull).
 DEFAULT_PULL_PIPELINE_WIDTH = 4     # concurrent file reassemblies
 DEFAULT_PULL_INFLIGHT_BYTES = 2 << 30  # in-flight reassembly byte budget
-DEFAULT_DECODE_WORKERS = 0          # term-decode pool; 0 = auto, 1 = serial
+# Decode parallelism (ZEST_DECODE_WORKERS): 0 = auto, 1 = serial. Sizes
+# BOTH the Python term-decode pool and the native batch-decode engine's
+# C++ worker pool (native/decode.cc) — one knob, whichever tier runs.
+DEFAULT_DECODE_WORKERS = 0
 DEFAULT_LAND_DECODE_AHEAD = 1       # shards decoded ahead of the commit
+# Decoded-blob reader cache (ZEST_DECODE_CACHE, bytes): the landing's
+# per-term cache-entry reads repeat heavily (a ~32 MB unit serves many
+# ~MB terms); a small parsed-reader LRU turns N whole-file reads per
+# unit into one. Sized to hold a few units; 0 disables.
+DEFAULT_DECODE_CACHE_BYTES = 192 * 1024 * 1024
 
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
 
@@ -106,6 +114,7 @@ class Config:
     pull_inflight_bytes: int = DEFAULT_PULL_INFLIGHT_BYTES
     decode_workers: int = DEFAULT_DECODE_WORKERS
     land_decode_ahead: int = DEFAULT_LAND_DECODE_AHEAD
+    decode_cache_bytes: int = DEFAULT_DECODE_CACHE_BYTES
     # Per-pull wall-clock budget in seconds (ZEST_PULL_DEADLINE_S;
     # None/0 = off). When armed, every tier's timeouts and retry sleeps
     # are capped by the remaining budget and the bridge hedges slow
@@ -162,6 +171,8 @@ class Config:
                 env.get("ZEST_DECODE_WORKERS", DEFAULT_DECODE_WORKERS))),
             land_decode_ahead=max(0, int(
                 env.get("ZEST_LAND_AHEAD", DEFAULT_LAND_DECODE_AHEAD))),
+            decode_cache_bytes=max(0, int(
+                env.get("ZEST_DECODE_CACHE", DEFAULT_DECODE_CACHE_BYTES))),
             pull_deadline_s=(
                 float(env["ZEST_PULL_DEADLINE_S"])
                 if float(env.get("ZEST_PULL_DEADLINE_S") or 0) > 0

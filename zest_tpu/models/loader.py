@@ -15,6 +15,7 @@ replicate.
 
 from __future__ import annotations
 
+import functools
 import re
 import time
 from pathlib import Path
@@ -122,7 +123,8 @@ def load_checkpoint(
             # peak at ~one safetensors file (the sharding contract) while
             # still amortizing the per-shape transfer setup; casting
             # lives in commit_tensors (one implementation, both paths).
-            out.update(commit_tensors(host, mesh, rules, dtype=dtype))
+            out.update(commit_tensors(host, mesh, rules, dtype=dtype,
+                                      donate=True))
     return out
 
 
@@ -144,11 +146,36 @@ def resolve_dtype(name: str | None):
         ) from None
 
 
+# Tensors below this size coalesce into one transfer per dtype (the
+# norm/bias vectors: a Llama shard carries dozens of KB-scale 1-D
+# weights whose per-buffer transfer setup costs more than their bytes).
+_COALESCE_MAX_BYTES = 256 * 1024
+# Minimum group size worth the on-device split dispatch.
+_COALESCE_MIN_TENSORS = 2
+
+
+@functools.lru_cache(maxsize=64)
+def _coalesced_split(bounds: tuple[int, ...],
+                     shapes: tuple[tuple[int, ...], ...]):
+    """Jitted flat-buffer → per-tensor views splitter, cached per layout
+    so a repeated commit geometry (every shard of one checkpoint) pays
+    one compile and ONE dispatch per group — not a slice round-trip per
+    tensor."""
+    def split(flat):
+        return tuple(
+            flat[bounds[i]:bounds[i + 1]].reshape(shapes[i])
+            for i in range(len(shapes))
+        )
+
+    return jax.jit(split)
+
+
 def commit_tensors(
     host: dict[str, np.ndarray],
     mesh: Mesh | None = None,
     rules: ShardRules | None = None,
     dtype=None,
+    donate: bool = False,
 ) -> dict[str, jax.Array]:
     """One BATCHED ``device_put`` for a whole tensor dict.
 
@@ -163,7 +190,22 @@ def commit_tensors(
     int/bool rather than matching np.floating because ml_dtypes
     extension types (the bf16 most modern checkpoints ship) are NOT
     np.floating subtypes. ``copy=False`` keeps the matched-dtype case
-    free (no doubled host peak)."""
+    free (no doubled host peak).
+
+    Two commit-side optimizations from ISSUE 3:
+
+    - **Small-tensor coalescing**: sub-``_COALESCE_MAX_BYTES`` tensors
+      that land replicated (the norm/bias vectors — sharded smalls keep
+      their own buffer, a concat would misalign the shard boundaries)
+      are concatenated per dtype into ONE transfer and split back on
+      device by a single jitted dispatch, so a shard's dozens of tiny
+      buffers stop paying per-buffer transfer setup.
+    - **Donation** (``donate=True``): callers that promise not to reuse
+      the staging buffers let the runtime alias/free inputs eagerly —
+      a no-op for host numpy staging, but device-resident inputs
+      (re-landing, resharding) release their source HBM immediately
+      instead of at the next GC.
+    """
     if dtype is not None:
         def cast(a):
             a = np.asarray(a)
@@ -173,16 +215,55 @@ def commit_tensors(
 
         host = {n: cast(a) for n, a in host.items()}
     names = list(host)
-    if mesh is None:
-        shardings = None
-        arrays = jax.device_put([host[n] for n in names])
+    specs = None
+    if mesh is not None:
+        specs = {n: spec_for(n, host[n].shape, mesh, rules) for n in names}
+
+    # Group coalescible names per dtype (order-preserving). Keyed by the
+    # np.dtype OBJECT, not its .str: ml_dtypes sub-byte types (uint4,
+    # float8_e8m0fnu, ...) all stringify as '<V1', and a string key
+    # would concat distinct dtypes into one group — DTypePromotionError
+    # at best, silently mis-typed split views at worst.
+    by_dtype: dict[np.dtype, list[str]] = {}
+    for n in names:
+        a = host[n]
+        if not 0 < a.nbytes < _COALESCE_MAX_BYTES:
+            continue
+        if specs is not None and specs[n] != P():
+            continue
+        by_dtype.setdefault(np.dtype(a.dtype), []).append(n)
+    groups = [g for g in by_dtype.values()
+              if len(g) >= _COALESCE_MIN_TENSORS]
+    grouped = {n for g in groups for n in g}
+
+    payloads, payload_shardings = [], []
+    singles = [n for n in names if n not in grouped]
+    for n in singles:
+        payloads.append(host[n])
+        payload_shardings.append(
+            None if specs is None else NamedSharding(mesh, specs[n]))
+    for g in groups:
+        flat = np.concatenate([np.ascontiguousarray(host[n]).reshape(-1)
+                               for n in g])
+        payloads.append(flat)
+        payload_shardings.append(
+            None if specs is None else NamedSharding(mesh, P()))
+
+    if specs is None:
+        arrays = jax.device_put(payloads, donate=donate)
     else:
-        shardings = [
-            NamedSharding(mesh, spec_for(n, host[n].shape, mesh, rules))
-            for n in names
-        ]
-        arrays = jax.device_put([host[n] for n in names], shardings)
-    return dict(zip(names, arrays))
+        arrays = jax.device_put(payloads, payload_shardings, donate=donate)
+
+    out = dict(zip(singles, arrays[:len(singles)]))
+    for g, flat_dev in zip(groups, arrays[len(singles):]):
+        bounds, shapes, off = [0], [], 0
+        for n in g:
+            off += int(np.prod(host[n].shape, dtype=np.int64))
+            bounds.append(off)
+            shapes.append(tuple(host[n].shape))
+        parts = _coalesced_split(tuple(bounds), tuple(shapes))(flat_dev)
+        out.update(zip(g, parts))
+    return {n: out[n] for n in names}  # caller-visible order preserved
 
 
 def _commit_stats(
@@ -233,6 +314,7 @@ def stage_cached_to_hbm(
     decode_ahead: int | None = None,
     decode_workers: int | None = None,
     on_host_ready=None,
+    clock=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -264,9 +346,13 @@ def stage_cached_to_hbm(
     without decoding the shard a second time. The callback may retain
     ``host``'s arrays (the commit never mutates them; a dtype cast
     copies) and may block, which backpressures the decode-ahead.
+    ``clock``, when given (a transfer.pull.StageClock), records each
+    shard's cache→host decode under stage ``"decode"`` with its bytes
+    attributed — the stage the ISSUE-3 engine is judged on.
     Returns ``(params, stats)`` like stage_snapshot_to_hbm, with
     ``stats["direct"] = True``.
     """
+    import contextlib
     from concurrent.futures import ThreadPoolExecutor
 
     from zest_tpu.models.direct import land_tensors
@@ -285,8 +371,13 @@ def stage_cached_to_hbm(
         if prefetch_next is not None:
             prefetch_next(i)
         rec, header = recs_with_headers[i]
-        host = land_tensors(bridge.cache, rec, header, bridge=bridge,
-                            workers=decode_workers)
+        with (clock("decode") if clock is not None
+              else contextlib.nullcontext()):
+            host = land_tensors(bridge.cache, rec, header, bridge=bridge,
+                                workers=decode_workers)
+        if clock is not None:
+            clock.note_bytes("decode",
+                             sum(int(a.nbytes) for a in host.values()))
         if on_host_ready is not None:
             on_host_ready(i, host)
         return host
@@ -308,12 +399,13 @@ def stage_cached_to_hbm(
                 # file-bounded host peak); async dispatch means this
                 # returns while the transfer is still draining.
                 params.update(commit_tensors(host, mesh, rules,
-                                             dtype=dtype))
+                                             dtype=dtype, donate=True))
                 del host
     else:
         for i in range(n):
             host = decode(i)
-            params.update(commit_tensors(host, mesh, rules, dtype=dtype))
+            params.update(commit_tensors(host, mesh, rules, dtype=dtype,
+                                         donate=True))
             del host
     for arr in params.values():
         arr.block_until_ready()

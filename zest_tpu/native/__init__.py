@@ -19,7 +19,7 @@ from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
 _BUILD_DIR = _HERE / "build"
-_SOURCES = ["blake3.cc", "gearhash.cc", "lz4.cc", "wire.cc"]
+_SOURCES = ["blake3.cc", "decode.cc", "gearhash.cc", "lz4.cc", "wire.cc"]
 
 _lock = threading.Lock()
 _dll: ctypes.CDLL | None = None
@@ -124,6 +124,24 @@ def _bind(dll: ctypes.CDLL) -> None:
             ctypes.c_uint8, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p,
         ]
         dll.zest_wire_frame_chunk_not_found.restype = ctypes.c_size_t
+        dll.zest_decode_batch.argtypes = [
+            ctypes.c_void_p,  # const uint8_t* const* srcs
+            ctypes.c_void_p,  # const uint64_t* src_lens
+            ctypes.c_void_p,  # const uint8_t* schemes
+            ctypes.c_void_p,  # const uint64_t* dst_offs
+            ctypes.c_void_p,  # const uint64_t* dst_lens
+            ctypes.c_uint64,  # n
+            ctypes.c_void_p,  # uint8_t* dst
+            ctypes.c_uint64,  # dst_cap
+            ctypes.c_uint64,  # workers
+        ]
+        dll.zest_decode_batch.restype = ctypes.c_size_t
+        dll.zest_parse_frames.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        dll.zest_parse_frames.restype = ctypes.c_size_t
 
 
 _gear_array = None
@@ -245,6 +263,54 @@ class lib:
             ext_id, req_id, chunk_hash, out
         )
         return out.raw[:n]
+
+    @staticmethod
+    def decode_batch(src_ptrs, src_lens, schemes, dst_offs, dst_lens,
+                     dst_ptr: int, dst_cap: int, workers: int) -> int:
+        """Decode N chunk payloads into a caller-owned buffer in ONE
+        GIL-released call (native/decode.cc): ``src_ptrs``/``src_lens``/
+        ``schemes``/``dst_offs``/``dst_lens`` are C-contiguous numpy
+        arrays (u64/u64/u8/u64/u64) of equal length, ``dst_ptr`` the
+        destination base address. Returns 0 on success, or ``i + 1`` for
+        the first failing descriptor (dst contents are then unspecified
+        — callers fall back to the pure path, which also produces the
+        precise error). Callers own every buffer's lifetime for the
+        duration of the call; validation (range bounds, overlap) lives
+        in cas.compression.decode_batch_into, the one entry point."""
+        dll = _load()
+        n = len(schemes)
+        if n == 0:
+            return 0
+        return dll.zest_decode_batch(
+            src_ptrs.ctypes.data, src_lens.ctypes.data, schemes.ctypes.data,
+            dst_offs.ctypes.data, dst_lens.ctypes.data, n,
+            dst_ptr, dst_cap, max(1, int(workers)),
+        )
+
+    @staticmethod
+    def parse_frames(buf, frames_end: int, max_chunks: int):
+        """Columnar frame-table parse of a xorb frame stream (one native
+        pass — no per-chunk Python): returns ``(frame_offs u64,
+        comp_lens u32, unc_lens u32, schemes u8)`` numpy arrays of the
+        chunk count, or None for a malformed stream (the caller's
+        pure-Python walk then produces the precise error)."""
+        import numpy as np
+
+        dll = _load()
+        src = np.frombuffer(buf, dtype=np.uint8)
+        cap = max(1, min(max_chunks, frames_end // 8 + 1))
+        frame_offs = np.empty(cap, dtype=np.uint64)
+        comp_lens = np.empty(cap, dtype=np.uint32)
+        unc_lens = np.empty(cap, dtype=np.uint32)
+        schemes = np.empty(cap, dtype=np.uint8)
+        n = dll.zest_parse_frames(
+            src.ctypes.data, frames_end, cap,
+            frame_offs.ctypes.data, comp_lens.ctypes.data,
+            unc_lens.ctypes.data, schemes.ctypes.data,
+        )
+        if n == ctypes.c_size_t(-1).value:
+            return None
+        return (frame_offs[:n], comp_lens[:n], unc_lens[:n], schemes[:n])
 
     @staticmethod
     def lz4_decompress(data: bytes, expected_len: int) -> bytes:

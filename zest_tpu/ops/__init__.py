@@ -10,6 +10,10 @@ from zest_tpu.ops.blake3 import (  # noqa: F401
     verify_chunks_device,
 )
 from zest_tpu.ops.blake3_pallas import PallasHasher  # noqa: F401
+from zest_tpu.ops.decode_pallas import (  # noqa: F401
+    FusedBg4Verifier,
+    fused_verifier_for_backend,
+)
 
 
 def best_hasher(key: bytes | None = None):

@@ -60,6 +60,11 @@ from zest_tpu.ops.blake3 import (
 
 _U32 = jnp.uint32
 
+# jax renamed TPUCompilerParams → CompilerParams around 0.4.3x/0.5;
+# resolve whichever this build ships so the kernel runs on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # Static per-round message schedules (word index per G-function input):
 # round r reads the identity permutation advanced r times. Baking the
 # schedule in lets the kernel index message words with *static* slices —
@@ -345,7 +350,7 @@ def _hash_pallas(words, lengths, key_words, base_flags, interpret):
             pltpu.VMEM((WORDS_PER_BLOCK, _TILE), _U32),  # deferred block
             pltpu.VMEM((2, _TILE), jnp.int32),     # deferred len/flags
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

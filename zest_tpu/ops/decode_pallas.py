@@ -1,0 +1,214 @@
+"""Fused on-device decode→verify: BG4 byte-plane regroup as a Pallas
+kernel, chained in front of the BLAKE3 verify kernel — the device front
+of ISSUE 3's decode engine.
+
+ByteGrouping4 (cas.compression, the dominant tensor-data scheme) stores
+a chunk as four byte planes — byte ``k`` of every 4-byte group,
+contiguously — because fp32/bf16 exponent bytes compress far better
+planar. The inverse transform (``out[4i+k] = plane_k[i]``) is a pure
+byte shuffle: exactly the kind of work the EQuARX argument (PAPERS.md)
+says belongs where the FLOPs are. With this kernel, a BG4 chunk's wire
+payload crosses PCIe in its *planar* (still-compressed-form) layout and
+is regrouped AND BLAKE3-verified in one fused device pass:
+
+- stored BG4 frames (incompressible tails): the wire payload IS the
+  device input — zero host transform, the bytes `device_put` as they
+  arrived;
+- LZ4-compressed BG4 frames: the host runs only the entropy stage
+  (native LZ4, GIL-released) to planar bytes; the regroup — the full
+  extra pass over every byte that `_bg4_inverse` used to burn host
+  time on — moves to the VPU.
+
+The regroup lowers as wide u32 lane ops, no gathers: the host stages
+each plane at a word-aligned slot (capacity/4), so output word ``w``
+is a static byte-pack of the four planes' word ``w//4`` — vectorized
+over 128 chunk lanes like the BLAKE3 kernel's layout
+(ops/blake3_pallas.py).
+
+On non-TPU backends the kernel runs in interpreter mode; the identity
+test against the host reference (tests/test_decode_engine.py) runs on
+``JAX_PLATFORMS=cpu`` exactly as for the BLAKE3 kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zest_tpu.cas.blake3 import CHUNK_LEN, IV, KEYED_HASH
+from zest_tpu.ops.blake3 import MAX_LEAVES, WORDS_PER_LEAF
+from zest_tpu.ops.blake3_pallas import _CompilerParams, _hash_pallas
+
+_U32 = jnp.uint32
+_TILE = 128          # chunk lanes per grid step (Mosaic lane width)
+_GROUP_WORDS = 256   # plane words per grid step (VMEM knob: ~2.5 MiB/step)
+
+
+def bg4_plane_sizes(n: int) -> tuple[int, int, int, int]:
+    """Byte count of each BG4 plane for an ``n``-byte chunk."""
+    return tuple((n - k + 3) // 4 for k in range(4))
+
+
+def _make_regroup_kernel(gw: int):
+    """Kernel over grid (batch_tile, word_group): block in is the four
+    planes' words (4, gw, T), block out the regrouped words (4·gw, T).
+    Output word ``w = 4g + s`` packs byte ``s`` of each plane's word
+    ``g`` — static shifts and masks only, no in-kernel gather."""
+
+    def kernel(a_ref, out_ref):
+        p = a_ref[:]                       # (4, gw, T) u32
+        parts = []
+        for s in range(4):
+            sh = 8 * s
+            b0 = (p[0] >> sh) & 0xFF
+            b1 = (p[1] >> sh) & 0xFF
+            b2 = (p[2] >> sh) & 0xFF
+            b3 = (p[3] >> sh) & 0xFF
+            parts.append(b0 | (b1 << 8) | (b2 << 16) | (b3 << 24))
+        out_ref[:] = jnp.stack(parts, axis=1).reshape(4 * gw, p.shape[2])
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _regroup_pallas(planar_words, interpret):
+    """(B, W) u32 planar words (plane k at word offset k·W/4) →
+    (B, W) u32 regrouped words."""
+    B, W = planar_words.shape
+    if W % 4:
+        raise ValueError("planar capacity must be a 16-byte multiple")
+    w4 = W // 4  # words per plane
+
+    pad_b = (-B) % _TILE
+    if pad_b:
+        planar_words = jnp.pad(planar_words, ((0, pad_b), (0, 0)))
+    Bp = B + pad_b
+
+    gw = min(_GROUP_WORDS, w4)
+    n_groups = pl.cdiv(w4, gw)
+    w4p = n_groups * gw
+    # Planes split into separate leading-axis rows BEFORE the kernel, so
+    # each grid step's block is a clean (4, gw, T) slab — padding the
+    # per-plane word count never shifts a plane's base offset.
+    planes = planar_words.reshape(Bp, 4, w4)
+    if w4p != w4:
+        planes = jnp.pad(planes, ((0, 0), (0, 0), (0, w4p - w4)))
+    a = planes.transpose(1, 2, 0)                     # (4, w4p, Bp)
+
+    out_t = pl.pallas_call(
+        _make_regroup_kernel(gw),
+        out_shape=jax.ShapeDtypeStruct((4 * w4p, Bp), _U32),
+        grid=(Bp // _TILE, n_groups),
+        in_specs=[
+            pl.BlockSpec((4, gw, _TILE), lambda i, g: (0, g, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((4 * gw, _TILE), lambda i, g: (g, i),
+                               memory_space=pltpu.VMEM),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(a)
+    return out_t.T[:B, : 4 * w4]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("key_words", "base_flags", "interpret")
+)
+def _fused_regroup_hash(planar_words, lengths, key_words, base_flags,
+                        interpret):
+    """The fused pass: BG4 regroup chained straight into the BLAKE3
+    verify kernel (ops.blake3_pallas._hash_pallas) inside one jit — the
+    interleaved bytes exist only on device."""
+    words = _regroup_pallas(planar_words, interpret)
+    return _hash_pallas(words, lengths.astype(jnp.int32), key_words,
+                        base_flags, interpret)
+
+
+class FusedBg4Verifier:
+    """Drop-in sibling of ops.blake3_pallas.PallasHasher whose input is
+    BG4 *planar* payloads: one call regroups and hashes on device."""
+
+    def __init__(self, key: bytes | None = None,
+                 interpret: bool | None = None):
+        if key is not None:
+            if len(key) != 32:
+                raise ValueError("key must be 32 bytes")
+            self.key_words = tuple(
+                int(w) for w in np.frombuffer(key, dtype="<u4")
+            )
+            self.base_flags = int(KEYED_HASH)
+        else:
+            self.key_words = tuple(int(w) for w in IV)
+            self.base_flags = 0
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+
+    @staticmethod
+    def stage_planar(payloads: list[bytes], lengths: list[int]):
+        """Planar payloads → (words, lengths) device-ready arrays: each
+        chunk's four planes land at word-aligned quarter-capacity slots
+        (the kernel's static layout), zero-padded — a few memcpys per
+        chunk, never a byte-level transform."""
+        if len(payloads) != len(lengths):
+            raise ValueError("payloads and lengths differ in count")
+        max_len = max(lengths) if lengths else 0
+        cap = max(
+            (max_len + CHUNK_LEN - 1) // CHUNK_LEN * CHUNK_LEN, CHUNK_LEN
+        )
+        if cap > MAX_LEAVES * CHUNK_LEN:
+            raise ValueError(
+                f"chunks larger than {MAX_LEAVES} KiB unsupported"
+            )
+        slot = cap // 4
+        buf = np.zeros((len(payloads), cap), dtype=np.uint8)
+        for i, (payload, n) in enumerate(zip(payloads, lengths)):
+            sizes = bg4_plane_sizes(n)
+            if len(payload) != sum(sizes):
+                raise ValueError(
+                    f"planar payload {i} is {len(payload)} bytes for a "
+                    f"{n}-byte chunk"
+                )
+            off = 0
+            for k, size_k in enumerate(sizes):
+                buf[i, k * slot : k * slot + size_k] = np.frombuffer(
+                    payload, dtype=np.uint8, count=size_k, offset=off
+                )
+                off += size_k
+        return (jnp.asarray(buf.view("<u4")),
+                jnp.asarray(np.asarray(lengths, dtype=np.int32)))
+
+    def hash_planar_device(self, words: jax.Array,
+                           lengths: jax.Array) -> jax.Array:
+        """(B, padded_words) u32 plane-slotted words + (B,) original
+        chunk lengths → (B, 8) u32 digests of the REGROUPED bytes."""
+        if words.shape[-1] % WORDS_PER_LEAF:
+            raise ValueError("padded capacity must be a 1 KiB multiple")
+        return _fused_regroup_hash(words, lengths, self.key_words,
+                                   self.base_flags, self.interpret)
+
+    def hash_planar_batch(self, payloads: list[bytes],
+                          lengths: list[int]) -> list[bytes]:
+        """Planar BG4 payloads → digests of the original chunk bytes,
+        without the host ever materializing those bytes."""
+        if not payloads:
+            return []
+        words, lens = self.stage_planar(payloads, lengths)
+        digests = np.asarray(self.hash_planar_device(words, lens))
+        return [d.astype("<u4").tobytes() for d in digests]
+
+
+def fused_verifier_for_backend(key: bytes | None = None):
+    """A FusedBg4Verifier on TPU (the fused path pays off exactly where
+    the VPU is), None elsewhere — production CPU keeps the host decode,
+    interpret mode being a test vehicle, not a fast path."""
+    if jax.default_backend() != "tpu":
+        return None
+    return FusedBg4Verifier(key)

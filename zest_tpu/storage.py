@@ -119,6 +119,27 @@ def read_chunk(cfg: Config, chunk_hash: bytes) -> bytes | None:
 # ── Xorb cache (reference: swarm.zig:57-148; LE-u64-hex keys) ──
 
 
+def _read_with_readahead(path: Path) -> bytes | None:
+    """Whole-file read with an aggressive readahead hint (the
+    madvise/fadvise WILLNEED from ISSUE 3): GB-scale warm-cache landings
+    read back tens of ~32 MB cache entries moments after the fetch wrote
+    them, and on a cold page cache each read stalls the decode pool on
+    demand page-in. WILLNEED starts the whole entry's page-in before the
+    copying read walks it, so the decode workers stream instead of
+    faulting."""
+    try:
+        with open(path, "rb") as f:
+            if hasattr(os, "posix_fadvise"):
+                try:
+                    os.posix_fadvise(f.fileno(), 0, 0,
+                                     os.POSIX_FADV_WILLNEED)
+                except OSError:
+                    pass  # advisory only; the read below still works
+            return f.read()
+    except OSError:
+        return None
+
+
 @dataclass(frozen=True)
 class CacheResult:
     """Range-aware lookup result: ``data`` is a serialized xorb whose chunk 0
@@ -147,10 +168,7 @@ class XorbCache:
         return self._path(hash_hex).exists()
 
     def get(self, hash_hex: str) -> bytes | None:
-        try:
-            return self._path(hash_hex).read_bytes()
-        except OSError:
-            return None
+        return _read_with_readahead(self._path(hash_hex))
 
     def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
         """Full xorb first (offset 0), then exact partial entry
@@ -159,6 +177,46 @@ class XorbCache:
         if data is not None:
             return CacheResult(data, 0)
         data = self.get(f"{hash_hex}.{range_start}")
+        if data is not None:
+            return CacheResult(data, range_start)
+        return None
+
+    def _get_mapped(self, key: str):
+        """Read-only mmap view of one entry (WILLNEED-advised), or None.
+
+        The decode engine reads cache entries through here: an mmap
+        view hands the decoder page-cache bytes directly — the whole-
+        file ``read()`` copy (a full extra memory pass per GB on the
+        landing path) disappears, and MADV_WILLNEED starts the entry's
+        page-in before the decode walks it. The map lives exactly as
+        long as the returned view (and anything sliced from it); the
+        atomic-rename write discipline means an overwritten entry's old
+        inode stays valid for existing maps."""
+        import mmap
+
+        try:
+            with open(self._path(key), "rb") as f:
+                size = os.fstat(f.fileno()).st_size
+                if size == 0:
+                    return memoryview(b"")
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (OSError, ValueError):
+            return None
+        try:
+            mm.madvise(mmap.MADV_WILLNEED)
+        except (AttributeError, OSError):
+            pass  # advisory only
+        return memoryview(mm)
+
+    def get_with_range_mapped(self, hash_hex: str,
+                              range_start: int) -> CacheResult | None:
+        """``get_with_range`` with mmap-backed ``data`` (see
+        :meth:`_get_mapped`); falls back to None exactly like the
+        copying lookup."""
+        data = self._get_mapped(hash_hex)
+        if data is not None:
+            return CacheResult(data, 0)
+        data = self._get_mapped(f"{hash_hex}.{range_start}")
         if data is not None:
             return CacheResult(data, range_start)
         return None

@@ -175,6 +175,15 @@ class XetBridge:
         # transfer.pull before any fetch; flows into the CAS client at
         # authenticate() and into the swarm per call.
         self.deadline = None
+        # Whole-xorb evidence integrity (ADVICE r5): ``provably_whole``
+        # judges "is this blob the complete xorb?" against every KNOWN
+        # reference — which is only sound while every reference is
+        # actually known. When a file's reconstruction fails to resolve
+        # (pull.py's best-effort aux-evidence loop), the pull marks the
+        # bridge and every cache write downgrades to a partial key: an
+        # evidence gap can then never cache a truncated blob under the
+        # full key that seeding advertises as the whole xorb.
+        self.evidence_incomplete = False
         self._recons: dict[str, recon.Reconstruction] = {}
         # Guards the reconstruction memo: the pipelined pull resolves
         # and fetches from several file workers at once, and an unlocked
@@ -473,12 +482,25 @@ class XetBridge:
         it = self.cas.fetch_xorb_iter(
             self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
         )
-        if full_key:
+        if full_key and not self.evidence_incomplete:
             n = self.cache.put_stream(hash_hex, it)
         else:
             n = self.cache.put_partial_stream(hash_hex, fi.range.start, it)
         self.stats.record("cdn", n)
         return n
+
+    def mark_evidence_incomplete(self) -> None:
+        """Record that some file's references could not be resolved:
+        from here on every cache write uses a partial key (see
+        ``evidence_incomplete`` in ``__init__``)."""
+        self.evidence_incomplete = True
+
+    def whole_xorb_provable(self, entries, chunk_offset: int) -> bool:
+        """``provably_whole`` gated on this bridge's evidence integrity
+        — the one predicate every cache-write site (here, federated's
+        ``_cache_unit``, pod's expert path) should consult."""
+        return (not self.evidence_incomplete
+                and provably_whole(entries, chunk_offset))
 
     def _cache_fetched(self, rec: recon.Reconstruction, hash_hex: str,
                        chunk_offset: int, data: bytes) -> None:
@@ -492,7 +514,8 @@ class XetBridge:
         chunk 0) while another file reads its later chunks — caching the
         truncated blob under the full key would shadow those partial
         entries and advertise an incomplete xorb as seedable."""
-        if provably_whole(self._known_entries(rec, hash_hex), chunk_offset):
+        if self.whole_xorb_provable(self._known_entries(rec, hash_hex),
+                                    chunk_offset):
             self.cache.put(hash_hex, data)
         else:
             self.cache.put_partial(hash_hex, chunk_offset, data)

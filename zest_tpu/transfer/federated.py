@@ -38,7 +38,6 @@ from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.hierarchy import owner_pod_host
 from zest_tpu.parallel.plan import collect_units
-from zest_tpu.transfer.bridge import provably_whole
 from zest_tpu.transfer.dcn import DcnPool, DcnResponse
 
 
@@ -97,10 +96,13 @@ def _entries_by_hash(recs: list[Reconstruction]) -> dict[str, list[FetchInfo]]:
 def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
                 chunk_offset: int, data: bytes) -> None:
     """Cache a fetched unit under the same full-vs-partial rule as the
-    bridge (_cache_fetched): full key only with whole-xorb evidence.
+    bridge (_cache_fetched): full key only with whole-xorb evidence —
+    including the bridge's evidence-integrity flag (a pull with
+    unresolved aux references forces partial keys everywhere).
     ``provably_whole`` dedupes ranges, so the same whole-xorb reference
     appearing in several files' fetch_info still counts as whole."""
-    if provably_whole(entries_map.get(hash_hex, []), chunk_offset):
+    if bridge.whole_xorb_provable(entries_map.get(hash_hex, []),
+                                  chunk_offset):
         bridge.cache.put(hash_hex, data)
     else:
         bridge.cache.put_partial(hash_hex, chunk_offset, data)
@@ -165,8 +167,8 @@ def warm_units_parallel(
             # the cache file — one full memory pass fewer than
             # fetch-then-put, which is worth ~15% of the whole fetch
             # stage at GB scale on one core.
-            full = provably_whole(entries_map.get(hash_hex, []),
-                                  fi.range.start)
+            full = bridge.whole_xorb_provable(entries_map.get(hash_hex, []),
+                                              fi.range.start)
             return bridge.stream_unit_from_cdn(hash_hex, fi, full)
         data = bridge.fetch_unit(hash_hex, fi)
         _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)

@@ -26,20 +26,44 @@ from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.collectives import PodDistributor
 from zest_tpu.parallel.mesh import num_slots, pod_mesh
 from zest_tpu.parallel.plan import DistributionPlan
-from zest_tpu.transfer.bridge import provably_whole
 
 
-def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher) -> bool:
+def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher,
+                             fused=None) -> bool:
     """Full-xorb integrity on the accelerator: decode frames, hash every
     chunk payload on device (keyed, chunk domain), Merkle-fold on host,
-    compare to the content address."""
+    compare to the content address.
+
+    With a ``fused`` verifier (ops.FusedBg4Verifier, TPU landings), BG4
+    chunks skip the host byte-regroup entirely: only the LZ4 entropy
+    stage runs host-side, the planar bytes ride PCIe, and the regroup +
+    BLAKE3 happen in one fused device pass — the host never
+    materializes the interleaved bytes of the dominant tensor-data
+    scheme."""
+    from zest_tpu.cas.compression import Scheme
+
     try:
         reader = XorbReader(data)
-        chunks = [
-            reader.extract_chunk(i, verify=False) for i in range(len(reader))
-        ]
-        digests = hasher.hash_batch(chunks)
-        leaves = [(d, len(c)) for d, c in zip(digests, chunks)]
+        n = len(reader)
+        digests: list[bytes | None] = [None] * n
+        # Columnar views, not reader.entries: verification runs per
+        # filled unit, and materializing a ChunkEntry per frame here
+        # would re-pay the per-chunk object cost the decode engine
+        # removed.
+        sizes = reader.chunk_sizes.tolist()
+        bg4 = [i for i, s in enumerate(reader.chunk_schemes.tolist())
+               if s == int(Scheme.BG4_LZ4)] if fused is not None else []
+        if bg4:
+            planar = [reader.extract_chunk_planar(i) for i in bg4]
+            for i, d in zip(bg4, fused.hash_planar_batch(
+                    planar, [sizes[i] for i in bg4])):
+                digests[i] = d
+        rest = [i for i in range(n) if digests[i] is None]
+        if rest:
+            chunks = [reader.extract_chunk(i, verify=False) for i in rest]
+            for i, d in zip(rest, hasher.hash_batch(chunks)):
+                digests[i] = d
+        leaves = list(zip(digests, sizes))
         return hashing.hash_to_hex(hashing.xorb_hash(leaves)) == hash_hex
     except Exception:
         # Any malformed peer-supplied blob — bad framing (XorbFormatError)
@@ -121,8 +145,8 @@ def expert_pod_round(
                 failed += 1
                 continue
             fi = a.fetch_info
-            if provably_whole(entries_map.get(a.hash_hex, []),
-                              fi.range.start):
+            if bridge.whole_xorb_provable(entries_map.get(a.hash_hex, []),
+                                          fi.range.start):
                 bridge.cache.put(a.hash_hex, data)
             else:
                 bridge.cache.put_partial(a.hash_hex, fi.range.start, data)
@@ -160,7 +184,7 @@ def pod_round(
     if not plan.assignments or n <= 1:
         return {"slots": n, "units": len(plan.assignments), "skipped": True}
 
-    from zest_tpu.ops import best_hasher
+    from zest_tpu.ops import best_hasher, fused_verifier_for_backend
     from zest_tpu.parallel.collectives import split_waves
 
     if budget_bytes is None:
@@ -173,6 +197,9 @@ def pod_round(
     # (XorbReader) — same trust boundary as the reference's cache writes
     # (swarm.zig:416-420).
     hasher = best_hasher(hashing.CHUNK_KEY)
+    # TPU only: BG4 chunks expand+verify in one fused device pass
+    # (ops.decode_pallas); None elsewhere keeps the host decode.
+    fused = fused_verifier_for_backend(hashing.CHUNK_KEY)
     filled = rejected = 0
     gather_s = fill_s = 0.0
     peak_pool = 0
@@ -186,7 +213,7 @@ def pod_round(
         f, r = pool.fill_cache(
             bridge.cache,
             verify=lambda hh, data: _device_verify_full_xorb(
-                data, hh, hasher
+                data, hh, hasher, fused=fused
             ),
         )
         filled += f

@@ -657,7 +657,13 @@ def _try_direct_stage(
             # to a xorb — a tokenizer packed into the tail of a shard's
             # xorb would otherwise get that xorb full-keyed truncated.
             # Best-effort: a miss here costs evidence (partial keys),
-            # never the landing.
+            # never the landing — but the gap must be RECORDED: with a
+            # file's references unresolved, "every known reference sees
+            # the whole xorb" is no longer provable for ANY xorb, so the
+            # bridge is flagged to force partial cache keys for the rest
+            # of the pull (ADVICE r5: an evidence gap could otherwise
+            # cache a truncated blob under the full key that seeding
+            # then advertises as the complete xorb).
             evidence_recs = [r for r, _h in recs_with_headers]
             for e in files:
                 if e.is_xet and not e.path.endswith(".safetensors"):
@@ -665,7 +671,7 @@ def _try_direct_stage(
                         evidence_recs.append(
                             bridge.get_reconstruction(e.xet_hash))
                     except Exception:  # noqa: BLE001
-                        pass
+                        bridge.mark_evidence_incomplete()
         # Whatever the distribution rounds didn't cache (single chip:
         # everything) arrives max_concurrent-wide, not term-by-term —
         # pipelined per shard: shard 0's fetch is the visible "fetch"
@@ -707,6 +713,7 @@ def _try_direct_stage(
                 dtype=dtype,
                 prefetch_next=pipeline.ensure,
                 on_host_ready=on_host_ready,
+                clock=clock,
             )
         warm = pipeline.summary()
         if warm["failed"] or warm.get("prefetch_errors"):
@@ -801,20 +808,34 @@ class _PipelinedWarm:
             t.join()
         self._spawn(i + 1)
 
+    # The per-shard counters summary() may sum. warm_units_parallel
+    # counters are ADDITIVE by contract; anything it reports outside
+    # this allowlist (a future rate, width, or timestamp) is surfaced
+    # under ``unsummed_keys`` instead of being silently added up as if
+    # it were a counter (ADVICE r5 — a summed timestamp would corrupt
+    # the pull telemetry without ever failing a test).
+    _COUNTER_KEYS = frozenset({"units", "bytes", "failed", "retried"})
+
     def summary(self) -> dict:
-        """Aggregate of the per-shard warm stats. Sums EVERY numeric
-        counter the fetcher reports (units/bytes/failed/retried/...), so
-        a new counter in warm_units_parallel can't silently vanish from
-        the pull's telemetry here."""
+        """Aggregate of the per-shard warm stats: the allowlisted
+        additive counters are summed; unknown numeric keys are listed,
+        not summed."""
         out = {"units": 0, "bytes": 0, "failed": 0,
                "pipelined_shards": len(self.threads)}
+        unsummed: set[str] = set()
         for s in self.stats:
             if s.get("prefetch_error"):
                 out["prefetch_errors"] = out.get("prefetch_errors", 0) + 1
             for k, v in s.items():
-                if k != "prefetch_error" and isinstance(v, (int, float)) \
-                        and not isinstance(v, bool):
+                if k == "prefetch_error" or not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    continue
+                if k in self._COUNTER_KEYS:
                     out[k] = out.get(k, 0) + v
+                else:
+                    unsummed.add(k)
+        if unsummed:
+            out["unsummed_keys"] = sorted(unsummed)
         return out
 
 
