@@ -1,0 +1,99 @@
+"""Telemetry rot guard: a synthetic pull with ``ZEST_TRACE`` on must
+produce a valid, non-trivial Chrome trace (ISSUE 4 CI satellite).
+
+Spins the in-process fixture hub with a 64 MiB safetensors payload,
+runs a CDN-only pull with the span tracer armed, then fails loudly if
+the exported trace is empty, malformed, or covers less than 90% of the
+pull's wall time — the acceptance bar. Silent telemetry regressions
+(a span() call site dropped, export format broken, the env knob dead)
+all land here instead of in a fleet dashboard weeks later.
+
+Usage: python scripts/trace_smoke.py [--size BYTES] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64 * 1024 * 1024,
+                    help="safetensors payload bytes (default 64 MiB)")
+    ap.add_argument("--out", default=None,
+                    help="trace path (default: tempdir/trace.json)")
+    args = ap.parse_args()
+
+    work = Path(tempfile.mkdtemp(prefix="zest-trace-smoke-"))
+    trace_path = Path(args.out) if args.out else work / "trace.json"
+    # The satellite's contract is the ENV knob, not the API: arm the
+    # tracer exactly the way an operator would.
+    os.environ["ZEST_TRACE"] = str(trace_path)
+    os.environ.pop("ZEST_TELEMETRY", None)
+
+    from zest_tpu import telemetry
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.pull import pull_model
+    from fixtures import FixtureHub, FixtureRepo
+
+    files = {
+        "config.json": b'{"model_type": "smoke"}',
+        "model.safetensors": os.urandom(args.size),
+    }
+    repo = FixtureRepo("acme/trace-smoke", files, chunks_per_xorb=4)
+    with FixtureHub(repo) as hub:
+        cfg = Config(hf_home=work / "hf", cache_dir=work / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+        result = pull_model(cfg, "acme/trace-smoke", no_p2p=True)
+
+    tracer = telemetry.trace.active()
+    if tracer is None:
+        print("FAIL: ZEST_TRACE did not arm the tracer", file=sys.stderr)
+        return 1
+    telemetry.trace.export(trace_path)  # atexit would too; validate now
+
+    try:
+        doc = json.loads(trace_path.read_text())
+    except (OSError, ValueError) as exc:
+        print(f"FAIL: trace unreadable/malformed: {exc}", file=sys.stderr)
+        return 1
+    events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    problems = []
+    if not events:
+        problems.append("trace has no spans")
+    names = {e.get("name", "") for e in events}
+    if "pull" not in names:
+        problems.append("no root 'pull' span")
+    if not any(n.startswith("stage.") for n in names):
+        problems.append("no stage.* spans")
+    for e in events:
+        if not (isinstance(e.get("ts"), (int, float))
+                and isinstance(e.get("dur"), (int, float))
+                and e.get("dur") >= 0):
+            problems.append(f"malformed event: {e}")
+            break
+    elapsed = result.stats["elapsed_s"]
+    coverage = tracer.coverage_s()
+    if coverage < 0.9 * elapsed:
+        problems.append(
+            f"span coverage {coverage:.2f}s < 90% of {elapsed:.2f}s wall")
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"OK: {len(events)} spans, coverage {coverage:.2f}s / "
+          f"{elapsed:.2f}s wall, {result.stats['fetch']['bytes']['cdn']} "
+          f"CDN bytes -> {trace_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

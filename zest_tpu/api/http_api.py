@@ -22,7 +22,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from zest_tpu import storage
+from zest_tpu import faults, storage, telemetry
 from zest_tpu.config import Config
 from zest_tpu.version import __version__
 
@@ -46,6 +46,13 @@ class HttpApi:
         self.swarm = swarm
         self.dcn_server = dcn_server
         self.http_requests = 0
+        # Live-state metrics: event counters mirror at bump time, but
+        # occupancy/quarantine are *states*, so they register a
+        # scrape-time collector closed over the live objects. Removed in
+        # close() — tests build many HttpApi instances per process and a
+        # leaked collector would pin each one (and double-report gauges).
+        self._collector = self._collect_gauges
+        telemetry.REGISTRY.add_collector(self._collector)
         self.shutdown_event = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
         # snapshot_dir → (model_type, generate); see _generator_for.
@@ -86,10 +93,37 @@ class HttpApi:
             self.bt_server.shutdown()
 
     def close(self) -> None:
+        telemetry.REGISTRY.remove_collector(self._collector)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+    def _collect_gauges(self, registry) -> None:
+        """Scrape-time gauges from the live objects this daemon owns."""
+        if self.hbm_cache is not None:
+            h = self.hbm_cache.summary()
+            registry.gauge(
+                "zest_hbm_cache_used_bytes",
+                "HBM staging-cache occupancy").set(h["used_bytes"])
+            registry.gauge(
+                "zest_hbm_cache_entries",
+                "HBM staging-cache entry count").set(h["entries"])
+        if self.swarm is not None:
+            health = getattr(self.swarm, "health", None)
+            if health is not None:
+                s = health.summary()
+                registry.gauge(
+                    "zest_peers_tracked",
+                    "Peers with recorded health").set(s["tracked"])
+                registry.gauge(
+                    "zest_peers_quarantined",
+                    "Peers currently quarantined").set(s["quarantined_now"])
+        if self.bt_server is not None:
+            bt = self.bt_server.get_stats()
+            registry.gauge(
+                "zest_bt_active_peers",
+                "Active inbound BT-wire connections").set(bt.active_peers)
 
     @property
     def port(self) -> int:
@@ -127,7 +161,21 @@ class HttpApi:
         if self.cfg.mesh.mesh_axes:
             payload["mesh_axes"] = self.cfg.mesh.mesh_axes
         if self.swarm is not None:
-            payload["swarm"] = self.swarm.stats.summary()
+            # summary() folds in the health registry's aggregate view;
+            # injected doubles may only carry bare stats.
+            payload["swarm"] = (
+                self.swarm.summary() if hasattr(self.swarm, "summary")
+                else self.swarm.stats.summary())
+            health = getattr(self.swarm, "health", None)
+            if health is not None and hasattr(health, "detail"):
+                # Per-peer EWMA latency / strikes / quarantine windows:
+                # the circuit-breaker decisions used to be invisible
+                # outside the process (ISSUE 4 satellite).
+                payload["peers"] = health.detail()
+        payload["telemetry"] = telemetry.status_snapshot()
+        fired = faults.counters()
+        if fired:
+            payload["faults"] = dict(sorted(fired.items()))
         return payload
 
     def models_payload(self) -> dict:
@@ -417,6 +465,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"status": "ok"})
         elif self.path == "/v1/status":
             self._json(self.api.status_payload())
+        elif self.path == "/v1/metrics":
+            # Prometheus text exposition format (0.0.4) — the scrape
+            # surface fleet collection points at.
+            body = telemetry.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/v1/models":
             self._json(self.api.models_payload())
         elif self.path == "/":

@@ -23,9 +23,15 @@ import threading
 
 import requests
 
-from zest_tpu import faults
+from zest_tpu import faults, telemetry
 from zest_tpu.cas import reconstruction as recon
 from zest_tpu.resilience import Backoff, Deadline, DeadlineExceeded
+
+
+def _span_url(url: str) -> str:
+    """Trace-safe URL: scheme+host+path only — presigned CDN URLs carry
+    auth in the query string, which must never land in a trace file."""
+    return url.split("?", 1)[0]
 
 
 class CasError(RuntimeError):
@@ -134,6 +140,10 @@ class CasClient:
 
     def get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
         """GET /v1/reconstructions/{hex} -> terms + fetch_info."""
+        with telemetry.span("cas.reconstruction", file=file_hash_hex):
+            return self._get_reconstruction(file_hash_hex)
+
+    def _get_reconstruction(self, file_hash_hex: str) -> recon.Reconstruction:
         url = f"{self.cas_url}/v1/reconstructions/{file_hash_hex}"
         backoff = Backoff(self.backoff_base_s, _BACKOFF_CAP_S)
         attempt = 0
@@ -194,6 +204,13 @@ class CasClient:
         from byte N (the GET is idempotent and ranged), so a multi-GB
         unit doesn't restart from zero on a mid-stream reset — and the
         consumer sees one uninterrupted byte stream either way."""
+        with telemetry.span("cdn.get", url=_span_url(url)) as sp:
+            for chunk in self._fetch_xorb_iter_inner(url, byte_range):
+                sp.add_bytes(len(chunk))
+                yield chunk
+
+    def _fetch_xorb_iter_inner(self, url: str,
+                               byte_range: tuple[int, int] | None = None):
         if byte_range is not None:
             start, end = byte_range
             if not (0 <= start < end):

@@ -441,6 +441,81 @@ def cmd_status(_args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """Process-wide metrics from the daemon's registry: ``GET
+    /v1/metrics`` verbatim (Prometheus text — pipe it anywhere a scraper
+    would), or the ``/v1/status`` telemetry/faults/peer-health blocks
+    with ``--json``."""
+    cfg = Config.load()
+    if args.json:
+        payload = _daemon_get(cfg, "/v1/status")
+        if payload is None:
+            print("daemon not running", file=sys.stderr)
+            return 1
+        keep = {k: payload[k] for k in
+                ("telemetry", "faults", "swarm", "peers", "hbm", "dcn")
+                if k in payload}
+        print(json.dumps(keep, indent=2))
+        return 0
+    try:
+        import requests
+    except ImportError:
+        print("error: `zest stats` needs the requests package",
+              file=sys.stderr)
+        return 1
+    try:
+        r = requests.get(
+            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/metrics",
+            timeout=2.0,
+        )
+        r.raise_for_status()
+    except requests.RequestException:
+        print("daemon not running", file=sys.stderr)
+        return 1
+    sys.stdout.write(r.text)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Pull with the span tracer armed and write a Chrome/Perfetto
+    trace — the measurement tool of record for per-stage attribution
+    (open the JSON at ui.perfetto.dev or chrome://tracing). Equivalent
+    to ``ZEST_TRACE=out.json zest pull ...`` but also prints the span
+    count and wall-coverage so scripts can gate on a healthy trace."""
+    cfg = Config.load()
+    try:
+        cfg.model_cache_dir(args.repo)  # repo-id syntax, pre-network
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    from zest_tpu import telemetry
+    from zest_tpu.telemetry import trace as trace_mod
+    from zest_tpu.transfer.pull import pull_model
+
+    # The command IS the opt-in: a ZEST_TELEMETRY=0 environment must not
+    # silently turn an explicitly requested trace into 0 events.
+    telemetry.set_enabled(True)
+    tracer = trace_mod.install(None)  # explicit export below, not atexit
+    t0 = time.monotonic()
+    failed = None
+    try:
+        res = pull_model(cfg, args.repo, revision=args.revision,
+                         device=args.device, no_p2p=args.no_p2p)
+    except Exception as exc:  # noqa: BLE001 - trace of a failed pull is
+        failed = exc          # exactly what the operator wants to see
+    elapsed = time.monotonic() - t0
+    n = tracer.export(args.out)
+    cov = tracer.coverage_s()
+    print(f"trace: {args.out} ({n} events, spans cover {cov:.2f}s "
+          f"of {elapsed:.2f}s wall)")
+    print("view:  https://ui.perfetto.dev or chrome://tracing")
+    if failed is not None:
+        print(f"error: pull failed: {failed}", file=sys.stderr)
+        return 1
+    print(f"✓ {args.repo} -> {res.snapshot_dir}")
+    return 0
+
+
 def cmd_models(args) -> int:
     """Cache introspection: pulled models + xorb cache totals. Asks the
     daemon (/v1/models) when one is running — same payload the dashboard
@@ -580,6 +655,24 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("stop", help="stop the daemon").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="print daemon status") \
         .set_defaults(fn=cmd_status)
+    stats_p = sub.add_parser(
+        "stats", help="print the daemon's metrics (Prometheus text)")
+    stats_p.add_argument("--json", action="store_true",
+                         help="telemetry/faults/peer-health blocks from "
+                              "/v1/status as JSON instead")
+    stats_p.set_defaults(fn=cmd_stats)
+
+    trace_p = sub.add_parser(
+        "trace", help="pull with the span tracer on; write a Chrome trace")
+    trace_p.add_argument("repo")
+    trace_p.add_argument("--revision", default="main")
+    trace_p.add_argument("--device", choices=["tpu"], default=None)
+    trace_p.add_argument("--out", default="zest-trace.json",
+                         metavar="PATH",
+                         help="trace file (default zest-trace.json); "
+                              "view at ui.perfetto.dev")
+    trace_p.add_argument("--no-p2p", action="store_true")
+    trace_p.set_defaults(fn=cmd_trace)
     models_p = sub.add_parser(
         "models", help="list pulled models and xorb cache totals")
     models_p.add_argument("--json", action="store_true")

@@ -15,6 +15,8 @@ import os
 import re
 from pathlib import Path
 
+from zest_tpu.telemetry.state import _OFF_VALUES as _TELEMETRY_OFF_VALUES
+
 # ── Compiled defaults (reference: src/config.zig:6-19) ──
 DEFAULT_LISTEN_PORT = 6881          # BT/seed listener + DHT UDP port
 DEFAULT_HTTP_PORT = 9847            # localhost REST control plane
@@ -127,6 +129,13 @@ class Config:
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
     # halves HBM and transfer bytes). Resolved by models.loader.
     land_dtype: str | None = None
+    # Telemetry (zest_tpu.telemetry): the observability layer reads the
+    # env directly on its hot paths (ZEST_TELEMETRY gates everything,
+    # ZEST_TRACE=path arms the span tracer); these fields are the
+    # introspection mirror — what /v1/status and `zest status` report
+    # as this process' configuration.
+    telemetry_enabled: bool = True
+    trace_path: str | None = None
 
     # ── Construction ──
 
@@ -180,6 +189,12 @@ class Config:
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,
+            # Same off-value set the hot-path gate uses (state._OFF_VALUES)
+            # — a divergent inline copy would make this introspection
+            # field lie about what the gate actually does.
+            telemetry_enabled=env.get("ZEST_TELEMETRY", "").strip().lower()
+            not in _TELEMETRY_OFF_VALUES,
+            trace_path=env.get("ZEST_TRACE") or None,
         )
 
     # ── Path builders (reference: src/config.zig:95-133) ──

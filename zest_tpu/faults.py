@@ -47,8 +47,17 @@ import os
 import threading
 import time
 
+from zest_tpu import telemetry
+
 ENV_SPEC = "ZEST_FAULTS"
 ENV_SEED = "ZEST_FAULTS_SEED"
+
+# Fired-fault counts also land in the process metrics registry, so a
+# chaos run can assert "the fault actually fired" from /v1/metrics (or
+# stats["faults"]) instead of inferring it from downstream effects.
+_M_FIRED = telemetry.counter(
+    "zest_faults_fired_total", "Injected faults fired, by fault name",
+    ("fault",))
 
 
 class FaultSpecError(ValueError):
@@ -148,6 +157,7 @@ class FaultInjector:
             return None
         with self._lock:
             self.fired[name] = self.fired.get(name, 0) + 1
+        _M_FIRED.inc(fault=name)
         return spec
 
     def counters(self) -> dict[str, int]:

@@ -21,10 +21,15 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
+from zest_tpu import telemetry
 from zest_tpu.cas import compression, reconstruction as recon
 from zest_tpu.cas.xorb import XorbReader, _exclusive_cumsum
 from zest_tpu.config import DEFAULT_DECODE_CACHE_BYTES
 from zest_tpu.models.safetensors_io import SafetensorsHeader
+
+_M_READER_EVENTS = telemetry.counter(
+    "zest_decode_reader_cache_events_total",
+    "Parsed-reader LRU events on the landing decode path", ("event",))
 
 
 class DirectLandingError(RuntimeError):
@@ -123,7 +128,10 @@ class CachedFileReader:
             hit = self._readers.get(key)
             if hit is not None:
                 self._readers.move_to_end(key)
-                return hit[0], hit[1]
+        if hit is not None:
+            _M_READER_EVENTS.inc(event="hit")
+            return hit[0], hit[1]
+        _M_READER_EVENTS.inc(event="miss")
         # mmap-backed entry when the cache offers it: the decoder then
         # consumes page-cache bytes in place — no whole-file read()
         # copy — with readahead hinted ahead of the decode walk.
@@ -445,6 +453,15 @@ def land_tensors(
     """
     import numpy as np
 
+    with telemetry.span("land.decode", file=rec.file_hash.hex(),
+                        tensors=len(header.tensors)) as _sp:
+        out = _land_tensors_inner(cache, rec, header, predicate, bridge,
+                                  workers, np)
+        _sp.add_bytes(sum(int(a.nbytes) for a in out.values()))
+        return out
+
+
+def _land_tensors_inner(cache, rec, header, predicate, bridge, workers, np):
     reader = CachedFileReader(cache, rec, bridge=bridge, workers=workers)
     out: dict[str, np.ndarray] = {}
     if predicate is None and header.tensors:

@@ -24,7 +24,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from zest_tpu import telemetry
 from zest_tpu.models.safetensors_io import SafetensorsFile
+
+_M_COMMIT_BYTES = telemetry.counter(
+    "zest_hbm_commit_bytes_total", "Bytes committed host→HBM")
+_M_COMMIT_TENSORS = telemetry.counter(
+    "zest_hbm_commit_tensors_total", "Tensors committed host→HBM")
 
 ShardRules = list[tuple[str, P]]
 
@@ -206,6 +212,23 @@ def commit_tensors(
       (re-landing, resharding) release their source HBM immediately
       instead of at the next GC.
     """
+    # .nbytes, never np.asarray: inputs may be device-resident (the
+    # resharding path) and asarray would round-trip them through host.
+    nbytes = sum(int(getattr(a, "nbytes", 0)) for a in host.values())
+    with telemetry.span("hbm.commit", tensors=len(host), bytes=nbytes):
+        out = _commit_tensors(host, mesh, rules, dtype, donate)
+    _M_COMMIT_BYTES.inc(nbytes)
+    _M_COMMIT_TENSORS.inc(len(host))
+    return out
+
+
+def _commit_tensors(
+    host: dict[str, np.ndarray],
+    mesh: Mesh | None = None,
+    rules: ShardRules | None = None,
+    dtype=None,
+    donate: bool = False,
+) -> dict[str, jax.Array]:
     if dtype is not None:
         def cast(a):
             a = np.asarray(a)

@@ -29,7 +29,18 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from zest_tpu import telemetry
+
 Addr = tuple[str, int]
+
+# Event mirrors into the process registry: strikes and breaker trips are
+# fleet-attribution signals ("which host keeps quarantining peers"), so
+# they must outlive the swarm session that counted them.
+_M_STRIKES = telemetry.counter(
+    "zest_peer_strikes_total", "Peer health strikes, by failure kind",
+    ("kind",))
+_M_QUARANTINES = telemetry.counter(
+    "zest_peer_quarantines_total", "Peer circuit-breaker trips")
 
 DEFAULT_STRIKES_TO_QUARANTINE = 3
 DEFAULT_QUARANTINE_BASE_S = 15.0
@@ -114,6 +125,7 @@ class HealthRegistry:
             if kind == "corrupt":
                 p.corruptions += 1
             p.strikes += 1
+            _M_STRIKES.inc(kind=kind)
             if p.strikes < self.strikes_to_quarantine:
                 return False
             p.quarantines += 1
@@ -126,6 +138,7 @@ class HealthRegistry:
             # (with the doubled window); a success clears it.
             p.strikes = self.strikes_to_quarantine - 1
             self.quarantine_events += 1
+            _M_QUARANTINES.inc()
             return True
 
     # ── Queries ──
@@ -198,3 +211,31 @@ class HealthRegistry:
                     p.corruptions for p in self._peers.values()
                 ),
             }
+
+    def detail(self) -> list[dict]:
+        """Per-peer health rows for ``/v1/status`` / ``zest status`` —
+        quarantine decisions used to be invisible outside the process;
+        this is the operator's view of why a peer is being avoided.
+        ``quarantined_for_s`` is the remaining window (0 = not
+        quarantined), reported relative so the payload is meaningful to
+        a reader without this process' monotonic clock."""
+        now = self._time()
+        with self._lock:
+            rows = []
+            for (host, port), p in sorted(self._peers.items()):
+                rows.append({
+                    "peer": f"{host}:{port}",
+                    "ewma_rtt_ms": (None if p.ewma_rtt_s is None
+                                    else round(p.ewma_rtt_s * 1e3, 2)),
+                    "ewma_connect_ms": (
+                        None if p.ewma_connect_s is None
+                        else round(p.ewma_connect_s * 1e3, 2)),
+                    "strikes": p.strikes,
+                    "successes": p.successes,
+                    "failures": p.failures,
+                    "corruptions": p.corruptions,
+                    "quarantines": p.quarantines,
+                    "quarantined_for_s": round(
+                        max(0.0, p.quarantined_until - now), 2),
+                })
+            return rows

@@ -21,7 +21,12 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from zest_tpu import telemetry
 from zest_tpu.storage import CacheResult
+
+_M_EVENTS = telemetry.counter(
+    "zest_hbm_cache_events_total",
+    "HBM staging-cache events (hit/miss/eviction)", ("event",))
 
 
 @dataclass
@@ -74,6 +79,7 @@ class HbmStagingCache:
                 _, evicted = self._entries.popitem(last=False)
                 self._used -= evicted.nbytes
                 self.evictions += 1
+                _M_EVENTS.inc(event="eviction")
             self._entries[key] = HbmEntry(arr, chunk_offset)
             self._used += len(data)
 
@@ -101,7 +107,8 @@ class HbmStagingCache:
                 self.hits += 1
             else:
                 self.misses += 1
-            return entry
+        _M_EVENTS.inc(event="hit" if entry is not None else "miss")
+        return entry
 
     def get_device(self, hash_hex: str, range_start: int = 0) -> HbmEntry | None:
         """Device-resident lookup — the input to collectives/ops paths."""

@@ -1,0 +1,88 @@
+"""zest_tpu.telemetry — process-wide observability for the pull path.
+
+Three pieces, zero dependencies, all thread-safe:
+
+- **Spans** (:mod:`.trace`): ``with telemetry.span("swarm.fetch",
+  xorb=h) as sp: ... sp.add_bytes(n)`` — nested wall-clock spans that
+  serialize to Chrome/Perfetto ``trace_event`` JSON. Armed by
+  ``ZEST_TRACE=path`` (written at exit) or ``zest trace``.
+- **Metrics** (:mod:`.metrics`): counters/gauges/histograms with label
+  sets in one process registry; the per-session stats objects
+  (``FetchStats``, ``SwarmStats``, fault counters, cache hit/miss ints)
+  mirror into it, and live state registers scrape-time collectors.
+  Exported as Prometheus text on the daemon's ``GET /v1/metrics`` and
+  summarized in ``/v1/status`` / ``zest stats``.
+- **The switch** (:mod:`.state`): ``ZEST_TELEMETRY=0`` turns the whole
+  layer into flag checks; tracing additionally requires ``ZEST_TRACE``.
+
+Import discipline: this package imports nothing from the rest of
+``zest_tpu``, so every hot-path module can use it without cycles.
+"""
+
+from zest_tpu.telemetry.metrics import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    render_prometheus,
+    sum_allowlisted,
+)
+from zest_tpu.telemetry.state import enabled, set_enabled  # noqa: F401
+from zest_tpu.telemetry.trace import (  # noqa: F401
+    NULL_SPAN,
+    Span,
+    Tracer,
+    span,
+)
+from zest_tpu.telemetry import state as _state
+from zest_tpu.telemetry import trace as trace  # noqa: PLC0414
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+    "counter",
+    "enabled",
+    "gauge",
+    "histogram",
+    "render_prometheus",
+    "reset_all",
+    "set_enabled",
+    "span",
+    "status_snapshot",
+    "sum_allowlisted",
+    "trace",
+]
+
+
+def status_snapshot() -> dict:
+    """The ``telemetry`` block for ``/v1/status``: is the layer on, is a
+    trace armed, and how much has been recorded."""
+    tracer = trace.active()
+    doc: dict = {
+        "enabled": enabled(),
+        "trace_active": tracer is not None,
+        "metrics": len(REGISTRY.metrics()),
+    }
+    if tracer is not None:
+        doc["trace_path"] = trace.trace_path()
+        doc["spans"] = len(tracer)
+    return doc
+
+
+def reset_all() -> None:
+    """Tests: unresolve the enable flag, drop the tracer, clear metrics."""
+    _state.reset()
+    trace.reset()
+    REGISTRY.reset()

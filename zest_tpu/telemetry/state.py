@@ -1,0 +1,51 @@
+"""Process-wide telemetry on/off switch (``ZEST_TELEMETRY``).
+
+One flag gates every telemetry surface — span recording, metric
+mirroring, trace export — so the knob-off contract is checkable at a
+single point: with ``ZEST_TELEMETRY=0`` the hot path pays one module
+load and one ``if`` per call site, nothing else (same zero-cost
+discipline as :mod:`zest_tpu.faults`).
+
+Default is ON: the metrics registry is a handful of dict bumps per
+fetch (micro-benched far under the 1%% pull budget), and a daemon that
+starts with telemetry off can never answer ``/v1/metrics`` usefully.
+Tracing has its own opt-in (``ZEST_TRACE=path``) because it accumulates
+per-span records for the life of the pull.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_TELEMETRY = "ZEST_TELEMETRY"
+
+_OFF_VALUES = frozenset({"0", "false", "off", "no"})
+
+_lock = threading.Lock()
+_enabled: bool | None = None  # None = not yet resolved from env
+
+
+def enabled() -> bool:
+    """The hot-path gate: one global load in the common (resolved) case."""
+    global _enabled
+    on = _enabled
+    if on is not None:
+        return on
+    with _lock:
+        if _enabled is None:
+            raw = os.environ.get(ENV_TELEMETRY, "").strip().lower()
+            _enabled = raw not in _OFF_VALUES
+        return _enabled
+
+
+def set_enabled(on: bool | None) -> None:
+    """Test/CLI override; ``None`` returns to env resolution."""
+    global _enabled
+    with _lock:
+        _enabled = on
+
+
+def reset() -> None:
+    """Back to unresolved: the next ``enabled()`` re-reads the env."""
+    set_enabled(None)

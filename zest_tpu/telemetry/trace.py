@@ -1,0 +1,305 @@
+"""Span tracer: nested wall-clock spans → Chrome/Perfetto trace JSON.
+
+The pull path is a pipeline of overlapping stages across many threads
+(file workers, the landing's staging thread, hedge racers, warm-fetch
+lookahead) — exactly the shape ``stats["stages"]`` scalars flatten away
+and a trace viewer renders directly. ``span("swarm.fetch", xorb=...)``
+records one complete event per exit: name, wall interval, thread, and
+attributes (plus byte counts via :meth:`Span.add_bytes`), serialized as
+``trace_event`` *X* (complete) events that chrome://tracing and Perfetto
+nest by containment per thread track.
+
+Activation mirrors :mod:`zest_tpu.faults`: lazy env resolution
+(``ZEST_TRACE=path`` arms a process-global tracer whose file is written
+at interpreter exit), ``install()``/``reset()`` for tests and the
+``zest trace`` CLI, and a shared no-op span when tracing is off — the
+hot path pays one global load and a ``None`` check.
+
+Memory: one small record per finished span. A 2 GB pull emits a few
+thousand spans (per-term fetches dominate), single-digit MB; the tracer
+also hard-caps recorded spans (:data:`MAX_SPANS`) so a pathological
+caller cannot turn the trace buffer into a leak — the drop is counted
+and reported in the exported JSON rather than silently truncated.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from zest_tpu.telemetry import state
+
+ENV_TRACE = "ZEST_TRACE"
+
+# Hard cap on buffered spans per tracer (drops are counted, not silent).
+MAX_SPANS = 500_000
+
+
+class Span:
+    """One finished (or in-flight) span. Context-manager protocol; the
+    ``with`` target supports ``set(key, value)`` / ``add_bytes(n)`` so
+    call sites can attach results discovered mid-span (bytes served,
+    source tier, error class)."""
+
+    __slots__ = ("name", "attrs", "t0", "t1", "tid", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.tid = 0
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def add_bytes(self, n: int) -> None:
+        self.attrs["bytes"] = self.attrs.get("bytes", 0) + int(n)
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.monotonic()
+        self.tid = threading.get_ident()
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        self.t1 = time.monotonic()
+        if exc_type is not None:
+            # The error *class* only: messages can carry URLs/paths and
+            # the trace file may be shared more widely than logs.
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._record(self)
+
+
+class _NullSpan:
+    """Shared no-op span: tracing disabled costs one attribute load."""
+
+    __slots__ = ()
+
+    def set(self, key: str, value) -> None:
+        pass
+
+    def add_bytes(self, n: int) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Thread-safe span recorder for one process (normally one pull)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self.dropped = 0
+        # Anchors mapping monotonic span times to an absolute epoch, so
+        # traces from several hosts of one pod can be laid side by side.
+        self.t_origin = time.monotonic()
+        self.epoch_origin = time.time()
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) >= MAX_SPANS:
+                self.dropped += 1
+                return
+            self._spans.append(span)
+
+    # ── Introspection ──
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def coverage_s(self, prefix: str | None = None) -> float:
+        """Union wall-clock coverage of recorded spans (optionally only
+        those whose name starts with ``prefix``) — the acceptance
+        denominator: a trace is useful when its spans cover ~all of the
+        pull's wall time, not a sliver of it."""
+        with self._lock:
+            ivs = sorted(
+                (s.t0, s.t1) for s in self._spans
+                if prefix is None or s.name.startswith(prefix)
+            )
+        total, end = 0.0, float("-inf")
+        for s, e in ivs:
+            if s > end:
+                total += e - s
+                end = e
+            elif e > end:
+                total += e - end
+                end = e
+        return total
+
+    # ── Export (Chrome trace_event JSON) ──
+
+    def to_chrome(self) -> dict:
+        """``{"traceEvents": [...]}`` — the Trace Event Format's JSON
+        object form. Spans become ``ph: "X"`` complete events with
+        microsecond ``ts``/``dur``; viewers nest same-track events by
+        containment, which matches how our spans actually nest (a span
+        opened inside another on the same thread closes inside it)."""
+        pid = os.getpid()
+        events: list[dict] = [
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": "zest-tpu"}},
+        ]
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self.dropped
+        for s in spans:
+            ev = {
+                "name": s.name,
+                "ph": "X",
+                "ts": round((s.t0 - self.t_origin) * 1e6, 1),
+                "dur": round((s.t1 - s.t0) * 1e6, 1),
+                "pid": pid,
+                "tid": s.tid,
+                "cat": s.name.split(".", 1)[0],
+            }
+            if s.attrs:
+                ev["args"] = {k: _jsonable(v) for k, v in s.attrs.items()}
+            events.append(ev)
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "zest-tpu",
+                "epoch_origin_s": round(self.epoch_origin, 6),
+                "spans": len(spans),
+            },
+        }
+        if dropped:
+            doc["otherData"]["dropped_spans"] = dropped
+        return doc
+
+    def export(self, path: str | os.PathLike) -> int:
+        """Write the Chrome trace JSON; returns the span count written.
+        Atomic (tmp + rename): a reader racing the atexit hook must see
+        either nothing or a complete valid trace, never a prefix."""
+        doc = self.to_chrome()
+        path = os.fspath(path)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return len(doc["traceEvents"]) - 1  # minus the metadata event
+
+
+# ── Module-level switchboard (lazy env parse, test override) ──
+
+_lock = threading.Lock()
+_tracer: Tracer | None = None
+_trace_path: str | None = None
+_resolved = False
+_atexit_armed = False
+
+
+def _arm_atexit() -> None:
+    global _atexit_armed
+    if not _atexit_armed:
+        _atexit_armed = True
+        atexit.register(_export_at_exit)
+
+
+def _export_at_exit() -> None:
+    tracer, path = _tracer, _trace_path
+    if tracer is not None and path:
+        try:
+            tracer.export(path)
+        except OSError:
+            pass  # interpreter teardown: nowhere sane to report
+
+
+def install(path: str | None = None) -> Tracer:
+    """Install a fresh process tracer (CLI/tests). ``path`` arms the
+    atexit export; callers may also :func:`export` explicitly."""
+    global _tracer, _trace_path, _resolved
+    with _lock:
+        _resolved = True
+        _tracer = Tracer()
+        _trace_path = path
+        if path:
+            _arm_atexit()
+        return _tracer
+
+
+def uninstall() -> None:
+    """Disable tracing (no export). Tests."""
+    global _tracer, _trace_path, _resolved
+    with _lock:
+        _resolved = True
+        _tracer = None
+        _trace_path = None
+
+
+def reset() -> None:
+    """Back to unresolved: the next ``span()`` re-reads ``ZEST_TRACE``."""
+    global _tracer, _trace_path, _resolved
+    with _lock:
+        _tracer = None
+        _trace_path = None
+        _resolved = False
+
+
+def active() -> Tracer | None:
+    global _tracer, _resolved, _trace_path
+    if _resolved:
+        return _tracer
+    with _lock:
+        if not _resolved:
+            path = os.environ.get(ENV_TRACE)
+            if path and state.enabled():
+                _tracer = Tracer()
+                _trace_path = path
+                _arm_atexit()
+            _resolved = True
+    return _tracer
+
+
+def trace_path() -> str | None:
+    active()  # resolve env first
+    return _trace_path
+
+
+def span(name: str, **attrs):
+    """The hot-path hook: the shared no-op span unless a tracer is
+    armed (``ZEST_TRACE``/:func:`install`) and telemetry is enabled."""
+    tracer = _tracer
+    if tracer is None:
+        if _resolved:
+            return NULL_SPAN
+        tracer = active()
+        if tracer is None:
+            return NULL_SPAN
+    if not state.enabled():
+        return NULL_SPAN
+    return tracer.span(name, **attrs)
+
+
+def export(path: str | os.PathLike) -> int:
+    """Export the active tracer's spans; 0 when tracing is off."""
+    tracer = active()
+    return tracer.export(path) if tracer is not None else 0
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)

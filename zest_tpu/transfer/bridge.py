@@ -20,12 +20,26 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 
+from zest_tpu import telemetry
 from zest_tpu.cas import reconstruction as recon
 from zest_tpu.cas.client import CasClient
 from zest_tpu.cas.hub import HubClient
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.config import Config
 from zest_tpu.storage import XorbCache
+
+# Process-wide mirrors of the per-session FetchStats: the session object
+# stays the per-pull report; these outlive it so the daemon's
+# /v1/metrics aggregates across every pull this process served.
+_M_XORBS = telemetry.counter(
+    "zest_fetch_xorbs_total", "Xorb fetches by source tier", ("source",))
+_M_BYTES = telemetry.counter(
+    "zest_fetch_bytes_total", "Fetched payload bytes by source tier",
+    ("source",))
+_M_EVENTS = telemetry.counter(
+    "zest_fetch_events_total",
+    "Resilience events on the fetch path (retries, hedges, heals)",
+    ("event",))
 
 # Hedging: with a pull deadline armed, the peer tier gets at most this
 # fraction of the remaining budget (capped) as a head start before a
@@ -81,10 +95,13 @@ class FetchStats:
                     getattr(self, f"xorbs_from_{source}") + 1)
             setattr(self, f"bytes_from_{source}",
                     getattr(self, f"bytes_from_{source}") + nbytes)
+        _M_XORBS.inc(source=source)
+        _M_BYTES.inc(nbytes, source=source)
 
     def bump(self, name: str, amount: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + amount)
+        _M_EVENTS.inc(amount, event=name)
 
     @property
     def p2p_ratio(self) -> float:
@@ -239,6 +256,15 @@ class XetBridge:
     def fetch_xorb_for_term(
         self, term: recon.Term, rec: recon.Reconstruction
     ) -> XorbFetchResult:
+        with telemetry.span("fetch.term", xorb=term.hash_hex) as sp:
+            result = self._fetch_xorb_for_term(term, rec)
+            sp.set("source", result.source)
+            sp.add_bytes(len(result.data))
+            return result
+
+    def _fetch_xorb_for_term(
+        self, term: recon.Term, rec: recon.Reconstruction
+    ) -> XorbFetchResult:
         hash_hex = term.hash_hex
         fi = rec.find_fetch_info(term)
         if fi is None:
@@ -325,9 +351,12 @@ class XetBridge:
         cache write overwrites any poisoned key)."""
         if self.cas is None:
             raise NotAuthenticated("no CAS client and no peers had the xorb")
-        data = self.cas.fetch_xorb_from_url(
-            self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
-        )
+        with telemetry.span("cdn.fetch", xorb=hash_hex) as sp:
+            data = self.cas.fetch_xorb_from_url(
+                self._absolute_url(fi.url),
+                (fi.url_range_start, fi.url_range_end)
+            )
+            sp.add_bytes(len(data))
         self.stats.record("cdn", len(data))
         self._cache_fetched(rec, hash_hex, fi.range.start, data)
         if self.swarm is not None:
@@ -412,6 +441,12 @@ class XetBridge:
         pod distribution round hands to PodDistributor (owners source
         their assigned units here, then the ICI all-gather carries them
         to everyone)."""
+        with telemetry.span("fetch.unit", xorb=hash_hex) as sp:
+            data = self._fetch_unit(hash_hex, fi)
+            sp.add_bytes(len(data))
+            return data
+
+    def _fetch_unit(self, hash_hex: str, fi: recon.FetchInfo) -> bytes:
         cached = self.cache.get_with_range(hash_hex, fi.range.start)
         if cached is not None and cached.chunk_offset <= fi.range.start:
             lo = fi.range.start - cached.chunk_offset
@@ -479,13 +514,17 @@ class XetBridge:
         cached bytes are BLAKE3-verified at extraction."""
         if self.cas is None:
             raise NotAuthenticated("no CAS client")
-        it = self.cas.fetch_xorb_iter(
-            self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
-        )
-        if full_key and not self.evidence_incomplete:
-            n = self.cache.put_stream(hash_hex, it)
-        else:
-            n = self.cache.put_partial_stream(hash_hex, fi.range.start, it)
+        with telemetry.span("cdn.stream", xorb=hash_hex) as sp:
+            it = self.cas.fetch_xorb_iter(
+                self._absolute_url(fi.url),
+                (fi.url_range_start, fi.url_range_end)
+            )
+            if full_key and not self.evidence_incomplete:
+                n = self.cache.put_stream(hash_hex, it)
+            else:
+                n = self.cache.put_partial_stream(hash_hex, fi.range.start,
+                                                  it)
+            sp.add_bytes(n)
         self.stats.record("cdn", n)
         return n
 

@@ -41,12 +41,19 @@ import struct
 import threading
 from dataclasses import dataclass
 
-from zest_tpu import faults
+from zest_tpu import faults, telemetry
 from zest_tpu.cas import hashing
 from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
 from zest_tpu.config import Config
 from zest_tpu.p2p.wire import MAX_MESSAGE_SIZE
 from zest_tpu.storage import XorbCache, read_chunk
+
+_M_CHUNKS_SERVED = telemetry.counter(
+    "zest_dcn_chunks_served_total",
+    "Chunks served to other pods over the DCN RPC")
+_M_BYTES_SERVED = telemetry.counter(
+    "zest_dcn_bytes_served_total",
+    "Payload bytes served over the DCN RPC")
 
 MAGIC = b"ZDCN"
 VERSION = 1
@@ -417,6 +424,8 @@ class DcnServer:
         with self._stats_lock:
             self.stats.chunks_served += 1
             self.stats.bytes_served += len(blob)
+        _M_CHUNKS_SERVED.inc()
+        _M_BYTES_SERVED.inc(len(blob))
         # Scatter-gather send: the blob can be a whole 64 MiB xorb, and
         # encode_message would memcpy it twice building one bytestring.
         _sendmsg_all(conn, [
@@ -606,19 +615,21 @@ class DcnPool:
         exactly here: the pool believed the channel was live, the first
         send/response proves otherwise). A *fresh* connection's failure
         propagates — that's a real peer problem, not staleness."""
-        ch, reused = self._lease(host, port)
-        try:
-            return ch.request_many(wants)
-        except (ConnectionError, TimeoutError, OSError):
-            self.drop(host, port)
-            if not reused:
-                raise
-            ch, _ = self._lease(host, port)
+        with telemetry.span("dcn.request_many", peer=f"{host}:{port}",
+                            requests=len(wants)):
+            ch, reused = self._lease(host, port)
             try:
                 return ch.request_many(wants)
             except (ConnectionError, TimeoutError, OSError):
                 self.drop(host, port)
-                raise
+                if not reused:
+                    raise
+                ch, _ = self._lease(host, port)
+                try:
+                    return ch.request_many(wants)
+                except (ConnectionError, TimeoutError, OSError):
+                    self.drop(host, port)
+                    raise
 
     def drop(self, host: str, port: int) -> None:
         with self._lock:

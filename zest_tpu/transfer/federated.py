@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import time
 
+from zest_tpu import telemetry
 from zest_tpu.cas import hashing
 from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
 from zest_tpu.cas.xorb import XorbReader
@@ -131,6 +132,15 @@ def warm_units_parallel(
     under the full key would shadow the other shard's partial entries
     and poison extraction.
     """
+    with telemetry.span("warm.units", shards=len(recs)):
+        return _warm_units_parallel(bridge, recs, max_concurrent,
+                                    entries_map)
+
+
+def _warm_units_parallel(
+    bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
+    entries_map: dict[str, list[FetchInfo]] | None = None,
+) -> dict:
     import os
     from concurrent.futures import ThreadPoolExecutor
 
@@ -225,6 +235,21 @@ def federated_round(
     ``pod_addrs`` maps pod index → (host, dcn_port). Missing pods are
     treated as unreachable (their units degrade to CDN).
     """
+    with telemetry.span("federated.round", pod=pod_index, pods=n_pods):
+        return _federated_round(bridge, recs, pod_index, n_pods, pod_addrs,
+                                dcn_pool, pipeline_depth, log)
+
+
+def _federated_round(
+    bridge,
+    recs: list[Reconstruction],
+    pod_index: int,
+    n_pods: int,
+    pod_addrs: dict[int, tuple[str, int]],
+    dcn_pool: DcnPool | None = None,
+    pipeline_depth: int = 16,
+    log=None,
+) -> dict:
     t0 = time.monotonic()
     pool = dcn_pool or DcnPool()
     own_pool = dcn_pool is None

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import time
 
+from zest_tpu import telemetry
 from zest_tpu.cas import hashing
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.collectives import PodDistributor
@@ -110,6 +111,13 @@ def expert_pod_round(
     """
     from zest_tpu.parallel.expert import ExpertRoutedPlan
 
+    with telemetry.span("pod.expert_round", files=len(file_maps)):
+        return _expert_pod_round(bridge, file_maps, placement, mesh, log,
+                                 ExpertRoutedPlan)
+
+
+def _expert_pod_round(bridge, file_maps, placement, mesh, log,
+                      ExpertRoutedPlan) -> dict:
     mesh = pod_mesh() if mesh is None else mesh
     routed = ExpertRoutedPlan.build(file_maps, placement)
 
@@ -178,6 +186,13 @@ def pod_round(
     per-device HBM cost is bounded by the budget, not the model size.
     Returns the stats block recorded under ``stats["pod"]`` in PullResult.
     """
+    with telemetry.span("pod.round", files=len(recs)):
+        return _pod_round(bridge, recs, mesh, log, _plan, budget_bytes)
+
+
+def _pod_round(
+    bridge, recs, mesh=None, log=None, _plan=None, budget_bytes=None,
+) -> dict:
     mesh = pod_mesh() if mesh is None else mesh
     n = num_slots(mesh)
     plan = _plan if _plan is not None else DistributionPlan.build(recs, n)
