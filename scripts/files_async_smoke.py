@@ -1,0 +1,84 @@
+"""CI smoke for the background file-materialization contract (ISSUE 5).
+
+A 64 MiB synthetic ``--device`` pull against the loopback fixture hub
+must report, schema-level (no wall-clock thresholds — CI runners are
+weather):
+
+- ``time_to_hbm_s < elapsed_s`` — the pull was *usable* (params
+  resident, verified) strictly before it finished: file materialization
+  ran past the landing instead of serializing into it;
+- ``files_after_hbm_s > 0`` — the files span overlaps the post-commit
+  window (the durability barrier alone guarantees a non-empty overlap
+  when the write-behind lane engaged);
+- the lane accounting exists and the safetensors bytes on disk are
+  exact.
+
+Exit code 0 on success; any broken invariant prints the offending
+stats block and fails the step.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+
+def main() -> int:
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.pull import pull_model
+
+    files = llama_checkpoint_files(0.064, shard_bytes=16 * 1024 * 1024,
+                                   scale=8)
+    repo = FixtureRepo("smoke/files-async", files, chunks_per_xorb=32)
+    with FixtureHub(repo) as hub, tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+        res = pull_model(cfg, "smoke/files-async", device="tpu",
+                         no_p2p=True, log=lambda *a, **k: None)
+        stats = res.stats
+
+        def fail(msg: str) -> int:
+            print(f"FILES-ASYNC SMOKE FAILED: {msg}", file=sys.stderr)
+            print(json.dumps({k: stats.get(k) for k in (
+                "time_to_hbm_s", "elapsed_s", "files_after_hbm_s",
+                "stages", "files_pipeline", "hbm")}, indent=2,
+                default=str), file=sys.stderr)
+            return 1
+
+        hbm = stats.get("hbm") or {}
+        if not hbm.get("direct"):
+            return fail("pull did not take the direct landing")
+        if "time_to_hbm_s" not in stats:
+            return fail("no time_to_hbm_s recorded")
+        if not stats["time_to_hbm_s"] < stats["elapsed_s"]:
+            return fail(
+                f"time_to_hbm_s ({stats['time_to_hbm_s']}) did not end "
+                f"before the pull ({stats['elapsed_s']}) — "
+                "materialization is back on the critical path")
+        if not stats.get("files_after_hbm_s", 0) > 0:
+            return fail("files span does not overlap the post-commit "
+                        f"window (files_after_hbm_s="
+                        f"{stats.get('files_after_hbm_s')})")
+        lanes = (stats.get("files_pipeline") or {}).get("lane_bytes") or {}
+        if not lanes:
+            return fail("no lane accounting in files_pipeline")
+        for name, data in files.items():
+            got = (res.snapshot_dir / name).read_bytes()
+            if got != data:
+                return fail(f"{name} materialized inexactly")
+        print("files-async smoke OK: "
+              f"time_to_hbm {stats['time_to_hbm_s']}s < total "
+              f"{stats['elapsed_s']}s, files_after_hbm "
+              f"{stats['files_after_hbm_s']}s, lanes {lanes}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

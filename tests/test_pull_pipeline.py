@@ -195,11 +195,11 @@ def test_mid_pull_failure_resumes_idempotently(hub, tmp_path, monkeypatch):
     orig = pull_mod._pull_xet_file
 
     def sabotaged(bridge, par, hub_, cfg, repo_id, revision, entry, dest,
-                  log):
+                  log, **kw):
         if entry.path == victim:
             raise RuntimeError("injected mid-pull failure")
         return orig(bridge, par, hub_, cfg, repo_id, revision, entry,
-                    dest, log)
+                    dest, log, **kw)
 
     monkeypatch.setattr(pull_mod, "_pull_xet_file", sabotaged)
     cfg = _cfg(hub, tmp_path, pull_pipeline_width=2)

@@ -204,3 +204,34 @@ def test_cli_bench_host_only(capsys):
     assert {"bencode_encode", "bencode_decode", "blake3_64kb",
             "sha1_info_hash", "bt_wire_frame"} <= names
     assert all(r["mb_per_s"] > 0 for r in results)
+
+
+def test_cmd_start_prints_dashboard_url(monkeypatch, capsys):
+    """VERDICT r5 item 8: `start` must surface the dashboard URL once
+    health passes, and ZEST_OPEN_DASHBOARD=1 opens the browser."""
+    import webbrowser
+
+    from zest_tpu import cli
+
+    health = iter([False, True])
+    monkeypatch.setattr(cli, "_server_running",
+                        lambda cfg: next(health, True))
+    monkeypatch.setattr(cli, "auto_start_server", lambda cfg: True)
+    monkeypatch.setenv("ZEST_HTTP_PORT", "9848")
+    opened = []
+    monkeypatch.setattr(webbrowser, "open",
+                        lambda url: opened.append(url) or True)
+
+    monkeypatch.delenv("ZEST_OPEN_DASHBOARD", raising=False)
+    assert cli.main(["start"]) == 0
+    out = capsys.readouterr().out
+    assert "dashboard: http://127.0.0.1:9848/" in out
+    assert opened == []  # opt-in only: headless CI must not spawn a browser
+
+    monkeypatch.setenv("ZEST_OPEN_DASHBOARD", "1")
+    monkeypatch.setattr(cli, "_server_running", lambda cfg: True)
+    assert cli.main(["start"]) == 0
+    out = capsys.readouterr().out
+    assert "already running" in out
+    assert "dashboard: http://127.0.0.1:9848/" in out
+    assert opened == ["http://127.0.0.1:9848/"]

@@ -377,16 +377,34 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _announce_dashboard(cfg: Config) -> None:
+    """Print the dashboard URL once the daemon is healthy (reference:
+    main.zig:471-482 opens the browser after serve comes up); with
+    ``ZEST_OPEN_DASHBOARD=1`` also open it in the default browser —
+    opt-in, because `start` runs headless in CI and on pod hosts."""
+    url = f"http://127.0.0.1:{cfg.effective_http_port()}/"
+    print(f"dashboard: {url}")
+    if os.environ.get("ZEST_OPEN_DASHBOARD") == "1":
+        import webbrowser
+
+        try:
+            webbrowser.open(url)
+        except Exception:  # noqa: BLE001 - no browser is not an error
+            pass
+
+
 def cmd_start(_args) -> int:
     cfg = Config.load()
     if _server_running(cfg):
         print("already running")
+        _announce_dashboard(cfg)
         return 0
     auto_start_server(cfg)
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:
         if _server_running(cfg):
             print(f"started (http :{cfg.effective_http_port()})")
+            _announce_dashboard(cfg)
             return 0
         time.sleep(0.1)
     print("daemon failed to become healthy", file=sys.stderr)

@@ -42,6 +42,17 @@ DEFAULT_LAND_DECODE_AHEAD = 1       # shards decoded ahead of the commit
 # ~MB terms); a small parsed-reader LRU turns N whole-file reads per
 # unit into one. Sized to hold a few units; 0 disables.
 DEFAULT_DECODE_CACHE_BYTES = 192 * 1024 * 1024
+# Background file materialization (ZEST_FILES_ASYNC): with 1 (default)
+# the --device=tpu write-behind lane never blocks the landing — a full
+# byte budget makes it decline to the post-commit cache lane instead of
+# stalling the decode thread, and tmp files commit (fsync + rename) at
+# the pull-exit durability barrier. 0 restores the blocking handoff.
+DEFAULT_FILES_ASYNC = True
+# Materialization writer pool (ZEST_FILES_WORKERS): how many HF-cache
+# files the background lane writes concurrently (pwritev/copy_file_range
+# byte movement, disk-bound — distinct from ZEST_PULL_WIDTH, which
+# sizes the network-bound waterfall reassembly lane). 0 = auto.
+DEFAULT_FILES_WORKERS = 0
 
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
 
@@ -117,6 +128,9 @@ class Config:
     decode_workers: int = DEFAULT_DECODE_WORKERS
     land_decode_ahead: int = DEFAULT_LAND_DECODE_AHEAD
     decode_cache_bytes: int = DEFAULT_DECODE_CACHE_BYTES
+    # Background materialization lane (see DEFAULT_FILES_* above).
+    files_async: bool = DEFAULT_FILES_ASYNC
+    files_workers: int = DEFAULT_FILES_WORKERS
     # Per-pull wall-clock budget in seconds (ZEST_PULL_DEADLINE_S;
     # None/0 = off). When armed, every tier's timeouts and retry sleeps
     # are capped by the remaining budget and the bridge hedges slow
@@ -182,6 +196,11 @@ class Config:
                 env.get("ZEST_LAND_AHEAD", DEFAULT_LAND_DECODE_AHEAD))),
             decode_cache_bytes=max(0, int(
                 env.get("ZEST_DECODE_CACHE", DEFAULT_DECODE_CACHE_BYTES))),
+            files_async=env.get(
+                "ZEST_FILES_ASYNC",
+                "1" if DEFAULT_FILES_ASYNC else "0").strip() != "0",
+            files_workers=max(0, int(
+                env.get("ZEST_FILES_WORKERS", DEFAULT_FILES_WORKERS))),
             pull_deadline_s=(
                 float(env["ZEST_PULL_DEADLINE_S"])
                 if float(env.get("ZEST_PULL_DEADLINE_S") or 0) > 0

@@ -323,6 +323,87 @@ class CachedFileReader:
                 f"read [{lo},{hi}) outside file of {self.size} bytes"
             )
 
+    def copy_plan(self, lo: int, hi: int):
+        """Zero-copy materialization plan for file bytes [lo, hi).
+
+        Returns ``(copies, leftovers)``:
+
+        - ``copies`` — one ``(entry_path, src_offs, dst_offs, lens)``
+          group per copyable term: numpy u64 columns of per-chunk
+          payload spans, source offsets into the on-disk cache entry,
+          destination offsets relative to ``lo``. A term is copyable iff
+          its cached entry is an on-disk file, carries no footer (so the
+          decode path wouldn't hash-verify it either — the plan never
+          weakens the trust model), and every chunk in range is
+          stored-scheme: the payload bytes ARE the file bytes, so the
+          kernel can move them without userspace ever touching them.
+        - ``leftovers`` — merged ``(d_lo, d_hi)`` byte ranges (relative
+          to ``lo``) the caller must materialize through the decode
+          path: compressed or footer-hashed chunks, cache misses, and
+          terms only partially inside the read.
+
+        Planning never reads payload bytes — only the columnar chunk
+        table (already LRU-memoized for the decode path)."""
+        self._check_range(lo, hi)
+        import numpy as np
+
+        copies, leftovers = [], []
+
+        def leftover(d_lo: int, d_hi: int) -> None:
+            if leftovers and leftovers[-1][1] == d_lo:
+                leftovers[-1] = (leftovers[-1][0], d_hi)
+            else:
+                leftovers.append((d_lo, d_hi))
+
+        for t_lo, t_hi, term in self._spans:
+            if t_hi <= lo:
+                continue
+            if t_lo >= hi:
+                break
+            d_lo, d_hi = max(lo, t_lo) - lo, min(hi, t_hi) - lo
+            if not (lo <= t_lo and t_hi <= hi):
+                leftover(d_lo, d_hi)  # boundary term: decode path
+                continue
+            fi = self.rec.find_fetch_info(term)
+            if fi is None:
+                raise DirectLandingError(
+                    f"no fetch_info covers term {term.hash_hex}"
+                )
+            located = self.cache.locate_with_range(term.hash_hex,
+                                                   fi.range.start)
+            got = self._entry_reader(term.hash_hex, fi.range.start)
+            if located is None or got is None:
+                leftover(d_lo, d_hi)
+                continue
+            path, path_chunk_offset = located
+            reader, chunk_offset = got
+            if (path_chunk_offset != chunk_offset
+                    or reader.xorb_hash_footer is not None):
+                # Entry flavor changed under us, or it carries footer
+                # hashes the decode path would verify per chunk — the
+                # copy lane must not skip a check the decode lane makes.
+                leftover(d_lo, d_hi)
+                continue
+            local = (term.range.start - chunk_offset,
+                     term.range.end - chunk_offset)
+            try:
+                cols = reader.decode_columns(*local)
+            except ValueError:
+                leftover(d_lo, d_hi)  # malformed entry: slow path heals
+                continue
+            if cols is None:
+                leftover(d_lo, d_hi)
+                continue
+            src_offs, src_lens, schemes, dst_lens = cols
+            if (schemes.any()  # any non-NONE scheme needs real decode
+                    or int(dst_lens.sum(dtype=np.uint64))
+                    != term.unpacked_length):
+                leftover(d_lo, d_hi)
+                continue
+            dst_offs = np.uint64(d_lo) + _exclusive_cumsum(dst_lens)
+            copies.append((path, src_offs, dst_offs, dst_lens))
+        return copies, leftovers
+
     def read(self, lo: int, hi: int) -> bytes:
         """Bytes [lo, hi) of the reconstructed file."""
         self._check_range(lo, hi)  # before allocating hi-lo bytes

