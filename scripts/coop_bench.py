@@ -1,0 +1,89 @@
+"""MULTIHOST artifact driver: cooperative pull vs per-host CDN,
+unshaped AND WAN-shaped (VERDICT r5 item 3 + ROADMAP item 1).
+
+Writes ``MULTIHOST_r06.json``-style artifacts with two sections:
+
+- ``unshaped`` — CDN at loopback speed (the honesty rows: on one
+  machine everything is CPU/disk-bound and cooperation's win is
+  modest);
+- ``shaped``  — the hub's CDN data plane token-bucketed to a WAN-ish
+  shared rate while the DCN exchange stays at loopback speed: the
+  asymmetry the reference's tier-3 scenario table measures, under
+  which the per-host baseline pays N x model_bytes through the shaped
+  pipe and the cooperative pull pays ~1x + a loopback exchange of
+  *compressed* frames.
+
+Usage: python scripts/coop_bench.py [--out MULTIHOST_r06.json]
+       [--mb 64] [--hosts 8] [--cdn-mbps 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="MULTIHOST_r06.json")
+    ap.add_argument("--mb", type=float, default=64.0,
+                    help="checkpoint megabytes")
+    ap.add_argument("--hosts", type=int, default=8)
+    ap.add_argument("--cdn-mbps", type=float, default=4.0,
+                    help="shaped CDN rate, MB/s shared across hosts "
+                         "(~32 Mbps: a WAN-class origin allocation)")
+    ap.add_argument("--skip-unshaped", action="store_true")
+    args = ap.parse_args()
+
+    from zest_tpu.bench_scale import bench_coop_pull
+
+    out: dict = {
+        "bench": "coop_pull",
+        "hosts": args.hosts,
+        "requested_mb": args.mb,
+        # Honesty note: all N hosts share this machine's cores, so the
+        # exchange (aggregate N*(N-1)/N x model bytes of loopback DCN +
+        # verify in ONE process) is ~Nx under-provisioned vs a real pod
+        # where each host brings its own CPUs and NIC; the shaped
+        # speedup below is therefore a LOWER bound on the pod-scale
+        # win, while the baseline is faithfully (N x bytes)/(CDN rate).
+        "note": "single-machine simulation; exchange shares host CPUs",
+    }
+    if not args.skip_unshaped:
+        print(f"[coop-bench] unshaped: {args.hosts} hosts, "
+              f"{args.mb} MB ...", flush=True)
+        out["unshaped"] = bench_coop_pull(gb=args.mb / 1000.0,
+                                          n_hosts=args.hosts)
+        print(json.dumps(out["unshaped"], indent=1), flush=True)
+    rate = int(args.cdn_mbps * 1e6)
+    print(f"[coop-bench] shaped: CDN {args.cdn_mbps} MB/s shared ...",
+          flush=True)
+    out["shaped"] = bench_coop_pull(gb=args.mb / 1000.0,
+                                    n_hosts=args.hosts,
+                                    shaped_bps=rate)
+    print(json.dumps(out["shaped"], indent=1), flush=True)
+
+    sh = out["shaped"]
+    ok = True
+    if (sh.get("speedup") or 0) < 2.0:
+        print(f"FAIL: shaped cooperative speedup {sh.get('speedup')} "
+              "< 2.0 — cooperation did not beat the per-host baseline",
+              file=sys.stderr)
+        ok = False
+    wire = (sh.get("coop") or {}).get("wire") or {}
+    if not (wire.get("compressed_ratio") or 1.0) < 1.0:
+        print("FAIL: exchange wire bytes not smaller than unpacked — "
+              "compressed frames did not cross the wire",
+              file=sys.stderr)
+        ok = False
+    pathlib.Path(args.out).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"[coop-bench] wrote {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,167 @@
+"""CI smoke for the cooperative pod-scale pull (ROADMAP item 1).
+
+Runs the 8-device dryrun shape (XLA_FLAGS forces 8 virtual CPU
+devices; conftest-style env is set by the CI step): 8 simulated hosts
+with isolated caches, loopback DCN servers, one 64 MiB synthetic
+Llama-shaped checkpoint. Host 0 runs the REAL ``pull_model`` with
+``--device=tpu`` and cooperative mode on; hosts 1..7 run their side of
+the round concurrently. Asserts, schema- and content-level:
+
+- ``stats["coop"]["peer_served_ratio"] >= 0.8`` on the pulling host —
+  the cooperative win actually happened (7/8 of bytes peer-served by
+  construction at 8 hosts);
+- the landed HBM param tree is BYTE-IDENTICAL to a solo (non-coop)
+  pull of the same repo (models.loader.params_digest) — cooperation
+  must never change what lands;
+- the exchange carried compressed frames: wire bytes < unpacked bytes
+  (the fixture is generated compressible, as real checkpoints are);
+- zero exchange fallbacks on the healthy path.
+
+Exit 0 on success; prints the offending stats block and fails
+otherwise.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+N_HOSTS = 8
+REPO_ID = "smoke/coop-llama"
+
+
+def main() -> int:
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.models.loader import params_digest
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.coop import coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.pull import pull_model
+
+    files = llama_checkpoint_files(0.064, shard_bytes=16 * 1024 * 1024,
+                                   scale=8, smooth=True)
+    repo = FixtureRepo(REPO_ID, files, chunks_per_xorb=32)
+
+    def fail(msg: str, blob=None) -> int:
+        print(f"COOP SMOKE FAILED: {msg}", file=sys.stderr)
+        if blob is not None:
+            print(json.dumps(blob, indent=2, default=str),
+                  file=sys.stderr)
+        return 1
+
+    with FixtureHub(repo) as hub, tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+
+        def host_cfg(tag: str, i: int) -> Config:
+            return Config(hf_home=rootp / f"{tag}{i}/hf",
+                          cache_dir=rootp / f"{tag}{i}/zest",
+                          hf_token="hf_test", endpoint=hub.url,
+                          dcn_port=0)
+
+        # Peer hosts 1..7: bridge + DCN server + their coop_round side.
+        peers, servers, addrs = [], [], {}
+        for i in range(1, N_HOSTS):
+            bridge = XetBridge(host_cfg("coop", i))
+            bridge.authenticate(REPO_ID)
+            server = DcnServer(bridge.cfg, bridge.cache)
+            addrs[i] = ("127.0.0.1", server.start())
+            peers.append(bridge)
+            servers.append(server)
+        # Host 0 serves through the DcnServer its own pull starts
+        # (coop_round binds one on dcn_port=0 and parks it on the
+        # bridge); peers discover it lazily via the retry loop — but a
+        # deterministic smoke wants a known addr map up front, so host
+        # 0 gets a pre-started server over its cache dir too.
+        cfg0 = host_cfg("coop", 0)
+        server0 = DcnServer(cfg0, __import__(
+            "zest_tpu.storage", fromlist=["XorbCache"]).XorbCache(cfg0))
+        addrs[0] = ("127.0.0.1", server0.start())
+        servers.append(server0)
+
+        peer_results: list = [None] * N_HOSTS
+        peer_errors: list[str] = []
+
+        def run_peer(idx: int, bridge) -> None:
+            try:
+                recs = [bridge.get_reconstruction(e.xet_hash)
+                        for e in HubClient(bridge.cfg).list_files(REPO_ID)
+                        if e.is_xet]
+                peer_results[idx] = coop_round(
+                    bridge, recs, idx, N_HOSTS, addrs)
+            except Exception as exc:  # noqa: BLE001 - reported below
+                peer_errors.append(f"host {idx}: {exc!r}")
+
+        threads = [threading.Thread(target=run_peer, args=(i + 1, b),
+                                    daemon=True)
+                   for i, b in enumerate(peers)]
+        for t in threads:
+            t.start()
+
+        res = pull_model(cfg0, REPO_ID, device="tpu", no_p2p=True,
+                         coop=True, coop_hosts=N_HOSTS, coop_index=0,
+                         coop_addrs=addrs, log=lambda *a, **k: None)
+        for t in threads:
+            t.join(timeout=180)
+        for s in servers:
+            s.shutdown()
+
+        stats = res.stats
+        coop = stats.get("coop")
+        if peer_errors:
+            return fail(f"peer rounds failed: {peer_errors}")
+        if not coop or coop.get("skipped"):
+            return fail("pull did not run the cooperative round", stats)
+        ratio = coop.get("peer_served_ratio", 0.0)
+        if ratio < 0.8:
+            return fail(f"peer_served_ratio {ratio} < 0.8", coop)
+        ex = coop.get("exchange", {})
+        if coop.get("fallbacks"):
+            return fail(f"{coop['fallbacks']} exchange fallbacks on the "
+                        "healthy path", coop)
+        if not ex.get("wire_bytes"):
+            return fail("no bytes crossed the exchange wire", coop)
+        if not ex["wire_bytes"] < ex.get("unpacked_bytes", 0):
+            return fail(
+                f"exchange wire carried {ex['wire_bytes']} bytes for "
+                f"{ex.get('unpacked_bytes')} unpacked — frames were "
+                "not compressed on the wire", coop)
+        if not (stats.get("hbm") or {}).get("direct"):
+            return fail("coop pull did not take the direct landing",
+                        stats.get("hbm"))
+        if res.params is None:
+            return fail("coop pull landed no params")
+        coop_digest = params_digest(res.params)
+        res.params = None
+
+        # Solo oracle: same repo, no cooperation, fresh dirs.
+        solo = pull_model(host_cfg("solo", 0), REPO_ID, device="tpu",
+                          no_p2p=True, coop=False,
+                          log=lambda *a, **k: None)
+        if solo.params is None:
+            return fail("solo pull landed no params")
+        solo_digest = params_digest(solo.params)
+        solo.params = None
+        if coop_digest != solo_digest:
+            return fail(f"HBM contents diverge: coop {coop_digest[:16]} "
+                        f"vs solo {solo_digest[:16]}")
+
+        peer_ratios = [round(r["peer_served_ratio"], 3)
+                       for r in peer_results if r]
+        print("coop smoke OK: host-0 peer_served_ratio "
+              f"{ratio:.3f}, exchange {ex['units']} units / "
+              f"{ex['wire_bytes']} wire bytes "
+              f"({ex['unpacked_bytes']} unpacked), peers "
+              f"{peer_ratios}, HBM digest {coop_digest[:16]} == solo")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,11 @@ def main() -> int:
     ap.add_argument("--repo", default="acme/loopback-model")
     ap.add_argument("--size", type=int, default=1_000_000,
                     help="safetensors payload bytes")
+    ap.add_argument("--throttle-bps", type=int, default=None,
+                    help="shape the CDN data plane (/xorbs, /resolve "
+                         "bodies) to this many bytes/s, shared across "
+                         "all connections — the WAN-asymmetry knob for "
+                         "the multihost harness")
     kind = ap.add_mutually_exclusive_group()
     kind.add_argument("--gpt2", action="store_true",
                       help="serve a tiny valid GPT-2 checkpoint instead of "
@@ -61,9 +66,12 @@ def main() -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
-    with FixtureHub(repo) as hub:
+    with FixtureHub(repo, throttle_bps=args.throttle_bps) as hub:
         Path(args.url_file).write_text(hub.url)
-        print(f"fixture hub for {args.repo} at {hub.url}", flush=True)
+        shaped = (f" (CDN shaped to {args.throttle_bps} B/s)"
+                  if args.throttle_bps else "")
+        print(f"fixture hub for {args.repo} at {hub.url}{shaped}",
+              flush=True)
         stop.wait()
     return 0
 

@@ -11,6 +11,11 @@
 # Usage: scripts/multihost-harness.sh [all|provision|deploy|test|report|teardown]
 # Env:   NODES (default 3)  MODEL_BYTES (default 8000000)
 #        WORK (default /tmp/zest-multihost)  BASE_PORT (default 27881)
+#        CDN_BPS (default unset = unshaped) — token-bucket the fixture
+#        hub's CDN data plane to this many bytes/s (shared across all
+#        nodes) so the CDN-vs-P2P asymmetry the reference's tier-3
+#        scenarios measure is reproduced on one machine (peers stay at
+#        loopback speed; VERDICT r5 item 3).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -45,7 +50,8 @@ provision() {
     mkdir -p "$RESULTS"
     for i in $(seq 0 $((NODES - 1))); do mkdir -p "$WORK/node$i"; done
     python scripts/fixture_hub.py --url-file "$WORK/hub.url" \
-        --repo "$REPO_ID" --size "$MODEL_BYTES" &
+        --repo "$REPO_ID" --size "$MODEL_BYTES" \
+        ${CDN_BPS:+--throttle-bps "$CDN_BPS"} &
     echo $! > "$WORK/hub.pid"
     # GB-scale fixtures take the hub a while to generate and encode
     # before it binds — scale the wait with the model size (~0.2 s per

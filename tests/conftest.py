@@ -13,6 +13,11 @@ import os
 # alone are not enough — sitecustomize may import jax before this module
 # runs, freezing its config defaults — so set both env and jax.config.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermeticity: the serving path arms jax's persistent compile cache
+# under ~/.cache/zest by default (models.generate.enable_compile_cache);
+# tests must not write to — or get warm-start artifacts from — the
+# developer's home.
+os.environ.setdefault("ZEST_JIT_CACHE", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -27,6 +27,9 @@ from zest_tpu.config import Config
 from zest_tpu.version import __version__
 
 
+_WARMED = threading.Event()  # process-global serve warm-up latch
+
+
 class HttpApi:
     """Control-plane server. ``run()`` blocks until ``/v1/stop``."""
 
@@ -79,7 +82,39 @@ class HttpApi:
         self._httpd.daemon_threads = True
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
+        # Serve cold-start (VERDICT r5 item 6): the daemon is healthy
+        # the instant the socket binds — jax import, backend init, and
+        # the XLA warm-up compile run in a background thread, NOT on
+        # the health-check or first-request path. By the time a real
+        # generate request finishes its pull, the runtime is warm and
+        # (with the persistent compile cache) the decode executable is
+        # often already on disk.
+        if not _WARMED.is_set():
+            threading.Thread(target=self._warmup, daemon=True,
+                             name="zest-serve-warmup").start()
         return self._httpd.server_address[1]
+
+    @staticmethod
+    def _warmup() -> None:
+        """Pay the jax/backend/first-compile fixed costs off-path, once
+        per process (tests construct many HttpApi instances; the warm
+        state is process-global). Best-effort: a machine without a
+        working backend still serves status/pull — only generate needs
+        jax, and it degrades to paying these costs inline as before."""
+        if _WARMED.is_set():
+            return
+        _WARMED.set()
+        try:
+            from zest_tpu.models.generate import enable_compile_cache
+
+            enable_compile_cache()
+            import jax
+            import jax.numpy as jnp
+
+            jax.devices()  # backend init (the multi-second term on TPU)
+            jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+        except Exception:  # noqa: BLE001 - warmup must never kill serve
+            pass
 
     def run(self) -> None:
         """Blocking serve-until-stopped (reference main.zig:458-467)."""

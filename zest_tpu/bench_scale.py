@@ -48,7 +48,7 @@ import time
 
 import numpy as np
 
-__all__ = ["llama_checkpoint_files", "bench_gb_pull"]
+__all__ = ["llama_checkpoint_files", "bench_gb_pull", "bench_coop_pull"]
 
 # Llama-8B geometry (hidden/FFN/heads as in Llama-3-8B; vocab reduced to
 # keep the embedding from dominating a small-N-layer checkpoint).
@@ -80,7 +80,8 @@ _EDGE_BYTES = _edge_bytes(_HIDDEN, _VOCAB)
 
 def llama_checkpoint_files(gb: float, seed: int = 0,
                            shard_bytes: int = 700 * 1024 * 1024,
-                           scale: int = 1) -> dict[str, bytes]:
+                           scale: int = 1,
+                           smooth: bool = False) -> dict[str, bytes]:
     """Synthetic Llama-shaped checkpoint of ~``gb`` GB as HF repo files.
 
     Real tensor names and Llama-8B shapes (so the landing registry
@@ -93,6 +94,15 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
     checkpoints with the same tensor *structure*; the driver bench runs
     scale=1, i.e. true 8B geometry — one layer alone is ~436 MB, so
     sub-GB requests at scale=1 still come out ~1 GB).
+
+    ``smooth`` draws N(0, 0.02) weights instead of uniform random bit
+    patterns — the *realistic* compressibility case (trained weights'
+    bf16 exponent bytes are low-entropy; that structure is exactly what
+    BG4's byte planes exploit). The default stays the incompressible
+    worst case so ``pull_gb`` artifacts remain comparable across
+    rounds; the cooperative bench uses ``smooth=True`` because its
+    compressed-on-the-wire evidence is only visible when the payload
+    compresses at all.
     """
     from zest_tpu.models.safetensors_io import write_safetensors
 
@@ -114,6 +124,9 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
 
     def t(*shape):
         n = int(np.prod(shape))
+        if smooth and bf16 != np.dtype(np.uint16):
+            return rng.normal(0.0, 0.02, n).astype(np.float32).astype(
+                bf16).reshape(shape)
         return rng.integers(0, 1 << 16, n, dtype=np.uint16).view(
             bf16).reshape(shape)
 
@@ -169,6 +182,179 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
             write_safetensors(p, shard)
             files[name] = p.read_bytes()
     return files
+
+
+def _import_fixtures():
+    """tests/fixtures scoped import (same rationale as bench_gb_pull:
+    the loopback hub is a test double, not product code)."""
+    import sys
+
+    tests_dir = str(pathlib.Path(__file__).resolve().parent.parent
+                    / "tests")
+    sys.path.insert(0, tests_dir)
+    try:
+        import fixtures
+    finally:
+        try:
+            sys.path.remove(tests_dir)
+        except ValueError:
+            pass
+    return fixtures
+
+
+def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
+                    shaped_bps: int | None = None,
+                    chunks_per_xorb: int = 16, scale: int = 8) -> dict:
+    """Multi-host cooperative pull vs the per-host-CDN baseline
+    (ROADMAP item 1's acceptance bench; headline: peer_served_ratio).
+
+    ``n_hosts`` simulated hosts (isolated cache dirs + bridges, DCN
+    servers on loopback — the same in-process multi-host shape the
+    MULTICHIP dryrun uses) race two strategies to a fully-populated
+    verified cache on EVERY host:
+
+    - **baseline**: each host independently fetches all units from the
+      (optionally shaped) CDN — today's per-host waterfall;
+    - **coop**: each host fetches its ~1/N plan share, then the DCN
+      exchange redistributes compressed frames (transfer.coop).
+
+    ``shaped_bps`` token-buckets the hub's CDN data plane *globally*
+    (one WAN-rate origin shared by all hosts; peers stay loopback) —
+    the asymmetry under which cooperation's N-fold CDN-demand cut turns
+    into wall-clock. The wire block records compressed bytes crossing
+    the exchange vs their unpacked size — the EQuARX-grounded
+    compressed-on-the-wire evidence."""
+    import tempfile as _tempfile
+    import threading
+
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.coop import coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.federated import warm_units_parallel
+
+    fixtures = _import_fixtures()
+    repo_id = "bench/coop-llama"
+    files = llama_checkpoint_files(gb, scale=scale, smooth=True,
+                                   shard_bytes=32 * 1024 * 1024)
+    total = sum(len(b) for b in files.values())
+    repo = fixtures.FixtureRepo(repo_id, files,
+                                chunks_per_xorb=chunks_per_xorb)
+
+    def make_host(root: pathlib.Path, tag: str, i: int):
+        cfg = Config(hf_home=root / f"{tag}{i}/hf",
+                     cache_dir=root / f"{tag}{i}/zest",
+                     hf_token="hf_test", endpoint=hub.url, dcn_port=0)
+        bridge = XetBridge(cfg)
+        bridge.authenticate(repo_id)
+        recs = [bridge.get_reconstruction(e.xet_hash)
+                for e in HubClient(cfg).list_files(repo_id) if e.is_xet]
+        return bridge, recs
+
+    out: dict = {
+        "model_bytes": total,
+        "hosts": n_hosts,
+        "chunks_per_xorb": chunks_per_xorb,
+        "cdn_bps": shaped_bps,
+    }
+    with fixtures.FixtureHub(repo, throttle_bps=shaped_bps) as hub, \
+            _tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+
+        # Baseline: every host pulls everything through the CDN.
+        hosts = [make_host(rootp, "base", i) for i in range(n_hosts)]
+        walls = [0.0] * n_hosts
+        errors: list[str] = []
+
+        def base_run(i):
+            bridge, recs = hosts[i]
+            t0 = time.perf_counter()
+            try:
+                warm_units_parallel(bridge, recs)
+            except Exception as exc:  # noqa: BLE001 - reported, not raised
+                errors.append(f"baseline host {i}: {exc}")
+            walls[i] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=base_run, args=(i,))
+                   for i in range(n_hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        base_wall = time.perf_counter() - t0
+        cdn_bytes = sum(b.stats.bytes_from_cdn for b, _r in hosts)
+        out["baseline"] = {
+            "wall_s": round(base_wall, 3),
+            "per_host_wall_s": [round(w, 3) for w in walls],
+            "cdn_bytes": cdn_bytes,
+            "gbps_per_host": round(total / base_wall / 1e9, 4),
+        }
+        for b, _r in hosts:
+            b.close()
+
+        # Cooperative: fetch 1/N each + compressed exchange.
+        hosts = [make_host(rootp, "coop", i) for i in range(n_hosts)]
+        servers, addrs = [], {}
+        for i, (bridge, _recs) in enumerate(hosts):
+            s = DcnServer(bridge.cfg, bridge.cache)
+            addrs[i] = ("127.0.0.1", s.start())
+            servers.append(s)
+        results: list[dict | None] = [None] * n_hosts
+
+        def coop_run(i):
+            bridge, recs = hosts[i]
+            try:
+                results[i] = coop_round(bridge, recs, i, n_hosts, addrs,
+                                        server=servers[i])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"coop host {i}: {exc}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=coop_run, args=(i,))
+                   for i in range(n_hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        coop_wall = time.perf_counter() - t0
+        for s in servers:
+            s.shutdown()
+        for b, _r in hosts:
+            b.close()
+
+    done = [r for r in results if r]
+    ratios = sorted(r["peer_served_ratio"] for r in done) or [0.0]
+    wire = sum(r["exchange"]["wire_bytes"] for r in done)
+    unpacked = sum(r["exchange"]["unpacked_bytes"] for r in done)
+    out["coop"] = {
+        "wall_s": round(coop_wall, 3),
+        "hosts_completed": len(done),
+        "peer_served_ratio": ratios[len(ratios) // 2],
+        "peer_served_ratio_min": ratios[0],
+        "cdn_bytes": sum(
+            r["fetch"]["tiers"].get("cdn", 0)
+            + r["exchange"].get("fallback_tiers", {}).get("cdn", 0)
+            for r in done),
+        "fallbacks": sum(r["fallbacks"] for r in done),
+        "plan_skew": done[0]["plan"]["skew"] if done else None,
+        "wire": {
+            "dcn_bytes": wire,
+            "unpacked_bytes": unpacked,
+            # <1.0 = compressed frames crossed the exchange, not
+            # expanded tensors (bf16 random data compresses little;
+            # real checkpoints more).
+            "compressed_ratio": round(wire / unpacked, 4)
+            if unpacked else None,
+        },
+        "gbps_per_host": round(total / coop_wall / 1e9, 4),
+    }
+    out["speedup"] = (round(base_wall / coop_wall, 2)
+                      if coop_wall > 0 else None)
+    if errors:
+        out["errors"] = errors
+    return out
 
 
 def _settle_page_cache(drop: bool) -> str:

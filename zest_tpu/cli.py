@@ -151,15 +151,27 @@ def cmd_pull(args) -> int:
         print(f"error: --pod-index {args.pod_index} outside [0,{args.pods})",
               file=sys.stderr)
         return 2
-    pod_addrs = {}
-    for spec in args.pod_addr or []:
-        idx, eq, addr = spec.partition("=")
-        host, colon, port = addr.rpartition(":")
-        if not (eq and colon and idx.isdigit() and port.isdigit() and host):
-            print(f"error: --pod-addr {spec!r} is not I=HOST:PORT",
-                  file=sys.stderr)
-            return 2
-        pod_addrs[int(idx)] = (host, int(port))
+    def parse_addr_flags(flag: str, specs) -> dict | None:
+        from zest_tpu.config import parse_host_addr
+
+        out = {}
+        for spec in specs or []:
+            try:
+                idx, addr = parse_host_addr(spec)
+            except ValueError:
+                print(f"error: {flag} {spec!r} is not I=HOST:PORT",
+                      file=sys.stderr)
+                return None
+            out[idx] = addr
+        return out
+
+    pod_addrs = parse_addr_flags("--pod-addr", args.pod_addr)
+    if pod_addrs is None:
+        return 2
+    coop = True if args.coop else (False if args.no_coop else None)
+    coop_addrs = parse_addr_flags("--coop-addr", args.coop_addr)
+    if coop_addrs is None:
+        return 2
     import contextlib
 
     profile_ctx = contextlib.nullcontext()
@@ -187,7 +199,10 @@ def cmd_pull(args) -> int:
         res = pull_model(cfg, args.repo, revision=args.revision,
                          device=args.device, swarm=swarm,
                          no_p2p=args.no_p2p, pod=pod, pods=args.pods,
-                         pod_index=args.pod_index, pod_addrs=pod_addrs)
+                         pod_index=args.pod_index, pod_addrs=pod_addrs,
+                         coop=coop, coop_hosts=args.coop_hosts,
+                         coop_index=args.coop_index,
+                         coop_addrs=coop_addrs)
     if args.profile:
         print(f"profiler trace written to {args.profile}")
     print(f"✓ {args.repo} -> {res.snapshot_dir}")
@@ -226,6 +241,15 @@ def _print_pull_stats(stats: dict) -> None:
         if pipelined:
             print(f"  Busy:       {'  '.join(pipelined)} "
                   "(thread-seconds > stage wall: pipelined)")
+    if "coop" in stats and not stats["coop"].get("skipped"):
+        c = stats["coop"]
+        ex = c.get("exchange", {})
+        print(f"  Coop:       host {c['host']}/{c['hosts']}: "
+              f"{(c.get('fetch') or {}).get('units', 0)} fetched, "
+              f"{ex.get('units', 0)} over DCN "
+              f"({ex.get('wire_bytes', 0)} wire bytes), "
+              f"{c.get('fallbacks', 0)} fallback — peer-served "
+              f"{c.get('peer_served_ratio', 0.0):.1%}")
     if "federated" in stats:
         f = stats["federated"]
         print(f"  Federated:  pod {f['pod']}/{f['pods']}: {f['own_units']} "
@@ -628,6 +652,26 @@ def build_parser() -> argparse.ArgumentParser:
     pull.add_argument("--pod-addr", action="append", metavar="I=HOST:PORT",
                       help="DCN endpoint of pod I (repeatable); units "
                            "owned by unreachable pods degrade to CDN")
+    coop_group = pull.add_mutually_exclusive_group()
+    coop_group.add_argument("--coop", action="store_true",
+                            help="cooperative pod-scale pull: this host "
+                                 "fetches ~1/N of the CDN bytes and "
+                                 "exchanges compressed chunks with the "
+                                 "other hosts over DCN (auto when a "
+                                 "multi-host topology is configured; "
+                                 "also ZEST_COOP=1)")
+    coop_group.add_argument("--no-coop", action="store_true",
+                            help="never run the cooperative round")
+    pull.add_argument("--coop-hosts", type=int, default=None,
+                      help="total hosts in the cooperative pull "
+                           "(also ZEST_COOP_HOSTS)")
+    pull.add_argument("--coop-index", type=int, default=None,
+                      help="this host's index, 0-based "
+                           "(also ZEST_COOP_INDEX)")
+    pull.add_argument("--coop-addr", action="append", metavar="I=HOST:PORT",
+                      help="DCN endpoint of coop host I (repeatable; "
+                           "omit to discover via the jax.distributed "
+                           "KV store)")
     pull.add_argument("--http-port", type=int, default=None)
     pull.set_defaults(fn=cmd_pull)
 

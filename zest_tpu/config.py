@@ -53,8 +53,38 @@ DEFAULT_FILES_ASYNC = True
 # byte movement, disk-bound — distinct from ZEST_PULL_WIDTH, which
 # sizes the network-bound waterfall reassembly lane). 0 = auto.
 DEFAULT_FILES_WORKERS = 0
+# Cooperative pull (transfer.coop): exchange-phase in-flight byte budget
+# (ZEST_COOP_INFLIGHT) — bounds how many compressed wire bytes a host
+# stages in memory before draining them to the verified cache.
+DEFAULT_COOP_INFLIGHT_BYTES = 1 << 30
 
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
+
+
+def parse_host_addr(spec: str) -> tuple[int, tuple[str, int]]:
+    """One ``"IDX=HOST:PORT"`` entry → ``(idx, (host, port))`` — the
+    single parser behind ``ZEST_COOP_ADDRS`` and the CLI's repeatable
+    ``--pod-addr``/``--coop-addr`` flags (one grammar, one place to
+    evolve it). Raises ValueError on any malformation — a typo
+    silently dropping a host from an exchange would quietly halve the
+    cooperative win."""
+    idx, eq, addr = spec.strip().partition("=")
+    host, colon, port = addr.rpartition(":")
+    if not eq or not colon or not idx.strip().isdigit() \
+            or not port.isdigit() or not host:
+        raise ValueError(f"bad host-addr entry: {spec!r} "
+                         "(want IDX=HOST:PORT)")
+    return int(idx), (host, int(port))
+
+
+def _parse_coop_addrs(spec: str) -> dict[int, tuple[str, int]]:
+    """``"0=hostA:6991,1=hostB:6991"`` -> {0: ("hostA", 6991), ...}."""
+    out: dict[int, tuple[str, int]] = {}
+    for part in spec.split(","):
+        if part.strip():
+            idx, addr = parse_host_addr(part)
+            out[idx] = addr
+    return out
 
 
 def _expand(p: str) -> Path:
@@ -138,6 +168,18 @@ class Config:
     # unattended pull should keep trying, an interactive/serving pull
     # wants a bound.
     pull_deadline_s: float | None = None
+    # Cooperative pod-scale pull (transfer.coop; ROADMAP item 1).
+    # ``coop_pull`` is tri-state: True/False force it on/off (ZEST_COOP
+    # =1/0), None = auto — on when a multi-host topology is known
+    # (coop_hosts > 1, or a multi-process mesh). ``coop_addrs`` maps
+    # host index -> (host, dcn_port) (ZEST_COOP_ADDRS="0=h:p,1=h:p");
+    # when absent, a jax.distributed KV exchange discovers them.
+    coop_pull: bool | None = None
+    coop_hosts: int | None = None
+    coop_index: int | None = None
+    coop_addrs: dict[int, tuple[str, int]] = dataclasses.field(
+        default_factory=dict)
+    coop_inflight_bytes: int = DEFAULT_COOP_INFLIGHT_BYTES
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
@@ -205,6 +247,16 @@ class Config:
                 float(env["ZEST_PULL_DEADLINE_S"])
                 if float(env.get("ZEST_PULL_DEADLINE_S") or 0) > 0
                 else None),
+            coop_pull={"1": True, "0": False}.get(
+                env.get("ZEST_COOP", "").strip()),
+            coop_hosts=(int(env["ZEST_COOP_HOSTS"])
+                        if env.get("ZEST_COOP_HOSTS") else None),
+            coop_index=(int(env["ZEST_COOP_INDEX"])
+                        if env.get("ZEST_COOP_INDEX") else None),
+            coop_addrs=_parse_coop_addrs(env.get("ZEST_COOP_ADDRS", "")),
+            coop_inflight_bytes=max(1, int(
+                env.get("ZEST_COOP_INFLIGHT")
+                or DEFAULT_COOP_INFLIGHT_BYTES)),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,

@@ -20,7 +20,9 @@ corrupts only that peer's chunks).
 Registered fault names (injection sites):
 
 ==================  =====================================================
-``peer_timeout``    ``BtPeer.connect`` raises ``TimeoutError`` pre-dial
+``peer_timeout``    ``BtPeer.connect`` raises ``TimeoutError`` pre-dial;
+                    also fired per exchange window in the cooperative
+                    round (transfer.coop — a silent owner host)
 ``peer_slow``       ``BtPeer.request_chunk`` sleeps *arg* seconds (1.0)
 ``chunk_corrupt``   swarm flips a byte in a successful peer response
 ``cdn_503``         ``CasClient`` GET observes an injected 503

@@ -291,6 +291,26 @@ def _commit_tensors(
     return {n: out[n] for n in names}  # caller-visible order preserved
 
 
+def params_digest(params: dict) -> str:
+    """Order-independent BLAKE3 digest of a landed param tree — name,
+    dtype, shape, and raw bytes of every tensor, device arrays fetched
+    back to host. The byte-identity oracle the cooperative-pull smoke
+    (scripts/coop_smoke.py) compares against a solo pull: two landings
+    agree iff every tensor's HBM contents agree bit-for-bit. O(model
+    bytes) — a verification tool, not a hot-path call."""
+    from zest_tpu.cas import hashing
+
+    leaves = []
+    for name in sorted(params):
+        arr = np.asarray(jax.device_get(params[name]))
+        leaves.append(hashing.blake3_hash(
+            name.encode() + b"\x00" + str(arr.dtype).encode()
+            + b"\x00" + repr(arr.shape).encode() + b"\x00"
+            + arr.tobytes()
+        ))
+    return hashing.blake3_hash(b"".join(leaves)).hex()
+
+
 def _commit_stats(
     params: dict, dt: float, mesh: Mesh | None, direct: bool
 ) -> dict:

@@ -25,3 +25,41 @@ def best_hasher(key: bytes | None = None):
     if jax.default_backend() == "tpu":
         return PallasHasher(key)
     return DeviceHasher(key)
+
+
+class HostBatchHasher:
+    """``hash_batch`` on the host's native SIMD BLAKE3 — the right
+    verifier when no accelerator is attached (the XLA-on-CPU lowering
+    is a correctness vehicle, ~3 orders slower than the native path;
+    a CPU-backend pod/coop round verifying peer blobs through it would
+    be bottlenecked on its own trust boundary). Enforces the same
+    ``MAX_LEAVES``-KiB chunk cap as the device hashers (ValueError),
+    so a hostile over-cap chunk is rejected identically on every
+    backend."""
+
+    def __init__(self, key: bytes | None = None):
+        self.key = key
+
+    def hash_batch(self, chunks: list[bytes]) -> list[bytes]:
+        from zest_tpu.cas import hashing
+        from zest_tpu.ops.blake3 import MAX_LEAVES
+
+        cap = MAX_LEAVES * 1024
+        for c in chunks:
+            if len(c) > cap:
+                raise ValueError(
+                    f"chunk of {len(c)} bytes over the {cap}-byte leaf cap")
+        if self.key is None:
+            return [hashing.blake3_hash(c) for c in chunks]
+        return [hashing.blake3_keyed(self.key, c) for c in chunks]
+
+
+def unit_verify_hasher(key: bytes | None = None):
+    """Hasher for whole-unit trust-boundary verification (pod fill,
+    coop exchange): the device kernel where a device is the point
+    (TPU), native host SIMD everywhere else."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return PallasHasher(key)
+    return HostBatchHasher(key)

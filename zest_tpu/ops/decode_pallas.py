@@ -208,7 +208,17 @@ class FusedBg4Verifier:
 def fused_verifier_for_backend(key: bytes | None = None):
     """A FusedBg4Verifier on TPU (the fused path pays off exactly where
     the VPU is), None elsewhere — production CPU keeps the host decode,
-    interpret mode being a test vehicle, not a fast path."""
+    interpret mode being a test vehicle, not a fast path.
+
+    ``ZEST_FUSED_INTERPRET=1`` opts a non-TPU backend into the
+    interpret-mode kernel anyway: the cooperative exchange
+    (transfer.coop) verifies received whole xorbs through this exact
+    fused pass on real pods, and the 8-device CPU dryrun/smoke can then
+    drive the identical code path — slow, so never on by default."""
+    import os
+
     if jax.default_backend() != "tpu":
+        if os.environ.get("ZEST_FUSED_INTERPRET") == "1":
+            return FusedBg4Verifier(key, interpret=True)
         return None
     return FusedBg4Verifier(key)

@@ -209,14 +209,31 @@ class XetBridge:
         # Lazy: only a deadline-armed pull ever hedges.
         self._hedge_pool: ThreadPoolExecutor | None = None
         self._hedge_lock = threading.Lock()
+        # A DCN listener the cooperative round started for this pull
+        # (transfer.coop): it must outlive the round — peer hosts still
+        # mid-exchange read from it — so it lives until close().
+        self._coop_server = None
+
+    def adopt_coop_server(self, server) -> None:
+        """Own a coop-round DCN listener until :meth:`close` (see
+        transfer.coop.coop_round: the server serves peer hosts that are
+        still exchanging after this host's round returned)."""
+        self._coop_server = server
 
     def close(self) -> None:
         """Release the hedge pool's threads (per-pull bridges in a
-        long-lived daemon must not accumulate idle workers)."""
+        long-lived daemon must not accumulate idle workers) and any
+        coop-round DCN listener."""
         with self._hedge_lock:
             pool, self._hedge_pool = self._hedge_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        server, self._coop_server = self._coop_server, None
+        if server is not None:
+            try:
+                server.shutdown()
+            except Exception:  # noqa: BLE001 - closing is best-effort
+                pass
 
     # ── Auth (reference: xet_bridge.zig:76-130) ──
 
@@ -468,12 +485,23 @@ class XetBridge:
         pod distribution round hands to PodDistributor (owners source
         their assigned units here, then the ICI all-gather carries them
         to everyone)."""
-        with telemetry.span("fetch.unit", xorb=hash_hex) as sp:
-            data = self._fetch_unit(hash_hex, fi)
-            sp.add_bytes(len(data))
-            return data
+        return self.fetch_unit_tiered(hash_hex, fi)[0]
 
-    def _fetch_unit(self, hash_hex: str, fi: recon.FetchInfo) -> bytes:
+    def fetch_unit_tiered(
+        self, hash_hex: str, fi: recon.FetchInfo
+    ) -> tuple[bytes, str]:
+        """:meth:`fetch_unit` plus the serving tier (``cache`` | ``peer``
+        | ``cdn``) — the cooperative round attributes its fallback bytes
+        per tier (peer_served_ratio must not count a peer-served
+        fallback as CDN spend)."""
+        with telemetry.span("fetch.unit", xorb=hash_hex) as sp:
+            data, source = self._fetch_unit(hash_hex, fi)
+            sp.set("source", source)
+            sp.add_bytes(len(data))
+            return data, source
+
+    def _fetch_unit(self, hash_hex: str,
+                    fi: recon.FetchInfo) -> tuple[bytes, str]:
         cached = self.cache.get_with_range(hash_hex, fi.range.start)
         if cached is not None and cached.chunk_offset <= fi.range.start:
             lo = fi.range.start - cached.chunk_offset
@@ -495,7 +523,7 @@ class XetBridge:
                 else:
                     data = reader.slice_range(lo, hi)
                 self.stats.record("cache", len(data))
-                return data
+                return data, "cache"
 
         if self.swarm is not None:
             xorb_hash = None
@@ -516,7 +544,7 @@ class XetBridge:
                             and self._unit_blob_verifies(
                                 xorb_hash, hash_hex, peer_result)):
                         self.stats.record("peer", len(peer_result.data))
-                        return peer_result.data
+                        return peer_result.data, "peer"
                     if peer_result.chunk_offset == fi.range.start \
                             and peer_result.addr is not None:
                         # Right frame, bad bytes: structural failure is
@@ -531,7 +559,7 @@ class XetBridge:
             self._absolute_url(fi.url), (fi.url_range_start, fi.url_range_end)
         )
         self.stats.record("cdn", len(data))
-        return data
+        return data, "cdn"
 
     def stream_unit_from_cdn(self, hash_hex: str, fi: recon.FetchInfo,
                              full_key: bool) -> int:

@@ -1,0 +1,650 @@
+"""Cooperative pod-scale pull: shard the CDN fetch across hosts,
+redistribute compressed chunks host-to-host (ROADMAP item 1).
+
+The single-host pull is done (PRs 1-5); what remains between this build
+and the north star (Llama-3.1-70B -> v5p-64 HBM in <60 s, >=90%
+peer-served) is that every host still fetches the WHOLE deduped xorb
+set from CDN. This module makes the pull pod-native: the pod's N hosts
+agree — with zero coordination — on a byte-balanced ownership plan over
+the deduped fetch units, each host fetches only its ~1/N share through
+the existing resilient waterfall (cache -> peers -> CDN, PR-2
+hedging/retries intact), and an **exchange phase** redistributes the
+verified chunks host-to-host over the DCN chunk RPC so every host ends
+fully cached and lands its own mesh shard. Per-host CDN demand drops
+N-fold (16x on v5p-64) and the peer-served ratio rises to ~(N-1)/N by
+construction.
+
+Three design rules carried through from the papers this leans on:
+
+- **Compressed on the wire** (EQuARX, PAPERS.md): the exchange moves
+  xorb *frame streams* — BG4/LZ4 payloads still in their compressed,
+  planar form — and the receiving host expands+verifies with the fused
+  Pallas kernel (ops.decode_pallas.FusedBg4Verifier via
+  transfer.pod.make_unit_verifier) before anything is decoded for
+  ``device_put``. The interconnect never carries expanded bytes.
+- **Bounded staging** ("Bounded-Memory Parallel Image Pulling",
+  PAPERS.md): exchange windows acquire a :class:`ByteBudget` before
+  any reply is in flight and drain into the on-disk cache before the
+  next window stages — no host ever holds ~model-size blobs in memory
+  on top of the landing's own staging.
+- **Degradation, never a stall** (PR-2 failure model): a host that the
+  health machinery has quarantined is excluded from the plan up front
+  (its share re-shards across the alive hosts, every unit exactly
+  once); a host that dies *mid-exchange* (connection reset, injected
+  ``dcn_reset``/``peer_timeout``) degrades its units to the per-host
+  CDN fallback — the pull always completes, ``fallbacks`` counts the
+  cost, and nothing unverified ever reaches the cache.
+
+The in-pod spread (one host's devices) stays with the existing
+collective machinery: ``transfer.pod.pod_round`` over ICI after this
+round, and ``transfer.federated`` remains the cross-pod (separate-job)
+tier. This module is the *host-level* tier between them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from zest_tpu import faults, telemetry
+from zest_tpu.cas import hashing
+from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.parallel.plan import collect_units
+from zest_tpu.transfer.dcn import DcnPool, DcnResponse, DcnServer
+from zest_tpu.transfer.federated import (
+    _already_cached,
+    _blob_covers,
+    _cache_unit,
+    _entries_by_hash,
+    warm_units_parallel,
+)
+
+_M_COOP_BYTES = telemetry.counter(
+    "zest_coop_bytes_total",
+    "Cooperative-pull payload bytes by serving tier",
+    ("tier",))
+_M_COOP_FALLBACKS = telemetry.counter(
+    "zest_coop_fallbacks_total",
+    "Exchange units degraded to the per-host CDN fallback")
+
+# Exchange pacing: how long a host keeps retrying NOT_FOUND units
+# (the owner may simply still be fetching them — hosts run the round
+# concurrently) before degrading them to CDN, and the per-pass backoff.
+DEFAULT_EXCHANGE_DEADLINE_S = 60.0
+_RETRY_SLEEP_S = 0.25
+_RETRY_SLEEP_CAP_S = 2.0
+# Exchange window target: enough replies in flight to pipeline the
+# channel without staging more than this (and never more than the
+# ByteBudget admits) per request batch.
+_WINDOW_TARGET_BYTES = 32 * 1024 * 1024
+_WINDOW_MAX_UNITS = 64
+
+
+class CoopUnavailable(RuntimeError):
+    """Cooperative mode cannot run (no peer addresses, no alive hosts):
+    the caller must degrade to the ordinary full-fetch waterfall —
+    partially fetching 1/N and then having nobody to exchange with
+    would be strictly worse than not cooperating."""
+
+
+@dataclass(frozen=True)
+class CoopPlan:
+    """Deterministic, byte-balanced unit->host ownership.
+
+    Every host builds the plan independently from the same
+    reconstruction set and MUST get byte-for-byte the same answer (the
+    exchange asks owner ``h`` for exactly the units ``h`` believes it
+    owns). Determinism comes from sorted inputs + a pure greedy:
+    units sorted by (wire bytes desc, key) are assigned to the
+    least-loaded alive host, ties broken by host index. LPT keeps the
+    per-host byte skew within ``mean + largest_unit`` — far inside the
+    1.15x-of-mean bound the tests pin for checkpoint-shaped unit sets —
+    where the HRW draw the pod/federated tiers use (uniform, not
+    load-aware) can leave a host with 2x the mean at typical unit
+    counts.
+
+    ``quarantined`` hosts (the PR-2 health registry's verdict, or an
+    operator's) are excluded from the draw entirely: their share
+    re-shards across the alive hosts with every unit still assigned
+    exactly once — the straggler rule SCALING.md §6 documents.
+    """
+
+    n_hosts: int
+    alive: tuple[int, ...]
+    units: tuple[tuple[tuple[str, int], FetchInfo], ...]
+    owners: dict[tuple[str, int], int]
+
+    @staticmethod
+    def build(recs: list[Reconstruction], n_hosts: int,
+              quarantined=frozenset()) -> "CoopPlan":
+        if n_hosts <= 0:
+            raise ValueError("n_hosts must be positive")
+        alive = tuple(h for h in range(n_hosts) if h not in set(quarantined))
+        if not alive:
+            raise CoopUnavailable("every host is quarantined")
+        units = tuple(collect_units(recs))
+        order = sorted(
+            units,
+            key=lambda u: (-(u[1].url_range_end - u[1].url_range_start),
+                           u[0]),
+        )
+        load = {h: 0 for h in alive}
+        owners: dict[tuple[str, int], int] = {}
+        for key, fi in order:
+            best = min(alive, key=lambda h: (load[h], h))
+            owners[key] = best
+            load[best] += fi.url_range_end - fi.url_range_start
+        return CoopPlan(n_hosts, alive, units, owners)
+
+    def for_host(self, host: int) -> list[tuple[str, FetchInfo]]:
+        return [(key[0], fi) for key, fi in self.units
+                if self.owners[key] == host]
+
+    def bytes_per_host(self) -> dict[int, int]:
+        out = {h: 0 for h in self.alive}
+        for key, fi in self.units:
+            out[self.owners[key]] += fi.url_range_end - fi.url_range_start
+        return out
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(fi.url_range_end - fi.url_range_start
+                   for _k, fi in self.units)
+
+    def skew(self) -> float:
+        """max bytes/host over mean bytes/host (1.0 = perfect)."""
+        per = self.bytes_per_host()
+        if not per or self.total_bytes == 0:
+            return 1.0
+        mean = self.total_bytes / len(per)
+        return max(per.values()) / mean if mean else 1.0
+
+    def fingerprint(self) -> str:
+        """Content hash of the full assignment — the determinism proof
+        hosts could cross-check out of band (tests pin that shuffled
+        reconstruction order and repeated builds agree)."""
+        acc = hashing.blake3_hash(
+            b"|".join(
+                f"{hh}:{start}:{self.owners[(hh, start)]}".encode()
+                for (hh, start), _fi in self.units
+            )
+        )
+        return acc.hex()
+
+    def summary(self) -> dict:
+        per = self.bytes_per_host()
+        return {
+            "units": len(self.units),
+            "hosts": self.n_hosts,
+            "alive": len(self.alive),
+            "total_bytes": self.total_bytes,
+            "bytes_per_host": [per.get(h, 0) for h in range(self.n_hosts)],
+            "skew": round(self.skew(), 4),
+            "fingerprint": self.fingerprint()[:16],
+        }
+
+
+def quarantined_hosts(health, host_addrs: dict[int, tuple[str, int]]):
+    """Hosts whose DCN address the PR-2 health registry currently holds
+    in quarantine — excluded from the plan so their share re-shards
+    before the round instead of timing out during it."""
+    if health is None:
+        return frozenset()
+    out = set()
+    for h, addr in host_addrs.items():
+        try:
+            if health.is_quarantined(addr):
+                out.add(h)
+        except Exception:  # noqa: BLE001 - health is advisory
+            continue
+    return frozenset(out)
+
+
+def _unpacked_bytes(data: bytes) -> int:
+    """Sum of the blob's chunk unpacked sizes — the bytes the wire
+    would have carried had the exchange shipped expanded payloads.
+    ``wire < unpacked`` on compressible checkpoints is the
+    compressed-on-the-wire evidence the bench records."""
+    try:
+        return int(XorbReader(data).chunk_sizes.sum())
+    except Exception:  # noqa: BLE001 - malformed blobs are rejected later
+        return len(data)
+
+
+class _ExchangeStats:
+    """Thread-safe accumulator for the exchange phase."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.units = 0
+        self.wire_bytes = 0
+        self.unpacked_bytes = 0
+        self.fallback_units = 0
+        self.fallback_bytes = 0
+        # Fallback bytes by the tier that ACTUALLY served them (the
+        # full waterfall runs, so a "CDN fallback" unit can still come
+        # from a swarm peer or the cache): peer_served_ratio must not
+        # book peer-served fallback bytes as CDN spend.
+        self.fallback_tiers: dict[str, int] = {}
+        self.verify_rejected = 0
+        self.retries = 0
+        self.dead_hosts: set[int] = set()
+
+    def summary(self) -> dict:
+        out = {
+            "units": self.units,
+            "wire_bytes": self.wire_bytes,
+            "unpacked_bytes": self.unpacked_bytes,
+            "fallback_units": self.fallback_units,
+            "fallback_bytes": self.fallback_bytes,
+            "verify_rejected": self.verify_rejected,
+            "retries": self.retries,
+        }
+        if self.fallback_tiers:
+            out["fallback_tiers"] = dict(sorted(self.fallback_tiers.items()))
+        if self.dead_hosts:
+            out["dead_hosts"] = sorted(self.dead_hosts)
+        return out
+
+
+def coop_round(
+    bridge,
+    recs: list[Reconstruction],
+    host_index: int,
+    n_hosts: int,
+    host_addrs: dict[int, tuple[str, int]] | None = None,
+    *,
+    budget_bytes: int | None = None,
+    server: DcnServer | None = None,
+    quarantined=None,
+    entries_map: dict[str, list[FetchInfo]] | None = None,
+    deadline_s: float | None = None,
+    dcn_pool: DcnPool | None = None,
+    log=None,
+) -> dict:
+    """One cooperative round: plan -> fetch (my ~1/N) -> exchange.
+
+    Afterwards every unit of ``recs`` is in the local verified cache,
+    so the direct landing (or the in-pod ``pod_round``) runs entirely
+    peer-fed. Returns the ``stats["coop"]`` block with
+    ``peer_served_ratio`` as the headline.
+
+    ``host_addrs`` maps host index -> (host, dcn_port) for every OTHER
+    host (``server``, when given, is this host's already-running DCN
+    listener; otherwise one is started on ``cfg.dcn_port`` — or
+    ephemeral when that port is taken — and owned by the bridge until
+    ``bridge.close()``, so late peers can still read from us while the
+    landing proceeds). Raises :class:`CoopUnavailable` when no exchange
+    peer is addressable — the caller degrades to the full waterfall.
+    """
+    with telemetry.span("coop.round", host=host_index, hosts=n_hosts):
+        return _coop_round(bridge, recs, host_index, n_hosts,
+                           host_addrs or {}, budget_bytes, server,
+                           quarantined, entries_map, deadline_s,
+                           dcn_pool, log)
+
+
+def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
+                budget_bytes, server, quarantined, entries_map,
+                deadline_s, dcn_pool, log) -> dict:
+    from zest_tpu.transfer.pull import ByteBudget
+
+    t0 = time.monotonic()
+    if n_hosts <= 1:
+        return {"host": host_index, "hosts": n_hosts, "skipped": True}
+    peers = {h: a for h, a in host_addrs.items() if h != host_index}
+    if not peers:
+        raise CoopUnavailable(
+            f"cooperative pull over {n_hosts} hosts has no peer "
+            "addresses (host_addrs empty)")
+
+    swarm_health = getattr(getattr(bridge, "swarm", None), "health", None)
+    q = set(quarantined or ())
+    q |= quarantined_hosts(swarm_health, peers)
+    q.discard(host_index)  # we are demonstrably alive
+    plan = CoopPlan.build(recs, n_hosts, frozenset(q))
+    if entries_map is None:
+        entries_map = _entries_by_hash(recs)
+
+    # Serve our share while (and after) we pull everyone else's: the
+    # listener must outlive this round — peers behind us in the round
+    # still read from it — so an owned server is parked on the bridge
+    # and closed with it (transfer.pull calls bridge.close() at exit).
+    own_server = False
+    if server is None:
+        server = DcnServer(bridge.cfg, bridge.cache)
+        try:
+            server.start()
+        except OSError:
+            # Port taken — normally this host's own daemon already
+            # serving the same cache dir over DCN; peers reach that.
+            server = None
+        else:
+            own_server = True
+            bridge.adopt_coop_server(server)
+
+    if budget_bytes is None:
+        budget_bytes = getattr(bridge.cfg, "coop_inflight_bytes",
+                               1 << 30)
+    if deadline_s is None:
+        deadline_s = DEFAULT_EXCHANGE_DEADLINE_S
+        # The default must scale with the work: retry headroom for
+        # owners that are legitimately still fetching their share at
+        # pod scale (a fixed 60 s would mass-fallback a 9 GB/host
+        # checkpoint on a WAN CDN), while explicit callers keep full
+        # control. 8 s per plan-GB on top of the floor is ~3x the
+        # north-star per-host fetch time.
+        deadline_s += 8.0 * plan.total_bytes / 1e9
+
+    # ── Phase 1: fetch my share through the resilient waterfall ──
+    mine = plan.for_host(host_index)
+    before = _tier_bytes(bridge.stats)
+    with telemetry.span("coop.fetch", host=host_index, units=len(mine)):
+        fetch_stats = warm_units_parallel(bridge, recs,
+                                          entries_map=entries_map,
+                                          units=mine)
+    fetch_tiers = _tier_delta(before, _tier_bytes(bridge.stats))
+    for tier, nbytes in fetch_tiers.items():
+        if nbytes:
+            _M_COOP_BYTES.inc(nbytes, tier=tier)
+
+    # ── Phase 2: exchange — pull every foreign-owned unit from its
+    # owner over DCN, windowed under the byte budget ──
+    budget = ByteBudget(budget_bytes)
+    ex = _ExchangeStats()
+    pool = dcn_pool or DcnPool()
+    own_pool = dcn_pool is None
+    verify = _make_verifier()
+    # Anchored HERE, not at round start: the fetch phase's duration is
+    # workload (a slow CDN), and letting it consume the exchange budget
+    # would time out healthy owners — striking their health and
+    # degrading the whole exchange to CDN exactly when cooperation
+    # matters most.
+    deadline = time.monotonic() + deadline_s
+
+    foreign = {
+        h: [(hh, fi) for hh, fi in plan.for_host(h)
+            if not _already_cached(bridge, hh, fi)]
+        for h in plan.alive if h != host_index
+    }
+    try:
+        workers = [
+            threading.Thread(
+                target=_exchange_from,
+                args=(bridge, entries_map, pool, peers, h, units, budget,
+                      ex, verify, deadline, swarm_health),
+                name=f"zest-coop-x{h}", daemon=True,
+            )
+            for h, units in foreign.items() if units
+        ]
+        with telemetry.span("coop.exchange", host=host_index,
+                            owners=len(workers)):
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+    finally:
+        if own_pool:
+            pool.close()
+    # Units owned by hosts the plan already excluded (quarantined) were
+    # re-sharded into `mine`/`foreign` above; nothing is unowned.
+
+    _M_COOP_BYTES.inc(ex.wire_bytes, tier="dcn")
+    if ex.fallback_bytes:
+        _M_COOP_BYTES.inc(ex.fallback_bytes, tier="fallback")
+
+    # Headline ratio over *network* bytes (cache hits excluded), with
+    # fallback bytes attributed to the tier that actually served them.
+    cdn_bytes = (fetch_tiers.get("cdn", 0)
+                 + ex.fallback_tiers.get("cdn", 0))
+    peer_bytes = (fetch_tiers.get("peer", 0) + ex.wire_bytes
+                  + ex.fallback_tiers.get("peer", 0))
+    served = peer_bytes + cdn_bytes
+    ratio = 1.0 - (cdn_bytes / served) if served else 1.0
+
+    stats = {
+        "host": host_index,
+        "hosts": n_hosts,
+        "plan": plan.summary(),
+        "fetch": {**fetch_stats, "tiers": fetch_tiers},
+        "exchange": {
+            **ex.summary(),
+            "budget_bytes": budget.budget_bytes,
+            "inflight_peak_bytes": budget.peak_bytes,
+        },
+        "fallbacks": ex.fallback_units,
+        "own_server": own_server,
+        "peer_served_ratio": round(ratio, 4),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+    if log is not None:
+        log(f"coop round host {host_index}/{n_hosts}: "
+            f"{len(mine)} fetched, {ex.units} over DCN "
+            f"({ex.wire_bytes} wire bytes), {ex.fallback_units} "
+            f"CDN-fallback, peer_served {stats['peer_served_ratio']:.0%}")
+    return stats
+
+
+def _tier_bytes(stats) -> dict[str, int]:
+    return {"cache": stats.bytes_from_cache,
+            "peer": stats.bytes_from_peer,
+            "cdn": stats.bytes_from_cdn}
+
+
+def _tier_delta(before: dict[str, int], after: dict[str, int]) -> dict:
+    return {k: after[k] - before[k] for k in before
+            if after[k] - before[k] > 0}
+
+
+def _make_verifier():
+    """Whole-xorb verifier for exchange-received blobs: the same fused
+    device pass the pod round uses (BG4 expands+verifies on the
+    accelerator; the host never materializes the interleaved bytes of
+    a blob it is about to reject)."""
+    from zest_tpu.transfer.pod import make_unit_verifier
+
+    return make_unit_verifier()
+
+
+def _exchange_from(bridge, entries_map, pool, peers, owner, units,
+                   budget, ex: _ExchangeStats, verify, deadline,
+                   health) -> None:
+    """Pull ``units`` from ``owner``; NOT_FOUND retries until the
+    deadline (the owner may still be fetching), a dead channel or an
+    expired deadline degrades the rest to the per-host CDN fallback."""
+    addr = peers.get(owner)
+    if addr is None:
+        _fallback(bridge, entries_map, units, ex)
+        return
+    host, port = addr
+    pending = list(units)
+    sleep_s = _RETRY_SLEEP_S
+    # A window never plans past the budget: ByteBudget's oversized-alone
+    # admission exists for single items larger than the whole budget —
+    # letting a multi-unit window ride it would defeat the bound.
+    window_cap = min(_WINDOW_TARGET_BYTES, budget.budget_bytes)
+    while pending:
+        window, wire_est = [], 0
+        while pending and len(window) < _WINDOW_MAX_UNITS:
+            nbytes = (pending[0][1].url_range_end
+                      - pending[0][1].url_range_start)
+            if window and wire_est + nbytes > window_cap:
+                break
+            window.append(pending.pop(0))
+            wire_est += nbytes
+        budget.acquire(wire_est)
+        try:
+            if faults.fire("peer_timeout", key=f"{host}:{port}"):
+                raise TimeoutError("injected peer_timeout")
+            replies = pool.request_many(
+                host, port,
+                [(hashing.hex_to_hash(hh), fi.range.start, fi.range.end)
+                 for hh, fi in window],
+                timeout=max(1.0, deadline - time.monotonic()),
+            )
+        except (ConnectionError, TimeoutError, OSError):
+            budget.release(wire_est)
+            with ex.lock:
+                ex.dead_hosts.add(owner)
+            if health is not None:
+                try:
+                    health.record_failure(addr, kind="io_timeout")
+                except Exception:  # noqa: BLE001 - health is advisory
+                    pass
+            _fallback(bridge, entries_map, window + pending, ex)
+            return
+        missing = []
+        try:
+            for (hh, fi), reply in zip(window, replies):
+                admitted, wire, unpacked = _admit(
+                    bridge, entries_map, hh, fi, reply, verify)
+                if admitted:
+                    bridge.stats.record("peer", wire)
+                    with ex.lock:
+                        ex.units += 1
+                        ex.wire_bytes += wire
+                        ex.unpacked_bytes += unpacked
+                elif isinstance(reply, DcnResponse):
+                    # Structurally or content-bad bytes from a live
+                    # owner: do NOT retry (same bytes would come back);
+                    # degrade to CDN, which self-heals the cache key.
+                    with ex.lock:
+                        ex.verify_rejected += 1
+                    _fallback(bridge, entries_map, [(hh, fi)], ex)
+                else:
+                    missing.append((hh, fi))  # NOT_FOUND: owner behind
+        finally:
+            budget.release(wire_est)
+        if health is not None and not missing:
+            try:
+                health.record_success(addr)
+            except Exception:  # noqa: BLE001
+                pass
+        if missing:
+            if time.monotonic() + sleep_s > deadline:
+                _fallback(bridge, entries_map, missing + pending, ex)
+                return
+            with ex.lock:
+                ex.retries += 1
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * 2, _RETRY_SLEEP_CAP_S)
+            pending = missing + pending
+
+
+def _admit(bridge, entries_map, hh, fi, reply, verify):
+    """Gate one exchange reply into the cache: right coordinate frame,
+    structural cover, and — when the evidence proves the blob is the
+    whole xorb — a full content verification (fused on TPU) BEFORE the
+    cache write. Partial-range blobs keep the extraction-time per-chunk
+    hash model, the same trust boundary as every other tier. Returns
+    (admitted, wire_bytes, unpacked_bytes)."""
+    if not isinstance(reply, DcnResponse):
+        return False, 0, 0
+    if reply.chunk_offset > fi.range.start:
+        return False, 0, 0
+    if not _blob_covers(reply.data, fi.range.end - reply.chunk_offset):
+        return False, 0, 0
+    if bridge.whole_xorb_provable(entries_map.get(hh, []),
+                                  reply.chunk_offset):
+        if not verify(hh, reply.data):
+            return False, 0, 0
+    _cache_unit(bridge, entries_map, hh, fi, reply.chunk_offset,
+                reply.data)
+    return True, len(reply.data), _unpacked_bytes(reply.data)
+
+
+def _fallback(bridge, entries_map, units, ex: _ExchangeStats) -> None:
+    """Per-host CDN fallback for units the exchange could not deliver.
+    Runs through the full waterfall (a *different* peer or the swarm
+    tier may still serve them before CDN does)."""
+    for hh, fi in units:
+        if _already_cached(bridge, hh, fi):
+            continue
+        try:
+            data, source = bridge.fetch_unit_tiered(hh, fi)
+        except Exception:  # noqa: BLE001 - landing waterfall retries per term
+            continue
+        _cache_unit(bridge, entries_map, hh, fi, fi.range.start, data)
+        with ex.lock:
+            ex.fallback_units += 1
+            ex.fallback_bytes += len(data)
+            ex.fallback_tiers[source] = (
+                ex.fallback_tiers.get(source, 0) + len(data))
+        _M_COOP_FALLBACKS.inc()
+
+
+# ── Address exchange over the jax.distributed KV store ──
+
+
+def _advertise_host() -> str:
+    """The address peer hosts should dial for this host's DCN listener:
+    ``ZEST_COOP_ADVERTISE`` when set, else the primary interface's
+    routable IP (UDP-connect trick — no packet is sent), else the
+    hostname's resolution. Loopback is the LAST resort: on a real
+    multi-host job an announced 127.0.0.1 makes every peer dial itself
+    and the exchange silently degrade to full CDN."""
+    import os
+    import socket
+
+    env = os.environ.get("ZEST_COOP_ADVERTISE")
+    if env:
+        return env
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # route lookup only
+            addr = s.getsockname()[0]
+        if addr and not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if addr:
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+def exchange_addrs_via_kv(pull_key: str, host_index: int, n_hosts: int,
+                          dcn_port: int, advertise_host: str | None = None,
+                          timeout_s: float = 30.0):
+    """Best-effort DCN endpoint exchange through the coordinator KV
+    store (the pod-native discovery tier, parallel.coordinator): every
+    host announces ``zest/coop/{pull_key}/{index} -> host:port`` and
+    polls until all ``n_hosts`` entries exist. Returns the full addr
+    map, or None when jax.distributed is not initialized / peers never
+    appear — the caller then needs explicit ``host_addrs`` or degrades.
+    """
+    from zest_tpu.parallel.coordinator import _kv_client
+
+    client = _kv_client()
+    if client is None:
+        return None
+    if advertise_host is None:
+        advertise_host = _advertise_host()
+    prefix = f"zest/coop/{pull_key}"
+    try:
+        client.key_value_set(f"{prefix}/{host_index}",
+                             f"{advertise_host}:{dcn_port}",
+                             allow_overwrite=True)
+    except Exception:  # noqa: BLE001 - KV write failure = no coop
+        return None
+    deadline = time.monotonic() + timeout_s
+    addrs: dict[int, tuple[str, int]] = {}
+    while time.monotonic() < deadline:
+        try:
+            entries = client.key_value_dir_get(prefix)
+        except Exception:  # noqa: BLE001
+            entries = []
+        for key, value in entries:
+            idx = key.rsplit("/", 1)[-1]
+            host, _, port = value.rpartition(":")
+            if idx.isdigit() and host and port.isdigit():
+                addrs[int(idx)] = (host, int(port))
+        if len(addrs) >= n_hosts:
+            return addrs
+        time.sleep(0.2)
+    return addrs if len(addrs) > 1 else None

@@ -536,12 +536,17 @@ class DcnChannel:
         ).wait(self.timeout)
 
     def request_many(
-        self, wants: list[tuple[bytes, int, int]]
+        self, wants: list[tuple[bytes, int, int]],
+        timeout: float | None = None,
     ) -> list[DcnMessage]:
         """Pipelined batch: all requests go out before any response is
-        awaited; results come back in ``wants`` order."""
+        awaited; results come back in ``wants`` order. ``timeout``
+        overrides the channel default per call — the cooperative
+        exchange bounds each window by its round deadline's remainder
+        instead of letting one silent owner hold a 30 s default."""
         waiters = [self.send_request(*w) for w in wants]
-        return [w.wait(self.timeout) for w in waiters]
+        t = self.timeout if timeout is None else timeout
+        return [w.wait(t) for w in waiters]
 
 
 class _Waiter:
@@ -607,26 +612,31 @@ class DcnPool:
             return ch, False
 
     def request_many(
-        self, host: str, port: int, wants: list[tuple[bytes, int, int]]
+        self, host: str, port: int, wants: list[tuple[bytes, int, int]],
+        timeout: float | None = None,
     ) -> list[DcnMessage]:
         """Pipelined batch through a pooled channel, transparently
         reconnecting and retrying ONCE when a previously pooled channel
         turns out to be dead (the server's IDLE_TIMEOUT_S drop lands
         exactly here: the pool believed the channel was live, the first
         send/response proves otherwise). A *fresh* connection's failure
-        propagates — that's a real peer problem, not staleness."""
+        propagates — that's a real peer problem, not staleness.
+        ``timeout`` caps each response wait for this call only."""
+        # Forwarded only when set: injected channel doubles (tests,
+        # wrappers) predate the parameter.
+        kw = {} if timeout is None else {"timeout": timeout}
         with telemetry.span("dcn.request_many", peer=f"{host}:{port}",
                             requests=len(wants)):
             ch, reused = self._lease(host, port)
             try:
-                return ch.request_many(wants)
+                return ch.request_many(wants, **kw)
             except (ConnectionError, TimeoutError, OSError):
                 self.drop(host, port)
                 if not reused:
                     raise
                 ch, _ = self._lease(host, port)
                 try:
-                    return ch.request_many(wants)
+                    return ch.request_many(wants, **kw)
                 except (ConnectionError, TimeoutError, OSError):
                     self.drop(host, port)
                     raise

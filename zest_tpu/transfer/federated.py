@@ -112,6 +112,7 @@ def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
 def warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
     entries_map: dict[str, list[FetchInfo]] | None = None,
+    units: list[tuple[str, FetchInfo]] | None = None,
 ) -> dict:
     """Fetch every uncached unit of ``recs`` into the local cache with
     ``max_concurrent`` waterfall fetches in flight (the reference's
@@ -131,24 +132,35 @@ def warm_units_parallel(
     another shard reads its later chunks — caching the truncated blob
     under the full key would shadow the other shard's partial entries
     and poison extraction.
+
+    ``units`` restricts the warm to an explicit subset of ``recs``'s
+    fetch units — the cooperative round's fetch phase (transfer.coop)
+    warms exactly its ownership-plan share through this same resilient
+    path (width heuristics, retry pass, streamed CDN tier) instead of
+    reimplementing it. ``entries_map`` must still span ALL files, for
+    the same evidence reason as above.
     """
     with telemetry.span("warm.units", shards=len(recs)):
         return _warm_units_parallel(bridge, recs, max_concurrent,
-                                    entries_map)
+                                    entries_map, units)
 
 
 def _warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
     entries_map: dict[str, list[FetchInfo]] | None = None,
+    units: list[tuple[str, FetchInfo]] | None = None,
 ) -> dict:
     import os
     from concurrent.futures import ThreadPoolExecutor
 
     if entries_map is None:
         entries_map = _entries_by_hash(recs)
+    if units is None:
+        units = [(hash_hex, fi)
+                 for (hash_hex, _s), fi in collect_units(recs)]
     wanted = [
         (hash_hex, fi)
-        for (hash_hex, _s), fi in collect_units(recs)
+        for hash_hex, fi in units
         if not _already_cached(bridge, hash_hex, fi)
     ]
     if max_concurrent is None:

@@ -74,6 +74,30 @@ def _device_verify_full_xorb(data: bytes, hash_hex: str, hasher,
         return False
 
 
+def make_unit_verifier(key: bytes | None = None):
+    """``verify(hash_hex, data) -> bool`` for provably-whole xorb blobs
+    — one construction shared by every tier that admits peer-served
+    whole units into the cache (the pod round's ICI gather fill and the
+    cooperative exchange, transfer.coop). On TPU the BG4 chunks of the
+    blob expand+verify in one fused Pallas pass
+    (ops.decode_pallas.FusedBg4Verifier) so the compressed wire bytes
+    are judged where the FLOPs are; elsewhere the host batch hasher
+    runs. Built once per round: the hasher/fused-kernel setup is not
+    per-unit work."""
+    from zest_tpu.ops import fused_verifier_for_backend, unit_verify_hasher
+
+    if key is None:
+        key = hashing.CHUNK_KEY
+    hasher = unit_verify_hasher(key)
+    fused = fused_verifier_for_backend(key)
+
+    def verify(hash_hex: str, data: bytes) -> bool:
+        return _device_verify_full_xorb(data, hash_hex, hasher,
+                                        fused=fused)
+
+    return verify
+
+
 def fetch_file_header(bridge, rec):
     """Parse a safetensors header by fetching only the file's head terms.
 
@@ -199,7 +223,6 @@ def _pod_round(
     if not plan.assignments or n <= 1:
         return {"slots": n, "units": len(plan.assignments), "skipped": True}
 
-    from zest_tpu.ops import best_hasher, fused_verifier_for_backend
     from zest_tpu.parallel.collectives import split_waves
 
     if budget_bytes is None:
@@ -210,11 +233,9 @@ def _pod_round(
     # Full xorbs are device-verified before caching; partial-range blobs
     # carry per-chunk hashes in their frames, checked at extraction
     # (XorbReader) — same trust boundary as the reference's cache writes
-    # (swarm.zig:416-420).
-    hasher = best_hasher(hashing.CHUNK_KEY)
-    # TPU only: BG4 chunks expand+verify in one fused device pass
-    # (ops.decode_pallas); None elsewhere keeps the host decode.
-    fused = fused_verifier_for_backend(hashing.CHUNK_KEY)
+    # (swarm.zig:416-420). On TPU the verifier's BG4 chunks
+    # expand+verify in one fused device pass (ops.decode_pallas).
+    verifier = make_unit_verifier()
     filled = rejected = 0
     gather_s = fill_s = 0.0
     peak_pool = 0
@@ -225,12 +246,7 @@ def _pod_round(
             lambda a: bridge.fetch_unit(a.hash_hex, a.fetch_info),
         )
         t_gather = time.monotonic()
-        f, r = pool.fill_cache(
-            bridge.cache,
-            verify=lambda hh, data: _device_verify_full_xorb(
-                data, hh, hasher, fused=fused
-            ),
-        )
+        f, r = pool.fill_cache(bridge.cache, verify=verifier)
         filled += f
         rejected += r
         peak_pool = max(peak_pool, pool.layout.pool_bytes)

@@ -544,6 +544,10 @@ def pull_model(
     pods: int | None = None,
     pod_index: int | None = None,
     pod_addrs: dict[int, tuple[str, int]] | None = None,
+    coop: bool | None = None,
+    coop_hosts: int | None = None,
+    coop_index: int | None = None,
+    coop_addrs: dict[int, tuple[str, int]] | None = None,
     log=print,
 ) -> PullResult:
     t0 = time.monotonic()
@@ -556,6 +560,8 @@ def pull_model(
         try:
             result = _pull_model(cfg, repo_id, revision, device, swarm,
                                  no_p2p, pod, pods, pod_index, pod_addrs,
+                                 (coop, coop_hosts, coop_index,
+                                  coop_addrs),
                                  log, t0)
         except BaseException:
             _M_PULLS.inc(outcome="error")
@@ -579,6 +585,7 @@ def _pull_model(
     pods: int | None,
     pod_index: int | None,
     pod_addrs: dict[int, tuple[str, int]] | None,
+    coop_args: tuple,
     log,
     t0: float,
 ) -> PullResult:
@@ -662,8 +669,9 @@ def _pull_model(
             env = os.environ.get("ZEST_TPU_POD")
             pod = env == "1" if env in ("0", "1") else device == "tpu"
         fed = pods is not None and pods > 1 and pod_index is not None
-        pod_stats = fed_stats = None
-        if pod or fed:
+        coop_cfg = _resolve_coop(cfg, *coop_args, log=log)
+        pod_stats = fed_stats = coop_stats = None
+        if pod or fed or coop_cfg:
             pending = [
                 e for e in files
                 if e.is_xet and not _is_complete(snapshot_dir, e)
@@ -680,6 +688,19 @@ def _pull_model(
                         "continuing with the per-host waterfall",
                         file=sys.stderr)
                     recs = None
+                # Cooperative host tier FIRST (transfer.coop): each host
+                # fetches ~1/N and the exchange completes the cache, so
+                # the federated/pod stages (and the landing) run
+                # peer-fed. Failure degrades to the full waterfall.
+                if recs and coop_cfg:
+                    try:
+                        coop_stats = _coop_stage(
+                            bridge, recs, cfg, coop_cfg, repo_id,
+                            commit_sha, log)
+                    except Exception as exc:  # noqa: BLE001
+                        log(f"cooperative pull unavailable ({exc}); "
+                            "continuing with the per-host waterfall",
+                            file=sys.stderr)
                 # Cross-pod stage first (pods that are separate processes —
                 # DCN chunk RPC), so the in-pod collective spreads a warm
                 # cache. Either round failing degrades to the waterfall.
@@ -779,6 +800,12 @@ def _pull_model(
         # that time_to_hbm_s < elapsed_s, schema-level).
         stats["files_after_hbm_s"] = round(
             clock.coverage_after("files", hbm_done_at), 4)
+    if coop_stats is not None:
+        stats["coop"] = coop_stats
+        # Headline stat (README schema note): the fraction of this
+        # round's network bytes served by peers instead of CDN — the
+        # number the ≥90% north-star target is judged on.
+        stats["peer_served_ratio"] = coop_stats.get("peer_served_ratio")
     if fed_stats is not None:
         stats["federated"] = fed_stats
     if pod_stats is not None:
@@ -1075,6 +1102,71 @@ class _PipelinedWarm:
         if unsummed:
             out["unsummed_keys"] = unsummed
         return out
+
+
+def _resolve_coop(cfg, coop, coop_hosts, coop_index, coop_addrs, log):
+    """Resolve the cooperative-pull topology: explicit args > config
+    (ZEST_COOP*) > auto. Auto turns coop ON when a multi-host topology
+    is actually known (addr map / host count / multi-process mesh) —
+    the ISSUE's "auto when a multi-host mesh is present" — and quietly
+    OFF otherwise; an explicit ``coop=True`` with an unusable topology
+    logs why it degraded. Returns (index, n_hosts, addrs) or None."""
+    enabled = coop if coop is not None else cfg.coop_pull
+    if enabled is False:
+        return None
+    n = coop_hosts if coop_hosts is not None else cfg.coop_hosts
+    i = coop_index if coop_index is not None else cfg.coop_index
+    addrs = dict(coop_addrs) if coop_addrs else dict(cfg.coop_addrs)
+    if n is None and addrs:
+        n = max(addrs) + 1
+    if cfg.mesh.is_distributed:
+        if n is None:
+            n = cfg.mesh.num_processes
+        if i is None:
+            i = cfg.mesh.process_id
+    if enabled is None:
+        enabled = bool(n and n > 1)
+    if not enabled:
+        return None
+    if not n or n <= 1 or i is None or not 0 <= i < n:
+        log("cooperative pull disabled: need coop hosts > 1 and a "
+            f"host index in range (hosts={n}, index={i})",
+            file=sys.stderr)
+        return None
+    return i, n, addrs
+
+
+def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log):
+    """Run the cooperative round, discovering peer DCN endpoints over
+    the jax.distributed KV store when no explicit addr map was given
+    (the zero-config multi-host TPU job path). The DCN listener binds
+    BEFORE the announce so peers learn the truly bound port; it stays
+    up under the bridge until pull exit (peers behind us still read)."""
+    from zest_tpu.transfer.coop import (
+        CoopUnavailable, coop_round, exchange_addrs_via_kv,
+    )
+    from zest_tpu.transfer.dcn import DcnServer
+
+    host_index, n_hosts, addrs = coop_cfg
+    server = None
+    if not addrs:
+        server = DcnServer(cfg, bridge.cache)
+        try:
+            port = server.start()
+        except OSError:
+            server, port = None, cfg.dcn_port
+        else:
+            bridge.adopt_coop_server(server)
+        addrs = exchange_addrs_via_kv(
+            f"{repo_id}@{commit_sha}", host_index, n_hosts, port)
+        if not addrs:
+            raise CoopUnavailable(
+                "no coop peer addresses: set ZEST_COOP_ADDRS or run "
+                "under jax.distributed for KV discovery")
+    return coop_round(bridge, recs, host_index, n_hosts, addrs,
+                      server=server,
+                      budget_bytes=cfg.coop_inflight_bytes,
+                      log=lambda m: log(m))
 
 
 def _early_config(hub, repo_id, revision, files, snapshot_dir) -> dict | None:
