@@ -17,6 +17,16 @@ the round concurrently. Asserts, schema- and content-level:
   (the fixture is generated compressible, as real checkpoints are);
 - zero exchange fallbacks on the healthy path.
 
+Fleet-observability gates (ISSUE 7) — the run is TRACED, and after the
+pull the per-host spans merge into ONE Perfetto doc that must show:
+
+- >= 2 host tracks, every host sharing the pull's trace_id;
+- cross-host flow links (``dcn.request_many`` -> ``dcn.serve``);
+- span coverage >= 90% of each host's root pull/round span;
+
+then an injected ``dcn_reset`` round must leave a NON-EMPTY
+flight-recorder dump (fault fired -> fallback, in order).
+
 Exit 0 on success; prints the offending stats block and fails
 otherwise.
 """
@@ -37,10 +47,13 @@ REPO_ID = "smoke/coop-llama"
 
 def main() -> int:
     from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu import faults, telemetry
     from zest_tpu.bench_scale import llama_checkpoint_files
     from zest_tpu.cas.hub import HubClient
     from zest_tpu.config import Config
     from zest_tpu.models.loader import params_digest
+    from zest_tpu.telemetry import fleet, recorder
+    from zest_tpu.telemetry import trace as trace_mod
     from zest_tpu.transfer.bridge import XetBridge
     from zest_tpu.transfer.coop import coop_round
     from zest_tpu.transfer.dcn import DcnServer
@@ -71,7 +84,8 @@ def main() -> int:
         for i in range(1, N_HOSTS):
             bridge = XetBridge(host_cfg("coop", i))
             bridge.authenticate(REPO_ID)
-            server = DcnServer(bridge.cfg, bridge.cache)
+            server = DcnServer(bridge.cfg, bridge.cache,
+                               span_attrs={"host": i})
             addrs[i] = ("127.0.0.1", server.start())
             peers.append(bridge)
             servers.append(server)
@@ -82,9 +96,18 @@ def main() -> int:
         # 0 gets a pre-started server over its cache dir too.
         cfg0 = host_cfg("coop", 0)
         server0 = DcnServer(cfg0, __import__(
-            "zest_tpu.storage", fromlist=["XorbCache"]).XorbCache(cfg0))
+            "zest_tpu.storage", fromlist=["XorbCache"]).XorbCache(cfg0),
+            span_attrs={"host": 0})
         addrs[0] = ("127.0.0.1", server0.start())
         servers.append(server0)
+
+        # Traced run (ISSUE 7): the pull mints the fleet trace_id from
+        # repo@sha (no KV store here, so nonce=""); peers derive the
+        # SAME id the same way — the correlation contract under test.
+        telemetry.set_enabled(True)
+        tracer = trace_mod.install(None)
+        sha = HubClient(cfg0).resolve_revision(REPO_ID, "main")
+        trace_id = fleet.mint_trace_id(f"{REPO_ID}@{sha}")
 
         peer_results: list = [None] * N_HOSTS
         peer_errors: list[str] = []
@@ -95,7 +118,8 @@ def main() -> int:
                         for e in HubClient(bridge.cfg).list_files(REPO_ID)
                         if e.is_xet]
                 peer_results[idx] = coop_round(
-                    bridge, recs, idx, N_HOSTS, addrs)
+                    bridge, recs, idx, N_HOSTS, addrs,
+                    trace_id=trace_id)
             except Exception as exc:  # noqa: BLE001 - reported below
                 peer_errors.append(f"host {idx}: {exc!r}")
 
@@ -153,13 +177,85 @@ def main() -> int:
             return fail(f"HBM contents diverge: coop {coop_digest[:16]} "
                         f"vs solo {solo_digest[:16]}")
 
+        # ── Fleet trace gates (ISSUE 7) ──
+        if coop.get("trace_id") != trace_id:
+            return fail(f"pull trace_id {coop.get('trace_id')} != "
+                        f"minted {trace_id}", coop)
+        for i, r in enumerate(peer_results):
+            if r and r.get("trace_id") != trace_id:
+                return fail(f"host {i+1} trace_id diverged", r)
+        doc = tracer.to_chrome()
+        per_host = fleet.split_hosts(doc, default_host=0)
+        merged = fleet.merge_traces(per_host)
+        meta = merged["otherData"]
+        if len(meta["merged_hosts"]) < 2:
+            return fail(f"merged trace has {meta['merged_hosts']} "
+                        "host tracks (< 2)", meta)
+        if meta.get("trace_ids") != [trace_id]:
+            return fail(f"merged trace_ids {meta.get('trace_ids')} != "
+                        f"[{trace_id}]", meta)
+        if not meta["flow_links"]:
+            return fail("no cross-host dcn.request_many→dcn.serve "
+                        "flow links in the merged trace", meta)
+        for host in sorted(per_host):
+            root_name = "pull" if host == 0 else "coop.round"
+            cov, root_s = fleet.host_coverage_s(merged, host, root_name)
+            if not root_s or cov < 0.9 * root_s:
+                return fail(
+                    f"host {host} trace coverage {cov:.2f}s < 90% of "
+                    f"its {root_name} span ({root_s:.2f}s)")
+        merged_path = rootp / "coop-merged-trace.json"
+        merged_path.write_text(json.dumps(merged))
+
+        # ── Flight recorder on an injected dcn_reset round ──
+        faults.install("dcn_reset:1.0", seed=1337)
+        try:
+            chaos, chaos_addrs, chaos_servers = [], {}, []
+            for i in range(2):
+                b = XetBridge(host_cfg("chaos", i))
+                b.authenticate(REPO_ID)
+                s = DcnServer(b.cfg, b.cache, span_attrs={"host": i})
+                chaos_addrs[i] = ("127.0.0.1", s.start())
+                chaos.append(b)
+                chaos_servers.append(s)
+
+            def run_chaos(i):
+                recs = [chaos[i].get_reconstruction(e.xet_hash)
+                        for e in HubClient(chaos[i].cfg)
+                        .list_files(REPO_ID) if e.is_xet]
+                coop_round(chaos[i], recs, i, 2, chaos_addrs,
+                           server=chaos_servers[i])
+
+            ct = [threading.Thread(target=run_chaos, args=(i,),
+                                   daemon=True) for i in range(2)]
+            for t in ct:
+                t.start()
+            for t in ct:
+                t.join(timeout=120)
+            for s in chaos_servers:
+                s.shutdown()
+        finally:
+            faults.reset()
+        kinds = [e["kind"] for e in recorder.tail()]
+        if "fault_fired" not in kinds or "cdn_fallback" not in kinds:
+            return fail(f"flight recorder missed the chaos story: "
+                        f"{kinds[-20:]}")
+        dump_path = recorder.RECORDER.dump(rootp / "recorder.json",
+                                           reason="injected dcn_reset")
+        dumped = json.loads(pathlib.Path(dump_path).read_text())
+        if not dumped["events"]:
+            return fail("flight-recorder dump is empty", dumped)
+
         peer_ratios = [round(r["peer_served_ratio"], 3)
                        for r in peer_results if r]
         print("coop smoke OK: host-0 peer_served_ratio "
               f"{ratio:.3f}, exchange {ex['units']} units / "
               f"{ex['wire_bytes']} wire bytes "
               f"({ex['unpacked_bytes']} unpacked), peers "
-              f"{peer_ratios}, HBM digest {coop_digest[:16]} == solo")
+              f"{peer_ratios}, HBM digest {coop_digest[:16]} == solo; "
+              f"merged trace: {len(meta['merged_hosts'])} host tracks, "
+              f"{meta['flow_links']} flow links, trace_id {trace_id[:8]}…; "
+              f"recorder dump: {len(dumped['events'])} events")
     return 0
 
 

@@ -1,0 +1,705 @@
+"""Fleet observability (ISSUE 7): cross-host trace correlation, pod
+metrics aggregation, and the flight recorder.
+
+- merged-trace round-trip: two simulated hosts run a real cooperative
+  round over loopback DCN; the single process trace splits into
+  per-host docs, merges into ONE Perfetto doc with per-host tracks,
+  a shared trace_id, client→server flow links, and the clock-offset
+  normalization metadata;
+- DCN hello negotiation: new↔new exchanges the v2 trace block (and a
+  clock-offset estimate), old↔new in BOTH directions degrades to v1
+  with the chunk RPC fully functional;
+- flight recorder: ring bound, event capture from injected faults
+  (reusing ZEST_FAULTS), dump-on-pull-failure crash report;
+- pod-scope metrics: counters summed, gauges host-labeled, histograms
+  re-summed, derived straggler/skew/ratio gauges, and the live
+  ``/v1/metrics?scope=pod`` endpoint with a dead-peer scrape error;
+- the knob-off contract: a ``ZEST_TELEMETRY=0`` cooperative pull is
+  byte-identical with zero spans and zero recorder events.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from fixtures import FixtureHub, FixtureRepo
+
+from zest_tpu import faults, telemetry
+from zest_tpu.cas import hashing
+from zest_tpu.cas.hub import HubClient
+from zest_tpu.config import Config
+from zest_tpu.telemetry import fleet, recorder as recorder_mod
+from zest_tpu.telemetry import trace as trace_mod
+from zest_tpu.transfer import dcn
+from zest_tpu.transfer.bridge import XetBridge
+from zest_tpu.transfer.coop import coop_round
+from zest_tpu.transfer.dcn import DcnChannel, DcnPool, DcnServer
+
+REPO_ID = "acme/fleet-model"
+
+_PAYLOAD = np.random.default_rng(11).integers(
+    0, 4, 1_200_000, dtype=np.uint8).tobytes()
+FILES = {
+    "config.json": b'{"model_type": "fleet"}',
+    "model.safetensors": _PAYLOAD,
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo(REPO_ID, FILES, chunks_per_xorb=2)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset_all()
+    faults.reset()
+    yield
+    telemetry.reset_all()
+    faults.reset()
+
+
+def _bridge(hub, root):
+    cfg = Config(hf_home=root / "hf", cache_dir=root / "zest",
+                 hf_token="hf_test", endpoint=hub.url, dcn_port=0)
+    b = XetBridge(cfg)
+    b.authenticate(REPO_ID)
+    return b
+
+
+def _recs(bridge):
+    return [bridge.get_reconstruction(e.xet_hash)
+            for e in HubClient(bridge.cfg).list_files(REPO_ID)
+            if e.is_xet]
+
+
+def _run_coop_hosts(hub, tmp_path, n):
+    """n concurrent simulated hosts with per-host DCN servers, each
+    round under its own thread trace context (the server's serve spans
+    get the host via span_attrs)."""
+    bridges, servers, addrs = [], [], {}
+    for i in range(n):
+        b = _bridge(hub, tmp_path / f"h{i}")
+        bridges.append(b)
+        s = DcnServer(b.cfg, b.cache, span_attrs={"host": i})
+        addrs[i] = ("127.0.0.1", s.start())
+        servers.append(s)
+    results: list = [None] * n
+    errors: list = []
+
+    def run(i):
+        try:
+            results[i] = coop_round(bridges[i], _recs(bridges[i]), i, n,
+                                    addrs, server=servers[i])
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for s in servers:
+        s.shutdown()
+    assert not errors, errors
+    return results
+
+
+# ── Trace identity ──
+
+
+def test_mint_trace_id_deterministic_and_nonce_scoped():
+    a = fleet.mint_trace_id("acme/m@sha1")
+    assert a == fleet.mint_trace_id("acme/m@sha1")
+    assert len(a) == 32 and bytes.fromhex(a)
+    assert a != fleet.mint_trace_id("acme/m@sha2")
+    assert a != fleet.mint_trace_id("acme/m@sha1", nonce="n1")
+
+
+# ── Merged-trace round-trip over a real cooperative round ──
+
+
+def test_merged_trace_round_trip_two_hosts(hub, tmp_path):
+    tracer = trace_mod.install(None)
+    results = _run_coop_hosts(hub, tmp_path, 2)
+
+    # Both hosts minted the SAME trace id with zero coordination.
+    assert results[0]["trace_id"] == results[1]["trace_id"]
+    trace_id = results[0]["trace_id"]
+    # ...and every host measured a clock offset from its peer's hello.
+    for i, r in enumerate(results):
+        peer = 1 - i
+        assert peer in r["clock_offsets"], r
+        off = r["clock_offsets"][peer]
+        assert abs(off["offset_s"]) < 2.0  # same machine: ~0, ±rtt/2
+        assert off["rtt_s"] >= 0.0
+
+    doc = tracer.to_chrome()
+    per_host = fleet.split_hosts(doc, default_host=0)
+    assert set(per_host) >= {0, 1}
+    merged = fleet.merge_traces(per_host)
+
+    meta = merged["otherData"]
+    assert set(meta["merged_hosts"]) >= {"0", "1"}
+    assert meta["trace_ids"] == [trace_id]
+    assert meta["flow_links"] > 0, "no dcn.request_many↔dcn.serve links"
+    assert set(meta["clock_normalization"]) >= {"0", "1"}
+    # Per-host tracks: one distinct synthetic pid per host, named.
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("host 0" in n for n in names)
+    assert any("host 1" in n for n in names)
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert len(pids) >= 2
+
+    # Every host's spans carry the shared trace_id; flow events bind
+    # client windows to serve spans via matching ids.
+    rounds = [e for e in merged["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "coop.round"]
+    assert len(rounds) == 2
+    assert all(e["args"]["trace_id"] == trace_id for e in rounds)
+    starts = [e for e in merged["traceEvents"] if e.get("ph") == "s"]
+    finishes = [e for e in merged["traceEvents"] if e.get("ph") == "f"]
+    assert starts and finishes
+    assert {e["id"] for e in starts} >= {e["id"] for e in finishes}
+
+    # Coverage per host: the round span dominates its track.
+    for host in (0, 1):
+        cov, root = fleet.host_coverage_s(merged, host, "coop.round")
+        assert root > 0 and cov >= 0.9 * root
+
+    # The merged doc is valid JSON and survives a file round trip.
+    out = tmp_path / "merged.json"
+    out.write_text(json.dumps(merged))
+    assert json.loads(out.read_text())["otherData"]["flow_links"] > 0
+
+
+def test_cli_merge_offline(tmp_path, capsys):
+    """``zest trace --merge a.json b.json``: offline merge of exported
+    per-host traces, host keys recovered from each doc's context."""
+    from zest_tpu import cli
+
+    docs = []
+    for host in (0, 1):
+        trace_mod.clear_context()
+        trace_mod.set_context(host=host, trace_id="cd" * 16)
+        t = trace_mod.install(None)
+        with telemetry.span("coop.round"):
+            pass
+        docs.append(t.to_chrome())
+        trace_mod.uninstall()
+    trace_mod.clear_context()
+    paths = []
+    for i, doc in enumerate(docs):
+        p = tmp_path / f"host{i}.json"
+        p.write_text(json.dumps(doc))
+        paths.append(str(p))
+    out = tmp_path / "merged.json"
+    assert cli.main(["trace", "--merge", *paths, "--out", str(out)]) == 0
+    assert "2 host tracks" in capsys.readouterr().out
+    merged = json.loads(out.read_text())
+    assert merged["otherData"]["merged_hosts"] == ["0", "1"]
+    assert merged["otherData"]["trace_ids"] == ["cd" * 16]
+
+
+# ── DCN hello negotiation (old ↔ new) ──
+
+
+@pytest.fixture
+def dcn_server(tmp_config):
+    from zest_tpu.storage import XorbCache
+
+    tmp_config.dcn_port = 0
+    server = DcnServer(tmp_config, XorbCache(tmp_config))
+    port = server.start()
+    yield server, port
+    server.shutdown()
+
+
+def test_hello_new_to_new_negotiates_v2(dcn_server):
+    _server, port = dcn_server
+    trace_mod.set_context(host=3, trace_id="ef" * 16)
+    try:
+        ch = DcnChannel("127.0.0.1", port, timeout=5.0)
+    finally:
+        trace_mod.clear_context()
+    try:
+        assert ch.hello.subversion == 2
+        assert ch.hello.clock_offset_s is not None
+        assert ch.hello.rtt_s is not None and ch.hello.rtt_s < 5.0
+        assert abs(ch.hello.clock_offset_s) < 2.0  # same clock
+        # The RPC still works over the negotiated stream.
+        reply = ch.request(b"\x01" * 32, 0, 1)
+        assert isinstance(reply, dcn.DcnNotFound)
+    finally:
+        ch.close()
+
+
+def test_hello_old_client_to_new_server(dcn_server):
+    """A v1 peer (version byte 1, reserved u16 zero, no trace block)
+    must be served exactly as before: the server's v2 advert lands in
+    bytes v1 never validated, and no extra block bytes follow."""
+    _server, port = dcn_server
+    with socket.create_connection(("127.0.0.1", port), timeout=5.0) as s:
+        s.sendall(b"ZDCN" + bytes([1, 0, 0, 0]))  # the v1 hello, verbatim
+        theirs = dcn._recv_exact(s, 8)
+        assert theirs[:4] == b"ZDCN"
+        assert theirs[4] == 1  # version byte still satisfies v1's check
+        # Negotiated down: the very next bytes are the RPC reply header,
+        # not a 32-byte trace block.
+        req = dcn.encode_message(dcn.DcnRequest(7, b"\x02" * 32, 0, 1))
+        s.sendall(req)
+        msg = dcn._recv_message(s)
+        assert isinstance(msg, dcn.DcnNotFound)
+        assert msg.request_id == 7
+
+
+def test_hello_new_client_to_old_server(tmp_path):
+    """A v1 server (sends the legacy 8-byte hello, expects none of the
+    v2 block) still serves a new client: the client reads rsvd=0 and
+    never sends its block."""
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    seen: dict = {}
+
+    def old_server():
+        conn, _ = lsock.accept()
+        with conn:
+            conn.sendall(b"ZDCN" + bytes([1, 0, 0, 0]))
+            hello = dcn._recv_exact(conn, 8)
+            seen["hello"] = hello
+            msg = dcn._recv_message(conn)  # v1 decode path
+            seen["request"] = msg
+            conn.sendall(dcn.encode_message(
+                dcn.DcnNotFound(msg.request_id, msg.chunk_hash)))
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    try:
+        ch = DcnChannel("127.0.0.1", port, timeout=5.0)
+        try:
+            assert ch.hello.subversion == 1
+            assert ch.hello.clock_offset_s is None
+            reply = ch.request(b"\x03" * 32, 0, 2)
+            assert isinstance(reply, dcn.DcnNotFound)
+        finally:
+            ch.close()
+        t.join(timeout=5)
+        assert seen["hello"][:5] == b"ZDCN" + bytes([1])
+        # Our advert rides the bytes v1 reserved (and ignored).
+        assert struct.unpack("<H", seen["hello"][6:8])[0] == 2
+        assert isinstance(seen["request"], dcn.DcnRequest)
+    finally:
+        lsock.close()
+
+
+def test_request_tag_reaches_server_spans(dcn_server):
+    """A traced pool tags its windows; the server's dcn.serve spans
+    carry the tag + the client's host identity — the flow-link key."""
+    server, port = dcn_server
+    tracer = trace_mod.install(None)
+    pool = DcnPool(timeout=5.0)
+    trace_mod.set_context(host=5, trace_id="aa" * 16)
+    try:
+        pool.request_many("127.0.0.1", port, [(b"\x04" * 32, 0, 1)])
+    finally:
+        trace_mod.clear_context()
+        pool.close()
+    spans = {s.name: s for s in tracer.spans()}
+    client = spans["dcn.request_many"]
+    assert client.attrs["flow_tag"] >= 1
+    serve = spans["dcn.serve"]
+    assert serve.attrs["tag"] == client.attrs["flow_tag"]
+    assert serve.attrs["client_host"] == 5
+    assert serve.attrs["trace_id"] == "aa" * 16
+
+
+def test_untraced_requests_stay_untagged(dcn_server):
+    """No tracer armed → no tag allocation: wire bytes and the
+    request shape match the pre-v2 path (the knob-off contract at the
+    transport layer)."""
+    _server, port = dcn_server
+    pool = DcnPool(timeout=5.0)
+    try:
+        ch = pool.channel("127.0.0.1", port)
+        sent = []
+        orig = ch.send_request
+
+        def spy(*a, **kw):
+            sent.append((a, kw))
+            return orig(*a, **kw)
+
+        ch.send_request = spy
+        pool.request_many("127.0.0.1", port, [(b"\x05" * 32, 0, 1)])
+        assert sent and sent[0][1].get("tag", 0) == 0
+    finally:
+        pool.close()
+
+
+# ── Flight recorder ──
+
+
+def test_recorder_ring_bound_and_dump(tmp_path):
+    rec = recorder_mod.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("fault_fired", fault=f"f{i}")
+    events = rec.tail()
+    assert len(events) == 8, "ring must stay bounded"
+    assert events[0]["fault"] == "f12" and events[-1]["fault"] == "f19"
+    assert rec.recorded == 20
+    out = tmp_path / "crash" / "report.json"
+    rec.dump(out, reason="test")
+    doc = json.loads(out.read_text())
+    assert doc["reason"] == "test"
+    assert doc["recorded_total"] == 20 and len(doc["events"]) == 8
+    assert not list(out.parent.glob("*.tmp.*"))
+
+
+def test_recorder_env_capacity(monkeypatch):
+    monkeypatch.setenv(recorder_mod.ENV_EVENTS, "3")
+    rec = recorder_mod.FlightRecorder()
+    assert rec.capacity == 3
+
+
+def test_recorder_tail_zero_is_empty():
+    rec = recorder_mod.FlightRecorder(capacity=4)
+    rec.record("fault_fired", fault="x")
+    assert rec.tail(0) == []      # [-0:] would be the WHOLE ring
+    assert rec.tail(-1) == []
+    assert len(rec.tail(1)) == 1
+
+
+def test_recorder_captures_chaos_round(hub, tmp_path):
+    """An injected dcn_reset exchange (reusing ZEST_FAULTS) leaves an
+    ordered story in the ring: the fault fired, then the fallbacks —
+    and the dump is a valid non-empty crash report."""
+    faults.install("dcn_reset:1.0", seed=1337)
+    _run_coop_hosts(hub, tmp_path, 2)
+    kinds = [e["kind"] for e in recorder_mod.tail()]
+    assert "fault_fired" in kinds
+    assert "exchange_dead_host" in kinds
+    assert "cdn_fallback" in kinds
+    assert kinds.index("fault_fired") < kinds.index("cdn_fallback")
+    path = recorder_mod.dump_crash_report(tmp_path, "chaos round")
+    assert path is not None
+    doc = json.loads((tmp_path / "crash").joinpath(
+        path.rsplit("/", 1)[-1]).read_text())
+    assert doc["events"]
+
+
+def test_pull_failure_dumps_crash_report(tmp_path):
+    """pull_model failure → crash-report JSON under cache_dir/crash."""
+    from zest_tpu.transfer.pull import pull_model
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 endpoint="http://127.0.0.1:9")  # nothing listens
+    with pytest.raises(Exception):
+        pull_model(cfg, "acme/nope", no_p2p=True,
+                   log=lambda *a, **k: None)
+    crashes = list((tmp_path / "zest" / "crash").glob("zest-crash-*.json"))
+    assert crashes, "no crash report written"
+    doc = json.loads(crashes[0].read_text())
+    assert any(e["kind"] == "pull_failed" for e in doc["events"])
+
+
+def test_recorder_off_with_telemetry_knob():
+    telemetry.set_enabled(False)
+    try:
+        telemetry.record("fault_fired", fault="x")
+        assert recorder_mod.tail() == []
+    finally:
+        telemetry.set_enabled(None)
+
+
+# ── Pod metrics aggregation ──
+
+_H0 = """\
+# HELP zest_coop_bytes_total coop bytes
+# TYPE zest_coop_bytes_total counter
+zest_coop_bytes_total{tier="cdn"} 100
+zest_coop_bytes_total{tier="dcn"} 700
+# HELP zest_coop_exchange_wall_seconds wall
+# TYPE zest_coop_exchange_wall_seconds gauge
+zest_coop_exchange_wall_seconds 2.0
+# HELP zest_coop_fetch_bytes fetch
+# TYPE zest_coop_fetch_bytes gauge
+zest_coop_fetch_bytes 400
+# HELP zest_pull_seconds lat
+# TYPE zest_pull_seconds histogram
+zest_pull_seconds_bucket{le="1"} 1
+zest_pull_seconds_bucket{le="+Inf"} 2
+zest_pull_seconds_sum 3.5
+zest_pull_seconds_count 2
+"""
+
+_H1 = """\
+# HELP zest_coop_bytes_total coop bytes
+# TYPE zest_coop_bytes_total counter
+zest_coop_bytes_total{tier="cdn"} 100
+zest_coop_bytes_total{tier="dcn"} 500
+# HELP zest_coop_exchange_wall_seconds wall
+# TYPE zest_coop_exchange_wall_seconds gauge
+zest_coop_exchange_wall_seconds 8.0
+# HELP zest_coop_fetch_bytes fetch
+# TYPE zest_coop_fetch_bytes gauge
+zest_coop_fetch_bytes 600
+# HELP zest_pull_seconds lat
+# TYPE zest_pull_seconds histogram
+zest_pull_seconds_bucket{le="1"} 0
+zest_pull_seconds_bucket{le="+Inf"} 1
+zest_pull_seconds_sum 4.5
+zest_pull_seconds_count 1
+"""
+
+
+def test_aggregate_counters_summed_gauges_labeled():
+    text = fleet.aggregate_prometheus({"0": _H0, "1": _H1})
+    parsed = fleet.parse_prometheus(text)
+    # Counters: summed across hosts per labelset.
+    assert parsed["zest_coop_bytes_total"]["samples"][
+        (("tier", "cdn"),)] == 200
+    assert parsed["zest_coop_bytes_total"]["samples"][
+        (("tier", "dcn"),)] == 1200
+    # Gauges: one sample per host, host-labeled.
+    walls = parsed["zest_coop_exchange_wall_seconds"]["samples"]
+    assert walls[(("host", "0"),)] == 2.0
+    assert walls[(("host", "1"),)] == 8.0
+    # Histograms: additive series re-summed.
+    assert parsed["zest_pull_seconds_count"]["samples"][()] == 3
+    assert parsed["zest_pull_seconds_sum"]["samples"][()] == 8.0
+    assert parsed["zest_pull_seconds_bucket"]["samples"][
+        (("le", "+Inf"),)] == 3
+
+
+def test_aggregate_derives_pod_gauges():
+    text = fleet.aggregate_prometheus({"0": _H0, "1": _H1})
+    parsed = fleet.parse_prometheus(text)
+    # Straggler: slowest (8.0) minus median (median(2,8)=5.0) = 3.0.
+    assert parsed["zest_coop_straggler_seconds"]["samples"][()] == \
+        pytest.approx(3.0)
+    # Fetch-share skew: max(600)/mean(500) = 1.2.
+    assert parsed["zest_coop_fetch_share_skew"]["samples"][()] == \
+        pytest.approx(1.2)
+    # Swarm-wide ratio: peerish 1200 / (1200 + 200) cdn.
+    assert parsed["zest_pod_peer_served_ratio"]["samples"][()] == \
+        pytest.approx(1200 / 1400)
+    assert parsed["zest_pod_hosts"]["samples"][()] == 2
+
+
+def test_aggregate_reports_scrape_errors():
+    text = fleet.aggregate_prometheus({"0": _H0}, errors={"1": "down"})
+    parsed = fleet.parse_prometheus(text)
+    assert parsed["zest_pod_scrape_errors"]["samples"][
+        (("host", "1"),)] == 1
+
+
+def test_aggregate_demotes_unparseable_host_to_scrape_error():
+    """A proxy's HTML error page behind a 200 must cost one host, not
+    the whole pod surface."""
+    text = fleet.aggregate_prometheus(
+        {"0": _H0, "1": "<html>502 Bad Gateway</html>"})
+    parsed = fleet.parse_prometheus(text)
+    assert parsed["zest_pod_hosts"]["samples"][()] == 1
+    assert parsed["zest_pod_scrape_errors"]["samples"][
+        (("host", "1"),)] == 1
+    assert parsed["zest_coop_bytes_total"]["samples"][
+        (("tier", "cdn"),)] == 100  # host 0 still aggregated
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        fleet.parse_prometheus("what even is this line\n")
+
+
+# ── HTTP surfaces ──
+
+
+@pytest.fixture
+def api(tmp_config):
+    from zest_tpu.api.http_api import HttpApi
+
+    requests = pytest.importorskip("requests")
+    tmp_config.http_port = 0
+    a = HttpApi(tmp_config)
+    port = a.start()
+    yield a, requests, f"http://127.0.0.1:{port}"
+    a.close()
+
+
+def test_v1_trace_endpoint(api):
+    a, requests, base = api
+    doc = requests.get(f"{base}/v1/trace", timeout=5).json()
+    assert doc["traceEvents"] == [] and "note" in doc["otherData"]
+    tracer = trace_mod.install(None)
+    with telemetry.span("pull", repo="x"):
+        pass
+    doc = requests.get(f"{base}/v1/trace", timeout=5).json()
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "pull" in names
+    assert doc["otherData"]["spans"] == len(tracer.spans())
+
+
+def test_v1_debug_endpoint(api):
+    _a, requests, base = api
+    telemetry.record("cdn_fallback", unit="abc", tier="cdn", bytes=5)
+    telemetry.counter("zest_coop_bytes_total", "", ("tier",)) \
+        .inc(900, tier="dcn")
+    telemetry.counter("zest_coop_bytes_total", "", ("tier",)) \
+        .inc(100, tier="cdn")
+    d = requests.get(f"{base}/v1/debug?tail=5", timeout=5).json()
+    assert d["recorder"]["events"][-1]["kind"] == "cdn_fallback"
+    assert d["coop"]["peer_served_ratio"] == pytest.approx(0.9)
+    assert d["coop"]["tier_bytes"] == {"dcn": 900, "cdn": 100}
+
+
+def test_v1_metrics_pod_scope_scrapes_peers(tmp_config):
+    """The coordinator aggregates a live peer and reports a dead one."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from zest_tpu.api.http_api import HttpApi
+
+    requests = pytest.importorskip("requests")
+
+    peer_text = ("# HELP zest_coop_bytes_total b\n"
+                 "# TYPE zest_coop_bytes_total counter\n"
+                 'zest_coop_bytes_total{tier="dcn"} 11\n')
+
+    class PeerHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            body = peer_text.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    peer_httpd = ThreadingHTTPServer(("127.0.0.1", 0), PeerHandler)
+    threading.Thread(target=peer_httpd.serve_forever, daemon=True).start()
+    peer_port = peer_httpd.server_address[1]
+
+    telemetry.counter("zest_coop_bytes_total", "", ("tier",)) \
+        .inc(9, tier="dcn")
+    tmp_config.http_port = 0
+    tmp_config.coop_index = 0
+    a = HttpApi(tmp_config, pod_peers={
+        1: ("127.0.0.1", peer_port),
+        2: ("127.0.0.1", 1),  # nothing listens: scrape error
+    })
+    port = a.start()
+    try:
+        r = requests.get(
+            f"http://127.0.0.1:{port}/v1/metrics?scope=pod", timeout=10)
+        assert r.status_code == 200
+        parsed = fleet.parse_prometheus(r.text)
+        assert parsed["zest_coop_bytes_total"]["samples"][
+            (("tier", "dcn"),)] == 20  # 9 local + 11 scraped
+        assert parsed["zest_pod_hosts"]["samples"][()] == 2
+        assert parsed["zest_pod_scrape_errors"]["samples"][
+            (("host", "2"),)] == 1
+        # Plain scope is untouched: local counters only.
+        local = fleet.parse_prometheus(requests.get(
+            f"http://127.0.0.1:{port}/v1/metrics", timeout=5).text)
+        assert local["zest_coop_bytes_total"]["samples"][
+            (("tier", "dcn"),)] == 9
+    finally:
+        a.close()
+        peer_httpd.shutdown()
+        peer_httpd.server_close()
+
+
+def test_cmd_debug_writes_report(api, tmp_path, monkeypatch):
+    from zest_tpu import cli
+
+    _a, _requests, base = api
+    port = base.rsplit(":", 1)[1]
+    monkeypatch.setenv("ZEST_HTTP_PORT", port)
+    telemetry.record("fault_fired", fault="cdn_503")
+    out = tmp_path / "report.json"
+    assert cli.main(["debug", "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert any(e["kind"] == "fault_fired"
+               for e in doc["recorder"]["events"])
+
+
+def test_cmd_stats_watch_renders_one_frame(api, monkeypatch, capsys):
+    from zest_tpu import cli
+
+    _a, _requests, base = api
+    monkeypatch.setenv("ZEST_HTTP_PORT", base.rsplit(":", 1)[1])
+    telemetry.counter("zest_coop_bytes_total", "", ("tier",)) \
+        .inc(42, tier="dcn")
+    telemetry.record("peer_strike", peer="10.0.0.9:7001", strike="corrupt")
+    assert cli.main(["stats", "--watch", "--count", "1",
+                     "--interval", "0.1"]) == 0
+    out = capsys.readouterr().out
+    assert "coop: peer_served=" in out
+    assert "peer_strike" in out
+
+
+# ── Knob-off contract: byte-identical coop pull, zero telemetry ──
+
+
+def test_knob_off_coop_pull_byte_identical(hub, tmp_path):
+    from zest_tpu.transfer.coop import CoopPlan
+    from zest_tpu.transfer.federated import warm_units_parallel
+    from zest_tpu.transfer.pull import pull_model
+
+    def coop_pull(root):
+        peer = _bridge(hub, root / "peer")
+        recs = _recs(peer)
+        warm_units_parallel(peer, recs,
+                            units=CoopPlan.build(recs, 2).for_host(1))
+        server = DcnServer(peer.cfg, peer.cache)
+        port = server.start()
+        try:
+            cfg = Config(hf_home=root / "p0/hf",
+                         cache_dir=root / "p0/zest",
+                         hf_token="hf_test", endpoint=hub.url,
+                         dcn_port=0)
+            return pull_model(cfg, REPO_ID, no_p2p=True, coop=True,
+                              coop_hosts=2, coop_index=0,
+                              coop_addrs={1: ("127.0.0.1", port)},
+                              log=lambda *a, **k: None)
+        finally:
+            server.shutdown()
+
+    tracer_on = trace_mod.install(None)
+    on = coop_pull(tmp_path / "on")
+    assert len(tracer_on) > 0
+    assert recorder_mod.RECORDER.recorded == 0 or True  # events optional
+    trace_mod.uninstall()
+    telemetry.reset_all()
+
+    tracer_off = trace_mod.install(None)
+    telemetry.set_enabled(False)
+    try:
+        off = coop_pull(tmp_path / "off")
+    finally:
+        telemetry.set_enabled(None)
+
+    for name, data in FILES.items():
+        assert (on.snapshot_dir / name).read_bytes() == data
+        assert (off.snapshot_dir / name).read_bytes() == data
+    assert len(tracer_off) == 0, "knob-off pull recorded spans"
+    assert recorder_mod.RECORDER.recorded == 0, \
+        "knob-off pull recorded flight-recorder events"
+    assert on.stats["coop"]["exchange"]["units"] == \
+        off.stats["coop"]["exchange"]["units"]
+    assert sorted(on.stats["coop"]) == sorted(off.stats["coop"])
+    # The pull restored the process trace context: a daemon's NEXT
+    # pull must not inherit this one's trace_id.
+    assert trace_mod.base_context() == {}

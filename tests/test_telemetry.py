@@ -437,8 +437,12 @@ class TestPullTelemetry:
         summary = clock.summary()
         assert set(summary) == {"fetch", "hbm_commit"}
         fetch_spans = [s for s in spans if s.name == "stage.fetch"]
+        # Tolerance = the summary's own rounding resolution (1e-4) plus
+        # headroom for the clock interval enclosing the span's enter/
+        # exit bookkeeping: near-zero stages can round up to 0.0001
+        # while the raw span walls stay in the µs range.
         assert summary["fetch"] <= sum(s.t1 - s.t0 for s in fetch_spans) \
-            + 1e-6
+            + 1e-3
 
     def test_faults_fired_lands_in_pull_stats(self, hub, tmp_path):
         faults.install("dcn_reset:1.0", seed=3)

@@ -14,16 +14,27 @@ The reference's http_api.zig: loopback-bound HTTP server routing
 - ``/v1/status`` additionally reports pod-level fields (HBM staging
   occupancy, mesh axes) — the TPU build's control plane surfaces the
   device tier too (SURVEY.md §2.1 row 16).
+- Fleet observability surfaces (ISSUE 7): ``GET /v1/trace`` (live span
+  snapshot as Chrome trace JSON — what ``zest trace --coop`` gathers
+  from every host), ``GET /v1/debug`` (flight-recorder tail + the coop
+  block the dashboard's panel polls), and ``GET /v1/metrics?scope=pod``
+  (the coordinator scrapes each pod peer's ``/v1/metrics`` and serves
+  one aggregated exposition: counters summed, gauges host-labeled,
+  derived ``zest_coop_straggler_seconds`` & co — telemetry.fleet).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from zest_tpu import faults, storage, telemetry
 from zest_tpu.config import Config
+from zest_tpu.telemetry import fleet
 from zest_tpu.version import __version__
 
 
@@ -41,6 +52,7 @@ class HttpApi:
         hbm_cache=None,
         swarm=None,
         dcn_server=None,
+        pod_peers: dict | None = None,
     ):
         self.cfg = cfg
         self.bt_server = bt_server
@@ -48,6 +60,10 @@ class HttpApi:
         self.hbm_cache = hbm_cache
         self.swarm = swarm
         self.dcn_server = dcn_server
+        # host index → (host, http_port) of the OTHER pod daemons, for
+        # the ?scope=pod aggregation (ZEST_POD_PEERS / --pod-peer).
+        self.pod_peers = dict(pod_peers if pod_peers is not None
+                              else getattr(cfg, "pod_peers", {}) or {})
         self.http_requests = 0
         # Live-state metrics: event counters mirror at bump time, but
         # occupancy/quarantine are *states*, so they register a
@@ -218,6 +234,105 @@ class HttpApi:
         from zest_tpu.storage import list_models
 
         return {"models": list_models(self.cfg)}
+
+    def trace_payload(self) -> dict:
+        """Live tracer snapshot as Chrome trace JSON (``GET /v1/trace``)
+        — the per-host piece ``zest trace --coop`` merges. Empty (with
+        a note) when no tracer is armed; gathering tools treat that as
+        a per-host error, not a gather failure."""
+        tracer = telemetry.trace.active()
+        if tracer is None:
+            return {"traceEvents": [],
+                    "otherData": {"tool": "zest-tpu",
+                                  "note": "no tracer armed "
+                                          "(set ZEST_TRACE)"}}
+        return tracer.to_chrome()
+
+    def debug_payload(self, tail: int = 100) -> dict:
+        """``GET /v1/debug``: the flight-recorder tail plus the live
+        coop summary the dashboard's panel renders — one JSON artifact
+        replacing the old ssh-and-grep triage loop."""
+        rec = telemetry.recorder.RECORDER
+        payload: dict = {
+            "recorder": {
+                "capacity": rec.capacity,
+                "recorded_total": rec.recorded,
+                "events": rec.tail(tail),
+            },
+            "telemetry": telemetry.status_snapshot(),
+        }
+        ctx = telemetry.trace.current_context()
+        if ctx:
+            payload["trace_context"] = ctx
+        fired = faults.counters()
+        if fired:
+            payload["faults"] = dict(sorted(fired.items()))
+
+        tiers = {}
+        for labels, value in self._metric_samples("zest_coop_bytes_total"):
+            tiers[labels.get("tier", "")] = int(value)
+        coop: dict = {}
+        if tiers:
+            peer = tiers.get("peer", 0) + tiers.get("dcn", 0)
+            total = peer + tiers.get("cdn", 0) + tiers.get("fallback", 0)
+            coop["tier_bytes"] = tiers
+            coop["peer_served_ratio"] = (
+                round(peer / total, 4) if total else None)
+        wall = self._metric_samples("zest_coop_exchange_wall_seconds")
+        if wall:
+            coop["exchange_wall_s"] = round(wall[0][1], 3)
+        for labels, value in self._metric_samples(
+                "zest_coop_fallbacks_total"):
+            coop["fallbacks"] = int(value)
+        if coop:
+            payload["coop"] = coop
+
+        health = getattr(self.swarm, "health", None) \
+            if self.swarm is not None else None
+        if health is not None and hasattr(health, "detail"):
+            payload["quarantined_peers"] = [
+                r for r in health.detail() if r["quarantined_for_s"] > 0]
+        return payload
+
+    @staticmethod
+    def _metric_samples(name: str) -> list:
+        for m in telemetry.REGISTRY.metrics():
+            if m.name == name:
+                return m.samples()
+        return []
+
+    def pod_metrics_text(self) -> str:
+        """``GET /v1/metrics?scope=pod``: this host's exposition plus a
+        concurrent scrape of every configured pod peer, aggregated by
+        telemetry.fleet (counters summed, gauges per-host labeled,
+        derived pod gauges). A peer that fails the scrape is reported
+        as ``zest_pod_scrape_errors{host=...}`` instead of failing the
+        whole surface — a flapping host is exactly when the operator
+        needs this endpoint."""
+        local_label = str(
+            self.cfg.coop_index if self.cfg.coop_index is not None
+            else self.cfg.mesh.process_id)
+        texts = {local_label: telemetry.render_prometheus()}
+        errors: dict = {}
+        peers = {str(k): v for k, v in self.pod_peers.items()
+                 if str(k) != local_label}
+        if peers:
+            def scrape(item):
+                label, (host, port) = item
+                url = f"http://{host}:{port}/v1/metrics"
+                try:
+                    with urllib.request.urlopen(url, timeout=2.0) as r:
+                        return label, r.read().decode(), None
+                except Exception as exc:  # noqa: BLE001 - per-host report
+                    return label, None, str(exc)
+
+            with ThreadPoolExecutor(max_workers=min(8, len(peers))) as ex:
+                for label, text, err in ex.map(scrape, peers.items()):
+                    if text is not None:
+                        texts[label] = text
+                    else:
+                        errors[label] = err
+        return fleet.aggregate_prometheus(texts, errors)
 
     def pull_events(self, repo_id: str, revision: str, device: str | None):
         """Generator of SSE progress events for one pull."""
@@ -494,31 +609,45 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         self.api.http_requests += 1
-        if self.path == "/v1/health":
+        url = urlparse(self.path)
+        query = parse_qs(url.query)
+        path = url.path
+        if path == "/v1/health":
             self._json({"status": "ok"})
-        elif self.path == "/v1/status":
+        elif path == "/v1/status":
             self._json(self.api.status_payload())
-        elif self.path == "/v1/metrics":
+        elif path == "/v1/metrics":
             # Prometheus text exposition format (0.0.4) — the scrape
-            # surface fleet collection points at.
-            body = telemetry.render_prometheus().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        elif self.path == "/v1/models":
+            # surface fleet collection points at. ``?scope=pod`` on the
+            # coordinator aggregates every configured pod peer.
+            if query.get("scope", [""])[0] == "pod":
+                text = self.api.pod_metrics_text()
+            else:
+                text = telemetry.render_prometheus()
+            self._text(text.encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/v1/trace":
+            self._json(self.api.trace_payload())
+        elif path == "/v1/debug":
+            try:
+                tail = int(query.get("tail", ["100"])[0])
+            except ValueError:
+                tail = 100
+            self._json(self.api.debug_payload(tail=tail))
+        elif path == "/v1/models":
             self._json(self.api.models_payload())
-        elif self.path == "/":
-            body = DASHBOARD_HTML.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        elif path == "/":
+            self._text(DASHBOARD_HTML.encode(),
+                       "text/html; charset=utf-8")
         else:
             self._json({"error": "not found"}, 404)
 
@@ -590,6 +719,10 @@ DASHBOARD_HTML = """<!doctype html>
 </style></head><body>
 <h1>zest-tpu <span id="ver" class="k"></span></h1>
 <div class="card"><table id="status"></table></div>
+<div class="card"><h2 style="font-size:1.05rem">Cooperative pull</h2>
+<table id="coop"></table>
+<h3 style="font-size:.95rem;margin-bottom:.2rem">Flight recorder</h3>
+<table id="recorder"><tbody></tbody></table></div>
 <div class="card"><h2 style="font-size:1.05rem">Cached models</h2>
 <table id="models"><thead><tr><th>repo</th><th>revision</th><th>files</th>
 </tr></thead><tbody></tbody></table></div>
@@ -606,6 +739,30 @@ async function tick(){
   document.querySelector('#models tbody').innerHTML=m.models.map(x=>
    `<tr><td>${x.repo_id}</td><td><code>${(x.revision||'').slice(0,12)}</code>
     </td><td>${x.files}</td></tr>`).join('');
+  // Coop panel (ISSUE 7): live peer-served ratio, per-tier bytes,
+  // quarantined peers, and the flight-recorder tail from /v1/debug.
+  const d=await (await fetch('/v1/debug?tail=8')).json();
+  const c=d.coop||{}, crows=[];
+  if(c.peer_served_ratio!=null)
+   crows.push(['peer_served_ratio',(c.peer_served_ratio*100).toFixed(1)+'%']);
+  for(const [t,b] of Object.entries(c.tier_bytes||{}))
+   crows.push(['bytes['+t+']',b.toLocaleString()]);
+  if(c.exchange_wall_s!=null)
+   crows.push(['exchange_wall_s',c.exchange_wall_s]);
+  if(c.fallbacks!=null) crows.push(['fallbacks',c.fallbacks]);
+  const q=(d.quarantined_peers||[]).map(p=>p.peer).join(', ');
+  if(crows.length||q) crows.push(['quarantined',q||'none']);
+  document.getElementById('coop').innerHTML=crows.map(([k,v])=>
+   `<tr><td class="k">${k}</td><td><code>${v}</code></td></tr>`).join('')
+   ||'<tr><td>no cooperative round yet</td></tr>';
+  const evs=(d.recorder||{}).events||[];
+  document.querySelector('#recorder tbody').innerHTML=evs.map(e=>{
+   const t=new Date(e.t*1000).toISOString().slice(11,23);
+   const extra=Object.entries(e).filter(([k])=>!['t','kind'].includes(k))
+    .map(([k,v])=>`${k}=${v}`).join(' ');
+   return `<tr><td><code>${t}</code></td><td class="k">${e.kind}</td>
+    <td><code>${extra}</code></td></tr>`;
+  }).join('')||'<tr><td>no events</td></tr>';
  }catch(e){}
 }
 tick();setInterval(tick,2000);

@@ -386,7 +386,16 @@ def cmd_serve(args) -> int:
     cfg.http_port_file().write_text(str(api.port))
     print(f"dashboard: http://127.0.0.1:{api.port}/")
 
-    def on_signal(_sig, _frm):
+    def on_signal(sig, _frm):
+        if sig == signal.SIGTERM:
+            # Flight-recorder contract (ISSUE 7): a SIGTERM'd daemon
+            # leaves its last-events crash report behind — the k8s/OOM
+            # eviction story is otherwise unreconstructable.
+            from zest_tpu.telemetry import recorder
+
+            path = recorder.dump_crash_report(cfg.cache_dir, "SIGTERM")
+            if path:
+                print(f"flight-recorder report: {path}")
         api.trigger_shutdown()
 
     signal.signal(signal.SIGTERM, on_signal)
@@ -402,12 +411,14 @@ def cmd_serve(args) -> int:
 
 
 def _announce_dashboard(cfg: Config) -> None:
-    """Print the dashboard URL once the daemon is healthy (reference:
-    main.zig:471-482 opens the browser after serve comes up); with
-    ``ZEST_OPEN_DASHBOARD=1`` also open it in the default browser —
-    opt-in, because `start` runs headless in CI and on pod hosts."""
+    """Print the dashboard + metrics URLs once the daemon is healthy
+    (reference: main.zig:471-482 opens the browser after serve comes
+    up); with ``ZEST_OPEN_DASHBOARD=1`` also open it in the default
+    browser — opt-in, because `start` runs headless in CI and on pod
+    hosts."""
     url = f"http://127.0.0.1:{cfg.effective_http_port()}/"
     print(f"dashboard: {url}")
+    print(f"metrics:   {url}v1/metrics  (?scope=pod on the coordinator)")
     if os.environ.get("ZEST_OPEN_DASHBOARD") == "1":
         import webbrowser
 
@@ -486,9 +497,13 @@ def cmd_status(_args) -> int:
 def cmd_stats(args) -> int:
     """Process-wide metrics from the daemon's registry: ``GET
     /v1/metrics`` verbatim (Prometheus text — pipe it anywhere a scraper
-    would), or the ``/v1/status`` telemetry/faults/peer-health blocks
-    with ``--json``."""
+    would), the ``/v1/status`` telemetry/faults/peer-health blocks with
+    ``--json``, or a 1 Hz live redraw with ``--watch`` (the operator's
+    top(1) over the new ``/v1/debug`` surface)."""
     cfg = Config.load()
+    if args.watch:
+        return _stats_watch(cfg, interval=args.interval,
+                            count=args.count)
     if args.json:
         payload = _daemon_get(cfg, "/v1/status")
         if payload is None:
@@ -505,10 +520,12 @@ def cmd_stats(args) -> int:
         print("error: `zest stats` needs the requests package",
               file=sys.stderr)
         return 1
+    scope = "?scope=pod" if args.pod else ""
     try:
         r = requests.get(
-            f"http://127.0.0.1:{cfg.effective_http_port()}/v1/metrics",
-            timeout=2.0,
+            f"http://127.0.0.1:{cfg.effective_http_port()}"
+            f"/v1/metrics{scope}",
+            timeout=10.0 if args.pod else 2.0,
         )
         r.raise_for_status()
     except requests.RequestException:
@@ -518,18 +535,170 @@ def cmd_stats(args) -> int:
     return 0
 
 
+def _stats_watch_lines(debug: dict, status: dict) -> list[str]:
+    """One redraw frame of ``zest stats --watch`` (pure — testable)."""
+    lines = [f"zest-tpu v{status.get('version', '?')}  "
+             f"http_requests={status.get('http_requests', 0)}  "
+             f"xorbs={status.get('xorbs_cached', 0)}"]
+    coop = debug.get("coop") or {}
+    if coop:
+        ratio = coop.get("peer_served_ratio")
+        tiers = " ".join(f"{t}={b}" for t, b in
+                         sorted((coop.get("tier_bytes") or {}).items()))
+        lines.append(
+            "coop: peer_served="
+            + (f"{ratio:.1%}" if ratio is not None else "n/a")
+            + (f"  wall={coop['exchange_wall_s']}s"
+               if "exchange_wall_s" in coop else "")
+            + (f"  fallbacks={coop['fallbacks']}"
+               if "fallbacks" in coop else "")
+            + (f"  [{tiers}]" if tiers else ""))
+    quarantined = debug.get("quarantined_peers") or []
+    if quarantined:
+        lines.append("quarantined: "
+                     + ", ".join(p["peer"] for p in quarantined))
+    faults_fired = debug.get("faults") or {}
+    if faults_fired:
+        lines.append("faults: " + " ".join(
+            f"{k}={v}" for k, v in sorted(faults_fired.items())))
+    events = (debug.get("recorder") or {}).get("events") or []
+    if events:
+        lines.append("recorder tail:")
+        for ev in events[-8:]:
+            extra = " ".join(f"{k}={v}" for k, v in ev.items()
+                             if k not in ("t", "kind"))
+            lines.append(f"  {ev.get('t', 0):.3f} {ev.get('kind')} {extra}")
+    return lines
+
+
+def _stats_watch(cfg: Config, interval: float = 1.0,
+                 count: int = 0) -> int:
+    """Redraw loop: ANSI home+clear per frame, Ctrl-C exits clean.
+    ``count`` bounds the frames (0 = until interrupted; tests use 1)."""
+    frames = 0
+    try:
+        while True:
+            debug = _daemon_get(cfg, "/v1/debug?tail=8") or {}
+            status = _daemon_get(cfg, "/v1/status") or {}
+            if not status:
+                print("daemon not running", file=sys.stderr)
+                return 1
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print("\n".join(_stats_watch_lines(debug, status)))
+            frames += 1
+            if count and frames >= count:
+                return 0
+            time.sleep(max(0.1, interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_debug(args) -> int:
+    """Dump the daemon's ``/v1/debug`` surface — the flight-recorder
+    tail, live coop summary, quarantine list — to stdout or, with
+    ``--out``, to a JSON report file (the post-hoc triage artifact)."""
+    cfg = Config.load()
+    payload = _daemon_get(cfg, f"/v1/debug?tail={args.tail}",
+                          timeout=5.0)
+    if payload is None:
+        print("daemon not running", file=sys.stderr)
+        return 1
+    body = json.dumps(payload, indent=2)
+    if args.out:
+        Path(args.out).write_text(body + "\n")
+        n = len((payload.get("recorder") or {}).get("events") or [])
+        print(f"debug report: {args.out} ({n} recorder events)")
+    else:
+        print(body)
+    return 0
+
+
+def _trace_merge_files(paths: list[str], out: str) -> int:
+    """Offline merge: N per-host trace exports → one Perfetto file.
+    Host keys come from each doc's recorded context (falling back to
+    the file's position)."""
+    from zest_tpu.telemetry import fleet
+
+    docs = {}
+    for i, p in enumerate(paths):
+        doc = json.loads(Path(p).read_text())
+        key = doc.get("otherData", {}).get("context", {}).get("host", i)
+        docs[key] = doc
+    merged = fleet.merge_traces(docs)
+    Path(out).write_text(json.dumps(merged))
+    meta = merged["otherData"]
+    print(f"merged trace: {out} ({len(meta['merged_hosts'])} host "
+          f"tracks, {meta['flow_links']} cross-host flow links)")
+    print("view:  https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _gather_and_merge(cfg, own_doc, own_host, peer_apis, out) -> int:
+    """``--coop`` tail: snapshot every peer daemon's ``/v1/trace`` and
+    merge with this host's trace into ONE multi-track file."""
+    from zest_tpu.telemetry import fleet
+
+    docs, errors = fleet.gather_traces(peer_apis)
+    for key, err in sorted(errors.items(), key=lambda i: str(i)):
+        print(f"host {key}: trace unavailable ({err})", file=sys.stderr)
+    # Prefer the host identity each doc recorded for itself.
+    keyed = {}
+    for key, doc in docs.items():
+        keyed[doc.get("otherData", {}).get("context", {})
+              .get("host", key)] = doc
+    keyed[own_host] = own_doc
+    merged = fleet.merge_traces(keyed, reference=own_host)
+    Path(out).write_text(json.dumps(merged))
+    meta = merged["otherData"]
+    print(f"merged trace: {out} ({len(meta['merged_hosts'])} host "
+          f"tracks, {meta['flow_links']} cross-host flow links)")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Pull with the span tracer armed and write a Chrome/Perfetto
     trace — the measurement tool of record for per-stage attribution
     (open the JSON at ui.perfetto.dev or chrome://tracing). Equivalent
     to ``ZEST_TRACE=out.json zest pull ...`` but also prints the span
-    count and wall-coverage so scripts can gate on a healthy trace."""
+    count and wall-coverage so scripts can gate on a healthy trace.
+
+    Fleet workflows (ISSUE 7): ``--merge a.json b.json`` merges
+    already-exported per-host traces offline (no pull); ``--coop``
+    runs the traced pull, then gathers every pod peer's live trace
+    (``GET /v1/trace`` at the ``--peer-api``/ZEST_POD_PEERS endpoints)
+    and writes ONE merged multi-track file instead of this host's
+    slice."""
+    if args.merge:
+        return _trace_merge_files(args.merge, args.out)
+    if args.repo is None:
+        print("error: a repo id is required unless --merge is given",
+              file=sys.stderr)
+        return 2
     cfg = Config.load()
     try:
         cfg.model_cache_dir(args.repo)  # repo-id syntax, pre-network
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    peer_apis = {}
+    if args.coop:
+        from zest_tpu.config import parse_host_addr
+
+        for spec in args.peer_api or []:
+            try:
+                idx, addr = parse_host_addr(spec)
+            except ValueError:
+                print(f"error: --peer-api {spec!r} is not I=HOST:PORT",
+                      file=sys.stderr)
+                return 2
+            peer_apis[idx] = addr
+        if not peer_apis:
+            peer_apis = dict(cfg.pod_peers)
+        if not peer_apis:
+            print("error: --coop needs peer API endpoints (--peer-api "
+                  "I=HOST:PORT or ZEST_POD_PEERS)", file=sys.stderr)
+            return 2
     from zest_tpu import telemetry
     from zest_tpu.telemetry import trace as trace_mod
     from zest_tpu.transfer.pull import pull_model
@@ -542,14 +711,23 @@ def cmd_trace(args) -> int:
     failed = None
     try:
         res = pull_model(cfg, args.repo, revision=args.revision,
-                         device=args.device, no_p2p=args.no_p2p)
+                         device=args.device, no_p2p=args.no_p2p,
+                         coop=True if args.coop else None)
     except Exception as exc:  # noqa: BLE001 - trace of a failed pull is
         failed = exc          # exactly what the operator wants to see
     elapsed = time.monotonic() - t0
-    n = tracer.export(args.out)
-    cov = tracer.coverage_s()
-    print(f"trace: {args.out} ({n} events, spans cover {cov:.2f}s "
-          f"of {elapsed:.2f}s wall)")
+    if args.coop:
+        own_host = cfg.coop_index if cfg.coop_index is not None \
+            else cfg.mesh.process_id
+        rc = _gather_and_merge(cfg, tracer.to_chrome(), own_host,
+                               peer_apis, args.out)
+        if rc:
+            return rc
+    else:
+        n = tracer.export(args.out)
+        cov = tracer.coverage_s()
+        print(f"trace: {args.out} ({n} events, spans cover {cov:.2f}s "
+              f"of {elapsed:.2f}s wall)")
     print("view:  https://ui.perfetto.dev or chrome://tracing")
     if failed is not None:
         print(f"error: pull failed: {failed}", file=sys.stderr)
@@ -722,11 +900,31 @@ def build_parser() -> argparse.ArgumentParser:
     stats_p.add_argument("--json", action="store_true",
                          help="telemetry/faults/peer-health blocks from "
                               "/v1/status as JSON instead")
+    stats_p.add_argument("--pod", action="store_true",
+                         help="pod-scope aggregation (/v1/metrics"
+                              "?scope=pod on the coordinator)")
+    stats_p.add_argument("--watch", action="store_true",
+                         help="live 1 Hz redraw over /v1/debug "
+                              "(Ctrl-C exits)")
+    stats_p.add_argument("--interval", type=float, default=1.0,
+                         help="redraw interval seconds (default 1.0)")
+    stats_p.add_argument("--count", type=int, default=0,
+                         help="stop after N frames (0 = forever)")
     stats_p.set_defaults(fn=cmd_stats)
+
+    debug_p = sub.add_parser(
+        "debug", help="dump the daemon's flight recorder + live "
+                      "coop summary (/v1/debug)")
+    debug_p.add_argument("--out", default=None, metavar="PATH",
+                         help="write the JSON report here instead of "
+                              "stdout")
+    debug_p.add_argument("--tail", type=int, default=100,
+                         help="recorder events to include (default 100)")
+    debug_p.set_defaults(fn=cmd_debug)
 
     trace_p = sub.add_parser(
         "trace", help="pull with the span tracer on; write a Chrome trace")
-    trace_p.add_argument("repo")
+    trace_p.add_argument("repo", nargs="?", default=None)
     trace_p.add_argument("--revision", default="main")
     trace_p.add_argument("--device", choices=["tpu"], default=None)
     trace_p.add_argument("--out", default="zest-trace.json",
@@ -734,6 +932,18 @@ def build_parser() -> argparse.ArgumentParser:
                          help="trace file (default zest-trace.json); "
                               "view at ui.perfetto.dev")
     trace_p.add_argument("--no-p2p", action="store_true")
+    trace_p.add_argument("--coop", action="store_true",
+                         help="after the traced coop pull, gather every "
+                              "pod peer's /v1/trace and write ONE "
+                              "merged multi-track file")
+    trace_p.add_argument("--peer-api", action="append",
+                         metavar="I=HOST:PORT",
+                         help="pod peer HTTP API endpoint for --coop "
+                              "(repeatable; default ZEST_POD_PEERS)")
+    trace_p.add_argument("--merge", nargs="+", default=None,
+                         metavar="TRACE.json",
+                         help="offline: merge per-host trace exports "
+                              "into --out (no pull)")
     trace_p.set_defaults(fn=cmd_trace)
     models_p = sub.add_parser(
         "models", help="list pulled models and xorb cache totals")

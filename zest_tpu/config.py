@@ -180,6 +180,13 @@ class Config:
     coop_addrs: dict[int, tuple[str, int]] = dataclasses.field(
         default_factory=dict)
     coop_inflight_bytes: int = DEFAULT_COOP_INFLIGHT_BYTES
+    # Pod fleet observability (telemetry.fleet; ISSUE 7): HTTP API
+    # endpoints of the OTHER hosts' daemons, ``ZEST_POD_PEERS=
+    # "1=hostB:9847,2=hostC:9847"`` (same grammar as coop addrs). The
+    # coordinator's daemon scrapes them for ``/v1/metrics?scope=pod``
+    # and ``zest trace --coop`` gathers their ``/v1/trace`` snapshots.
+    pod_peers: dict[int, tuple[str, int]] = dataclasses.field(
+        default_factory=dict)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
@@ -257,6 +264,7 @@ class Config:
             coop_inflight_bytes=max(1, int(
                 env.get("ZEST_COOP_INFLIGHT")
                 or DEFAULT_COOP_INFLIGHT_BYTES)),
+            pod_peers=_parse_coop_addrs(env.get("ZEST_POD_PEERS", "")),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,

@@ -160,6 +160,11 @@ class FaultInjector:
         with self._lock:
             self.fired[name] = self.fired.get(name, 0) + 1
         _M_FIRED.inc(fault=name)
+        # Flight-recorder breadcrumb (ISSUE 7): a chaos run's triage
+        # needs the fault's position in the event ORDER, not just its
+        # count — "dcn_reset fired, then the fallback, then the strike"
+        # is the story the counters can't tell.
+        telemetry.record("fault_fired", fault=name, key=key)
         return spec
 
     def counters(self) -> dict[str, int]:

@@ -119,6 +119,7 @@ class HealthRegistry:
 
     def record_failure(self, addr: Addr, kind: str = "error") -> bool:
         """One strike; True when this strike tripped the breaker."""
+        peer = f"{addr[0]}:{addr[1]}"
         with self._lock:
             p = self._peer_locked(addr)
             p.failures += 1
@@ -127,19 +128,28 @@ class HealthRegistry:
             p.strikes += 1
             _M_STRIKES.inc(kind=kind)
             if p.strikes < self.strikes_to_quarantine:
-                return False
-            p.quarantines += 1
-            window = min(
-                QUARANTINE_CAP_S,
-                self.quarantine_base_s * (2.0 ** (p.quarantines - 1)),
-            )
-            p.quarantined_until = self._time() + window
-            # Probation: on re-admit one more strike re-quarantines
-            # (with the doubled window); a success clears it.
-            p.strikes = self.strikes_to_quarantine - 1
-            self.quarantine_events += 1
-            _M_QUARANTINES.inc()
-            return True
+                tripped, window = False, 0.0
+            else:
+                p.quarantines += 1
+                window = min(
+                    QUARANTINE_CAP_S,
+                    self.quarantine_base_s * (2.0 ** (p.quarantines - 1)),
+                )
+                p.quarantined_until = self._time() + window
+                # Probation: on re-admit one more strike re-quarantines
+                # (with the doubled window); a success clears it.
+                p.strikes = self.strikes_to_quarantine - 1
+                self.quarantine_events += 1
+                _M_QUARANTINES.inc()
+                tripped = True
+        # Flight-recorder breadcrumbs, outside the lock (ISSUE 7): the
+        # circuit breaker's decisions in event order — what the counters
+        # alone can never reconstruct during triage.
+        telemetry.record("peer_strike", peer=peer, strike=kind)
+        if tripped:
+            telemetry.record("peer_quarantined", peer=peer,
+                             window_s=round(window, 2))
+        return tripped
 
     # ── Queries ──
 

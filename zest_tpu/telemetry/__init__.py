@@ -1,6 +1,6 @@
 """zest_tpu.telemetry — process-wide observability for the pull path.
 
-Three pieces, zero dependencies, all thread-safe:
+Five pieces, zero dependencies, all thread-safe:
 
 - **Spans** (:mod:`.trace`): ``with telemetry.span("swarm.fetch",
   xorb=h) as sp: ... sp.add_bytes(n)`` — nested wall-clock spans that
@@ -12,6 +12,15 @@ Three pieces, zero dependencies, all thread-safe:
   mirror into it, and live state registers scrape-time collectors.
   Exported as Prometheus text on the daemon's ``GET /v1/metrics`` and
   summarized in ``/v1/status`` / ``zest stats``.
+- **Fleet correlation** (:mod:`.fleet`): cross-host trace identity
+  (``mint_trace_id``), merged multi-track Perfetto traces
+  (``merge_traces``) with flow links and clock-offset normalization,
+  and the pod-scope Prometheus aggregation behind
+  ``GET /v1/metrics?scope=pod``.
+- **The flight recorder** (:mod:`.recorder`): a bounded ring of the
+  last N notable events (strikes, quarantines, fallbacks, faults,
+  verify rejections, budget declines), served at ``GET /v1/debug``
+  and dumped as a JSON crash report on pull failure / SIGTERM.
 - **The switch** (:mod:`.state`): ``ZEST_TELEMETRY=0`` turns the whole
   layer into flag checks; tracing additionally requires ``ZEST_TRACE``.
 
@@ -41,6 +50,8 @@ from zest_tpu.telemetry.trace import (  # noqa: F401
 )
 from zest_tpu.telemetry import state as _state
 from zest_tpu.telemetry import trace as trace  # noqa: PLC0414
+from zest_tpu.telemetry import recorder as recorder  # noqa: PLC0414
+from zest_tpu.telemetry.recorder import record  # noqa: F401
 
 __all__ = [
     "REGISTRY",
@@ -56,6 +67,8 @@ __all__ = [
     "enabled",
     "gauge",
     "histogram",
+    "record",
+    "recorder",
     "render_prometheus",
     "reset_all",
     "set_enabled",
@@ -82,7 +95,10 @@ def status_snapshot() -> dict:
 
 
 def reset_all() -> None:
-    """Tests: unresolve the enable flag, drop the tracer, clear metrics."""
+    """Tests: unresolve the enable flag, drop the tracer + contexts,
+    clear metrics, empty the flight recorder."""
     _state.reset()
     trace.reset()
+    trace.clear_context()
     REGISTRY.reset()
+    recorder.reset()

@@ -1,0 +1,160 @@
+"""Flight recorder: a bounded ring of the last N *notable* events.
+
+Post-hoc triage of a chaos-matrix run (or a real pod incident) used to
+be log archaeology: the interesting facts — which peer struck out, when
+the circuit breaker tripped, which exchange units fell back to CDN,
+what fault the injector fired right before the landing went sideways —
+are scattered across per-module counters that say *how many* but never
+*when* or *in what order*. The recorder is the ordering: every notable
+event lands in one process-wide ring with a wall-clock timestamp, the
+thread's open-span stack (so an event anchors into the Perfetto trace),
+and the fleet trace context (``trace_id``/``host``), and the ring is
+
+- served live at ``GET /v1/debug`` (the dashboard tails it),
+- dumped to a JSON crash report on pull failure / SIGTERM / an
+  operator's ``zest debug --out report.json``.
+
+Event kinds recorded by the instrumented sites (ISSUE 7):
+
+==================  ====================================================
+``fault_fired``     the chaos injector fired (zest_tpu.faults)
+``peer_strike``     a health strike (p2p.health; kind= the failure)
+``peer_quarantined``the strike tripped the circuit breaker
+``cdn_fallback``    an exchange/federated unit degraded to the waterfall
+``verify_rejected`` a peer/owner blob failed verification at the trust
+                    boundary
+``budget_decline``  a byte-budget handoff declined to the slow lane
+``pull_failed``     pull_model is about to re-raise (dumps the report)
+==================  ====================================================
+
+Same zero-cost discipline as every other telemetry surface: with
+``ZEST_TELEMETRY=0`` ``record()`` is one flag check; the ring itself is
+a deque append under a lock otherwise (the sites are failure paths and
+coarse-grained events, never per-chunk hot loops).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from zest_tpu.telemetry import state, trace
+
+ENV_EVENTS = "ZEST_RECORDER_EVENTS"
+DEFAULT_EVENTS = 512
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring for one process."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(ENV_EVENTS, DEFAULT_EVENTS))
+            except ValueError:
+                capacity = DEFAULT_EVENTS
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self.recorded = 0  # lifetime count (ring length caps at capacity)
+
+    def record(self, kind: str, /, **fields) -> None:
+        ev: dict = {"t": round(time.time(), 6), "kind": kind}
+        spans = trace.open_spans()
+        if spans:
+            ev["span"] = spans[-1]
+        ctx = trace.current_context()
+        if ctx:
+            ev.update({k: v for k, v in ctx.items() if k not in ev})
+        for k, v in fields.items():
+            if v is None:
+                continue
+            if k in ("t", "kind"):  # field names the envelope owns
+                k = f"{k}_"
+            ev[k] = v if isinstance(v, (str, int, float, bool)) else str(v)
+        with self._lock:
+            self._ring.append(ev)
+            self.recorded += 1
+
+    def tail(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            events = list(self._ring)
+        if n is None:
+            return events
+        return events[-n:] if n > 0 else []  # [-0:] would be ALL
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.recorded = 0
+
+    # ── Crash report ──
+
+    def report(self, reason: str = "") -> dict:
+        ctx = trace.current_context()
+        doc = {
+            "tool": "zest-tpu",
+            "kind": "flight-recorder",
+            "reason": reason,
+            "dumped_at": round(time.time(), 6),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "recorded_total": self.recorded,
+            "events": self.tail(),
+        }
+        if ctx:
+            doc["context"] = ctx
+        return doc
+
+    def dump(self, path: str | os.PathLike, reason: str = "") -> str:
+        """Write the crash-report JSON (atomic tmp+rename, same
+        discipline as the trace export); returns the path written."""
+        path = os.fspath(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.report(reason), f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+
+# ── Process-wide instance + module-level hooks ──
+
+RECORDER = FlightRecorder()
+
+
+def record(kind: str, /, **fields) -> None:
+    """The hot-path hook: one flag check when telemetry is off."""
+    if not state.enabled():
+        return
+    RECORDER.record(kind, **fields)
+
+
+def tail(n: int | None = None) -> list[dict]:
+    return RECORDER.tail(n)
+
+
+def dump_crash_report(cache_dir, reason: str) -> str | None:
+    """Dump under ``{cache_dir}/crash/`` with a timestamped name; None
+    when telemetry is off or the ring is empty (an empty report would
+    only bury the real one). Best-effort: a failing dump must never
+    mask the exception that triggered it."""
+    if not state.enabled() or not RECORDER.tail(1):
+        return None
+    try:
+        name = f"zest-crash-{int(time.time())}-{os.getpid()}.json"
+        return RECORDER.dump(os.path.join(os.fspath(cache_dir),
+                                          "crash", name), reason)
+    except OSError:
+        return None
+
+
+def reset() -> None:
+    """Tests: fresh ring at the env-configured capacity."""
+    global RECORDER
+    RECORDER = FlightRecorder()

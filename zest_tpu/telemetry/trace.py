@@ -37,6 +37,97 @@ ENV_TRACE = "ZEST_TRACE"
 # Hard cap on buffered spans per tracer (drops are counted, not silent).
 MAX_SPANS = 500_000
 
+# ── Trace context (fleet correlation, ISSUE 7) ──
+#
+# A pod-scale pull is N processes emitting N traces; what correlates
+# them is a shared ``trace_id`` plus a per-process ``host`` index
+# stamped on every span. Two scopes:
+#
+# - the *process* context (``set_context``): one host = one process in
+#   production, so stamping happens once at export time — zero per-span
+#   cost on the hot path;
+# - a *thread* context (``context()`` manager / ``use_context``): the
+#   in-process multi-host simulations (tests, the 8-device dryrun
+#   smoke) run each "host" as a thread of one process; their spans are
+#   stamped at record time so the merged trace can still split them
+#   into per-host tracks. Threads spawned inside a round must inherit
+#   explicitly (``current_context()`` → ``use_context``) — Python
+#   thread-locals do not propagate.
+
+_base_context: dict = {}
+_tls = threading.local()
+
+
+def set_context(**attrs) -> None:
+    """Merge ``attrs`` into the process-global trace context (stamped on
+    every exported event and recorded in the trace metadata). A value of
+    ``None`` removes the key."""
+    for k, v in attrs.items():
+        if v is None:
+            _base_context.pop(k, None)
+        else:
+            _base_context[k] = v
+
+
+def clear_context() -> None:
+    _base_context.clear()
+    _tls.ctx = {}
+
+
+def current_context() -> dict:
+    """Effective context for this thread: process base < thread overlay.
+    Pass the result to :func:`use_context` in worker threads a traced
+    round spawns."""
+    out = dict(_base_context)
+    out.update(getattr(_tls, "ctx", None) or {})
+    return out
+
+
+def base_context() -> dict:
+    """Snapshot of the process-global context (for save/restore around
+    a scope that installs its own — pull_model restores the previous
+    context at exit so a daemon's NEXT pull never exports under a
+    stale trace_id)."""
+    return dict(_base_context)
+
+
+def replace_context(ctx: dict) -> None:
+    """Replace the process-global context wholesale (the restore half
+    of :func:`base_context`)."""
+    _base_context.clear()
+    _base_context.update(ctx or {})
+
+
+def use_context(ctx: dict | None) -> None:
+    """Replace this thread's context overlay (worker-thread inheritance)."""
+    _tls.ctx = dict(ctx) if ctx else {}
+
+
+class context:
+    """Thread-local context overlay for a ``with`` block (simulated
+    hosts; restores the previous overlay on exit)."""
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+        self._prev: dict | None = None
+
+    def __enter__(self) -> "context":
+        self._prev = getattr(_tls, "ctx", None) or {}
+        merged = dict(self._prev)
+        merged.update(self._attrs)
+        _tls.ctx = merged
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.ctx = self._prev or {}
+
+
+def open_spans() -> tuple[str, ...]:
+    """Names of the spans currently open on THIS thread, outermost
+    first — the flight recorder stamps events with this to anchor them
+    in the trace without holding span references."""
+    return tuple(s.name for s in getattr(_tls, "stack", ()) or ())
+
 
 class Span:
     """One finished (or in-flight) span. Context-manager protocol; the
@@ -63,10 +154,34 @@ class Span:
     def __enter__(self) -> "Span":
         self.t0 = time.monotonic()
         self.tid = threading.get_ident()
+        # Context stamp at RECORD time (base < thread overlay; explicit
+        # attrs win, so the overlay must stamp before the base): the
+        # span keeps the identity that was true when it ran, and a
+        # daemon clearing the context after one pull cannot
+        # retroactively restamp (or unstamp) earlier spans at export.
+        tctx = getattr(_tls, "ctx", None)
+        if tctx:
+            for k, v in tctx.items():
+                self.attrs.setdefault(k, v)
+        if _base_context:
+            for k, v in _base_context.items():
+                self.attrs.setdefault(k, v)
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
         return self
 
     def __exit__(self, exc_type, exc, _tb) -> None:
         self.t1 = time.monotonic()
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif stack:  # defensive: out-of-order exit must not wedge the stack
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
         if exc_type is not None:
             # The error *class* only: messages can carry URLs/paths and
             # the trace file may be shared more widely than logs.
@@ -106,16 +221,34 @@ class Tracer:
         # traces from several hosts of one pod can be laid side by side.
         self.t_origin = time.monotonic()
         self.epoch_origin = time.time()
+        # Free-form export metadata (clock-offset estimates, peer maps):
+        # merged into the exported doc's ``otherData``.
+        self.metadata: dict = {}
 
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
+
+    def add_metadata(self, **kv) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                self.metadata[k] = v
 
     def _record(self, span: Span) -> None:
         with self._lock:
             if len(self._spans) >= MAX_SPANS:
                 self.dropped += 1
+            else:
+                self._spans.append(span)
                 return
-            self._spans.append(span)
+        # Outside the lock: the overflow used to be invisible outside
+        # the process — now it is a first-class metric (ISSUE 7
+        # satellite) a fleet scrape can alert on.
+        from zest_tpu.telemetry import metrics as _metrics
+
+        _metrics.counter(
+            "zest_trace_spans_dropped_total",
+            "Spans dropped at the tracer's MAX_SPANS ring bound",
+        ).inc()
 
     # ── Introspection ──
 
@@ -156,13 +289,18 @@ class Tracer:
         containment, which matches how our spans actually nest (a span
         opened inside another on the same thread closes inside it)."""
         pid = os.getpid()
+        base = dict(_base_context)
+        pname = "zest-tpu"
+        if "host" in base:
+            pname = f"zest-tpu host {base['host']}"
         events: list[dict] = [
             {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-             "args": {"name": "zest-tpu"}},
+             "args": {"name": pname}},
         ]
         with self._lock:
             spans = list(self._spans)
             dropped = self.dropped
+            metadata = dict(self.metadata)
         for s in spans:
             ev = {
                 "name": s.name,
@@ -173,6 +311,9 @@ class Tracer:
                 "tid": s.tid,
                 "cat": s.name.split(".", 1)[0],
             }
+            # Context attrs (trace_id/host) were stamped at RECORD time
+            # (Span.__enter__) — stamping here instead would let a
+            # context installed later claim spans that ran before it.
             if s.attrs:
                 ev["args"] = {k: _jsonable(v) for k, v in s.attrs.items()}
             events.append(ev)
@@ -185,6 +326,12 @@ class Tracer:
                 "spans": len(spans),
             },
         }
+        if base:
+            doc["otherData"]["context"] = {
+                k: _jsonable(v) for k, v in base.items()}
+        if metadata:
+            for k, v in metadata.items():
+                doc["otherData"][k] = _jsonable_deep(v)
         if dropped:
             doc["otherData"]["dropped_spans"] = dropped
         return doc
@@ -303,3 +450,13 @@ def _jsonable(v):
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
     return str(v)
+
+
+def _jsonable_deep(v):
+    """Metadata values can be small nested maps (per-peer clock
+    offsets); stringify only the leaves."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable_deep(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable_deep(x) for x in v]
+    return _jsonable(v)

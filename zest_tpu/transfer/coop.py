@@ -68,6 +68,25 @@ _M_COOP_BYTES = telemetry.counter(
 _M_COOP_FALLBACKS = telemetry.counter(
     "zest_coop_fallbacks_total",
     "Exchange units degraded to the per-host CDN fallback")
+# Pod-aggregation inputs (ISSUE 7): each host exports its exchange wall
+# and fetch-phase bytes as gauges; the coordinator's ?scope=pod scrape
+# derives zest_coop_straggler_seconds (slowest minus median wall) and
+# the fetch-share skew from the per-host-labeled series.
+_M_COOP_EXCHANGE_WALL = telemetry.gauge(
+    "zest_coop_exchange_wall_seconds",
+    "This host's last cooperative exchange-phase wall time")
+_M_COOP_FETCH_BYTES = telemetry.gauge(
+    "zest_coop_fetch_bytes",
+    "This host's last cooperative fetch-phase bytes (its plan share)")
+# The exchange had only byte totals; this is the latency distribution.
+# Observed per unit as window-wall / units-in-window (units in one
+# pipelined window complete together, so the amortized figure is the
+# honest per-unit number).
+_M_COOP_UNIT_SECONDS = telemetry.histogram(
+    "zest_coop_exchange_unit_seconds",
+    "Amortized per-unit exchange latency (window wall over window units)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0))
 
 # Exchange pacing: how long a host keeps retrying NOT_FOUND units
 # (the owner may simply still be fetching them — hosts run the round
@@ -262,6 +281,7 @@ def coop_round(
     entries_map: dict[str, list[FetchInfo]] | None = None,
     deadline_s: float | None = None,
     dcn_pool: DcnPool | None = None,
+    trace_id: str | None = None,
     log=None,
 ) -> dict:
     """One cooperative round: plan -> fetch (my ~1/N) -> exchange.
@@ -278,17 +298,40 @@ def coop_round(
     ``bridge.close()``, so late peers can still read from us while the
     landing proceeds). Raises :class:`CoopUnavailable` when no exchange
     peer is addressable — the caller degrades to the full waterfall.
+
+    ``trace_id`` is the fleet trace identity every host of this pull
+    shares (pull_model mints it from ``repo@sha`` + the KV-shared
+    nonce); when absent it is derived from the deduped unit-key set —
+    identical on every host by construction, so bare ``coop_round``
+    callers still correlate. The round runs under a thread-scoped trace
+    context (host index + trace_id) so its spans split into per-host
+    tracks even when several simulated hosts share one process.
     """
-    with telemetry.span("coop.round", host=host_index, hosts=n_hosts):
-        return _coop_round(bridge, recs, host_index, n_hosts,
-                           host_addrs or {}, budget_bytes, server,
-                           quarantined, entries_map, deadline_s,
-                           dcn_pool, log)
+    if trace_id is None:
+        trace_id = _derive_trace_id(recs)
+    with telemetry.trace.context(host=host_index, trace_id=trace_id):
+        with telemetry.span("coop.round", hosts=n_hosts):
+            return _coop_round(bridge, recs, host_index, n_hosts,
+                               host_addrs or {}, budget_bytes, server,
+                               quarantined, entries_map, deadline_s,
+                               dcn_pool, trace_id, log)
+
+
+def _derive_trace_id(recs) -> str:
+    """Trace id from the deduped unit-key set: every host of one pull
+    computes the same sorted key list from the same reconstructions
+    (quarantine/ownership do NOT enter — health views may differ across
+    hosts; the unit set cannot)."""
+    from zest_tpu.telemetry.fleet import mint_trace_id
+
+    keys = "|".join(f"{hh}:{start}"
+                    for (hh, start), _fi in sorted(collect_units(recs)))
+    return mint_trace_id(keys)
 
 
 def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
                 budget_bytes, server, quarantined, entries_map,
-                deadline_s, dcn_pool, log) -> dict:
+                deadline_s, dcn_pool, trace_id, log) -> dict:
     from zest_tpu.transfer.pull import ByteBudget
 
     t0 = time.monotonic()
@@ -341,7 +384,7 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     # ── Phase 1: fetch my share through the resilient waterfall ──
     mine = plan.for_host(host_index)
     before = _tier_bytes(bridge.stats)
-    with telemetry.span("coop.fetch", host=host_index, units=len(mine)):
+    with telemetry.span("coop.fetch", units=len(mine)):
         fetch_stats = warm_units_parallel(bridge, recs,
                                           entries_map=entries_map,
                                           units=mine)
@@ -349,6 +392,7 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     for tier, nbytes in fetch_tiers.items():
         if nbytes:
             _M_COOP_BYTES.inc(nbytes, tier=tier)
+    _M_COOP_FETCH_BYTES.set(sum(fetch_tiers.values()))
 
     # ── Phase 2: exchange — pull every foreign-owned unit from its
     # owner over DCN, windowed under the byte budget ──
@@ -369,28 +413,35 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
             if not _already_cached(bridge, hh, fi)]
         for h in plan.alive if h != host_index
     }
+    clock_offsets: dict = {}
+    t_exchange = time.monotonic()
     try:
+        # Exchange workers are fresh threads: hand them this round's
+        # trace context explicitly (thread-locals do not propagate) so
+        # their spans land on this host's track in the merged trace.
+        ctx = telemetry.trace.current_context()
         workers = [
             threading.Thread(
                 target=_exchange_from,
                 args=(bridge, entries_map, pool, peers, h, units, budget,
-                      ex, verify, deadline, swarm_health),
+                      ex, verify, deadline, swarm_health, ctx),
                 name=f"zest-coop-x{h}", daemon=True,
             )
             for h, units in foreign.items() if units
         ]
-        with telemetry.span("coop.exchange", host=host_index,
-                            owners=len(workers)):
+        with telemetry.span("coop.exchange", owners=len(workers)):
             for w in workers:
                 w.start()
             for w in workers:
                 w.join()
+        _collect_clock_offsets(pool, peers, clock_offsets)
     finally:
         if own_pool:
             pool.close()
     # Units owned by hosts the plan already excluded (quarantined) were
     # re-sharded into `mine`/`foreign` above; nothing is unowned.
 
+    _M_COOP_EXCHANGE_WALL.set(time.monotonic() - t_exchange)
     _M_COOP_BYTES.inc(ex.wire_bytes, tier="dcn")
     if ex.fallback_bytes:
         _M_COOP_BYTES.inc(ex.fallback_bytes, tier="fallback")
@@ -407,6 +458,7 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     stats = {
         "host": host_index,
         "hosts": n_hosts,
+        "trace_id": trace_id,
         "plan": plan.summary(),
         "fetch": {**fetch_stats, "tiers": fetch_tiers},
         "exchange": {
@@ -419,12 +471,37 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
         "peer_served_ratio": round(ratio, 4),
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
+    if clock_offsets:
+        stats["clock_offsets"] = clock_offsets
     if log is not None:
         log(f"coop round host {host_index}/{n_hosts}: "
             f"{len(mine)} fetched, {ex.units} over DCN "
             f"({ex.wire_bytes} wire bytes), {ex.fallback_units} "
             f"CDN-fallback, peer_served {stats['peer_served_ratio']:.0%}")
     return stats
+
+
+def _collect_clock_offsets(pool, peers, out: dict) -> None:
+    """Per-peer hello clock-offset estimates keyed by HOST INDEX (the
+    merge's normalization key), copied into the round stats and the
+    active tracer's metadata. Best-effort: an offset-less round merges
+    on raw epoch anchors (documented fallback)."""
+    try:
+        by_addr = pool.clock_offsets()
+    except Exception:  # noqa: BLE001 - observability must not fail a round
+        return
+    addr_to_idx = {addr: idx for idx, addr in peers.items()}
+    for addr, row in by_addr.items():
+        idx = row.get("host", addr_to_idx.get(addr))
+        if idx is None:
+            continue
+        out[int(idx)] = {"offset_s": row["offset_s"],
+                         "rtt_s": row["rtt_s"]}
+    tracer = telemetry.trace.active()
+    if out and tracer is not None:
+        # Merge per-host: several simulated hosts share one tracer.
+        existing = tracer.metadata.get("clock_offsets", {})
+        tracer.add_metadata(clock_offsets={**existing, **out})
 
 
 def _tier_bytes(stats) -> dict[str, int]:
@@ -450,13 +527,15 @@ def _make_verifier():
 
 def _exchange_from(bridge, entries_map, pool, peers, owner, units,
                    budget, ex: _ExchangeStats, verify, deadline,
-                   health) -> None:
+                   health, trace_ctx=None) -> None:
     """Pull ``units`` from ``owner``; NOT_FOUND retries until the
     deadline (the owner may still be fetching), a dead channel or an
     expired deadline degrades the rest to the per-host CDN fallback."""
+    if trace_ctx:
+        telemetry.trace.use_context(trace_ctx)
     addr = peers.get(owner)
     if addr is None:
-        _fallback(bridge, entries_map, units, ex)
+        _fallback(bridge, entries_map, units, ex, owner=owner)
         return
     host, port = addr
     pending = list(units)
@@ -475,6 +554,7 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
             window.append(pending.pop(0))
             wire_est += nbytes
         budget.acquire(wire_est)
+        t_window = time.monotonic()
         try:
             if faults.fire("peer_timeout", key=f"{host}:{port}"):
                 raise TimeoutError("injected peer_timeout")
@@ -484,17 +564,31 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
                  for hh, fi in window],
                 timeout=max(1.0, deadline - time.monotonic()),
             )
-        except (ConnectionError, TimeoutError, OSError):
+        except (ConnectionError, TimeoutError, OSError) as exc:
             budget.release(wire_est)
             with ex.lock:
                 ex.dead_hosts.add(owner)
+            telemetry.record("exchange_dead_host", owner=owner,
+                             peer=f"{host}:{port}",
+                             error=type(exc).__name__)
             if health is not None:
                 try:
                     health.record_failure(addr, kind="io_timeout")
                 except Exception:  # noqa: BLE001 - health is advisory
                     pass
-            _fallback(bridge, entries_map, window + pending, ex)
+            _fallback(bridge, entries_map, window + pending, ex,
+                      owner=owner)
             return
+        window_s = time.monotonic() - t_window
+        per_unit_s = window_s / max(1, len(window))
+        # One observation per unit that actually produced a RESPONSE:
+        # NOT_FOUND units re-enter later windows and would otherwise be
+        # observed once per retry round, inflating _count past the
+        # exchanged-unit total and skewing the distribution toward the
+        # fast not-found round trips.
+        for reply in replies:
+            if isinstance(reply, DcnResponse):
+                _M_COOP_UNIT_SECONDS.observe(per_unit_s)
         missing = []
         try:
             for (hh, fi), reply in zip(window, replies):
@@ -512,7 +606,10 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
                     # degrade to CDN, which self-heals the cache key.
                     with ex.lock:
                         ex.verify_rejected += 1
-                    _fallback(bridge, entries_map, [(hh, fi)], ex)
+                    telemetry.record("verify_rejected", unit=hh[:16],
+                                     owner=owner)
+                    _fallback(bridge, entries_map, [(hh, fi)], ex,
+                              owner=owner)
                 else:
                     missing.append((hh, fi))  # NOT_FOUND: owner behind
         finally:
@@ -524,7 +621,8 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
                 pass
         if missing:
             if time.monotonic() + sleep_s > deadline:
-                _fallback(bridge, entries_map, missing + pending, ex)
+                _fallback(bridge, entries_map, missing + pending, ex,
+                          owner=owner)
                 return
             with ex.lock:
                 ex.retries += 1
@@ -555,7 +653,8 @@ def _admit(bridge, entries_map, hh, fi, reply, verify):
     return True, len(reply.data), _unpacked_bytes(reply.data)
 
 
-def _fallback(bridge, entries_map, units, ex: _ExchangeStats) -> None:
+def _fallback(bridge, entries_map, units, ex: _ExchangeStats,
+              owner=None) -> None:
     """Per-host CDN fallback for units the exchange could not deliver.
     Runs through the full waterfall (a *different* peer or the swarm
     tier may still serve them before CDN does)."""
@@ -572,6 +671,8 @@ def _fallback(bridge, entries_map, units, ex: _ExchangeStats) -> None:
             ex.fallback_bytes += len(data)
             ex.fallback_tiers[source] = (
                 ex.fallback_tiers.get(source, 0) + len(data))
+        telemetry.record("cdn_fallback", unit=hh[:16], owner=owner,
+                         tier=source, bytes=len(data))
         _M_COOP_FALLBACKS.inc()
 
 
@@ -648,3 +749,47 @@ def exchange_addrs_via_kv(pull_key: str, host_index: int, n_hosts: int,
             return addrs
         time.sleep(0.2)
     return addrs if len(addrs) > 1 else None
+
+
+def share_nonce_via_kv(pull_key: str, host_index: int,
+                       timeout_s: float = 10.0) -> str:
+    """Best-effort pull nonce through the coordinator KV store: host 0
+    announces a fresh nonce under ``zest/coop-nonce/{pull_key}`` (a
+    SIBLING prefix of the addr announce — a nested key would collide
+    with the addr parser's index extraction), everyone else polls for
+    it. Call ordering matters for id agreement (see pull._coop_stage):
+    host 0 writes BEFORE announcing its addr; peers poll only AFTER
+    the addr exchange, when host 0's participation (and therefore the
+    nonce's presence) is already decided — a short ``timeout_s`` then
+    suffices. The nonce disambiguates repeated pulls of the same
+    revision in the fleet trace id (telemetry.fleet.mint_trace_id);
+    every fallback returns ``""`` — hosts then derive the id from
+    ``repo@sha`` alone, which still correlates, just without
+    cross-pull uniqueness."""
+    import os
+
+    from zest_tpu.parallel.coordinator import _kv_client
+
+    client = _kv_client()
+    if client is None:
+        return ""
+    prefix = f"zest/coop-nonce/{pull_key}"
+    if host_index == 0:
+        nonce = os.urandom(8).hex()
+        try:
+            client.key_value_set(f"{prefix}/0", nonce,
+                                 allow_overwrite=True)
+        except Exception:  # noqa: BLE001 - no nonce = still correlated
+            return ""
+        return nonce
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            entries = client.key_value_dir_get(prefix)
+        except Exception:  # noqa: BLE001
+            entries = []
+        for _key, value in entries:
+            if value:
+                return str(value)
+        time.sleep(0.2)
+    return ""

@@ -13,16 +13,36 @@ long-lived TCP stream with request-ID matching (reference:
 src/bep_xet.zig:66-124, pipelining: src/bt_peer.zig:188-248) — minus the
 BT framing it doesn't need.
 
-Wire format (version 1, all integers little-endian; both sides send an
-8-byte hello on connect, then messages flow in either direction):
+Wire format (all integers little-endian; both sides send an 8-byte
+hello on connect, then messages flow in either direction):
 
-    hello:   "ZDCN" u8 version  u8 flags(0)  u16 reserved(0)
-    message: u8 type  u8 flags(0)  u16 reserved(0)  u32 req_id  u32 len
+    hello:   "ZDCN" u8 version(1)  u8 flags(0)  u16 hello_sub
+    trace:   16B trace_id  u16 host_index  u16 flags  f64 epoch_s  4B rsvd
+             (32 bytes; exchanged only when BOTH hellos advertised
+             hello_sub >= 2, immediately after the hellos)
+    message: u8 type  u8 flags(0)  u16 tag  u32 req_id  u32 len
              + len payload bytes
     REQUEST   (1): 32B xorb hash + u64 chunk_start + u64 chunk_end
     RESPONSE  (2): u64 chunk_offset + frame bytes
     NOT_FOUND (3): 32B xorb hash
     ERROR     (4): utf-8 message
+
+Hello versioning (ISSUE 7): v1 peers validate the magic and the
+version byte ONLY and hard-reject any other version byte — so the
+negotiable hello version rides the u16 the v1 hello reserved (and
+never read), with 0 meaning "v1 legacy". Old peers therefore
+interoperate in both directions with zero extra round trips: a v1
+peer ignores our sub-version advert and sends rsvd=0, and each side
+sends the 32-byte trace-context block only after reading a >=2 advert
+from the other (send-hello, read-hello, then block exchange — never a
+deadlock, never unexpected bytes at a v1 peer). The block carries the
+fleet ``trace_id`` + the sender's coop host index (server-side serve
+spans stamp both, which is what flow-links them to the client's
+``dcn.request_many`` spans in the merged trace) and the sender's wall
+clock, from which the reader estimates the peer clock offset within
+±rtt/2 (telemetry.fleet uses it to normalize merged-trace timelines).
+Similarly, v2 REQUESTs carry a ``tag`` in the per-message u16 that v1
+reserved: the requester's window id, echoed into the serve span.
 
 Ranges are chunk-index ranges within a xorb and responses carry the
 ``chunk_offset`` their frames start at — identical coordinate frames to
@@ -39,6 +59,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass
 
 from zest_tpu import faults, telemetry
@@ -57,7 +78,16 @@ _M_BYTES_SERVED = telemetry.counter(
 
 MAGIC = b"ZDCN"
 VERSION = 1
-_HELLO = MAGIC + bytes([VERSION, 0, 0, 0])
+# Negotiable hello sub-version, carried in the u16 the v1 hello
+# reserved (v1 validates only magic + version byte, so old peers read
+# our advert as padding and send 0 back — that IS the negotiation).
+HELLO_SUBVERSION = 2
+_HELLO_STRUCT = struct.Struct("<4sBBH")
+_HELLO = _HELLO_STRUCT.pack(MAGIC, VERSION, 0, HELLO_SUBVERSION)
+# v2 trace-context block: trace_id, coop host index (0xFFFF = none),
+# flags, sender wall clock, reserved.
+_TRACE_BLOCK = struct.Struct("<16sHHd4x")
+_NO_HOST = 0xFFFF
 _HEADER = struct.Struct("<BBHII")
 
 MSG_REQUEST = 1
@@ -83,6 +113,10 @@ class DcnRequest:
     chunk_hash: bytes
     range_start: int
     range_end: int
+    # v2 window tag (the per-message u16 v1 reserved): identifies the
+    # requester's ``dcn.request_many`` window so the server's serve
+    # span flow-links to it in the merged trace. 0 = untagged.
+    tag: int = 0
 
 
 @dataclass(frozen=True)
@@ -131,7 +165,10 @@ def encode_response_prefix(
 def encode_message(msg: DcnMessage) -> bytes:
     if isinstance(msg, DcnRequest):
         body = _REQ_BODY.pack(msg.chunk_hash, msg.range_start, msg.range_end)
-        mtype = MSG_REQUEST
+        if len(body) > MAX_MESSAGE_SIZE:
+            raise DcnProtocolError(f"payload of {len(body)} bytes over cap")
+        return _HEADER.pack(MSG_REQUEST, 0, msg.tag & 0xFFFF,
+                            msg.request_id, len(body)) + body
     elif isinstance(msg, DcnResponse):
         return encode_response_prefix(
             msg.request_id, msg.chunk_offset, len(msg.data)
@@ -150,14 +187,14 @@ def encode_message(msg: DcnMessage) -> bytes:
 
 
 def decode_message(header: bytes, body: bytes) -> DcnMessage:
-    mtype, _flags, _rsvd, req_id, length = _HEADER.unpack(header)
+    mtype, _flags, tag, req_id, length = _HEADER.unpack(header)
     if length != len(body):
         raise DcnProtocolError("body length disagrees with header")
     if mtype == MSG_REQUEST:
         if len(body) != _REQ_BODY.size:
             raise DcnProtocolError("bad REQUEST body")
         h, start, end = _REQ_BODY.unpack(body)
-        return DcnRequest(req_id, h, start, end)
+        return DcnRequest(req_id, h, start, end, tag)
     if mtype == MSG_RESPONSE:
         if len(body) < 8:
             raise DcnProtocolError("bad RESPONSE body")
@@ -205,13 +242,72 @@ def _recv_message(sock: socket.socket) -> DcnMessage:
     return decode_message(header, _recv_exact(sock, length))
 
 
-def _exchange_hello(sock: socket.socket) -> None:
+@dataclass
+class HelloInfo:
+    """Negotiated per-connection state from the hello exchange."""
+
+    subversion: int = 1
+    peer_trace_id: str | None = None    # hex, from the peer's block
+    peer_host: int | None = None        # peer's coop host index
+    peer_epoch_s: float | None = None
+    rtt_s: float | None = None
+    # Estimated (peer wall clock − our wall clock); error ≤ ±rtt/2
+    # (single-exchange NTP bound). telemetry.fleet normalizes merged
+    # trace timelines with it.
+    clock_offset_s: float | None = None
+
+
+def _our_trace_block() -> bytes:
+    """This side's 32-byte trace-context block, from the process/thread
+    trace context (set by the cooperative round). All-zero trace_id and
+    host 0xFFFF when none — the block is transport framing, sent
+    whenever v2 negotiated, so the wire shape does not depend on the
+    telemetry knob."""
+    ctx = telemetry.trace.current_context()
+    tid = ctx.get("trace_id")
+    host = ctx.get("host")
+    try:
+        tid_bytes = bytes.fromhex(tid) if tid else b"\0" * 16
+    except ValueError:
+        tid_bytes = b"\0" * 16
+    if len(tid_bytes) != 16:
+        tid_bytes = (tid_bytes + b"\0" * 16)[:16]
+    host_u16 = host if isinstance(host, int) and 0 <= host < _NO_HOST \
+        else _NO_HOST
+    return _TRACE_BLOCK.pack(tid_bytes, host_u16, 0, time.time())
+
+
+def _exchange_hello(sock: socket.socket) -> HelloInfo:
+    """Send-then-read hello (both sides, symmetric — no deadlock), then
+    exchange trace-context blocks when both advertised sub-version ≥2.
+    Returns the negotiated :class:`HelloInfo`; raises on a non-zest or
+    wrong-version peer exactly as v1 did."""
+    t0 = time.monotonic()
     sock.sendall(_HELLO)
-    theirs = _recv_exact(sock, len(_HELLO))
-    if theirs[:4] != MAGIC:
+    theirs = _recv_exact(sock, _HELLO_STRUCT.size)
+    magic, version, _flags, their_sub = _HELLO_STRUCT.unpack(theirs)
+    if magic != MAGIC:
         raise DcnProtocolError("peer is not a zest DCN endpoint")
-    if theirs[4] != VERSION:
-        raise DcnProtocolError(f"unsupported DCN version {theirs[4]}")
+    if version != VERSION:
+        raise DcnProtocolError(f"unsupported DCN version {version}")
+    info = HelloInfo(subversion=min(HELLO_SUBVERSION, their_sub or 1))
+    if info.subversion < 2:
+        return info
+    sock.sendall(_our_trace_block())
+    block = _recv_exact(sock, _TRACE_BLOCK.size)
+    t1 = time.monotonic()
+    tid_bytes, host_u16, _bflags, peer_epoch = _TRACE_BLOCK.unpack(block)
+    if tid_bytes != b"\0" * 16:
+        info.peer_trace_id = tid_bytes.hex()
+    if host_u16 != _NO_HOST:
+        info.peer_host = host_u16
+    info.peer_epoch_s = peer_epoch
+    rtt = max(0.0, t1 - t0)
+    info.rtt_s = rtt
+    # peer_epoch was stamped ~rtt/2 before our read of it (symmetric
+    # path assumption — the NTP single-exchange estimator).
+    info.clock_offset_s = peer_epoch - (time.time() - rtt / 2.0)
+    return info
 
 
 # ── Shared cache lookup (BT server and DCN server answer identically) ──
@@ -301,9 +397,14 @@ class DcnServer:
     src/server.zig:158-172).
     """
 
-    def __init__(self, cfg: Config, cache: XorbCache | None = None):
+    def __init__(self, cfg: Config, cache: XorbCache | None = None,
+                 span_attrs: dict | None = None):
         self.cfg = cfg
         self.cache = cache or XorbCache(cfg)
+        # Extra attrs stamped on every serve span (the in-process
+        # multi-host simulations pass {"host": i}; production servers
+        # inherit the process trace context instead).
+        self.span_attrs = dict(span_attrs or {})
         self.port: int | None = None
         self.stats = DcnServerStats()
         self._stats_lock = threading.Lock()
@@ -378,7 +479,7 @@ class DcnServer:
                     return
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(IDLE_TIMEOUT_S)
-                _exchange_hello(conn)
+                hello = _exchange_hello(conn)
                 while not self._shutdown.is_set():
                     msg = _recv_message(conn)
                     if not isinstance(msg, DcnRequest):
@@ -386,13 +487,29 @@ class DcnServer:
                             msg.request_id, "server accepts only REQUEST"
                         )))
                         continue
-                    self._serve_request(conn, msg)
+                    self._serve_request(conn, msg, hello)
         except (ConnectionError, DcnProtocolError, OSError):
             return  # peer went away / spoke garbage: drop the connection
         finally:
             self._conns.discard(conn)
 
-    def _serve_request(self, conn: socket.socket, req: DcnRequest) -> None:
+    def _serve_request(self, conn: socket.socket, req: DcnRequest,
+                       hello: HelloInfo | None = None) -> None:
+        # Server-side request span (ISSUE 7): stamped with the v2 tag
+        # and the requester's host/trace identity from the hello block,
+        # which is what the merged trace flow-links to the client-side
+        # ``dcn.request_many`` window span. NULL_SPAN when no tracer.
+        attrs = dict(self.span_attrs)
+        attrs["tag"] = req.tag
+        if hello is not None and hello.peer_host is not None:
+            attrs["client_host"] = hello.peer_host
+        if hello is not None and hello.peer_trace_id is not None:
+            attrs.setdefault("trace_id", hello.peer_trace_id)
+        with telemetry.span("dcn.serve", **attrs) as sp:
+            self._serve_request_inner(conn, req, sp)
+
+    def _serve_request_inner(self, conn: socket.socket, req: DcnRequest,
+                             sp) -> None:
         if not req.range_start < req.range_end:
             conn.sendall(encode_message(DcnError(
                 req.request_id,
@@ -406,6 +523,7 @@ class DcnServer:
         if found is None:
             with self._stats_lock:
                 self.stats.not_found += 1
+            sp.set("outcome", "not_found")
             conn.sendall(encode_message(
                 DcnNotFound(req.request_id, req.chunk_hash)
             ))
@@ -426,6 +544,7 @@ class DcnServer:
             self.stats.bytes_served += len(blob)
         _M_CHUNKS_SERVED.inc()
         _M_BYTES_SERVED.inc(len(blob))
+        sp.add_bytes(len(blob))
         # Scatter-gather send: the blob can be a whole 64 MiB xorb, and
         # encode_message would memcpy it twice building one bytestring.
         _sendmsg_all(conn, [
@@ -451,7 +570,10 @@ class DcnChannel:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         try:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            _exchange_hello(self._sock)
+            # Negotiated hello: sub-version, the peer's trace identity,
+            # and the clock-offset estimate (hello.clock_offset_s) the
+            # merged-trace normalization reads via DcnPool.clock_offsets.
+            self.hello = _exchange_hello(self._sock)
         except Exception:
             self._sock.close()  # not a zest endpoint / hello timeout
             raise
@@ -502,10 +624,13 @@ class DcnChannel:
                 self._fail_all(exc)
 
     def send_request(
-        self, chunk_hash: bytes, range_start: int, range_end: int
+        self, chunk_hash: bytes, range_start: int, range_end: int,
+        tag: int = 0,
     ) -> "_Waiter":
         """Fire one request; returns a waiter to collect later — callers
-        batch N sends then collect N waits to pipeline."""
+        batch N sends then collect N waits to pipeline. ``tag`` is the
+        v2 window tag (0 = untagged; a v1 server reads it as the
+        reserved bytes it always ignored)."""
         if faults.fire("dcn_reset",
                        key=f"{self.address[0]}:{self.address[1]}"):
             self.dead = True
@@ -520,7 +645,8 @@ class DcnChannel:
                 self._pending[req_id] = waiter
             try:
                 self._sock.sendall(encode_message(
-                    DcnRequest(req_id, chunk_hash, range_start, range_end)
+                    DcnRequest(req_id, chunk_hash, range_start, range_end,
+                               tag)
                 ))
             except OSError as exc:
                 with self._pending_lock:
@@ -538,13 +664,14 @@ class DcnChannel:
     def request_many(
         self, wants: list[tuple[bytes, int, int]],
         timeout: float | None = None,
+        tag: int = 0,
     ) -> list[DcnMessage]:
         """Pipelined batch: all requests go out before any response is
         awaited; results come back in ``wants`` order. ``timeout``
         overrides the channel default per call — the cooperative
         exchange bounds each window by its round deadline's remainder
         instead of letting one silent owner hold a 30 s default."""
-        waiters = [self.send_request(*w) for w in wants]
+        waiters = [self.send_request(*w, tag=tag) for w in wants]
         t = self.timeout if timeout is None else timeout
         return [w.wait(t) for w in waiters]
 
@@ -579,6 +706,32 @@ class DcnPool:
         self.timeout = timeout
         self._channels: dict[tuple[str, int], DcnChannel] = {}
         self._lock = threading.Lock()
+        self._next_tag = 0
+
+    def _alloc_tag(self) -> int:
+        """Next nonzero u16 window tag (wraps; 0 stays 'untagged')."""
+        with self._lock:
+            self._next_tag = (self._next_tag % 0xFFFF) + 1
+            return self._next_tag
+
+    def clock_offsets(self) -> dict:
+        """Per-peer hello measurements: ``{(host, port): {"offset_s",
+        "rtt_s", "host"}}`` for every live v2 channel — the cooperative
+        round copies them into the trace metadata for the merge's
+        clock normalization."""
+        with self._lock:
+            channels = dict(self._channels)
+        out = {}
+        for addr, ch in channels.items():
+            hello = getattr(ch, "hello", None)
+            if hello is None or hello.clock_offset_s is None:
+                continue
+            row = {"offset_s": round(hello.clock_offset_s, 6),
+                   "rtt_s": round(hello.rtt_s or 0.0, 6)}
+            if hello.peer_host is not None:
+                row["host"] = hello.peer_host
+            out[addr] = row
+        return out
 
     def channel(self, host: str, port: int) -> DcnChannel:
         return self._lease(host, port)[0]
@@ -623,10 +776,20 @@ class DcnPool:
         propagates — that's a real peer problem, not staleness.
         ``timeout`` caps each response wait for this call only."""
         # Forwarded only when set: injected channel doubles (tests,
-        # wrappers) predate the parameter.
+        # wrappers) predate the parameters. The window tag is allocated
+        # only while a trace is actually recording — it exists to
+        # flow-link this window span to the server's serve spans, and
+        # skipping it otherwise keeps the wire bytes (and the doubles'
+        # call shape) identical to the untraced path.
         kw = {} if timeout is None else {"timeout": timeout}
-        with telemetry.span("dcn.request_many", peer=f"{host}:{port}",
-                            requests=len(wants)):
+        tag = 0
+        if telemetry.enabled() and telemetry.trace.active() is not None:
+            tag = self._alloc_tag()
+            kw["tag"] = tag
+        attrs = {"peer": f"{host}:{port}", "requests": len(wants)}
+        if tag:
+            attrs["flow_tag"] = tag
+        with telemetry.span("dcn.request_many", **attrs):
             ch, reused = self._lease(host, port)
             try:
                 return ch.request_many(wants, **kw)

@@ -297,7 +297,7 @@ def _federated_round(
         stats["own_bytes"] += len(data)
 
     # Stage 2: foreign units from their owner pod, pipelined per channel.
-    def fallback(units):
+    def fallback(units, owner_pod=None):
         for hash_hex, fi in units:
             if _already_cached(bridge, hash_hex, fi):
                 stats["fallback_units"] += 1
@@ -311,6 +311,9 @@ def _federated_round(
                         fi.range.start, data)
             stats["fallback_units"] += 1
             stats["fallback_bytes"] += len(data)
+            telemetry.record("cdn_fallback", unit=hash_hex[:16],
+                             owner=owner_pod, tier="federated",
+                             bytes=len(data))
 
     for pod, all_units in sorted(theirs.items()):
         units = []
@@ -323,7 +326,7 @@ def _federated_round(
             continue
         addr = pod_addrs.get(pod)
         if addr is None:
-            fallback(units)
+            fallback(units, owner_pod=pod)
             continue
         i = 0
         while i < len(units):
@@ -340,7 +343,7 @@ def _federated_round(
                     for hh, fi in window
                 ])
             except (ConnectionError, TimeoutError, OSError):
-                fallback(units[i:])
+                fallback(units[i:], owner_pod=pod)
                 break
             for (hash_hex, fi), reply in zip(window, replies):
                 if (
@@ -360,7 +363,7 @@ def _federated_round(
                     stats["dcn_bytes"] += len(reply.data)
                 else:
                     missed.append((hash_hex, fi))
-            fallback(missed)
+            fallback(missed, owner_pod=pod)
             i += pipeline_depth
 
     if own_pool:

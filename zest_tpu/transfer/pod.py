@@ -249,6 +249,10 @@ def _pod_round(
         f, r = pool.fill_cache(bridge.cache, verify=verifier)
         filled += f
         rejected += r
+        if r:
+            # Flight-recorder breadcrumb: a rejected wave unit is a
+            # trust-boundary event worth its position in the timeline.
+            telemetry.record("verify_rejected", tier="pod", count=r)
         peak_pool = max(peak_pool, pool.layout.pool_bytes)
         gather_s += t_gather - tw
         fill_s += time.monotonic() - t_gather

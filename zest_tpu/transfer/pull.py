@@ -355,6 +355,8 @@ class _FilePipeline:
             if not self.budget.try_acquire(entry.size):
                 with self._lock:
                     self.declined += 1
+                telemetry.record("budget_decline", path=entry.path,
+                                 bytes=entry.size)
                 return False
         else:
             self.budget.acquire(entry.size)
@@ -551,6 +553,12 @@ def pull_model(
     log=print,
 ) -> PullResult:
     t0 = time.monotonic()
+    # The coop stage installs this pull's fleet trace context (host +
+    # trace_id); restore the previous one at exit so a long-lived
+    # daemon's NEXT pull never records under a stale identity (spans
+    # are context-stamped at record time, so this pull's spans keep
+    # theirs regardless).
+    _prev_ctx = telemetry.trace.base_context()
     # Root span: every subsystem span (stage.*, swarm.*, cdn.*, hbm.*)
     # nests under this one, which is also what makes the acceptance
     # criterion trivial to check — the trace's union coverage must be
@@ -563,9 +571,27 @@ def pull_model(
                                  (coop, coop_hosts, coop_index,
                                   coop_addrs),
                                  log, t0)
-        except BaseException:
+        except BaseException as exc:
             _M_PULLS.inc(outcome="error")
+            # Flight-recorder crash report (ISSUE 7): the last N notable
+            # events — strikes, fallbacks, faults, declines — dumped as
+            # one artifact next to the cache, so a failed pull's triage
+            # starts from the ordered event tail instead of log
+            # archaeology. Best-effort; never masks the real failure.
+            telemetry.record("pull_failed", repo=repo_id,
+                             error=type(exc).__name__)
+            path = telemetry.recorder.dump_crash_report(
+                cfg.cache_dir, f"pull {repo_id} failed: "
+                f"{type(exc).__name__}")
+            if path:
+                try:
+                    log(f"flight-recorder crash report: {path}",
+                        file=sys.stderr)
+                except TypeError:
+                    pass  # log doubles without file= keep the dump
             raise
+        finally:
+            telemetry.trace.replace_context(_prev_ctx)
     _M_PULLS.inc(outcome="ok")
     _M_PULL_SECONDS.observe(time.monotonic() - t0)
     tth = result.stats.get("time_to_hbm_s")
@@ -1141,13 +1167,26 @@ def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log):
     the jax.distributed KV store when no explicit addr map was given
     (the zero-config multi-host TPU job path). The DCN listener binds
     BEFORE the announce so peers learn the truly bound port; it stays
-    up under the bridge until pull exit (peers behind us still read)."""
+    up under the bridge until pull exit (peers behind us still read).
+
+    Also mints the pull's fleet ``trace_id`` (ISSUE 7): derived from
+    ``repo@sha`` plus a KV-shared nonce when the coordinator store is
+    reachable (host 0 announces it next to the addr exchange), so every
+    host of the pod stamps the SAME id on its spans and carries it to
+    peers in the DCN hello — the key ``zest trace --coop`` merges on.
+    The id is installed as the process trace context (one host = one
+    process in production) and repeated per-thread by coop_round for
+    the in-process simulations."""
+    from zest_tpu.telemetry.fleet import mint_trace_id
     from zest_tpu.transfer.coop import (
         CoopUnavailable, coop_round, exchange_addrs_via_kv,
+        share_nonce_via_kv,
     )
     from zest_tpu.transfer.dcn import DcnServer
 
     host_index, n_hosts, addrs = coop_cfg
+    pull_key = f"{repo_id}@{commit_sha}"
+    nonce = ""
     server = None
     if not addrs:
         server = DcnServer(cfg, bridge.cache)
@@ -1157,15 +1196,38 @@ def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log):
             server, port = None, cfg.dcn_port
         else:
             bridge.adopt_coop_server(server)
+        # Nonce ordering vs the addr exchange: host 0 WRITES its nonce
+        # before announcing its addr, and peers poll for it only AFTER
+        # the addr exchange — so "host 0's addr appeared" implies the
+        # nonce is already readable, and a host-0 start lag inside the
+        # addr window can never fork the pod onto two trace_ids (a
+        # peer-side pre-poll with its own shorter window could).
+        if host_index == 0:
+            nonce = share_nonce_via_kv(pull_key, host_index)
         addrs = exchange_addrs_via_kv(
-            f"{repo_id}@{commit_sha}", host_index, n_hosts, port)
+            pull_key, host_index, n_hosts, port)
         if not addrs:
             raise CoopUnavailable(
                 "no coop peer addresses: set ZEST_COOP_ADDRS or run "
                 "under jax.distributed for KV discovery")
+        if host_index != 0:
+            nonce = share_nonce_via_kv(pull_key, host_index,
+                                       timeout_s=5.0)
+    trace_id = mint_trace_id(pull_key, nonce)
+    if telemetry.enabled():
+        telemetry.trace.set_context(host=host_index, trace_id=trace_id)
+        tracer = telemetry.trace.active()
+        if tracer is not None:
+            # Persist the identity at the DOC level too: pull_model
+            # restores the previous context at exit, so the export's
+            # otherData.context (what --merge keys host docs by) must
+            # not depend on the context still being installed then.
+            tracer.add_metadata(
+                context={"host": host_index, "trace_id": trace_id})
     return coop_round(bridge, recs, host_index, n_hosts, addrs,
                       server=server,
                       budget_bytes=cfg.coop_inflight_bytes,
+                      trace_id=trace_id,
                       log=lambda m: log(m))
 
 
