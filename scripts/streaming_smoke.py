@@ -1,0 +1,111 @@
+"""CI smoke for the streaming landing (ISSUE 8).
+
+Two 64 MiB synthetic ``--device`` pulls against the loopback fixture
+hub — one streaming (the default), one with ``ZEST_LAND_STREAM=0``
+(the PR-1 shard-level double buffer) — must agree and must prove the
+tensor-granularity pipeline actually engaged:
+
+- the streamed pull reports ``time_to_first_layer_s`` and it ends
+  strictly inside the first half of ``time_to_hbm_s`` (the acceptance
+  bar is 0.25× on the 2 GB warm bench; 0.5× here keeps CI robust to
+  runner weather on a pull whose fixed costs are a bigger fraction);
+- ``params_digest`` of the streamed HBM tree is byte-identical to the
+  non-streaming pull's — the ring moved buffers, never bytes;
+- the ring accounting exists (stats["hbm"]["ring"]) and the knob-off
+  pull carries NO streaming keys (schema restoration, bit-for-bit);
+- both pulls' materialized safetensors bytes are exact.
+
+Exit 0 on success; any broken invariant prints the offending stats
+block and fails the step.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+
+def main() -> int:
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.models.loader import params_digest
+    from zest_tpu.transfer.pull import pull_model
+
+    # scale=32 → ~120 tiny layers: the first-layer set is ~2% of the
+    # bytes, the realistic deep-model shape (a 70B is 80 layers — the
+    # scale=8 alternative has SIX, making "first layer" 16% of the
+    # model and the ratio bar mostly a measure of fixed startup cost).
+    files = llama_checkpoint_files(0.064, shard_bytes=8 * 1024 * 1024,
+                                   scale=32)
+    repo = FixtureRepo("smoke/streaming", files, chunks_per_xorb=32)
+
+    runs: dict[bool, dict] = {}
+    digests: dict[bool, str] = {}
+    with FixtureHub(repo) as hub:
+        for stream in (True, False):
+            with tempfile.TemporaryDirectory() as root:
+                rootp = pathlib.Path(root)
+                cfg = Config(hf_home=rootp / "hf",
+                             cache_dir=rootp / "zest",
+                             hf_token="hf_test", endpoint=hub.url,
+                             land_stream=stream)
+                res = pull_model(cfg, "smoke/streaming", device="tpu",
+                                 no_p2p=True, log=lambda *a, **k: None)
+                runs[stream] = res.stats
+                digests[stream] = params_digest(res.params)
+                for name, data in files.items():
+                    got = (res.snapshot_dir / name).read_bytes()
+                    if got != data:
+                        print(f"STREAMING SMOKE FAILED: {name} "
+                              f"materialized inexactly (stream="
+                              f"{stream})", file=sys.stderr)
+                        return 1
+                res.params = None
+
+    stats = runs[True]
+
+    def fail(msg: str) -> int:
+        print(f"STREAMING SMOKE FAILED: {msg}", file=sys.stderr)
+        print(json.dumps({k: stats.get(k) for k in (
+            "time_to_hbm_s", "time_to_first_layer_s", "elapsed_s",
+            "stages", "hbm")}, indent=2, default=str), file=sys.stderr)
+        return 1
+
+    hbm = stats.get("hbm") or {}
+    if not hbm.get("streamed"):
+        return fail("default pull did not take the streaming landing")
+    if not hbm.get("ring"):
+        return fail("no ring accounting in stats['hbm']")
+    tfl = stats.get("time_to_first_layer_s")
+    tth = stats.get("time_to_hbm_s")
+    if tfl is None or tth is None:
+        return fail(f"missing headline stats (first_layer={tfl}, "
+                    f"hbm={tth})")
+    if not tfl < 0.5 * tth:
+        return fail(f"time_to_first_layer_s ({tfl}) is not < 0.5 x "
+                    f"time_to_hbm_s ({tth}) — the layer-ordered "
+                    "pipeline did not engage")
+    off = runs[False]
+    for key in ("time_to_first_layer_s",):
+        if key in off:
+            return fail(f"knob-off pull leaked streaming key {key!r}")
+    off_hbm = off.get("hbm") or {}
+    if off_hbm.get("streamed") or off_hbm.get("ring"):
+        return fail("knob-off pull streamed anyway")
+    if digests[True] != digests[False]:
+        return fail(f"HBM digests differ: streamed {digests[True]} vs "
+                    f"non-streaming {digests[False]}")
+    print("streaming smoke OK: "
+          f"first_layer {tfl}s / hbm {tth}s "
+          f"({tfl / tth:.0%}), ring {hbm['ring']}, digest "
+          f"{digests[True][:16]} identical both modes")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -157,6 +157,41 @@ def test_commit_tensors_dtype_skips_integers():
     assert str(out["w"].dtype) == "float32"
 
 
+def test_commit_tensors_coalesced_float64_values_survive():
+    """A small-tensor float64 group must commit value-correct.
+
+    The coalesced bit-pattern carrier is uint64; with jax in default
+    (x64-off) mode device_put VALUE-casts uint64 → uint32, truncating
+    every 8-byte pattern — the group came back all zeros. 8-byte dtypes
+    must skip the carrier unless x64 is on (the plain per-group path
+    downcasts f64 → f32, which is value-correct)."""
+    from zest_tpu.models.loader import commit_tensors
+
+    host = {"a": np.arange(8, dtype=np.float64),
+            "b": np.arange(8, 16, dtype=np.float64)}
+    out = commit_tensors(host)
+    np.testing.assert_allclose(np.asarray(out["a"]), host["a"])
+    np.testing.assert_allclose(np.asarray(out["b"]), host["b"])
+
+
+def test_commit_tensors_coalesced_sub_byte_group():
+    """Sub-byte dtypes (int4 quantized exports) must not get a byte
+    carrier: itemsize says 1 but the type is 4 bits wide, and the
+    on-device bitcast back (uint8 → int4) is rejected by jax — the
+    group must coalesce raw, as it did before the carrier existed."""
+    import ml_dtypes
+
+    from zest_tpu.models.loader import commit_tensors
+
+    host = {"a": np.array([1, 2, 3, 4], dtype=ml_dtypes.int4),
+            "b": np.array([5, 6, 7, 1], dtype=ml_dtypes.int4)}
+    out = commit_tensors(host)
+    np.testing.assert_array_equal(
+        np.asarray(out["a"]).astype(np.int8), [1, 2, 3, 4])
+    np.testing.assert_array_equal(
+        np.asarray(out["b"]).astype(np.int8), [5, 6, 7, 1])
+
+
 @pytest.mark.slow
 def test_pull_lands_bf16(tmp_path):
     """--dtype bf16 halves landed bytes on both the direct path and the

@@ -1,0 +1,459 @@
+"""Streaming landing contracts (ISSUE 8 tentpole).
+
+The ``--device=tpu`` landing flows fetch → decode → verify →
+``device_put`` at tensor granularity through a fixed ring of reusable
+host staging buffers (models.loader.HostRing), committing in layer
+order so the first-token-capable set (embedding + layer 0) is resident
+while later layers are still on the wire. These tests pin:
+
+- byte identity of the streamed HBM tree (``params_digest``) and the
+  materialized files against the non-streaming path;
+- the ring's byte bound under an adversarially tiny budget, and the
+  oversized-alone admission (one tensor larger than the whole ring
+  lands serially, never deadlocks);
+- chaos: ``chunk_corrupt`` through the streaming path still attributes
+  corruption at the trust boundary and self-heals from CDN;
+- knob-off (``ZEST_LAND_STREAM=0``) restores the PR-1 shard-level
+  double buffer's stats schema bit-for-bit;
+- the deterministic layer-priority key: registry ordering, per-unit
+  priorities/covers from content-addressed metadata, and the coop
+  round's plan fingerprint UNCHANGED by priority ordering;
+- ring-knob env parsing (malformed values raise, like every landing
+  knob).
+"""
+
+import threading
+
+import pytest
+
+from fixtures import FixtureHub, FixtureRepo
+
+from zest_tpu.bench_scale import llama_checkpoint_files
+from zest_tpu.config import Config
+from zest_tpu.models.loader import HostRing, RingClosed, params_digest
+from zest_tpu.models.registry import (
+    first_layer_names,
+    layer_priority,
+    order_names,
+)
+from zest_tpu.transfer.pull import pull_model
+
+FILES = llama_checkpoint_files(0.012, shard_bytes=3 * 1024 * 1024,
+                               scale=8)
+SHARDS = sorted(n for n in FILES if n.endswith(".safetensors"))
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo("acme/streaming", FILES, chunks_per_xorb=8)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+def _cfg(hub, root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+def _quiet(*a, **k):
+    pass
+
+
+def _pull(hub, root, **cfg_kw):
+    return pull_model(_cfg(hub, root, **cfg_kw), "acme/streaming",
+                      device="tpu", no_p2p=True, log=_quiet)
+
+
+def _assert_files_exact(res):
+    for name, data in FILES.items():
+        assert (res.snapshot_dir / name).read_bytes() == data, name
+
+
+# ── Layer-priority ordering (models.registry) ──
+
+
+def test_layer_priority_groups():
+    assert layer_priority("model.embed_tokens.weight") == (0, 0)
+    assert layer_priority("transformer.wte.weight") == (0, 0)
+    assert layer_priority("model.layers.0.mlp.up_proj.weight") == (1, 0)
+    assert layer_priority("model.layers.17.input_layernorm.weight") \
+        == (1, 17)
+    assert layer_priority("h.3.attn.c_attn.weight") == (1, 3)
+    assert layer_priority("blocks.2.norm.weight") == (1, 2)
+    assert layer_priority("lm_head.weight") == (2, 0)
+    assert layer_priority("model.norm.weight") == (2, 0)
+    assert layer_priority("totally.unknown.tensor") == (2, 0)
+
+
+def test_order_names_stable_and_layered():
+    names = ["lm_head.weight", "model.layers.1.a", "model.layers.0.b",
+             "model.embed_tokens.weight", "model.layers.0.a",
+             "model.norm.weight"]
+    out = order_names(names)
+    assert out[0] == "model.embed_tokens.weight"
+    assert out[1:3] == ["model.layers.0.b", "model.layers.0.a"]  # stable
+    assert out[3] == "model.layers.1.a"
+    assert out[4:] == ["lm_head.weight", "model.norm.weight"]  # stable
+
+
+def test_first_layer_names():
+    names = ["model.embed_tokens.weight", "model.layers.2.a",
+             "model.layers.5.a", "model.norm.weight"]
+    # Lowest layer PRESENT (2 — a sharded landing may not start at 0).
+    assert first_layer_names(names) == frozenset(
+        {"model.embed_tokens.weight", "model.layers.2.a"})
+    # No recognizable layer structure: the honest answer is the whole
+    # set — first-layer-usable then coincides with the full landing.
+    flat = ["alpha.weight", "beta.weight"]
+    assert first_layer_names(flat) == frozenset(flat)
+
+
+def test_unit_priorities_and_covers(hub, tmp_path):
+    from zest_tpu.models.direct import (
+        tensor_unit_keys,
+        unit_layer_priorities,
+    )
+    from zest_tpu.parallel.plan import collect_units
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.pod import fetch_file_header
+
+    cfg = _cfg(hub, tmp_path)
+    bridge = XetBridge(cfg)
+    bridge.authenticate("acme/streaming")
+    repo = hub.repos["acme/streaming"]
+    rwh = [(repo.reconstructions[repo.files[n].xet_hash],
+            fetch_file_header(
+                bridge, repo.reconstructions[repo.files[n].xet_hash]))
+           for n in SHARDS]
+    prio = unit_layer_priorities(rwh)
+    all_keys = {k for k, _fi in collect_units([r for r, _h in rwh])}
+    # Every unit of every shard got a priority, and they are a pure
+    # function of content-addressed metadata: rebuild == original.
+    assert set(prio) == all_keys
+    assert unit_layer_priorities(rwh) == prio
+    # Units serving the embedding (file head) rank first-group.
+    best = min(prio.values())
+    assert best == (0, 0)
+    # Per-tensor unit covers: non-empty, subsets of the shard's units,
+    # and the embedding's cover is exactly the (0, 0)-priority units
+    # it touches.
+    rec0, header0 = rwh[0]
+    covers = tensor_unit_keys(rec0, header0)
+    shard0_keys = {k for k, _fi in collect_units([rec0])}
+    assert set(covers) == set(header0.tensors)
+    for name, keys in covers.items():
+        assert keys and keys <= shard0_keys, name
+    for key in covers["model.embed_tokens.weight"]:
+        assert prio[key] == (0, 0)
+    bridge.close()
+
+
+# ── End-to-end: identity + schema ──
+
+
+def test_streamed_pull_identical_and_first_layer_early(hub, tmp_path):
+    on = _pull(hub, tmp_path / "on")
+    off = _pull(hub, tmp_path / "off", land_stream=False)
+    try:
+        # Byte identity both places the bytes can land.
+        assert params_digest(on.params) == params_digest(off.params)
+        _assert_files_exact(on)
+        _assert_files_exact(off)
+
+        # Streaming evidence: ring accounting, the headline stat, and
+        # the first-layer stage interval agreeing with it.
+        hbm = on.stats["hbm"]
+        assert hbm["streamed"] is True
+        ring = hbm["ring"]
+        assert ring["buffers_allocated"] > 0
+        assert ring["peak_bytes"] <= ring["budget_bytes"]
+        tfl = on.stats["time_to_first_layer_s"]
+        tth = on.stats["time_to_hbm_s"]
+        assert 0 < tfl < tth
+        assert on.stats["stages"]["first_layer"] == pytest.approx(
+            tfl, abs=0.05)
+
+        # Knob-off restores the PR-1 schema bit-for-bit: same stats
+        # keys minus the streaming headline, no streamed/ring keys, no
+        # first_layer stage.
+        assert "time_to_first_layer_s" not in off.stats
+        assert set(off.stats) == set(on.stats) - {"time_to_first_layer_s"}
+        assert "streamed" not in off.stats["hbm"]
+        assert "ring" not in off.stats["hbm"]
+        assert "first_layer" not in off.stats["stages"]
+        assert off.stats["hbm"]["decode_ahead"] is True
+        # The write-behind lane engaged in BOTH modes (stream: ring
+        # slots retained by the sink; off: shard-level host dict).
+        for res in (on, off):
+            assert res.stats["files_pipeline"]["lane_bytes"].get(
+                "tensors", 0) > 0
+    finally:
+        on.params = None
+        off.params = None
+
+
+def test_tiny_ring_budget_bound_holds(hub, tmp_path):
+    """Adversarially tiny ring: the landing must still complete, byte-
+    identical, with in-flight staging bounded by max(budget, largest
+    single READ) — the oversized-alone admission's bound, where a read
+    is a tensor run rounded OUT to term boundaries (each boundary term
+    decodes in place instead of riding the per-term memo), so the
+    largest read can exceed the largest tensor by up to two terms."""
+    largest = 512 * 1024  # << several tensors in the fixture
+    res = _pull(hub, tmp_path, land_ring_bytes=largest, land_ring_slots=2)
+    try:
+        ring = res.stats["hbm"]["ring"]
+        biggest_tensor = max(
+            int(a.nbytes) for a in res.params.values())
+        repo = hub.repos["acme/streaming"]
+        max_term = max(
+            t.unpacked_length
+            for n in SHARDS
+            for t in repo.reconstructions[
+                repo.files[n].xet_hash].terms)
+        assert ring["budget_bytes"] == largest
+        assert ring["peak_bytes"] <= max(
+            largest, biggest_tensor + 2 * max_term)
+        assert ring["oversized"] > 0  # the big projections exceeded it
+        _assert_files_exact(res)
+    finally:
+        res.params = None
+
+
+def test_oversized_alone_never_deadlocks(hub, tmp_path):
+    """A ring smaller than EVERY tensor: fully serial admission, still
+    terminates with identical bytes (mirrors ByteBudget's rule)."""
+    res = _pull(hub, tmp_path, land_ring_bytes=1, land_ring_slots=1)
+    try:
+        ring = res.stats["hbm"]["ring"]
+        assert ring["oversized"] > 0
+        assert res.stats["hbm"]["streamed"] is True
+        _assert_files_exact(res)
+    finally:
+        res.params = None
+
+
+# ── HostRing unit behavior ──
+
+
+def test_hostring_close_wakes_blocked_acquire():
+    ring = HostRing(100, 1)
+    slot = ring.acquire(100)
+    errors: list = []
+
+    def blocked():
+        try:
+            ring.acquire(100)
+        except RingClosed as exc:
+            errors.append(exc)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    import time as _time
+
+    _time.sleep(0.15)  # let it stall (counted)
+    ring.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert errors and isinstance(errors[0], RingClosed)
+    assert ring.stalls >= 1
+    slot.release()
+
+
+def test_hostring_reuse_and_detach_accounting():
+    ring = HostRing(1000, 8)
+    a = ring.acquire(400)
+    a.release()
+    b = ring.acquire(300)  # smallest-fit reuse of the 400-byte buffer
+    assert ring.reuses == 1 and ring.allocs == 1
+    # Detach surrenders the accounting: a second large acquire fits.
+    b.addref()
+    b.detach()
+    c = ring.acquire(900)
+    assert ring.peak_bytes <= 1000 + 400  # detached bytes left the bound
+    b.release()
+    b.release()
+    assert ring.detached == 1
+    c.release()
+    ring.close()
+
+
+# ── Chaos: corruption through the streaming path ──
+
+
+@pytest.mark.chaos
+def test_chunk_corrupt_streaming_attributed_and_healed(tmp_path):
+    """A peer serving flipped bytes under the STREAMING landing: the
+    corruption is attributed at the trust boundary (peer strike), the
+    unit heals from CDN, and both the HBM tree and the materialized
+    files come out byte-exact — the ring changed the unit of
+    buffering, never the trust model."""
+    from zest_tpu import faults
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    chaos_files = llama_checkpoint_files(0.003,
+                                         shard_bytes=1024 * 1024,
+                                         scale=8)
+    repo = FixtureRepo("acme/streaming-chaos", chaos_files,
+                       chunks_per_xorb=1)
+    faults.reset()
+    with FixtureHub(repo) as hub:
+        def cfg_for(name):
+            return Config(hf_home=tmp_path / name / "hf",
+                          cache_dir=tmp_path / name / "zest",
+                          hf_token="hf_test", endpoint=hub.url)
+
+        seed_cfg = cfg_for("seeder")
+        pull_model(seed_cfg, "acme/streaming-chaos", no_p2p=True,
+                   log=_quiet)
+        server = BtServer(seed_cfg)
+        port = server.start()
+        try:
+            faults.install(f"chunk_corrupt:1.0@127.0.0.1:{port}",
+                           seed=1337)
+            cfg = cfg_for("leecher")
+            swarm = SwarmDownloader(cfg)
+            swarm.add_direct_peer("127.0.0.1", port)
+            # Capture the pull log: if the streaming landing ever falls
+            # back ("direct HBM landing unavailable (...)"), the assert
+            # below must show WHY, not die with a bare KeyError.
+            log_lines: list[str] = []
+
+            def log_capture(*a, **k):
+                log_lines.append(" ".join(str(x) for x in a))
+
+            try:
+                result = pull_model(cfg, "acme/streaming-chaos",
+                                    swarm=swarm, device="tpu", pod=False,
+                                    log=log_capture)
+            finally:
+                swarm.close()
+        finally:
+            server.shutdown()
+            faults.reset()
+
+    assert result.stats["hbm"].get("streamed") is True, (
+        f"streaming landing fell back: hbm={result.stats['hbm']!r} "
+        f"log={log_lines!r}")
+    for name, data in chaos_files.items():
+        assert (result.snapshot_dir / name).read_bytes() == data
+    assert result.stats["faults"]["chunk_corrupt"] >= 1
+    assert result.stats["swarm"]["corrupt_from_peer"] >= 1
+    assert result.stats["fetch"]["bytes"]["cdn"] > 0
+    result.params = None
+
+
+# ── Coop interop: priority ordering leaves the plan untouched ──
+
+
+def test_coop_fingerprint_unchanged_by_priorities(hub, tmp_path):
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.models.direct import unit_layer_priorities
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.coop import coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.pod import fetch_file_header
+
+    def run_pair(sub, priorities_for):
+        """2 in-process hosts, one coop round; returns host 0's stats."""
+        bridges, servers, addrs = [], [], {}
+        for i in range(2):
+            cfg = Config(hf_home=tmp_path / sub / f"h{i}" / "hf",
+                         cache_dir=tmp_path / sub / f"h{i}" / "zest",
+                         hf_token="hf_test", endpoint=hub.url,
+                         dcn_port=0)
+            b = XetBridge(cfg)
+            b.authenticate("acme/streaming")
+            bridges.append(b)
+            s = DcnServer(b.cfg, b.cache)
+            addrs[i] = ("127.0.0.1", s.start())
+            servers.append(s)
+        recs = [bridges[0].get_reconstruction(e.xet_hash)
+                for e in HubClient(bridges[0].cfg).list_files(
+                    "acme/streaming")
+                if e.is_xet]
+        results: list = [None, None]
+        errors: list = []
+
+        def run(i):
+            try:
+                results[i] = coop_round(
+                    bridges[i], recs, i, 2, addrs, server=servers[i],
+                    priorities=priorities_for(bridges[i], recs))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        ts = [threading.Thread(target=run, args=(i,), daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        for s in servers:
+            s.shutdown()
+        for b in bridges:
+            b.close()
+        assert not errors, errors
+        return results
+
+    def with_prio(bridge, recs):
+        repo = hub.repos["acme/streaming"]
+        rwh = [(repo.reconstructions[repo.files[n].xet_hash],
+                fetch_file_header(
+                    bridge,
+                    repo.reconstructions[repo.files[n].xet_hash]))
+               for n in SHARDS]
+        return unit_layer_priorities(rwh)
+
+    plain = run_pair("plain", lambda b, r: None)
+    ordered = run_pair("ordered", with_prio)
+    # The ownership plan — and with it the cross-host agreement every
+    # exchange depends on — is byte-identical with ordering on or off.
+    fp = {r["plan"]["fingerprint"] for r in plain + ordered}
+    assert len(fp) == 1
+    for r in ordered:
+        assert r["exchange"]["units"] > 0  # the round actually exchanged
+
+
+# ── Config: ring knobs through the env, uniformly ──
+
+
+def test_config_ring_env_parsing():
+    base = {"HF_HOME": "/tmp/x", "ZEST_CACHE_DIR": "/tmp/y"}
+    cfg = Config.load({**base, "ZEST_LAND_STREAM": "1",
+                       "ZEST_LAND_RING_BYTES": "8388608",
+                       "ZEST_LAND_RING_SLOTS": "7"})
+    assert cfg.land_stream is True
+    assert cfg.land_ring_bytes == 8 * 1024 * 1024
+    assert cfg.land_ring_slots == 7
+    off = Config.load({**base, "ZEST_LAND_STREAM": "0"})
+    assert off.land_stream is False
+    defaults = Config.load(base)
+    assert defaults.land_stream is True
+    assert defaults.land_ring_bytes == 512 * 1024 * 1024
+    assert defaults.land_ring_slots == 64
+    # Malformed values raise (like ZEST_COOP_ADDRS), never silently
+    # fall back to a default ring.
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_LAND_RING_BYTES": "256mb"})
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_LAND_RING_SLOTS": "many"})
+    # The rollback knob parses STRICTLY: "false"/"off"/a typo must
+    # raise, never silently keep streaming on.
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_LAND_STREAM": "false"})
+
+
+def test_stats_watch_landing_line():
+    from zest_tpu.cli import _stats_watch_lines
+
+    lines = _stats_watch_lines(
+        {"landing": {"first_layer_s": 1.2, "time_to_hbm_s": 6.0,
+                     "first_layer_ratio": 0.2, "ring_stalls": 3}},
+        {"version": "x"})
+    landing = [ln for ln in lines if ln.startswith("landing:")]
+    assert landing and "first_layer=1.2s" in landing[0]
+    assert "hbm=6.0s" in landing[0]
+    assert "20% of hbm" in landing[0]
+    assert "ring_stalls=3" in landing[0]

@@ -287,6 +287,31 @@ class HttpApi:
         if coop:
             payload["coop"] = coop
 
+        # Streaming-landing block (ISSUE 8): the last pull's first-layer
+        # vs HBM walls — what the dashboard/`zest stats --watch` render
+        # as "how soon was this model USABLE" — plus the ring stall
+        # counter (a rising value means the device transfer, not the
+        # decode, is the landing's bottleneck).
+        landing: dict = {}
+        last_fl = self._metric_samples("zest_last_pull_first_layer_seconds")
+        if last_fl and last_fl[0][1] > 0:
+            landing["first_layer_s"] = round(last_fl[0][1], 3)
+        last_hbm = self._metric_samples("zest_last_pull_hbm_seconds")
+        if last_hbm and last_hbm[0][1] > 0:
+            landing["time_to_hbm_s"] = round(last_hbm[0][1], 3)
+        if "first_layer_s" in landing and "time_to_hbm_s" in landing:
+            landing["first_layer_ratio"] = round(
+                landing["first_layer_s"] / landing["time_to_hbm_s"], 4)
+        # Per-pull gauge, not zest_land_ring_stalls_total: the
+        # cumulative counter would attribute earlier pulls' stalls to
+        # the last pull's first_layer/hbm walls shown beside it.
+        for _labels, value in self._metric_samples(
+                "zest_last_pull_ring_stalls"):
+            if value:
+                landing["ring_stalls"] = int(value)
+        if landing:
+            payload["landing"] = landing
+
         health = getattr(self.swarm, "health", None) \
             if self.swarm is not None else None
         if health is not None and hasattr(health, "detail"):
@@ -743,6 +768,13 @@ async function tick(){
   // quarantined peers, and the flight-recorder tail from /v1/debug.
   const d=await (await fetch('/v1/debug?tail=8')).json();
   const c=d.coop||{}, crows=[];
+  // Streaming-landing line (ISSUE 8): last pull's first-layer vs HBM.
+  const L=d.landing||{};
+  if(L.first_layer_s!=null)
+   crows.push(['first_layer_s',L.first_layer_s+(L.first_layer_ratio!=null?
+    ' ('+(L.first_layer_ratio*100).toFixed(0)+'% of hbm)':'')]);
+  if(L.time_to_hbm_s!=null) crows.push(['time_to_hbm_s',L.time_to_hbm_s]);
+  if(L.ring_stalls!=null) crows.push(['ring_stalls',L.ring_stalls]);
   if(c.peer_served_ratio!=null)
    crows.push(['peer_served_ratio',(c.peer_served_ratio*100).toFixed(1)+'%']);
   for(const [t,b] of Object.entries(c.tier_bytes||{}))

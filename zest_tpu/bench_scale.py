@@ -386,10 +386,20 @@ def _settle_page_cache(drop: bool) -> str:
 
 
 def bench_gb_pull(gb: float = 2.0, runs: int = 3,
-                  chunks_per_xorb: int = 512, scale: int = 1,
+                  chunks_per_xorb: int = 512, scale: int = 2,
                   budget_s: float | None = None,
                   drop_caches: bool | None = None) -> dict:
     """``runs`` cold GB-scale pulls; per-stage medians + relative spread.
+
+    ``scale=2`` since ISSUE 8: 2 GB at true-8B dims (scale=1) is a
+    DEGENERATE checkpoint — two ~1 GB embedding matrices plus a single
+    transformer layer, so the first-layer set is ~half the bytes and
+    ``first_layer_ratio`` (the streaming headline) is structurally
+    meaningless there. scale=2 keeps the byte total but gives the
+    fixture real depth (~14 layers), the shape a 2 GB slice of a
+    production pull actually has. The geometry is recorded in the
+    artifact (``"geometry"``), so scale-1 and scale-2 artifacts can't
+    be silently compared.
 
     The hub (and the one-time checkpoint + xorb build) is shared across
     runs; each run gets fresh cache/HF dirs so every pull is cold. The
@@ -502,6 +512,9 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
                         "stages": res.stats.get("stages", {}),
                         "stages_busy": res.stats.get("stages_busy", {}),
                         "time_to_hbm_s": res.stats.get("time_to_hbm_s"),
+                        "time_to_first_layer_s": res.stats.get(
+                            "time_to_first_layer_s"),
+                        "ring": (res.stats.get("hbm") or {}).get("ring"),
                         "files_hbm_span_s": res.stats.get(
                             "files_hbm_span_s"),
                         "files_after_hbm_s": res.stats.get(
@@ -569,6 +582,13 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
             else f"llama-8B-shapes/{scale}")
     after_vals = [r["files_after_hbm_s"] for r in results
                   if r.get("files_after_hbm_s") is not None]
+    # Streaming-landing headline (ISSUE 8): how soon the first-token-
+    # capable set was resident, next to time_to_hbm — plus per-run
+    # values and the last run's ring counters (occupancy/stall
+    # evidence). Absent entirely for knob-off runs.
+    fl_vals = [r["time_to_first_layer_s"] for r in results
+               if r.get("time_to_first_layer_s") is not None]
+    rings = [r["ring"] for r in results if r.get("ring")]
     timed_modes = page_cache_modes[-len(results):]
     return {
         "checkpoint_gb": round(total / 1e9, 3),
@@ -576,6 +596,12 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
         "runs": len(results),
         "time_to_hbm_s": round(med_hbm, 3),
         "time_to_hbm_runs_s": [round(t, 3) for t in hbm_times],
+        **({"time_to_first_layer_s": round(statistics.median(fl_vals), 3),
+            "time_to_first_layer_runs_s": [round(t, 3) for t in fl_vals],
+            "first_layer_ratio": round(
+                statistics.median(fl_vals) / med_hbm, 3)
+            if med_hbm else None,
+            "ring": rings[-1]} if fl_vals else {}),
         "total_pull_s": round(statistics.median(walls), 3),
         # Background materialization evidence (ISSUE 5): files-stage
         # wall that ran after the params were already resident — work

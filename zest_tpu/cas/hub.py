@@ -103,19 +103,27 @@ class HubClient:
         """Stream a non-xet file to ``dest``; returns byte count.
 
         Streams to a tmp file and renames — unlike the reference, which
-        buffers whole files in memory (quirk at main.zig:713-728).
+        buffers whole files in memory (quirk at main.zig:713-728). The
+        tmp name is unique per call (mkstemp, not a fixed
+        ``.tmp-<name>``): the early-config prefetch and the file loop
+        may both stream the same dest concurrently, and a shared tmp
+        would let one rename steal the other's file out from under its
+        own ``os.replace``.
         """
+        import tempfile
+
         url = f"{self.cfg.endpoint}/{repo_id}/resolve/{revision}/{filename}"
         dest.parent.mkdir(parents=True, exist_ok=True)
-        tmp = dest.with_name(f".tmp-{dest.name}")
+        fd, tmp = tempfile.mkstemp(dir=dest.parent,
+                                   prefix=f".tmp-{dest.name}.")
         total = 0
         try:
-            with self.session.get(
-                url, headers=self._headers(), timeout=60, stream=True
-            ) as resp:
-                if resp.status_code != 200:
-                    raise HubError(f"GET {url} -> {resp.status_code}")
-                with open(tmp, "wb") as f:
+            with os.fdopen(fd, "wb") as f:
+                with self.session.get(
+                    url, headers=self._headers(), timeout=60, stream=True
+                ) as resp:
+                    if resp.status_code != 200:
+                        raise HubError(f"GET {url} -> {resp.status_code}")
                     for piece in resp.iter_content(chunk_size=1 << 20):
                         f.write(piece)
                         total += len(piece)

@@ -267,6 +267,11 @@ def _print_pull_stats(stats: dict) -> None:
             print(f"  HBM commit: {h['tensors']} tensors, {h['bytes']} "
                   f"bytes ({h['gbps']} GB/s)"
                   + (" [direct]" if h.get("direct") else ""))
+        fl = stats.get("time_to_first_layer_s")
+        hbm_s = stats.get("time_to_hbm_s")
+        if fl is not None and hbm_s:
+            print(f"  First layer: {fl}s of {hbm_s}s to HBM "
+                  f"({fl / hbm_s:.0%})")
 
 
 def cmd_generate(args) -> int:
@@ -540,6 +545,21 @@ def _stats_watch_lines(debug: dict, status: dict) -> list[str]:
     lines = [f"zest-tpu v{status.get('version', '?')}  "
              f"http_requests={status.get('http_requests', 0)}  "
              f"xorbs={status.get('xorbs_cached', 0)}"]
+    landing = debug.get("landing") or {}
+    if landing:
+        fl = landing.get("first_layer_s")
+        hbm = landing.get("time_to_hbm_s")
+        ratio = landing.get("first_layer_ratio")
+        lane = "landing:"
+        if fl is not None:
+            lane += f" first_layer={fl}s"
+        if hbm is not None:
+            lane += f" hbm={hbm}s"
+        if ratio is not None:
+            lane += f" ({ratio:.0%} of hbm)"
+        if "ring_stalls" in landing:
+            lane += f"  ring_stalls={landing['ring_stalls']}"
+        lines.append(lane)
     coop = debug.get("coop") or {}
     if coop:
         ratio = coop.get("peer_served_ratio")

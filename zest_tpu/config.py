@@ -57,6 +57,29 @@ DEFAULT_FILES_WORKERS = 0
 # (ZEST_COOP_INFLIGHT) — bounds how many compressed wire bytes a host
 # stages in memory before draining them to the verified cache.
 DEFAULT_COOP_INFLIGHT_BYTES = 1 << 30
+# Streaming landing (models.loader._stage_streaming): with 1 (default)
+# a --device=tpu landing flows fetch → decode → device_put at TENSOR
+# granularity through a fixed ring of reusable host staging buffers —
+# tensors commit in layer order (embedding + layer 0 first) and the
+# decode engine writes straight into the ring slot the transfer reads
+# (no per-shard intermediate buffer). 0 restores the PR-1 shard-level
+# double buffer bit-for-bit (stats schema included). Requires
+# ZEST_LAND_AHEAD nonzero — a serial landing has no pipeline to ring.
+DEFAULT_LAND_STREAM = True
+# Ring capacity (ZEST_LAND_RING_BYTES): total bytes of staging buffers
+# in flight between decode and device transfer. Sized to hold ~3 decode
+# runs (a run is up to 2x the 64 MiB commit group, and slots round out
+# to term boundaries) so the producer isn't backpressured while one
+# group commits and another accumulates — still far below the
+# non-streaming path's ~two-shard staging peak (1.3 GB for 650 MB
+# shards); a tensor larger than the whole ring is admitted alone (the
+# ByteBudget oversized rule) rather than deadlocking.
+DEFAULT_LAND_RING_BYTES = 512 * 1024 * 1024
+# Ring slot cap (ZEST_LAND_RING_SLOTS): max concurrently-acquired
+# buffers — bounds buffer-object churn when a checkpoint is all tiny
+# tensors; bytes are the binding constraint for checkpoint-shaped
+# tensors.
+DEFAULT_LAND_RING_SLOTS = 64
 
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
 
@@ -85,6 +108,16 @@ def _parse_coop_addrs(spec: str) -> dict[int, tuple[str, int]]:
             idx, addr = parse_host_addr(part)
             out[idx] = addr
     return out
+
+
+def _strict_bool(name: str, value: str) -> bool:
+    """``"0"``/``"1"`` only — anything else raises. The lenient
+    ``!= "0"`` idiom would turn ``ZEST_LAND_STREAM=false`` (or a typo)
+    into streaming silently staying ON, defeating the rollback knob."""
+    v = value.strip()
+    if v not in ("0", "1"):
+        raise ValueError(f"{name} must be 0 or 1, got {value!r}")
+    return v == "1"
 
 
 def _expand(p: str) -> Path:
@@ -158,6 +191,10 @@ class Config:
     decode_workers: int = DEFAULT_DECODE_WORKERS
     land_decode_ahead: int = DEFAULT_LAND_DECODE_AHEAD
     decode_cache_bytes: int = DEFAULT_DECODE_CACHE_BYTES
+    # Streaming landing ring (see DEFAULT_LAND_* above).
+    land_stream: bool = DEFAULT_LAND_STREAM
+    land_ring_bytes: int = DEFAULT_LAND_RING_BYTES
+    land_ring_slots: int = DEFAULT_LAND_RING_SLOTS
     # Background materialization lane (see DEFAULT_FILES_* above).
     files_async: bool = DEFAULT_FILES_ASYNC
     files_workers: int = DEFAULT_FILES_WORKERS
@@ -245,6 +282,21 @@ class Config:
                 env.get("ZEST_LAND_AHEAD", DEFAULT_LAND_DECODE_AHEAD))),
             decode_cache_bytes=max(0, int(
                 env.get("ZEST_DECODE_CACHE", DEFAULT_DECODE_CACHE_BYTES))),
+            # Malformed values raise (_strict_bool / int() ValueError),
+            # like every other landing knob — a typo must not silently
+            # fall back to a default ring, and ZEST_LAND_STREAM=false
+            # must not silently keep streaming ON (it is the rollback
+            # knob).
+            land_stream=_strict_bool(
+                "ZEST_LAND_STREAM",
+                env.get("ZEST_LAND_STREAM",
+                        "1" if DEFAULT_LAND_STREAM else "0")),
+            land_ring_bytes=max(1, int(
+                env.get("ZEST_LAND_RING_BYTES",
+                        DEFAULT_LAND_RING_BYTES))),
+            land_ring_slots=max(1, int(
+                env.get("ZEST_LAND_RING_SLOTS",
+                        DEFAULT_LAND_RING_SLOTS))),
             files_async=env.get(
                 "ZEST_FILES_ASYNC",
                 "1" if DEFAULT_FILES_ASYNC else "0").strip() != "0",

@@ -19,6 +19,7 @@ import contextlib
 import functools
 import gc
 import re
+import threading
 import time
 from pathlib import Path
 
@@ -27,12 +28,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zest_tpu import telemetry
+from zest_tpu.config import (
+    DEFAULT_LAND_DECODE_AHEAD,
+    DEFAULT_LAND_RING_BYTES,
+    DEFAULT_LAND_RING_SLOTS,
+    DEFAULT_LAND_STREAM,
+)
 from zest_tpu.models.safetensors_io import SafetensorsFile
 
 _M_COMMIT_BYTES = telemetry.counter(
     "zest_hbm_commit_bytes_total", "Bytes committed host→HBM")
 _M_COMMIT_TENSORS = telemetry.counter(
     "zest_hbm_commit_tensors_total", "Tensors committed host→HBM")
+_M_RING_STALLS = telemetry.counter(
+    "zest_land_ring_stalls_total",
+    "Streaming-landing ring acquisitions that had to wait for capacity")
 
 ShardRules = list[tuple[str, P]]
 
@@ -162,18 +172,86 @@ _COALESCE_MAX_BYTES = 256 * 1024
 _COALESCE_MIN_TENSORS = 2
 
 
+# Bit-pattern carrier for coalesced float groups: XLA is free to
+# canonicalize NaN payloads when it touches FLOAT values (measured on
+# the CPU backend: non-canonical bf16 NaNs came back as 0x7FC0/0xFFC0
+# through the jitted split — a byte-integrity hole params_digest only
+# exposed once streaming changed which tensors coalesce). Moving the
+# group through a same-itemsize unsigned-integer dtype and bitcasting
+# back on device keeps every byte inert. Dtypes without a mapping
+# (integers — already inert — and exotic sub-byte types) pass through
+# unchanged.
+_BITSAFE_CARRIER: dict = {}
+
+
+def _dtype_bits(dt: np.dtype) -> int:
+    """True bit width — sub-byte ml_dtypes (int4, float4_e2m1fn)
+    report itemsize 1 but are 4 bits wide; a same-"itemsize" uint8
+    carrier can't bitcast back to them (ratio-1 bitcast needs equal
+    widths, and jax rejects 8→4). ml_dtypes' finfo/iinfo understand
+    both its own types and the standard numpy ones; np.finfo does
+    not (bfloat16 raises 'not inexact')."""
+    import ml_dtypes
+
+    for info in (ml_dtypes.finfo, ml_dtypes.iinfo):
+        try:
+            return info(dt).bits
+        except (ValueError, TypeError):
+            continue
+    return dt.itemsize * 8
+
+
+def _bit_carrier(dt: np.dtype) -> np.dtype | None:
+    if dt in _BITSAFE_CARRIER:
+        return _BITSAFE_CARRIER[dt]
+    carrier = None
+    if not (np.issubdtype(dt, np.integer) or dt == np.bool_):
+        carrier = {8: np.dtype(np.uint8), 16: np.dtype(np.uint16),
+                   32: np.dtype(np.uint32)}.get(_dtype_bits(dt))
+        if _dtype_bits(dt) == 64:
+            # A uint64 carrier only survives device_put when x64 is
+            # enabled; in default (x64-off) mode jax VALUE-casts it to
+            # uint32 — the high words vanish and every 8-byte bit
+            # pattern lands as zeros/garbage. Without x64 the group
+            # must NOT coalesce: un-carried tensors take the plain
+            # per-tensor device_put, whose float64→float32 downcast is
+            # value-correct (the pre-carrier behavior).
+            if jax.config.jax_enable_x64:
+                carrier = np.dtype(np.uint64)
+    # Cache keyed on (dtype, x64) would be overkill: flipping
+    # jax_enable_x64 mid-process is unsupported across jax generally.
+    _BITSAFE_CARRIER[dt] = carrier
+    return carrier
+
+
 @functools.lru_cache(maxsize=64)
 def _coalesced_split(bounds: tuple[int, ...],
-                     shapes: tuple[tuple[int, ...], ...]):
+                     shapes: tuple[tuple[int, ...], ...],
+                     dtype_str: str | None):
     """Jitted flat-buffer → per-tensor views splitter, cached per layout
     so a repeated commit geometry (every shard of one checkpoint) pays
     one compile and ONE dispatch per group — not a slice round-trip per
-    tensor."""
+    tensor. ``dtype_str`` (a numpy dtype name) is the group's REAL
+    dtype when the flat buffer rides a bit-pattern carrier; the split
+    bitcasts each piece back (ratio-1 bitcast: same shape, zero value
+    semantics — see ``_bit_carrier``)."""
+    import ml_dtypes  # noqa: F401 - dtype names resolve through it
+
+    target = None
+    if dtype_str is not None:
+        try:
+            target = np.dtype(dtype_str)
+        except TypeError:
+            target = np.dtype(getattr(ml_dtypes, dtype_str))
+
     def split(flat):
-        return tuple(
-            flat[bounds[i]:bounds[i + 1]].reshape(shapes[i])
-            for i in range(len(shapes))
-        )
+        out = []
+        for i in range(len(shapes)):
+            piece = flat[bounds[i]:bounds[i + 1]]
+            if target is not None:
+                piece = jax.lax.bitcast_convert_type(piece, target)
+            out.append(piece.reshape(shapes[i]))
+        return tuple(out)
 
     return jax.jit(split)
 
@@ -184,6 +262,7 @@ def commit_tensors(
     rules: ShardRules | None = None,
     dtype=None,
     donate: bool = False,
+    coalesce: bool = True,
 ) -> dict[str, jax.Array]:
     """One BATCHED ``device_put`` for a whole tensor dict.
 
@@ -213,12 +292,20 @@ def commit_tensors(
       a no-op for host numpy staging, but device-resident inputs
       (re-landing, resharding) release their source HBM immediately
       instead of at the next GC.
+
+    ``coalesce=False`` skips the small-tensor grouping: the jitted
+    split is cached *per group layout*, and a caller whose group
+    composition varies call to call (the streaming landing — its
+    commit groups cut the tensor stream wherever the byte threshold
+    lands) would pay an XLA compile per flush for a dispatch meant to
+    be amortized. Per-shard commits keep the default: one checkpoint
+    repeats one layout.
     """
     # .nbytes, never np.asarray: inputs may be device-resident (the
     # resharding path) and asarray would round-trip them through host.
     nbytes = sum(int(getattr(a, "nbytes", 0)) for a in host.values())
     with telemetry.span("hbm.commit", tensors=len(host), bytes=nbytes):
-        out = _commit_tensors(host, mesh, rules, dtype, donate)
+        out = _commit_tensors(host, mesh, rules, dtype, donate, coalesce)
     _M_COMMIT_BYTES.inc(nbytes)
     _M_COMMIT_TENSORS.inc(len(host))
     return out
@@ -230,6 +317,7 @@ def _commit_tensors(
     rules: ShardRules | None = None,
     dtype=None,
     donate: bool = False,
+    coalesce: bool = True,
 ) -> dict[str, jax.Array]:
     if dtype is not None:
         def cast(a):
@@ -250,7 +338,7 @@ def _commit_tensors(
     # would concat distinct dtypes into one group — DTypePromotionError
     # at best, silently mis-typed split views at worst.
     by_dtype: dict[np.dtype, list[str]] = {}
-    for n in names:
+    for n in names if coalesce else ():
         a = host[n]
         if not 0 < a.nbytes < _COALESCE_MAX_BYTES:
             continue
@@ -267,9 +355,18 @@ def _commit_tensors(
         payloads.append(host[n])
         payload_shardings.append(
             None if specs is None else NamedSharding(mesh, specs[n]))
+    group_dtypes: list[str | None] = []
     for g in groups:
+        dt = np.dtype(host[g[0]].dtype)
+        carrier = _bit_carrier(dt)
         flat = np.concatenate([np.ascontiguousarray(host[n]).reshape(-1)
                                for n in g])
+        if carrier is not None:
+            # Ship float groups as raw bit patterns (see _bit_carrier):
+            # the on-device split bitcasts back, so XLA never gets a
+            # chance to canonicalize NaN payloads in transit.
+            flat = flat.view(carrier)
+        group_dtypes.append(dt.name if carrier is not None else None)
         payloads.append(flat)
         payload_shardings.append(
             None if specs is None else NamedSharding(mesh, P()))
@@ -280,13 +377,15 @@ def _commit_tensors(
         arrays = jax.device_put(payloads, payload_shardings, donate=donate)
 
     out = dict(zip(singles, arrays[:len(singles)]))
-    for g, flat_dev in zip(groups, arrays[len(singles):]):
+    for g, gdt, flat_dev in zip(groups, group_dtypes,
+                                arrays[len(singles):]):
         bounds, shapes, off = [0], [], 0
         for n in g:
             off += int(np.prod(host[n].shape, dtype=np.int64))
             bounds.append(off)
             shapes.append(tuple(host[n].shape))
-        parts = _coalesced_split(tuple(bounds), tuple(shapes))(flat_dev)
+        parts = _coalesced_split(tuple(bounds), tuple(shapes),
+                                 gdt)(flat_dev)
         out.update(zip(g, parts))
     return {n: out[n] for n in names}  # caller-visible order preserved
 
@@ -375,6 +474,583 @@ def _gc_frozen():
         gc.collect()
 
 
+class RingClosed(RuntimeError):
+    """The ring was torn down (consumer error) while a producer waited."""
+
+
+class _RingSlot:
+    """One in-flight staging buffer: decode writes it, the device
+    transfer reads it, and (optionally) the write-behind file sink
+    reads it too. Reference-counted — the buffer returns to the ring's
+    free list only when every consumer is done with it."""
+
+    __slots__ = ("ring", "buffer", "view", "acct", "refs", "detached")
+
+    def __init__(self, ring: "HostRing", buffer: np.ndarray, nbytes: int,
+                 acct: int):
+        self.ring = ring
+        self.buffer = buffer
+        self.view = buffer[:nbytes]
+        self.acct = acct          # capacity bytes charged to the ring
+        self.refs = 1
+        self.detached = False
+
+    def addref(self) -> "_RingSlot":
+        with self.ring._cv:
+            self.refs += 1
+        return self
+
+    def detach(self) -> None:
+        """Move this slot's bytes OUT of the ring's accounting — the
+        file sink calls it when it keeps a reference past the commit,
+        so a slow disk can never stall the landing's ring (total host
+        memory stays bounded: ring budget + the sink's own cap). A
+        detached buffer is not pooled for reuse."""
+        with self.ring._cv:
+            if not self.detached:
+                self.detached = True
+                self.ring._in_use_bytes -= self.acct
+                self.ring._in_use -= 1
+                self.ring._cv.notify_all()
+
+    def release(self) -> None:
+        self.ring._unref(self)
+
+
+class HostRing:
+    """Fixed-capacity pool of reusable host staging buffers — the
+    streaming landing's bounded-memory core (ISSUE 8; the fixed-byte-
+    budget argument from "Bounded-Memory Parallel Image Pulling",
+    PAPERS.md).
+
+    ``acquire(n)`` admits a slot while the in-flight capacity stays
+    within ``budget_bytes`` and the slot count within ``max_slots``;
+    otherwise it waits (a *stall* — counted, and a ``ring_stall``
+    flight-recorder event) until the consumer recycles one. A tensor
+    larger than the whole budget is admitted alone once nothing else is
+    in flight (the ByteBudget oversized rule — a 1 GB embedding must
+    still land, serially, not deadlock). Freed buffers are kept for
+    reuse (smallest adequate fit) so a steady-state landing stops
+    paying allocation + page-fault cost per tensor; the invariant
+    ``in_use + free ≤ budget`` holds at all times except inside an
+    oversized-alone admission.
+
+    ``reuse=False`` makes every slot single-use (buffers drop after
+    their transfer drains instead of pooling). Required on backends
+    whose ``device_put`` may ZERO-COPY an aligned host buffer — the
+    CPU backend does (measured: a 64-byte-aligned numpy array becomes
+    the committed array's own storage), so reusing the buffer there
+    would rewrite already-committed params. The byte bound still
+    holds; only the allocation amortization is lost, on the backend
+    where transfers are memcpy-cheap anyway."""
+
+    def __init__(self, budget_bytes: int, max_slots: int,
+                 reuse: bool = True):
+        self.budget_bytes = max(1, int(budget_bytes))
+        self.max_slots = max(1, int(max_slots))
+        self.reuse = bool(reuse)
+        self._cv = threading.Condition()
+        self._free: list[np.ndarray] = []
+        self._free_bytes = 0
+        self._in_use = 0
+        self._in_use_bytes = 0
+        self._closed = False
+        self.peak_bytes = 0
+        self.stalls = 0
+        self.stall_s = 0.0
+        self.oversized = 0
+        self.allocs = 0
+        self.reuses = 0
+        self.detached = 0
+        self._waiting = False
+
+    def _trim_free_locked(self, incoming: int) -> None:
+        while self._free and (self._in_use_bytes + self._free_bytes
+                              + incoming > self.budget_bytes):
+            dropped = self._free.pop()
+            self._free_bytes -= dropped.nbytes
+
+    def acquire(self, nbytes: int,
+                block: bool = True) -> _RingSlot | None:
+        """Admit a slot of ``nbytes``; with ``block=False`` return
+        ``None`` instead of waiting (no stall is counted) — callers
+        holding a slot of their own use it to avoid waiting on
+        capacity their own reference may be pinning."""
+        nbytes = max(0, int(nbytes))
+        stalled_at = None
+        with self._cv:
+            try:
+                while True:
+                    if self._closed:
+                        raise RingClosed("landing ring closed")
+                    if self._in_use == 0:
+                        break  # oversized-alone admission
+                    if (self._in_use < self.max_slots
+                            and self._in_use_bytes + nbytes
+                            <= self.budget_bytes):
+                        break
+                    if not block:
+                        return None
+                    if stalled_at is None:
+                        stalled_at = time.monotonic()
+                        self.stalls += 1
+                        _M_RING_STALLS.inc()
+                        telemetry.record(
+                            "ring_stall", bytes=nbytes,
+                            in_use_bytes=self._in_use_bytes,
+                            slots=self._in_use)
+                    # Visible to the consumer (producer_waiting): a
+                    # stalled producer may need the very slots the
+                    # consumer's half-built commit group pins.
+                    self._waiting = True
+                    self._cv.wait(0.05)
+            finally:
+                self._waiting = False
+            if stalled_at is not None:
+                self.stall_s += time.monotonic() - stalled_at
+            if nbytes > self.budget_bytes:
+                self.oversized += 1
+            # Reuse the smallest free buffer that fits — but only when
+            # its CAPACITY also fits the budget (a roomy buffer reused
+            # for a small tensor must not bust the byte bound).
+            best = None
+            for i, b in enumerate(self._free):
+                if b.nbytes >= nbytes and (
+                        best is None
+                        or b.nbytes < self._free[best].nbytes):
+                    best = i
+            buf = None
+            if best is not None:
+                cand = self._free[best]
+                if (self._in_use == 0
+                        or self._in_use_bytes + cand.nbytes
+                        <= self.budget_bytes):
+                    buf = self._free.pop(best)
+                    self._free_bytes -= buf.nbytes
+                    self.reuses += 1
+            if buf is None:
+                self._trim_free_locked(nbytes)
+                buf = np.empty(nbytes, dtype=np.uint8)
+                self.allocs += 1
+            self._in_use += 1
+            self._in_use_bytes += buf.nbytes
+            self.peak_bytes = max(self.peak_bytes, self._in_use_bytes)
+            return _RingSlot(self, buf, nbytes, buf.nbytes)
+
+    def _unref(self, slot: _RingSlot) -> None:
+        with self._cv:
+            slot.refs -= 1
+            if slot.refs > 0:
+                return
+            if slot.detached:
+                self.detached += 1
+                return  # accounting already surrendered; don't pool
+            self._in_use -= 1
+            self._in_use_bytes -= slot.acct
+            # Pool the buffer for reuse when it keeps the invariant;
+            # oversized (or budget-crowding) buffers are dropped.
+            if (self.reuse and not self._closed
+                    and self._in_use_bytes + self._free_bytes
+                    + slot.acct <= self.budget_bytes):
+                self._free.append(slot.buffer)
+                self._free_bytes += slot.acct
+            self._cv.notify_all()
+
+    @property
+    def producer_waiting(self) -> bool:
+        """True while a producer is parked inside :meth:`acquire`."""
+        with self._cv:
+            return self._waiting
+
+    def close(self) -> None:
+        """Wake any waiter with :class:`RingClosed` — the consumer's
+        error path, so a failing commit can never leave the decode
+        thread parked in ``acquire`` forever."""
+        with self._cv:
+            self._closed = True
+            self._free.clear()
+            self._free_bytes = 0
+            self._cv.notify_all()
+
+    def summary(self) -> dict:
+        with self._cv:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "slots": self.max_slots,
+                "peak_bytes": self.peak_bytes,
+                "stalls": self.stalls,
+                "stall_s": round(self.stall_s, 4),
+                "buffers_allocated": self.allocs,
+                "buffer_reuses": self.reuses,
+                "oversized": self.oversized,
+                "detached": self.detached,
+            }
+
+
+# Streaming commit grouping: tensors accumulate until a group reaches
+# this many bytes (or a quarter of the ring's slots) and then commit as
+# ONE batched device_put — tensor-granularity overlap without paying
+# the per-shape transfer-setup round trip per tensor that
+# commit_tensors' docstring measures at ~0.1 s/shape on a remote chip.
+_STREAM_COMMIT_BYTES = 64 * 1024 * 1024
+
+
+def _stage_streaming(
+    bridge,
+    recs_with_headers,
+    mesh,
+    rules,
+    dtype,
+    prefetch_next,
+    decode_workers,
+    clock,
+    ring_bytes: int,
+    ring_slots: int,
+    tensor_gate=None,
+    on_first_layer=None,
+    stream_file_sink=None,
+) -> tuple[dict[str, jax.Array], dict]:
+    """The ring scheduler: decode of tensor N+k overlaps the device
+    transfer of tensor N, in layer order, through a :class:`HostRing`
+    of reusable staging buffers. See ``stage_cached_to_hbm`` for the
+    contract; this is its ``land_stream`` path."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+    from queue import Empty, SimpleQueue
+
+    from zest_tpu.models.direct import StreamingShardReader
+    from zest_tpu.models.registry import first_layer_names, order_names
+
+    t0 = time.monotonic()
+    # Slot reuse is only safe when the device transfer COPIES: the CPU
+    # backend zero-copy-aliases aligned host buffers into the committed
+    # arrays (see HostRing), so there every slot is single-use.
+    ring = HostRing(ring_bytes, ring_slots,
+                    reuse=jax.default_backend() != "cpu")
+    group_bytes = max(1, min(_STREAM_COMMIT_BYTES, ring.budget_bytes // 4))
+    group_count = max(1, ring.max_slots // 4)
+    all_names = frozenset(
+        name for _r, h in recs_with_headers for name in h.tensors)
+    first_set = first_layer_names(all_names)
+    # Eager flushing only buys latency when the first-layer set is a
+    # PROPER subset: for a layer-less checkpoint first_layer_names
+    # returns the FULL set ("first layer" honestly == whole landing,
+    # the stat still fires at the end), so flushing every queue blip
+    # would spend a device_put dispatch+sync per decode gap for a
+    # first-layer instant that cannot arrive early anyway.
+    eager = bool(first_set) and first_set < all_names
+    q: SimpleQueue = SimpleQueue()
+    cancel = threading.Event()
+    _DONE = object()
+
+    # Decode unit: a RUN of file-contiguous tensors (layer order keeps
+    # a layer's tensors adjacent, so runs ≈ layers up to the cap). One
+    # read per run keeps intra-run boundary terms on the native batch
+    # path — per-tensor reads pushed every such term through the
+    # per-term memo (decoded to a bytes object, copied twice), which
+    # cost ~25% of the warm decode wall. The cap keeps runs rotating
+    # through the ring; a single tensor larger than the cap is its own
+    # run (admitted alone if it outsizes the whole ring). Twice the
+    # commit-group size, not equal to it: every run CUT re-decodes up
+    # to one boundary term (see ``produce``), so fewer, larger runs
+    # trade a little gate granularity for measurably less double
+    # decode — the commit still flushes per ``group_bytes``, so
+    # first-layer latency keeps its granularity from the commit side.
+    run_cap = 2 * group_bytes
+
+    def shard_runs(header):
+        runs: list[list[str]] = []
+        run_lo = run_hi = None
+        prev_name = None
+        for name in order_names(header.tensors):
+            lo, hi = header.tensors[name].file_range(header.data_start)
+            # Hard boundary at the first-layer-set edge: a shard
+            # smaller than run_cap would otherwise be ONE run, so the
+            # first-layer set could not decode (or gate its fetch)
+            # ahead of the rest of its shard — first-layer latency
+            # would silently degrade to shard granularity, the exact
+            # unit of overlap streaming exists to break.
+            if (runs and lo == run_hi
+                    and hi - run_lo <= run_cap
+                    and not (prev_name in first_set
+                             and name not in first_set)):
+                runs[-1].append(name)
+                run_hi = hi
+            else:
+                runs.append([name])
+                run_lo, run_hi = lo, hi
+            prev_name = name
+        return runs
+
+    def produce():
+        import bisect
+
+        try:
+            for i, (rec, header) in enumerate(recs_with_headers):
+                if cancel.is_set():
+                    return
+                if prefetch_next is not None:
+                    prefetch_next(i)
+                sr = StreamingShardReader(
+                    bridge.cache, rec, header, bridge=bridge,
+                    workers=decode_workers)
+                sink = (stream_file_sink(i, sr)
+                        if stream_file_sink is not None else None)
+                # Term boundaries (cumulative unpacked offsets): each
+                # run's READ range rounds out to them, so every term a
+                # run touches is wholly contained and decodes on the
+                # native in-place batch path. A term straddling two
+                # runs decodes once per run — an extra GIL-released
+                # in-place pass over ≤ one term — instead of riding
+                # the per-term memo (a side bytes buffer plus two
+                # copies; measured ~0.5 s/2 GB when 32 MiB units put a
+                # term under most run cuts).
+                bounds = [0]
+                for t in rec.terms:
+                    bounds.append(bounds[-1] + t.unpacked_length)
+                # (slot, r_lo, r_hi) of the previous run, held by an
+                # extra ref: adjacent runs share the straddling term,
+                # and its bytes are already decoded in that slot — the
+                # next run memcpys the overlap out of it and decodes
+                # only its fresh tail, instead of decoding the term a
+                # second time (measured ~0.6 s/2 GB of extra decode
+                # wall when 32 MiB terms put one under most run cuts).
+                prev: tuple | None = None
+                try:
+                    for run in shard_runs(header):
+                        if cancel.is_set():
+                            return
+                        if tensor_gate is not None:
+                            # cancel lets the consumer's error path
+                            # interrupt a gate parked on a slow fetch —
+                            # the executor-exit join must not wait out
+                            # the network.
+                            for name in run:
+                                tensor_gate(i, name, cancel)
+                        if cancel.is_set():
+                            return
+                        spans = [header.tensors[n].file_range(
+                            header.data_start) for n in run]
+                        lo, hi = spans[0][0], spans[-1][1]
+                        r_lo = bounds[
+                            max(0, bisect.bisect_right(bounds, lo) - 1)]
+                        r_hi = bounds[
+                            min(len(bounds) - 1,
+                                bisect.bisect_left(bounds, hi))]
+                        r_hi = max(r_hi, hi)  # hi past the last term
+                        # The held prev slot is capacity the ring
+                        # counts: blocking on acquire while holding it
+                        # can deadlock (with the sink inert nothing
+                        # else ever detaches it, and oversized-alone
+                        # needs in_use == 0). Keep prev only when it
+                        # actually overlaps this run AND the ring
+                        # admits both without waiting; otherwise drop
+                        # it — the straddling term just re-decodes,
+                        # the pre-overlap-copy behavior.
+                        if prev is not None and not (
+                                prev[1] <= r_lo < prev[2]):
+                            prev[0].release()
+                            prev = None
+                        slot = None
+                        if prev is not None:
+                            slot = ring.acquire(r_hi - r_lo,
+                                                block=False)
+                            if slot is None:
+                                prev[0].release()
+                                prev = None
+                        if slot is None:
+                            slot = ring.acquire(r_hi - r_lo)
+                        try:
+                            d_lo = r_lo
+                            if prev is not None:
+                                p_slot, p_lo, p_hi = prev
+                                if p_lo <= r_lo < p_hi:
+                                    ov = min(p_hi, r_hi) - r_lo
+                                    src_lo = r_lo - p_lo
+                                    np.copyto(
+                                        slot.view[:ov],
+                                        p_slot.view[src_lo:src_lo + ov])
+                                    d_lo = r_lo + ov
+                            with (clock("decode") if clock is not None
+                                  else contextlib.nullcontext()):
+                                if d_lo < r_hi:
+                                    sr.decode_range_into(
+                                        d_lo, r_hi,
+                                        memoryview(
+                                            slot.view[d_lo - r_lo:]),
+                                        label=f"{run[0]}+{len(run) - 1}"
+                                        if len(run) > 1 else run[0])
+                            if clock is not None:
+                                clock.note_bytes("decode", r_hi - d_lo)
+                        except BaseException:
+                            slot.release()
+                            raise
+                        if prev is not None:
+                            prev[0].release()
+                        slot.addref()
+                        prev = (slot, r_lo, r_hi)
+                        # One ring slot, len(run) consumers: the queue
+                        # releases once per tensor (plus the sink's own
+                        # refs), so pre-add the extra references.
+                        for _ in range(len(run) - 1):
+                            slot.addref()
+                        for name, (t_lo, t_hi) in zip(run, spans):
+                            info = header.tensors[name]
+                            arr = (slot.view[t_lo - r_lo:t_hi - r_lo]
+                                   .view(info.np_dtype)
+                                   .reshape(info.shape))
+                            if sink is not None:
+                                # The sink addrefs + detaches the slot
+                                # if it keeps the bytes; never blocks.
+                                sink.offer(name, info, arr, slot)
+                            q.put((name, arr, slot))
+                finally:
+                    if prev is not None:
+                        prev[0].release()
+                    sr.close()
+                    if sink is not None:
+                        sink.done_decoding()
+            q.put(_DONE)
+        except BaseException as exc:  # noqa: BLE001 - consumer re-raises
+            q.put(exc)
+
+    params: dict[str, jax.Array] = {}
+    committed_names: set[str] = set()
+    fired = not first_set
+    batch: dict[str, np.ndarray] = {}
+    batch_slots: list[_RingSlot] = []
+    batch_bytes = 0
+    batch_slot_ids: set[int] = set()
+    pending: deque = deque()
+
+    def drain_one():
+        nonlocal fired
+        arrays, slots, names = pending.popleft()
+        for a in arrays:
+            a.block_until_ready()
+        for s in slots:
+            s.release()
+        committed_names.update(names)
+        if (not fired and first_set
+                and first_set <= committed_names):
+            fired = True
+            if on_first_layer is not None:
+                on_first_layer()
+
+    def flush():
+        nonlocal batch, batch_slots, batch_bytes
+        if not batch:
+            return
+        committed = commit_tensors(batch, mesh, rules, dtype=dtype,
+                                   donate=True, coalesce=False)
+        params.update(committed)
+        pending.append((list(committed.values()), batch_slots,
+                        list(batch)))
+        batch, batch_slots, batch_bytes = {}, [], 0
+        batch_slot_ids.clear()
+        # Double buffer: keep ONE committed group in flight (its
+        # transfer drains while the next group decodes), drain older
+        # ones — their slots are what feeds the ring.
+        while len(pending) > 1:
+            drain_one()
+
+    error: BaseException | None = None
+    with _gc_frozen():
+        with ThreadPoolExecutor(
+                1, thread_name_prefix="zest-land-stream") as staging:
+            staging.submit(produce)
+            try:
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except Empty:
+                        # Queue momentarily dry. Recycle committed
+                        # groups (free — their transfers have had the
+                        # whole gap to drain) but do NOT flush the
+                        # half-built batch on every blip: the queue
+                        # empties between decode runs, so that was one
+                        # device_put per run remainder (38 calls per
+                        # 2 GB pull vs 3, each a real dispatch+sync).
+                        # Park unbounded only while holding nothing;
+                        # while the batch pins ring slots, poll and
+                        # flush the moment the producer actually
+                        # stalls in acquire (it may need these very
+                        # bytes — the 50 ms poll bounds the race of it
+                        # stalling right after a check) or stays quiet
+                        # past a grace period (a fetch-bound gap, where
+                        # committing early is exactly the streaming
+                        # win: first layers land while later ones are
+                        # still on the wire). Until the first-layer
+                        # set has committed, stay EAGER — flush every
+                        # blip: those few extra dispatches are what
+                        # time_to_first_layer is buying, and on a pull
+                        # smaller than one commit group they are the
+                        # only thing that commits anything early.
+                        while pending:
+                            drain_one()
+                        waited = 0.0
+                        while True:
+                            if batch and ((eager and not fired)
+                                          or ring.producer_waiting
+                                          or waited >= 0.25):
+                                flush()
+                                while pending:
+                                    drain_one()
+                            try:
+                                item = q.get(
+                                    timeout=0.05 if batch else None)
+                                break
+                            except Empty:
+                                waited += 0.05
+                    if item is _DONE:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    name, arr, slot = item
+                    batch[name] = arr
+                    batch_slots.append(slot)
+                    batch_bytes += int(arr.nbytes)
+                    batch_slot_ids.add(id(slot))
+                    # The slot guard counts DISTINCT slots (a run's
+                    # tensors share one) — it bounds how many ring
+                    # buffers a half-built group pins, not how many
+                    # tensors it holds; counting tensors made a
+                    # small-tensor checkpoint flush far under
+                    # group_bytes (2× the flush/sync count at the
+                    # scale=2 bench geometry).
+                    if (batch_bytes >= group_bytes
+                            or len(batch_slot_ids) >= group_count):
+                        flush()
+                flush()
+                while pending:
+                    drain_one()
+            except BaseException as exc:
+                error = exc
+                cancel.set()
+                ring.close()
+                raise
+            finally:
+                if error is not None:
+                    # Unblock the producer (ring closed ⇒ its next
+                    # acquire raises; cancel ⇒ its loops exit) and
+                    # drop anything already queued.
+                    while True:
+                        try:
+                            item = q.get_nowait()
+                        except Empty:
+                            break
+                        if isinstance(item, tuple):
+                            item[2].release()
+        for arr in params.values():
+            arr.block_until_ready()
+        dt = time.monotonic() - t0
+    stats = _commit_stats(params, dt, mesh, direct=True)
+    stats["decode_ahead"] = True
+    stats["streamed"] = True
+    stats["ring"] = ring.summary()
+    return params, stats
+
+
 def stage_cached_to_hbm(
     bridge,
     recs_with_headers,
@@ -386,6 +1062,12 @@ def stage_cached_to_hbm(
     decode_workers: int | None = None,
     on_host_ready=None,
     clock=None,
+    stream: bool | None = None,
+    ring_bytes: int | None = None,
+    ring_slots: int | None = None,
+    tensor_gate=None,
+    on_first_layer=None,
+    stream_file_sink=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -422,17 +1104,59 @@ def stage_cached_to_hbm(
     attributed — the stage the ISSUE-3 engine is judged on.
     Returns ``(params, stats)`` like stage_snapshot_to_hbm, with
     ``stats["direct"] = True``.
+
+    **Streaming** (``stream``, default ``Config.land_stream``, ISSUE 8):
+    the landing flows at *tensor* granularity through a
+    :class:`HostRing` of reusable staging buffers — tensors decode
+    straight into ring slots (no per-shard host buffer), commit in
+    layer order (``models.registry.order_names``) as batched groups,
+    and slots recycle as transfers drain. ``tensor_gate(i, name,
+    cancel)``, when given, blocks until tensor ``name``'s fetch units
+    are cached (the pull's layer-ordered warm publishes them) so decode
+    can chase the fetch sub-shard; ``cancel`` (a ``threading.Event``)
+    is the landing's abort signal — the gate must return when it sets. ``on_first_layer()`` fires once, the moment
+    the first-token-capable set (embedding + layer 0,
+    ``registry.first_layer_names``) is resident. ``stream_file_sink(i,
+    reader)`` returns the shard's write-behind consumer (or None): its
+    ``offer(name, info, arr, slot)`` may keep slot references (addref +
+    detach) to assemble the HF-cache file without re-decoding.
+    ``ring_bytes``/``ring_slots`` bound the in-flight staging memory
+    (``Config.land_ring_bytes``/``land_ring_slots``). Streaming
+    requires ``decode_ahead`` (a serial landing has no pipeline to
+    ring) and is mutually exclusive with the shard-level
+    ``on_host_ready`` write-behind; with ``stream`` off the PR-1
+    shard-level double buffer runs unchanged, stats schema included.
     """
     import contextlib
     from concurrent.futures import ThreadPoolExecutor
 
     from zest_tpu.models.direct import land_tensors
 
+    # Every landing knob resolves through Config uniformly — the
+    # fallback constants ARE the config defaults, so a bridge without a
+    # cfg can never disagree with ``Config()`` about the defaults.
     cfg = getattr(bridge, "cfg", None)
     if decode_ahead is None:
-        decode_ahead = getattr(cfg, "land_decode_ahead", 1)
+        decode_ahead = getattr(cfg, "land_decode_ahead",
+                               DEFAULT_LAND_DECODE_AHEAD)
     if decode_workers is None:
         decode_workers = getattr(cfg, "decode_workers", None)
+    if stream is None:
+        stream = getattr(cfg, "land_stream", DEFAULT_LAND_STREAM)
+    if ring_bytes is None:
+        ring_bytes = getattr(cfg, "land_ring_bytes",
+                             DEFAULT_LAND_RING_BYTES)
+    if ring_slots is None:
+        ring_slots = getattr(cfg, "land_ring_slots",
+                             DEFAULT_LAND_RING_SLOTS)
+    if (stream and decode_ahead and on_host_ready is None
+            and recs_with_headers):
+        return _stage_streaming(
+            bridge, recs_with_headers, mesh, rules, dtype,
+            prefetch_next, decode_workers, clock,
+            ring_bytes, ring_slots,
+            tensor_gate=tensor_gate, on_first_layer=on_first_layer,
+            stream_file_sink=stream_file_sink)
 
     t0 = time.monotonic()
     params: dict[str, jax.Array] = {}

@@ -13,8 +13,9 @@ them balanced, just without family-aware TP placement.
 from __future__ import annotations
 
 import json
+import re
 from pathlib import Path
-from typing import TYPE_CHECKING, Callable
+from typing import TYPE_CHECKING, Callable, Iterable
 
 if TYPE_CHECKING:  # the alias is annotation-only; keep jax off the
     from zest_tpu.models.loader import ShardRules  # import path here
@@ -80,3 +81,72 @@ def detect_model_type(snapshot_dir: str | Path) -> str | None:
 
 def shard_rules_for_snapshot(snapshot_dir: str | Path) -> ShardRules | None:
     return shard_rules_for_model_type(detect_model_type(snapshot_dir))
+
+
+# ── Landing order: which tensors a serving mesh needs first ──
+#
+# The streaming landing (models.loader._stage_streaming) commits
+# tensors in "usefulness" order — the Petals insight applied to
+# loading: a decoder can start token generation once the embedding and
+# layer 0 are resident, while layer N is still on the wire. The
+# priority is a pure function of the tensor NAME so every host (and
+# the cooperative fetch ordering in transfer.coop) computes the same
+# order with no coordination.
+
+# Per-layer tensors across the families the registry knows: Llama/
+# Mistral/Qwen/Mixtral use ``model.layers.N.``, GPT-2 uses ``h.N.``
+# (optionally ``transformer.h.N.``), generic exports use ``blocks.N.``.
+_LAYER_RE = re.compile(r"(?:^|\.)(?:layers|h|blocks)\.(\d+)\.")
+# Embedding tensors — needed before ANY layer can run.
+_EMBED_RE = re.compile(
+    r"(?:^|\.)(?:embed_tokens|tok_embeddings|embed_positions|wte|wpe)"
+    r"(?:$|\.)")
+
+# Priority groups: 0 = embeddings, 1 = transformer layers (by index),
+# 2 = everything else (final norm, lm_head, unclassified) — the
+# tensors a forward pass touches LAST.
+LayerPriority = tuple[int, int]
+
+
+def layer_priority(name: str) -> LayerPriority:
+    """Sortable landing priority for one tensor name.
+
+    ``(group, layer_index)`` — embeddings first, then layer 0, 1, ...,
+    then the rest. Comparisons are total, so any tensor set sorts
+    deterministically; unrecognized names all land in the tail group
+    (sorted stably, i.e. file order) — an unknown checkpoint streams in
+    file order, losing nothing."""
+    m = _LAYER_RE.search(name)
+    if m:
+        return (1, int(m.group(1)))
+    if _EMBED_RE.search(name):
+        return (0, 0)
+    return (2, 0)
+
+
+def order_names(names: Iterable[str]) -> list[str]:
+    """Names in landing order — a STABLE sort by :func:`layer_priority`
+    so equal-priority tensors keep their original (file) order, which
+    keeps the streaming decode walking the shard mostly forward."""
+    return sorted(names, key=layer_priority)
+
+
+def first_layer_names(names: Iterable[str]) -> frozenset[str]:
+    """The first-token-capable set: embeddings plus every tensor of the
+    lowest-indexed layer present. ``time_to_first_layer_s`` is the
+    instant this whole set is resident in HBM.
+
+    A checkpoint with no recognizable layer structure returns the FULL
+    set — "first layer usable" then honestly coincides with the whole
+    landing instead of claiming an early readiness no forward pass
+    could use."""
+    names = list(names)
+    by_prio = [(layer_priority(n), n) for n in names]
+    layer_idxs = [p[1] for p, _n in by_prio if p[0] == 1]
+    if not layer_idxs:
+        return frozenset(names)
+    first = min(layer_idxs)
+    return frozenset(
+        n for p, n in by_prio
+        if p[0] == 0 or p == (1, first)
+    )

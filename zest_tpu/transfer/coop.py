@@ -282,6 +282,7 @@ def coop_round(
     deadline_s: float | None = None,
     dcn_pool: DcnPool | None = None,
     trace_id: str | None = None,
+    priorities: dict | None = None,
     log=None,
 ) -> dict:
     """One cooperative round: plan -> fetch (my ~1/N) -> exchange.
@@ -306,6 +307,16 @@ def coop_round(
     callers still correlate. The round runs under a thread-scoped trace
     context (host index + trace_id) so its spans split into per-host
     tracks even when several simulated hosts share one process.
+
+    ``priorities`` (unit key ``(hash_hex, range_start)`` → sortable
+    layer-priority tuple, models.direct.unit_layer_priorities) orders
+    BOTH phases' iteration — my fetch share and each owner's exchange
+    request stream — so a streaming landing receives embedding +
+    layer-0 bytes first. Ordering only: the ownership plan, its
+    fingerprint, and every stats field are computed exactly as without
+    it (tests pin the fingerprint unchanged), so hosts may even
+    disagree about priorities (they don't — the key is a pure function
+    of content-addressed metadata) without breaking the exchange.
     """
     if trace_id is None:
         trace_id = _derive_trace_id(recs)
@@ -314,7 +325,7 @@ def coop_round(
             return _coop_round(bridge, recs, host_index, n_hosts,
                                host_addrs or {}, budget_bytes, server,
                                quarantined, entries_map, deadline_s,
-                               dcn_pool, trace_id, log)
+                               dcn_pool, trace_id, priorities, log)
 
 
 def _derive_trace_id(recs) -> str:
@@ -329,9 +340,19 @@ def _derive_trace_id(recs) -> str:
     return mint_trace_id(keys)
 
 
+def _layer_order(units, priorities):
+    """Stable layer-priority ordering of ``[(hash_hex, fi)]`` unit
+    lists — units the map doesn't know (non-safetensors files) sort
+    last, keyed for determinism. No-op without priorities."""
+    if not priorities:
+        return units
+    from zest_tpu.models.direct import unit_priority_sort_key
+    return sorted(units, key=unit_priority_sort_key(priorities))
+
+
 def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
                 budget_bytes, server, quarantined, entries_map,
-                deadline_s, dcn_pool, trace_id, log) -> dict:
+                deadline_s, dcn_pool, trace_id, priorities, log) -> dict:
     from zest_tpu.transfer.pull import ByteBudget
 
     t0 = time.monotonic()
@@ -382,7 +403,10 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
         deadline_s += 8.0 * plan.total_bytes / 1e9
 
     # ── Phase 1: fetch my share through the resilient waterfall ──
-    mine = plan.for_host(host_index)
+    # Layer-ordered when the caller is a streaming landing: my share
+    # warms early-layer bytes first, and peers asking ME get them
+    # servable sooner. The plan itself is untouched.
+    mine = _layer_order(plan.for_host(host_index), priorities)
     before = _tier_bytes(bridge.stats)
     with telemetry.span("coop.fetch", units=len(mine)):
         fetch_stats = warm_units_parallel(bridge, recs,
@@ -409,8 +433,9 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     deadline = time.monotonic() + deadline_s
 
     foreign = {
-        h: [(hh, fi) for hh, fi in plan.for_host(h)
-            if not _already_cached(bridge, hh, fi)]
+        h: _layer_order([(hh, fi) for hh, fi in plan.for_host(h)
+                         if not _already_cached(bridge, hh, fi)],
+                        priorities)
         for h in plan.alive if h != host_index
     }
     clock_offsets: dict = {}

@@ -113,6 +113,7 @@ def warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
     entries_map: dict[str, list[FetchInfo]] | None = None,
     units: list[tuple[str, FetchInfo]] | None = None,
+    on_unit=None,
 ) -> dict:
     """Fetch every uncached unit of ``recs`` into the local cache with
     ``max_concurrent`` waterfall fetches in flight (the reference's
@@ -139,19 +140,30 @@ def warm_units_parallel(
     path (width heuristics, retry pass, streamed CDN tier) instead of
     reimplementing it. ``entries_map`` must still span ALL files, for
     the same evidence reason as above.
+
+    ``on_unit(key)``, when given, is called with a unit's
+    ``(hash_hex, range_start)`` key the moment that unit is RESOLVED:
+    immediately for units already cached, at fetch completion for
+    fetched ones (completion order, not submission order), and after
+    the final retry attempt for units that failed it (the caller's
+    per-term waterfall is the terminal fallback, so "resolved" never
+    means "guaranteed cached"). The streaming landing's tensor gate
+    rides this to start decoding a tensor while the rest of the shard
+    is still on the wire.
     """
     with telemetry.span("warm.units", shards=len(recs)):
         return _warm_units_parallel(bridge, recs, max_concurrent,
-                                    entries_map, units)
+                                    entries_map, units, on_unit)
 
 
 def _warm_units_parallel(
     bridge, recs: list[Reconstruction], max_concurrent: int | None = None,
     entries_map: dict[str, list[FetchInfo]] | None = None,
     units: list[tuple[str, FetchInfo]] | None = None,
+    on_unit=None,
 ) -> dict:
     import os
-    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import ThreadPoolExecutor, as_completed
 
     if entries_map is None:
         entries_map = _entries_by_hash(recs)
@@ -163,6 +175,11 @@ def _warm_units_parallel(
         for hash_hex, fi in units
         if not _already_cached(bridge, hash_hex, fi)
     ]
+    if on_unit is not None:
+        wanted_keys = {(hh, fi.range.start) for hh, fi in wanted}
+        for hh, fi in units:
+            if (hh, fi.range.start) not in wanted_keys:
+                on_unit((hh, fi.range.start))
     if max_concurrent is None:
         max_concurrent = bridge.cfg.max_concurrent_downloads
         urls = {bridge._absolute_url(fi.url) for _h, fi in wanted[:8]}
@@ -197,13 +214,22 @@ def _warm_units_parallel(
         return len(data)
 
     failed_units = []
+    # Futures + as_completed rather than pool.map: submission order is
+    # the caller's priority order (the layer-ordered streaming warm),
+    # and completion events must reach ``on_unit`` the moment a unit
+    # lands — map()'s in-order iteration would park a finished layer-0
+    # unit behind a slow earlier one.
     with ThreadPoolExecutor(max_workers=max_concurrent) as pool:
-        for unit, result in zip(wanted,
-                                pool.map(lambda u: _safe(fetch, u), wanted)):
+        futures = {pool.submit(_safe, fetch, u): u for u in wanted}
+        for fut in as_completed(futures):
+            unit = futures[fut]
+            result = fut.result()
             if result is None:
                 failed_units.append(unit)
             else:
                 stats["bytes"] += result
+                if on_unit is not None:
+                    on_unit((unit[0], unit[1].range.start))
     # One sequential retry pass: under load, concurrent fetches can fail
     # on timeouts the same transfer survives alone (observed: >half of
     # 16-wide ~32 MB unit fetches truncated on a contended host). A
@@ -217,6 +243,8 @@ def _warm_units_parallel(
         else:
             stats["retried"] = stats.get("retried", 0) + 1
             stats["bytes"] += n
+        if on_unit is not None:
+            on_unit((unit[0], unit[1].range.start))
     return stats
 
 

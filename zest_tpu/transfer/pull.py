@@ -33,6 +33,24 @@ _M_PULL_SECONDS = telemetry.histogram(
     "zest_pull_seconds", "End-to-end pull wall time")
 _M_TTH_SECONDS = telemetry.histogram(
     "zest_time_to_hbm_seconds", "Pull start → weights resident in HBM")
+_M_TTFL_SECONDS = telemetry.histogram(
+    "zest_time_to_first_layer_seconds",
+    "Pull start → first-token-capable set (embedding + layer 0) "
+    "resident in HBM (streaming landing)")
+# Last-pull wall gauges: the live first-layer-vs-HBM line the
+# dashboard / `zest stats --watch` renders (histograms aggregate; the
+# operator's question is "how did the LAST landing do").
+_M_LAST_TTFL = telemetry.gauge(
+    "zest_last_pull_first_layer_seconds",
+    "time_to_first_layer_s of the most recent streaming landing")
+_M_LAST_TTH = telemetry.gauge(
+    "zest_last_pull_hbm_seconds",
+    "time_to_hbm_s of the most recent --device pull")
+_M_LAST_RING_STALLS = telemetry.gauge(
+    "zest_last_pull_ring_stalls",
+    "Ring producer stalls during the most recent streaming landing "
+    "(the cumulative zest_land_ring_stalls_total would misattribute "
+    "earlier pulls' stalls to the last one)")
 _M_STAGE_SECONDS = telemetry.histogram(
     "zest_stage_seconds", "Per-entry stage wall time", ("stage",))
 _M_STAGE_BYTES = telemetry.counter(
@@ -117,6 +135,16 @@ class StageClock:
         with self._lock:
             self._intervals.setdefault(stage, [])
 
+    def note_interval(self, stage: str, t0: float, t1: float) -> None:
+        """Record an interval measured elsewhere (monotonic seconds) —
+        the streaming landing's ``first_layer`` span is anchored at the
+        pull's own t0, which no ``with clock(...)`` block brackets."""
+        if t1 < t0:
+            t0, t1 = t1, t0
+        with self._lock:
+            self._intervals.setdefault(stage, []).append((t0, t1))
+        _M_STAGE_SECONDS.observe(t1 - t0, stage=stage)
+
     def note_bytes(self, stage: str, nbytes: int) -> None:
         with self._lock:
             self._bytes[stage] = self._bytes.get(stage, 0) + int(nbytes)
@@ -183,6 +211,21 @@ def _resolve_files_workers(n: int | None) -> int:
     if n and n > 0:
         return int(n)
     return max(2, min(4, os.cpu_count() or 1))
+
+
+def _hdr_fan(fn, items):
+    """Map ``fn`` over independent KB-scale metadata fetches
+    (reconstructions, safetensors headers) with one bounded pool —
+    serialized they put shards × RTT on the time_to_first_layer
+    critical path; the single definition keeps every fan-out site
+    (coop priorities, the landing's rec+header resolve) on the same
+    width and thread naming."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    with ThreadPoolExecutor(min(8, len(items)),
+                            thread_name_prefix="zest-hdr") as pool:
+        return list(pool.map(fn, items))
 
 
 def _is_complete(snapshot_dir: Path, entry) -> bool:
@@ -351,6 +394,23 @@ class _FilePipeline:
         with self._lock:
             if entry.path in self._futures:
                 return True
+        if not self.acquire_for(entry):
+            return False
+        with self._lock:
+            if entry.path in self._futures:  # raced with a plain submit
+                self.budget.release(entry.size)
+                return True
+            self._queue_prepared(entry, prepared)
+        return True
+
+    def acquire_for(self, entry) -> bool:
+        """Acquire ``entry.size`` budget bytes in the caller's thread —
+        the one decline/backpressure protocol every prepared lane uses
+        (submit_prepared, the streaming sink). Non-blocking with
+        ``async_handoff``: a full budget bumps ``declined``, records a
+        ``budget_decline`` event, and returns False (the shard then
+        materializes from the cache lane); without it, blocks (the
+        PR-1 backpressure contract)."""
         if self.async_handoff:
             if not self.budget.try_acquire(entry.size):
                 with self._lock:
@@ -360,20 +420,35 @@ class _FilePipeline:
                 return False
         else:
             self.budget.acquire(entry.size)
+        return True
+
+    def _queue_prepared(self, entry, prepared) -> None:
+        """Queue a prepared job whose budget bytes are already held.
+        Caller MUST hold ``self._lock``. A queued future cancelled by
+        join()/abort() never runs _run_prepared's finally — its
+        pre-acquired bytes must be released by the done-callback or
+        the budget leaks and acquire()-parked workers hang the
+        shutdown itself."""
+        fut = self._prepared_pool.submit(
+            self._run_prepared, entry, prepared)
+        fut.add_done_callback(
+            lambda f, n=entry.size:
+            self.budget.release(n) if f.cancelled() else None)
+        self._futures[entry.path] = fut
+
+    def submit_held(self, entry, prepared) -> bool:
+        """Queue a prepared job whose ``entry.size`` budget bytes the
+        caller ALREADY holds (the streaming sink acquires them at
+        slot-retain time, before any byte is kept). Dedup by path like
+        :meth:`submit_prepared`; on a duplicate the held bytes are
+        released here and ``False`` is returned — the caller must then
+        drop its retained payload. Release on completion/cancel follows
+        the submit_prepared contract unchanged."""
         with self._lock:
-            if entry.path in self._futures:  # raced with a plain submit
+            if entry.path in self._futures:
                 self.budget.release(entry.size)
-                return True
-            fut = self._prepared_pool.submit(
-                self._run_prepared, entry, prepared)
-            # A queued prepared future cancelled by join()/abort() never
-            # runs _run_prepared's finally — its pre-acquired bytes must
-            # be released here or the budget leaks and acquire()-parked
-            # workers hang the shutdown itself.
-            fut.add_done_callback(
-                lambda f, n=entry.size:
-                self.budget.release(n) if f.cancelled() else None)
-            self._futures[entry.path] = fut
+                return False
+            self._queue_prepared(entry, prepared)
         return True
 
     def _run_prepared(self, entry, prepared) -> None:
@@ -535,6 +610,108 @@ class _FilePipeline:
         return out
 
 
+def _tensors_tile(header, size: int) -> bool:
+    """True iff the header's tensor ranges tile the data section
+    exactly — the provability precondition the write-behind fast lane
+    (``_write_file_from_tensors``) requires. Checked up front by the
+    streaming sink so it never retains ring slots for a shard it would
+    decline at assembly time."""
+    spans = sorted(info.file_range(header.data_start)
+                   for info in header.tensors.values())
+    pos = header.data_start
+    for lo, hi in spans:
+        if lo != pos:
+            return False
+        pos = hi
+    return pos == size
+
+
+class _StreamFileSink:
+    """Write-behind consumer for one shard of the STREAMING landing
+    (ISSUE 8): keeps addref'd ring-slot references per tensor as they
+    decode (``offer`` — never blocks; the slot is ``detach``ed so the
+    retained bytes count against the file pipeline's ByteBudget, not
+    the landing's ring), then submits ONE prepared write assembling the
+    HF-cache file from those buffers — the decoded bytes are written
+    without a second decode, exactly like the shard-level write-behind
+    the non-streaming path keeps.
+
+    Bounded memory: the whole shard's ``entry.size`` is acquired from
+    the pipeline's ByteBudget at construction (non-blocking in async
+    mode, mirroring ``submit_prepared``); a full budget — or a shard
+    whose tensors don't provably tile its data section — makes the
+    sink INERT: every ``offer`` is a no-op, slots recycle into the
+    ring untouched, and the shard materializes through the existing
+    post-landing cache lane instead ("slot recycled first" in the
+    ISSUE's terms)."""
+
+    def __init__(self, pipeline: _FilePipeline, bridge, entry, rec,
+                 header, snapshot_dir: Path, clock: StageClock):
+        self.pipeline = pipeline
+        self.bridge = bridge
+        self.entry = entry
+        self.rec = rec
+        self.header = header
+        self.snapshot_dir = snapshot_dir
+        self.clock = clock
+        self.held: dict[str, tuple] = {}
+        self.active = False
+        if _is_complete(snapshot_dir, entry):
+            return  # resume: nothing to write
+        if not _tensors_tile(header, rec.total_bytes):
+            return
+        if not pipeline.acquire_for(entry):
+            return
+        self.active = True
+
+    def offer(self, name: str, info, arr, slot) -> None:
+        """Producer thread, right after tensor ``name`` decoded into
+        ``slot``. Retains the slot (addref + detach) so the buffer
+        survives the ring recycle until the file write drains it."""
+        if not self.active:
+            return
+        slot.addref()
+        slot.detach()
+        self.held[name] = (arr, slot)
+
+    def done_decoding(self) -> None:
+        """Producer thread, after the shard's last tensor (or on the
+        landing's error path — the retained budget/slots must be
+        surrendered either way). Hands the write job to the pipeline's
+        prepared pool; an incomplete retain set (producer error
+        mid-shard) assembles to None inside the worker and falls back
+        to the waterfall there."""
+        if not self.active:
+            return
+        self.active = False
+        host = {n: a for n, (a, _s) in self.held.items()}
+        slots = [s for _a, s in self.held.values()]
+        self.held = {}
+        pipeline, bridge, clock = self.pipeline, self.bridge, self.clock
+        rec, header, snapshot_dir = self.rec, self.header, self.snapshot_dir
+
+        def write(entry, _host=host):
+            try:
+                dest = snapshot_dir / entry.path
+                if _is_complete(snapshot_dir, entry):
+                    return "skipped"
+                tmp = _write_file_from_tensors(bridge, rec, header,
+                                               _host, dest)
+                if tmp is None:
+                    return None  # decline → worker runs the waterfall
+                pipeline.defer_commit(tmp, dest)
+                pipeline.note_lane("tensors", entry.size)
+                clock.note_bytes("files", entry.size)
+                return "downloaded"
+            finally:
+                for s in slots:
+                    s.release()
+
+        if not pipeline.submit_held(self.entry, write):
+            for s in slots:  # raced with a plain submit: drop retains
+                s.release()
+
+
 def pull_model(
     cfg: Config,
     repo_id: str,
@@ -624,6 +801,21 @@ def _pull_model(
         from zest_tpu.models.loader import resolve_dtype
 
         land_dtype = resolve_dtype(cfg.land_dtype)
+        # First-touch backend init (jax.devices()) costs ~80 ms on CPU
+        # and far more on a real TPU runtime, and the landing path hits
+        # it strictly AFTER the metadata round trips. Warm it on a
+        # daemon thread so it overlaps the resolve/metadata network
+        # I/O instead of extending time_to_first_layer serially.
+        def _warm_backend():
+            try:
+                import jax
+
+                jax.devices()
+            except Exception:  # noqa: BLE001 - landing reports its own error
+                pass
+
+        threading.Thread(target=_warm_backend, daemon=True,
+                         name="zest-jax-warm").start()
     hub = HubClient(cfg)
     clock = StageClock()
 
@@ -688,6 +880,38 @@ def _pull_model(
         async_handoff=bool(getattr(cfg, "files_async", True)))
 
     try:
+        # config.json feeds family dispatch twice (pod pre-pass, landing
+        # rules) before the file loop would fetch it — prefetch it on
+        # the shared pool so neither consumer pays its round trip
+        # serially on the landing's critical path.
+        early_cfg = None
+        if device == "tpu":
+            early_cfg = term_pool.submit(
+                _early_config, hub, repo_id, revision, files,
+                snapshot_dir)
+
+            # Likewise the per-shard safetensors headers: the landing
+            # blocks on all of them before its first fetch, and none
+            # need jax — resolving them here rides under the pod
+            # round's backend-init wall, so the landing's own header
+            # pass becomes a warm cache read. Best-effort: a miss just
+            # leaves the landing to fetch them itself.
+            def _prefetch_headers():
+                try:
+                    from zest_tpu.transfer.pod import fetch_file_header
+
+                    ensure_auth()
+                    _hdr_fan(
+                        lambda e: fetch_file_header(
+                            bridge,
+                            bridge.get_reconstruction(e.xet_hash)),
+                        [e for e in files
+                         if e.is_xet
+                         and e.path.endswith(".safetensors")])
+                except Exception:  # noqa: BLE001 - advisory warmup
+                    pass
+
+            term_pool.submit(_prefetch_headers)
         # Pod pre-pass (BASELINE config #3): one collective round fills the
         # cache so the per-file loop below hits tier 1 for planned bytes.
         # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
@@ -719,10 +943,39 @@ def _pull_model(
                 # the federated/pod stages (and the landing) run
                 # peer-fed. Failure degrades to the full waterfall.
                 if recs and coop_cfg:
+                    # Streaming interop (ISSUE 8): hand the round the
+                    # deterministic layer-priority key so its fetch and
+                    # exchange phases ship embedding + layer-0 bytes
+                    # first — the ownership plan (and its fingerprint)
+                    # is untouched, only iteration order is. Header
+                    # fetches are KB-scale and idempotent (the landing
+                    # refetches them from cache moments later).
+                    prio = None
+                    if (device == "tpu"
+                            and getattr(cfg, "land_stream", True)):
+                        try:
+                            from zest_tpu.models.direct import (
+                                unit_layer_priorities,
+                            )
+                            from zest_tpu.transfer.pod import (
+                                fetch_file_header,
+                            )
+
+                            shard_recs = [
+                                r for e, r in zip(pending, recs)
+                                if e.path.endswith(".safetensors")
+                            ]
+                            headers = _hdr_fan(
+                                lambda r: fetch_file_header(bridge, r),
+                                shard_recs)
+                            prio = unit_layer_priorities(
+                                list(zip(shard_recs, headers)))
+                        except Exception:  # noqa: BLE001 - order is advisory
+                            prio = None
                     try:
                         coop_stats = _coop_stage(
                             bridge, recs, cfg, coop_cfg, repo_id,
-                            commit_sha, log)
+                            commit_sha, log, priorities=prio)
                     except Exception as exc:  # noqa: BLE001
                         log(f"cooperative pull unavailable ({exc}); "
                             "continuing with the per-host waterfall",
@@ -746,7 +999,8 @@ def _pull_model(
                     try:
                         pod_stats = _pod_stage(
                             bridge, pending, recs, hub, repo_id, revision,
-                            files, snapshot_dir, log)
+                            files, snapshot_dir, log,
+                            early_cfg=early_cfg)
                     except Exception as exc:  # noqa: BLE001
                         log(f"pod round unavailable ({exc}); "
                             "continuing with the per-host waterfall",
@@ -760,6 +1014,7 @@ def _pull_model(
         hbm_params = hbm_stats = None
         mesh = None
         time_to_hbm = hbm_done_at = None
+        time_to_first_layer = None
         if device == "tpu":
             if cfg.mesh.mesh_axes:
                 from zest_tpu.parallel.mesh import mesh_from_config
@@ -779,12 +1034,19 @@ def _pull_model(
                 bridge, hub, repo_id, revision, files, snapshot_dir, mesh,
                 land_dtype, log, clock,
                 file_pipeline=file_pipeline, ensure_auth=ensure_auth,
+                early_cfg=early_cfg,
             )
             authenticated = authenticated or bridge.cas is not None
             if hbm_stats is not None:
                 hbm_done_at = time.monotonic()
                 time_to_hbm = hbm_done_at - t0
                 clock.note_bytes("hbm_commit", hbm_stats.get("bytes", 0))
+                fl_at = hbm_stats.pop("first_layer_at", None)
+                if fl_at is not None:
+                    time_to_first_layer = fl_at - t0
+                    # Anchored at the pull's own t0 so the stage view
+                    # and the headline stat agree by construction.
+                    clock.note_interval("first_layer", t0, fl_at)
 
         # Tail pass: everything not already riding the pipeline (the whole
         # repo, for a plain pull) — submit is path-deduped, then the join is
@@ -820,12 +1082,32 @@ def _pull_model(
     }
     if time_to_hbm is not None:
         stats["time_to_hbm_s"] = round(time_to_hbm, 3)
+        _M_LAST_TTH.set(time_to_hbm)
         # Background-lane evidence: files-stage wall that ran AFTER the
         # params were resident — materialization work the restructure
         # moved off the time-to-HBM span (CI smoke asserts it's > 0 and
         # that time_to_hbm_s < elapsed_s, schema-level).
         stats["files_after_hbm_s"] = round(
             clock.coverage_after("files", hbm_done_at), 4)
+    if time_to_first_layer is not None:
+        # Headline next to time_to_hbm_s (ISSUE 8): the instant the
+        # first-token-capable set (embedding + layer 0) was resident —
+        # what a serving mesh needs to start generating while layer N
+        # is still on the wire. Only present when the streaming landing
+        # ran (knob-off pulls keep the pre-streaming stats schema).
+        stats["time_to_first_layer_s"] = round(time_to_first_layer, 3)
+        _M_TTFL_SECONDS.observe(time_to_first_layer)
+        _M_LAST_TTFL.set(time_to_first_layer)
+        _M_LAST_RING_STALLS.set(float(
+            ((hbm_stats or {}).get("ring") or {}).get("stalls", 0)))
+    elif time_to_hbm is not None:
+        # A landing ran but did NOT stream: zero the first-layer gauge
+        # so the status/dashboard "last pull" block never pairs a STALE
+        # first_layer_s from an earlier streamed pull with THIS pull's
+        # hbm wall (the renderers treat <= 0 as absent) — and the stall
+        # gauge with it, for the same staleness reason.
+        _M_LAST_TTFL.set(0.0)
+        _M_LAST_RING_STALLS.set(0.0)
     if coop_stats is not None:
         stats["coop"] = coop_stats
         # Headline stat (README schema note): the fraction of this
@@ -900,6 +1182,7 @@ def _try_direct_stage(
     clock: StageClock | None = None,
     file_pipeline: _FilePipeline | None = None,
     ensure_auth=None,
+    early_cfg=None,
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
@@ -928,12 +1211,14 @@ def _try_direct_stage(
                 ensure_auth()
             elif bridge.cas is None:
                 bridge.authenticate(repo_id, revision, hub=hub)
-            recs_with_headers = []
-            for e in st:
+            # One reconstruction + header round trip per shard; every
+            # landing stage waits on ALL of them — _hdr_fan keeps them
+            # off the serial critical path.
+            def _rec_with_header(e):
                 rec = bridge.get_reconstruction(e.xet_hash)
-                recs_with_headers.append(
-                    (rec, fetch_file_header(bridge, rec))
-                )
+                return rec, fetch_file_header(bridge, rec)
+
+            recs_with_headers = _hdr_fan(_rec_with_header, st)
             # Resolve every OTHER xet file's reconstruction too (KB-scale
             # metadata, memoized for the file loop moments later): the
             # full-vs-partial cache-key evidence must see ALL references
@@ -960,52 +1245,124 @@ def _try_direct_stage(
         # pipelined per shard: shard 0's fetch is the visible "fetch"
         # stage, every later shard's network time hides under the
         # previous shard's decode+commit inside "hbm_commit".
-        on_host_ready = None
-        if file_pipeline is not None:
-            # Write-behind: the moment shard i's host tensors are
-            # decoded, hand them to the file pipeline — the HF-cache
-            # file is assembled from the decoded bytes (no second
-            # decode) while the same shard's commit and the next
-            # shard's decode proceed. The handoff is non-blocking by
-            # default (ZEST_FILES_ASYNC): a full byte budget declines —
-            # the shard then materializes from the cache after the
-            # landing — instead of parking the decode thread and
-            # dragging file writes back onto the time-to-HBM span.
-            def on_host_ready(i, host, _st=st, _rwh=recs_with_headers):
-                rec, header = _rwh[i]
-                entry = _st[i]
+        cfg = getattr(bridge, "cfg", None)
+        stream_on = (bool(getattr(cfg, "land_stream", True))
+                     and bool(getattr(cfg, "land_decode_ahead", 1)))
+        rules = _landing_rules(hub, repo_id, revision, files, snapshot_dir,
+                               early_cfg=early_cfg)
+        recs_only = [r for r, _h in recs_with_headers]
 
-                def write(entry, _rec=rec, _h=header, _host=host):
-                    dest = snapshot_dir / entry.path
-                    if _is_complete(snapshot_dir, entry):
-                        return "skipped"
-                    tmp = _write_file_from_tensors(
-                        bridge, _rec, _h, _host, dest)
-                    if tmp is None:
-                        return None  # decline → worker runs the waterfall
-                    # Fully written under a temp name; fsync + rename
-                    # happen at the pull-exit durability barrier.
-                    file_pipeline.defer_commit(tmp, dest)
-                    file_pipeline.note_lane("tensors", entry.size)
-                    clock.note_bytes("files", entry.size)
-                    return "downloaded"
-
-                file_pipeline.submit_prepared(entry, write)
-
-        pipeline = _PipelinedWarm(bridge, [r for r, _h in recs_with_headers],
-                                  evidence_recs=evidence_recs)
-        with clock("fetch"):
-            pipeline.ensure(0)
-        with clock("hbm_commit"):
-            params, hbm_stats = stage_cached_to_hbm(
-                bridge, recs_with_headers, mesh=mesh,
-                rules=_landing_rules(hub, repo_id, revision, files,
-                                     snapshot_dir),
-                dtype=dtype,
-                prefetch_next=pipeline.ensure,
-                on_host_ready=on_host_ready,
-                clock=clock,
+        if stream_on:
+            # ── Streaming landing (ISSUE 8) ──
+            # Tensor-granularity flow through the loader's HostRing:
+            # the warm fetch runs layer-ordered with per-unit
+            # completion events, the tensor gate lets decode chase the
+            # fetch inside a shard, and the write-behind sink keeps
+            # the decoded ring slots so the HF-cache file assembles
+            # with zero re-decode.
+            from zest_tpu.models.direct import (
+                tensor_unit_keys, unit_layer_priorities,
             )
+
+            priorities = unit_layer_priorities(recs_with_headers)
+            required = [tensor_unit_keys(rec, header)
+                        for rec, header in recs_with_headers]
+            pipeline = _PipelinedWarm(bridge, recs_only,
+                                      evidence_recs=evidence_recs,
+                                      unit_priorities=priorities,
+                                      streaming=True, clock=clock)
+
+            def tensor_gate(i, name, cancel=None, _req=required,
+                            _p=pipeline):
+                keys = _req[i].get(name)
+                if keys:
+                    _p.wait_units(i, keys, cancel=cancel)
+
+            first_layer_at: list[float] = []
+
+            def on_first_layer():
+                first_layer_at.append(time.monotonic())
+
+            stream_file_sink = None
+            if file_pipeline is not None:
+                def stream_file_sink(i, _reader, _st=st,
+                                     _rwh=recs_with_headers):
+                    rec, header = _rwh[i]
+                    return _StreamFileSink(file_pipeline, bridge,
+                                           _st[i], rec, header,
+                                           snapshot_dir, clock)
+
+            clock.ensure("fetch")  # warm threads clock it; key must exist
+            pipeline.poke(0)
+            with clock("hbm_commit"):
+                params, hbm_stats = stage_cached_to_hbm(
+                    bridge, recs_with_headers, mesh=mesh, rules=rules,
+                    dtype=dtype,
+                    prefetch_next=pipeline.poke,
+                    clock=clock,
+                    stream=True,
+                    tensor_gate=tensor_gate,
+                    on_first_layer=on_first_layer,
+                    stream_file_sink=stream_file_sink,
+                )
+            if first_layer_at:
+                # Monotonic instant the first-token-capable set became
+                # resident; _pull_model anchors it to the pull's t0.
+                hbm_stats["first_layer_at"] = first_layer_at[0]
+        else:
+            on_host_ready = None
+            if file_pipeline is not None:
+                # Write-behind: the moment shard i's host tensors are
+                # decoded, hand them to the file pipeline — the HF-cache
+                # file is assembled from the decoded bytes (no second
+                # decode) while the same shard's commit and the next
+                # shard's decode proceed. The handoff is non-blocking by
+                # default (ZEST_FILES_ASYNC): a full byte budget declines
+                # — the shard then materializes from the cache after the
+                # landing — instead of parking the decode thread and
+                # dragging file writes back onto the time-to-HBM span.
+                def on_host_ready(i, host, _st=st, _rwh=recs_with_headers):
+                    rec, header = _rwh[i]
+                    entry = _st[i]
+
+                    def write(entry, _rec=rec, _h=header, _host=host):
+                        dest = snapshot_dir / entry.path
+                        if _is_complete(snapshot_dir, entry):
+                            return "skipped"
+                        tmp = _write_file_from_tensors(
+                            bridge, _rec, _h, _host, dest)
+                        if tmp is None:
+                            return None  # decline → waterfall
+                        # Fully written under a temp name; fsync + rename
+                        # happen at the pull-exit durability barrier.
+                        file_pipeline.defer_commit(tmp, dest)
+                        file_pipeline.note_lane("tensors", entry.size)
+                        clock.note_bytes("files", entry.size)
+                        return "downloaded"
+
+                    file_pipeline.submit_prepared(entry, write)
+
+            pipeline = _PipelinedWarm(bridge, recs_only,
+                                      evidence_recs=evidence_recs)
+            with clock("fetch"):
+                pipeline.ensure(0)
+            with clock("hbm_commit"):
+                params, hbm_stats = stage_cached_to_hbm(
+                    bridge, recs_with_headers, mesh=mesh, rules=rules,
+                    dtype=dtype,
+                    prefetch_next=pipeline.ensure,
+                    on_host_ready=on_host_ready,
+                    clock=clock,
+                    stream=False,
+                )
+        # Join the warm threads before reading their stats: the
+        # streaming tensor gate releases the moment a unit resolves —
+        # the last shard's warm thread may still be in its retry pass /
+        # stats append when the landing returns, and an unjoined thread
+        # could keep writing cache entries after the pull itself
+        # returns. (The non-streaming path joined every shard in
+        # ensure(); this makes both paths uniform.)
+        pipeline.drain()
         warm = pipeline.summary()
         if warm["failed"] or warm.get("prefetch_errors"):
             log(f"warm fetch: {warm['failed']} unit(s) + "
@@ -1035,7 +1392,9 @@ class _PipelinedWarm:
     missing units — and reported in :meth:`summary`.
     """
 
-    def __init__(self, bridge, recs, evidence_recs=None):
+    def __init__(self, bridge, recs, evidence_recs=None,
+                 unit_priorities=None, streaming: bool = False,
+                 clock: StageClock | None = None):
         import threading
 
         from zest_tpu.transfer.federated import _entries_by_hash
@@ -1054,14 +1413,56 @@ class _PipelinedWarm:
         self.threads: dict[int, object] = {}
         self.stats: list[dict] = []
         self.cancelled = False
+        # Streaming mode (ISSUE 8): the warm publishes per-unit
+        # completion so the landing's tensor gate can decode a tensor
+        # while the REST of its shard is still on the wire, and units
+        # submit in layer-priority order (models.direct.
+        # unit_layer_priorities) so embedding + layer 0 bytes arrive
+        # first. Fetch wall is clocked per shard here — with the
+        # landing no longer blocking on a whole-shard warm there is no
+        # foreground ensure() left to attribute "fetch" to.
+        self.streaming = streaming
+        self.unit_priorities = unit_priorities
+        self.clock = clock
+        self._cv = threading.Condition()
+        self._units_done: set[tuple[str, int]] = set()
+        self._shards_done: set[int] = set()
 
     def _spawn(self, i: int) -> None:
-        if (not self.cancelled and 0 <= i < len(self.recs)
-                and i not in self.threads):
+        # Under the condition's lock: streaming mode calls this from
+        # the decode thread (poke) AND each warm thread's chained
+        # finally concurrently — an unlocked check-then-insert would
+        # let two threads fetch the same shard (racing cache writes)
+        # with one of them lost to drain()'s join.
+        with self._cv:
+            if (self.cancelled or not 0 <= i < len(self.recs)
+                    or i in self.threads):
+                return
             t = self._threading.Thread(target=self._run, args=(i,),
                                        daemon=True)
             self.threads[i] = t
             t.start()
+
+    def _shard_units(self, i: int):
+        """Shard ``i``'s fetch units in landing-priority order (file
+        order when no priorities were given). Unknown units sort last."""
+        from zest_tpu.models.direct import unit_priority_sort_key
+        from zest_tpu.parallel.plan import collect_units
+
+        units = [(key[0], fi) for key, fi in collect_units([self.recs[i]])]
+        if self.unit_priorities:
+            units.sort(key=unit_priority_sort_key(self.unit_priorities))
+        return units
+
+    def _mark_unit(self, key) -> None:
+        with self._cv:
+            self._units_done.add(key)
+            self._cv.notify_all()
+
+    def _mark_shard(self, i: int) -> None:
+        with self._cv:
+            self._shards_done.add(i)
+            self._cv.notify_all()
 
     def _run(self, i: int) -> None:
         from zest_tpu.transfer.federated import warm_units_parallel
@@ -1070,21 +1471,55 @@ class _PipelinedWarm:
             # entries_map = ALL shards: the full-vs-partial cache-key
             # decision must see cross-shard dedup, or a xorb shared
             # between shards gets a truncated blob under its full key.
-            self.stats.append(warm_units_parallel(
-                self.bridge, [self.recs[i]], entries_map=self.entries_map))
+            if self.streaming:
+                import contextlib as _ctx
+
+                with (self.clock("fetch") if self.clock is not None
+                      else _ctx.nullcontext()):
+                    self.stats.append(warm_units_parallel(
+                        self.bridge, [self.recs[i]],
+                        entries_map=self.entries_map,
+                        units=self._shard_units(i),
+                        on_unit=self._mark_unit))
+            else:
+                self.stats.append(warm_units_parallel(
+                    self.bridge, [self.recs[i]],
+                    entries_map=self.entries_map))
         except Exception:  # noqa: BLE001 - landing self-serves misses
             self.stats.append({"units": 0, "bytes": 0, "failed": 0,
                                "prefetch_error": True})
+        finally:
+            # Shard-done ALWAYS fires (success, failure, cancel): gates
+            # blocked on this shard release and the landing's per-term
+            # waterfall self-serves whatever the warm didn't cache.
+            self._mark_shard(i)
+            if self.streaming:
+                # Chained lookahead: the moment shard i's fetch drains,
+                # shard i+1's starts — still at most ONE shard fetching
+                # (the dedup race rule below), but now fully decoupled
+                # from the landing's decode position.
+                self._spawn(i + 1)
 
     def drain(self) -> None:
         """Stop spawning and wait out any in-flight prefetch (at most
-        one shard). The landing's failure path calls this before the
-        disk fallback runs — an orphaned prefetch racing the fallback's
-        waterfall would double-fetch units and could still be writing
-        cache entries after the pull returns."""
-        self.cancelled = True
-        for t in self.threads.values():
+        one shard). Both landing exits call this — the failure path
+        before the disk fallback runs (an orphaned prefetch racing the
+        fallback's waterfall would double-fetch units) and the success
+        path before summary() (an unjoined warm thread could still be
+        appending stats or writing cache entries after the pull
+        returns). Idempotent."""
+        # cancelled is set under the same lock _spawn checks it under,
+        # so the snapshot below is complete: no thread can register
+        # after it (a chained spawn racing this used to escape the
+        # join and keep writing cache entries post-return).
+        with self._cv:
+            self.cancelled = True
+            threads = list(self.threads.values())
+        for t in threads:
             t.join()
+        with self._cv:  # release any gate still parked on us
+            self._shards_done.update(range(len(self.recs)))
+            self._cv.notify_all()
 
     def ensure(self, i: int) -> None:
         """Block until shard ``i`` is warmed; then start shard ``i+1``.
@@ -1098,6 +1533,29 @@ class _PipelinedWarm:
         if t is not None:
             t.join()
         self._spawn(i + 1)
+
+    def poke(self, i: int) -> None:
+        """Non-blocking ensure — the streaming landing's
+        ``prefetch_next``: start shard ``i``'s warm (no-op if running or
+        done) and return; the tensor gate below is what actually waits,
+        per tensor, not per shard."""
+        self._spawn(i)
+
+    def wait_units(self, i: int, keys: frozenset,
+                   cancel=None) -> None:
+        """Block until every unit in ``keys`` is resolved OR shard
+        ``i``'s whole warm finished (covers failed/unknown units — the
+        landing's waterfall self-serves those) OR ``cancel`` (the
+        landing's abort event) is set — without it, a consumer error
+        couldn't unblock a producer parked here until the in-flight
+        shard fetch resolved on its own, stalling the disk fallback by
+        the full fetch duration. The timeout re-check guards against a
+        lost wakeup ever deadlocking the landing."""
+        with self._cv:
+            while not (keys <= self._units_done
+                       or i in self._shards_done
+                       or (cancel is not None and cancel.is_set())):
+                self._cv.wait(0.05)
 
     # The per-shard counters summary() may sum. warm_units_parallel
     # counters are ADDITIVE by contract; anything it reports outside
@@ -1162,7 +1620,8 @@ def _resolve_coop(cfg, coop, coop_hosts, coop_index, coop_addrs, log):
     return i, n, addrs
 
 
-def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log):
+def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log,
+                priorities=None):
     """Run the cooperative round, discovering peer DCN endpoints over
     the jax.distributed KV store when no explicit addr map was given
     (the zero-config multi-host TPU job path). The DCN listener binds
@@ -1228,6 +1687,7 @@ def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log):
                       server=server,
                       budget_bytes=cfg.coop_inflight_bytes,
                       trace_id=trace_id,
+                      priorities=priorities,
                       log=lambda m: log(m))
 
 
@@ -1259,7 +1719,7 @@ def _early_config(hub, repo_id, revision, files, snapshot_dir) -> dict | None:
 
 
 def _pod_stage(bridge, pending, recs, hub, repo_id, revision, files,
-               snapshot_dir, log):
+               snapshot_dir, log, early_cfg=None):
     """Collective byte distribution, family-dispatched.
 
     Expert-sharded families (models.registry.is_expert_sharded — Mixtral)
@@ -1287,7 +1747,9 @@ def _pod_stage(bridge, pending, recs, hub, repo_id, revision, files,
 
     import jax
 
-    cfg_json = _early_config(hub, repo_id, revision, files, snapshot_dir)
+    cfg_json = (early_cfg.result() if early_cfg is not None
+                else _early_config(hub, repo_id, revision, files,
+                                   snapshot_dir))
     n_experts = int((cfg_json or {}).get("num_local_experts") or 0)
     mesh = pod_mesh()
     prepped = None
@@ -1357,13 +1819,16 @@ def _expert_stage(bridge, prepped, mesh, log):
     return stats
 
 
-def _landing_rules(hub, repo_id, revision, files, snapshot_dir):
+def _landing_rules(hub, repo_id, revision, files, snapshot_dir,
+                   early_cfg=None):
     """Family shard rules for direct landing (models.registry dispatch).
     Returns None on any miss: the loader's infer_spec fallback still
     lands the bytes balanced."""
     from zest_tpu.models.registry import shard_rules_for_model_type
 
-    cfg_json = _early_config(hub, repo_id, revision, files, snapshot_dir)
+    cfg_json = (early_cfg.result() if early_cfg is not None
+                else _early_config(hub, repo_id, revision, files,
+                                   snapshot_dir))
     return shard_rules_for_model_type((cfg_json or {}).get("model_type"))
 
 
