@@ -536,6 +536,24 @@ def bench_pull_gb() -> dict:
                          budget_s=budget if budget > 0 else None)
 
 
+def bench_delta_pull() -> dict:
+    """Delta pull vs cold pull (ISSUE 10): cold rev-A ``--device`` pull,
+    then an in-place hot-swap delta pull of the seeded 1%-changed
+    revision B. Headlines: ``delta_bytes_ratio`` (network-fetched
+    fraction, ≤3% gate), ``time_to_swap_s`` vs the cold median (≤0.3×
+    gate), ``digest_identical`` vs a cold pull of B. Shares pull_gb's
+    size/scale knobs; its own run count defaults lower — each run is
+    two full pulls plus a one-time digest-oracle third."""
+    from zest_tpu.bench_scale import bench_delta_pull as run
+
+    gb = float(os.environ.get("ZEST_BENCH_GB", "2.0"))
+    runs = int(os.environ.get("ZEST_BENCH_DELTA_RUNS", "2"))
+    scale = int(os.environ.get("ZEST_BENCH_SCALE", "2"))
+    budget = float(os.environ.get("ZEST_BENCH_BUDGET_S", "1200"))
+    return run(gb=gb, runs=runs, scale=scale,
+               budget_s=budget if budget > 0 else None)
+
+
 def bench_decode(steps: int = 64) -> dict:
     """KV-cached decode throughput (serving path): a tiny random-init
     Llama decodes ``steps`` tokens inside one jitted scan; tok/s from the
@@ -822,6 +840,9 @@ def child_main() -> None:
         ("http_warm_device", bench_http_warm_device),
         ("ici_all_gather", bench_ici_all_gather),
         ("pull_gb", bench_pull_gb),
+        # After pull_gb (same disk-heavy class): two pulls + the
+        # one-time digest oracle per run.
+        ("delta_pull", bench_delta_pull),
     ]
     skip = {s for s in os.environ.get("ZEST_BENCH_SKIP", "").split(",") if s}
     die_after = os.environ.get("ZEST_BENCH_DIE_AFTER")

@@ -1,0 +1,144 @@
+"""CI smoke for delta pulls + in-place hot-swap (ISSUE 10).
+
+A 64 MiB synthetic checkpoint (revision A) is pulled cold with
+``--device``; a seeded 1%-changed revision B is then delta-pulled into
+the same cache with the resident rev-A tree hot-swapped in place. The
+gates:
+
+- **changed-bytes-only fetch**: the delta pull's network bytes
+  (FetchStats CDN tier — no peers in this harness) are ≤ 3% of the
+  checkpoint total;
+- **digest identity**: ``params_digest`` of the swapped tree equals a
+  cold pull of revision B in a fresh cache — the delta moved buffers
+  and skipped work, never changed bytes;
+- **schema**: the delta pull reports ``stats["delta"]`` (with
+  ``fetched_ratio``) and ``time_to_swap_s``; the hbm block carries the
+  reused/landed split; the base param dict is fully consumed;
+- **knob-off**: a ``ZEST_DELTA=0`` pull of B carries NO delta keys
+  (stats schema restored bit-for-bit) and still lands correct bytes.
+
+Exit 0 on success; any broken invariant prints the offending stats
+block and fails the step.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+
+def main() -> int:
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.models.loader import params_digest
+    from zest_tpu.transfer.pull import pull_model
+
+    files_a = llama_checkpoint_files(0.064, shard_bytes=16 * 1024 * 1024,
+                                     scale=8)
+    files_b = llama_checkpoint_files(0.064, shard_bytes=16 * 1024 * 1024,
+                                     scale=8, mutate_fraction=0.01)
+    total = sum(len(b) for b in files_b.values())
+    repo = FixtureRepo("smoke/delta", files_a, chunks_per_xorb=64)
+    sha_a = repo.commit_sha
+    sha_b = repo.add_revision(files_b)
+
+    quiet = {"log": lambda *a, **k: None}
+
+    def fail(msg: str, stats: dict | None = None) -> int:
+        print(f"DELTA SMOKE FAILED: {msg}", file=sys.stderr)
+        if stats:
+            print(json.dumps({k: stats.get(k) for k in (
+                "delta", "time_to_swap_s", "time_to_hbm_s", "fetch",
+                "hbm")}, indent=2, default=str), file=sys.stderr)
+        return 1
+
+    with FixtureHub(repo) as hub:
+        with tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+            cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                         hf_token="hf_test", endpoint=hub.url)
+            res_a = pull_model(cfg, "smoke/delta", revision=sha_a,
+                               device="tpu", no_p2p=True, **quiet)
+            base = res_a.params
+            res_b = pull_model(cfg, "smoke/delta", revision=sha_b,
+                               device="tpu", no_p2p=True,
+                               base_params=base, base_revision=sha_a,
+                               **quiet)
+            stats = res_b.stats
+            d = stats.get("delta")
+            if not d:
+                return fail("no stats['delta'] block on the delta pull",
+                            stats)
+            fetched = stats["fetch"]["bytes"]["cdn"]
+            if fetched > 0.03 * total:
+                return fail(
+                    f"delta pull fetched {fetched} bytes "
+                    f"({fetched / total:.2%} of {total}) — over the "
+                    "3% gate for a 1%-changed revision", stats)
+            if stats.get("time_to_swap_s") is None:
+                return fail("no time_to_swap_s on the hot-swap pull",
+                            stats)
+            swap = (stats.get("hbm") or {}).get("swap") or {}
+            if not swap.get("reused_tensors"):
+                return fail("per-tensor short-circuit reused nothing",
+                            stats)
+            if base:
+                return fail(f"base params not consumed ({len(base)} "
+                            "left)", stats)
+            dig_swap = params_digest(res_b.params)
+            res_a.params = None
+            res_b.params = None
+
+        # Digest oracle: cold pull of B in a fresh cache.
+        with tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+            cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                         hf_token="hf_test", endpoint=hub.url)
+            res_cold = pull_model(cfg, "smoke/delta", revision=sha_b,
+                                  device="tpu", no_p2p=True, **quiet)
+            dig_cold = params_digest(res_cold.params)
+            cold_stats = res_cold.stats
+            res_cold.params = None
+            if "delta" in cold_stats:
+                # Fresh cache: no rev-A evidence exists, so no plan —
+                # and no base was passed, so no degraded event either.
+                return fail("cold pull in a fresh cache grew a delta "
+                            "block", cold_stats)
+        if dig_swap != dig_cold:
+            return fail(f"digests differ: swapped {dig_swap} vs cold "
+                        f"{dig_cold}")
+
+        # Knob-off: schema restored bit-for-bit.
+        with tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+            cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                         hf_token="hf_test", endpoint=hub.url,
+                         delta_pull=False)
+            pull_model(cfg, "smoke/delta", revision=sha_a,
+                       device="tpu", no_p2p=True, **quiet).params = None
+            res_off = pull_model(cfg, "smoke/delta", revision=sha_b,
+                                 device="tpu", no_p2p=True, **quiet)
+            off = res_off.stats
+            res_off.params = None
+            for key in ("delta", "time_to_swap_s"):
+                if key in off:
+                    return fail(f"knob-off pull leaked {key!r}", off)
+            if (rootp / "zest" / "manifests").exists():
+                return fail("knob-off pull wrote manifests")
+
+    print("delta smoke OK: "
+          f"fetched {fetched} of {total} bytes ({fetched / total:.2%}), "
+          f"swap {stats['time_to_swap_s']}s vs cold "
+          f"{cold_stats['time_to_hbm_s']}s, "
+          f"{swap['reused_tensors']} tensors reused, digest "
+          f"{dig_swap[:16]} identical")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

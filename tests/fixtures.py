@@ -50,6 +50,14 @@ class FixtureRepo:
 
     ``chunks_per_xorb`` forces files to split across several xorbs so tests
     exercise multi-term reconstruction and cross-xorb fetch planning.
+
+    :meth:`add_revision` adds a second (third, ...) revision whose files
+    chunk-dedup against every xorb the repo already holds — the real
+    Xet upload semantics: unchanged chunks are *referenced* (terms
+    pointing at existing xorbs' chunk ranges), only new chunks enter
+    new xorbs. That is what makes revision-to-revision deltas
+    structurally cheap at the CAS layer, and what the delta-pull tests
+    measure against.
     """
 
     def __init__(
@@ -61,54 +69,143 @@ class FixtureRepo:
     ):
         self.repo_id = repo_id
         self.commit_sha = commit_sha
+        self.chunks_per_xorb = chunks_per_xorb
         self.files: dict[str, _FileFixture] = {}
         self.xorbs: dict[str, _XorbFixture] = {}
         self.reconstructions: dict[str, recon.Reconstruction] = {}
+        # chunk hash -> (xorb_hex, chunk_index, length): the dedup
+        # index add_revision consults (first occurrence wins — any
+        # occurrence serves identical bytes, by content addressing).
+        self._chunk_index: dict[bytes, tuple[str, int, int]] = {}
         for path, data in files.items():
             if path.endswith(_XET_SUFFIXES):
-                self._add_xet_file(path, data, chunks_per_xorb)
+                # dedup=False: the base revision packs every chunk into
+                # its own xorbs exactly as it always did (fixture
+                # geometry is pinned by existing tests); only LATER
+                # revisions reference across.
+                self._add_xet_file(path, data, chunks_per_xorb,
+                                   self.files, dedup=False)
             else:
                 self.files[path] = _FileFixture(path, data)
+        # Revision order matters: "main" (and any unknown ref) resolves
+        # to the LATEST revision, like the real hub.
+        self.revisions: dict[str, dict[str, _FileFixture]] = {
+            commit_sha: self.files}
+        self._rev_order: list[str] = [commit_sha]
 
-    def _add_xet_file(self, path: str, data: bytes, chunks_per_xorb: int) -> None:
-        pieces = [piece for _, piece in chunking.chunk_stream(data)]
+    @property
+    def latest_sha(self) -> str:
+        return self._rev_order[-1]
+
+    def files_for(self, revision: str | None) -> dict[str, _FileFixture]:
+        """The file set a revision spec resolves to: an exact sha wins,
+        anything else ("main", None, a branch name) is the latest."""
+        if revision in self.revisions:
+            return self.revisions[revision]
+        return self.revisions[self.latest_sha]
+
+    def sha_for(self, revision: str | None) -> str:
+        return revision if revision in self.revisions else self.latest_sha
+
+    def add_revision(self, files: dict[str, bytes],
+                     commit_sha: str | None = None) -> str:
+        """Commit a new revision, chunk-deduped against the existing
+        xorb set; returns its sha. ``self.files`` moves to the new
+        revision (it is now what "main" resolves to)."""
+        if commit_sha is None:
+            commit_sha = hashing.blake3_hash(
+                (self.latest_sha + str(len(self._rev_order))).encode()
+            ).hex()[:40]
+        fileset: dict[str, _FileFixture] = {}
+        for path, data in files.items():
+            if path.endswith(_XET_SUFFIXES):
+                self._add_xet_file(path, data, self.chunks_per_xorb,
+                                   fileset, dedup=True)
+            else:
+                fileset[path] = _FileFixture(path, data)
+        self.revisions[commit_sha] = fileset
+        self._rev_order.append(commit_sha)
+        self.files = fileset
+        return commit_sha
+
+    def _register_xorb(self, builder: XorbBuilder) -> str:
+        xh_hex = hashing.hash_to_hex(builder.xorb_hash())
+        if xh_hex not in self.xorbs:
+            self.xorbs[xh_hex] = _XorbFixture(
+                xh_hex, builder.serialize(), builder.frame_offsets(),
+                builder.serialize_full())
+            for idx, (ch, clen) in enumerate(builder.chunk_hashes()):
+                self._chunk_index.setdefault(ch, (xh_hex, idx, clen))
+        return xh_hex
+
+    def _add_xet_file(self, path: str, data: bytes,
+                      chunks_per_xorb: int, fileset: dict,
+                      dedup: bool = False) -> None:
+        pieces = [(hashing.chunk_hash(piece), piece)
+                  for _, piece in chunking.chunk_stream(data)]
         limit = chunks_per_xorb or len(pieces) or 1
         terms: list[recon.Term] = []
         all_chunk_hashes: list[tuple[bytes, int]] = []
         fetch_info: dict[str, list[recon.FetchInfo]] = {}
-        for i in range(0, len(pieces), limit):
-            group = pieces[i : i + limit]
-            builder = XorbBuilder()
-            for piece in group:
-                builder.add_chunk(piece)
-            xh = builder.xorb_hash()
-            xh_hex = hashing.hash_to_hex(xh)
-            offs = builder.frame_offsets()
-            self.xorbs.setdefault(
-                xh_hex,
-                _XorbFixture(xh_hex, builder.serialize(), offs,
-                             builder.serialize_full()),
+
+        def add_term(xh_hex: str, start: int, end: int,
+                     nbytes: int) -> None:
+            xh = hashing.hex_to_hash(xh_hex)
+            offs = self.xorbs[xh_hex].frame_offsets
+            terms.append(recon.Term(
+                xorb_hash=xh,
+                range=recon.ChunkRange(start, end),
+                unpacked_length=nbytes,
+            ))
+            fi = recon.FetchInfo(
+                url=f"/xorbs/{xh_hex}",
+                url_range_start=offs[start],
+                url_range_end=offs[end],
+                range=recon.ChunkRange(start, end),
             )
-            n = len(group)
-            terms.append(
-                recon.Term(
-                    xorb_hash=xh,
-                    range=recon.ChunkRange(0, n),
-                    unpacked_length=sum(len(p) for p in group),
-                )
-            )
-            fetch_info.setdefault(xh_hex, []).append(
-                recon.FetchInfo(
-                    url=f"/xorbs/{xh_hex}",
-                    url_range_start=0,
-                    url_range_end=offs[n],
-                    range=recon.ChunkRange(0, n),
-                )
-            )
-            all_chunk_hashes.extend(builder.chunk_hashes())
+            entries = fetch_info.setdefault(xh_hex, [])
+            if fi not in entries:
+                entries.append(fi)
+
+        pending: list[tuple[bytes, bytes]] = []  # new chunks to pack
+
+        def flush_pending() -> None:
+            for i in range(0, len(pending), limit):
+                group = pending[i:i + limit]
+                builder = XorbBuilder()
+                for _h, piece in group:
+                    builder.add_chunk(piece)
+                xh_hex = self._register_xorb(builder)
+                add_term(xh_hex, 0, len(group),
+                         sum(len(p) for _h, p in group))
+            pending.clear()
+
+        i = 0
+        while i < len(pieces):
+            hit = self._chunk_index.get(pieces[i][0]) if dedup else None
+            if hit is None:
+                pending.append(pieces[i])
+                i += 1
+                continue
+            flush_pending()
+            # Extend a run of chunks that sit CONTIGUOUSLY in one
+            # existing xorb — the run becomes one referencing term.
+            xh_hex, idx, _len = hit
+            j, expect, run_bytes = i, idx, 0
+            while j < len(pieces):
+                nxt = self._chunk_index.get(pieces[j][0])
+                if nxt is None or nxt[0] != xh_hex or nxt[1] != expect:
+                    break
+                run_bytes += len(pieces[j][1])
+                expect += 1
+                j += 1
+            add_term(xh_hex, idx, expect, run_bytes)
+            i = j
+        flush_pending()
+        all_chunk_hashes = [(h, len(p)) for h, p in pieces]
         file_hash = hashing.file_hash(all_chunk_hashes)
         file_hex = hashing.hash_to_hex(file_hash)
-        self.files[path] = _FileFixture(path, data, file_hex, terms)
+        fileset[path] = _FileFixture(path, data, file_hex, terms)
         self.reconstructions[file_hex] = recon.Reconstruction(
             file_hash=file_hash, terms=terms, fetch_info=fetch_info
         )
@@ -244,10 +341,12 @@ class FixtureHub:
                 return
             action = rest[2] if len(rest) > 2 else ""
             if action == "revision":
+                rev = rest[3] if len(rest) > 3 else None
                 handler._send_json({
-                    "sha": repo.commit_sha,
+                    "sha": repo.sha_for(rev),
                     "siblings": [
-                        {"rfilename": p} for p in sorted(repo.files)
+                        {"rfilename": p}
+                        for p in sorted(repo.files_for(rev))
                     ],
                 })
             elif action == "xet-read-token":
@@ -299,7 +398,7 @@ class FixtureHub:
             if repo is None:
                 return
             filename = "/".join(parts[4:])
-            f = repo.files.get(filename)
+            f = repo.files_for(parts[3]).get(filename)
             if f is None:
                 handler._send(404, b"no such file")
             else:
@@ -315,10 +414,11 @@ class FixtureHub:
             repo = self._repo_for(handler, rest)
             if repo is None:
                 return
+            rev = rest[3] if len(rest) > 3 else None
             requested = json.loads(body or b"{}").get("paths", [])
             out = []
             for p in requested:
-                f = repo.files.get(p)
+                f = repo.files_for(rev).get(p)
                 if f is None:
                     continue
                 item = {"path": p, "size": len(f.data), "type": "file"}
@@ -486,12 +586,20 @@ def llama_checkpoint_files(
     vocab_size: int = 256,
     n_ctx: int = 64,
     seed: int = 0,
+    mutate_fraction: float | None = None,
+    mutate_seed: int = 1,
 ) -> dict[str, bytes]:
     """A small but *valid* HF Llama checkpoint (HF tensor names + config),
     the Llama-family counterpart of :func:`gpt2_checkpoint_files` —
     feeds the no-network lifecycle demo (examples/finetune_and_export.py
     via ``scripts/fixture_hub.py --llama``). GQA 4:2 heads, untied
-    embeddings, no attention/mlp biases (the Llama-3.x layout)."""
+    embeddings, no attention/mlp biases (the Llama-3.x layout).
+
+    ``mutate_fraction`` derives the deterministic "revision B" of the
+    same checkpoint (ISSUE 10): identical base tensors from ``seed``,
+    then ~that fraction of the bytes XOR-flipped in seeded contiguous
+    runs (``zest_tpu.bench_scale.mutate_tensors``; same shapes) — the
+    ~1%-changed revision the delta-pull tests diff against the base."""
     import json as _json
 
     import numpy as np
@@ -527,6 +635,10 @@ def llama_checkpoint_files(
         t[p + "mlp.gate_proj.weight"] = w(inter, E)
         t[p + "mlp.up_proj.weight"] = w(inter, E)
         t[p + "mlp.down_proj.weight"] = w(E, inter)
+    if mutate_fraction:
+        from zest_tpu.bench_scale import mutate_tensors
+
+        mutate_tensors(t, mutate_fraction, seed=mutate_seed)
     return {
         "config.json": _json.dumps(cfg).encode(),
         "model.safetensors": _safetensors_blob(t),

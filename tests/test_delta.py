@@ -1,0 +1,599 @@
+"""Delta-pull contracts (ISSUE 10 tentpole).
+
+A pull of revision B over a locally-evidenced revision A moves only
+changed bytes (chunk-level DeltaPlan over the content-addressed cache),
+short-circuits decode + verify + device_put for tensors whose chunk
+cover is unchanged, and hot-swaps a resident rev-A param tree in place.
+These tests pin:
+
+- the multi-revision fixture's chunk-level dedup (revision B references
+  revision A's xorbs; only changed chunks enter new xorbs);
+- manifest save/load and base-revision resolution;
+- per-tensor fingerprints: equal covers ⇒ equal fingerprints, and the
+  unchanged-name set is exactly what the mutation left untouched;
+- DeltaPlan classification is a pure function of the two revisions —
+  cache warmth never enters ``changed_keys`` (the cross-host coop
+  agreement), and the cooperative ownership plan over the changed set
+  fingerprint-agrees regardless of input order;
+- byte identity (``params_digest``) of the delta pull against a cold
+  pull of B — streamed and non-streamed, in-place hot-swap and
+  fresh-mesh — with the changed-bytes-only fetch asserted from
+  FetchStats;
+- mid-delta interrupt → resume idempotence, chaos ``chunk_corrupt``
+  through a delta fetch (attribution + heal), ``ZEST_DELTA=0`` knob-off
+  with the pre-delta stats schema, malformed env parsing raising;
+- the ``zest diff`` dry run: correct totals, zero payload fetches.
+"""
+
+import json
+
+import pytest
+
+from fixtures import FixtureHub, FixtureRepo
+
+from zest_tpu.bench_scale import llama_checkpoint_files
+from zest_tpu.config import Config
+from zest_tpu.models.loader import params_digest
+from zest_tpu.transfer import delta
+from zest_tpu.transfer.pull import pull_model
+
+FILES_A = llama_checkpoint_files(0.012, shard_bytes=3 * 1024 * 1024,
+                                 scale=8)
+FILES_B = llama_checkpoint_files(0.012, shard_bytes=3 * 1024 * 1024,
+                                 scale=8, mutate_fraction=0.01)
+SHARDS = sorted(n for n in FILES_A if n.endswith(".safetensors"))
+TOTAL_B = sum(len(b) for b in FILES_B.values())
+SHA_B = "b" * 40
+
+
+def _make_repo() -> FixtureRepo:
+    repo = FixtureRepo("acme/delta", FILES_A, chunks_per_xorb=8)
+    repo.add_revision(FILES_B, commit_sha=SHA_B)
+    return repo
+
+
+@pytest.fixture(scope="module")
+def hub():
+    with FixtureHub(_make_repo()) as h:
+        yield h
+
+
+def _cfg(hub, root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+def _quiet(*a, **k):
+    pass
+
+
+SHA_A = "f1x7ure5ha" + "0" * 30  # FixtureRepo's default commit sha
+
+
+def _pull(hub, root, revision, **kw):
+    cfg_kw = kw.pop("cfg_kw", {})
+    return pull_model(_cfg(hub, root, **cfg_kw), "acme/delta",
+                      revision=revision, no_p2p=True, log=_quiet, **kw)
+
+
+# ── Fixture: multi-revision chunk dedup ──
+
+
+def test_fixture_revision_dedup_and_exact_bytes():
+    repo = _make_repo()
+    # Revision B's reconstructions reference mostly revision-A xorbs:
+    # the NEW xorb bytes the mutation introduced are a small fraction.
+    a_xorbs = {t.hash_hex
+               for f in repo.revisions[repo.commit_sha].values()
+               if f.xet_hash
+               for t in repo.reconstructions[f.xet_hash].terms}
+    b_terms = [t for f in repo.revisions[SHA_B].values() if f.xet_hash
+               for t in repo.reconstructions[f.xet_hash].terms]
+    new_bytes = sum(t.unpacked_length for t in b_terms
+                    if t.hash_hex not in a_xorbs)
+    total = sum(t.unpacked_length for t in b_terms)
+    assert 0 < new_bytes < 0.06 * total
+    # The revision-aware hub surface: exact sha wins, "main" = latest.
+    assert repo.sha_for(SHA_B) == SHA_B
+    assert repo.sha_for("main") == SHA_B
+    assert repo.sha_for(repo.commit_sha) == repo.commit_sha
+    assert set(repo.files_for(repo.commit_sha)) == set(FILES_A)
+
+
+# ── Manifests + fingerprints ──
+
+
+def test_manifest_roundtrip_and_base_resolution(tmp_path):
+    repo = _make_repo()
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test")
+    ff = repo.revisions[repo.commit_sha][SHARDS[0]]
+    rec = repo.reconstructions[ff.xet_hash]
+
+    class E:
+        path, size, xet_hash, is_xet = SHARDS[0], len(ff.data), \
+            ff.xet_hash, True
+
+    assert delta.save_manifest(cfg, "acme/delta", SHA_A, [E],
+                               lambda e: rec)
+    man = delta.load_manifest(cfg, "acme/delta", SHA_A)
+    assert man and man["revision"] == SHA_A
+    assert man["files"][SHARDS[0]]["terms"] == [
+        [t.hash_hex, t.range.start, t.range.end, t.unpacked_length]
+        for t in rec.terms]
+    # find_base: explicit sha, then newest-other; same-sha excluded.
+    assert delta.find_base_manifest(cfg, "acme/delta", SHA_B,
+                                    SHA_A) is not None
+    assert delta.find_base_manifest(cfg, "acme/delta", SHA_B) is not None
+    assert delta.find_base_manifest(cfg, "acme/delta", SHA_A) is None
+    # Incomplete evidence declines to write.
+    assert not delta.save_manifest(cfg, "acme/delta", "x" * 40, [E],
+                                   lambda e: None)
+    assert delta.load_manifest(cfg, "acme/delta", "x" * 40) is None
+
+
+def test_tensor_fingerprints_detect_exactly_the_changed_tensors():
+    from zest_tpu.models.safetensors_io import parse_header_prefix
+
+    repo = _make_repo()
+    changed_names: set[str] = set()
+    unchanged_names: set[str] = set()
+    for shard in SHARDS:
+        fa = repo.revisions[repo.commit_sha][shard]
+        fb = repo.revisions[SHA_B][shard]
+        rec_a = repo.reconstructions[fa.xet_hash]
+        rec_b = repo.reconstructions[fb.xet_hash]
+        header = parse_header_prefix(fb.data)
+        got = delta.unchanged_tensor_names(delta.terms_of(rec_a), rec_b,
+                                           header)
+        # Ground truth from the raw file bytes: a tensor whose span's
+        # bytes are identical MAY be reused; one whose bytes differ
+        # must NEVER be.
+        for name, info in header.tensors.items():
+            lo, hi = info.file_range(header.data_start)
+            same = fa.data[lo:hi] == fb.data[lo:hi]
+            if not same:
+                assert name not in got, name
+                changed_names.add(name)
+            elif name in got:
+                unchanged_names.add(name)
+    assert changed_names, "the mutation changed no tensor?"
+    assert unchanged_names, "the fingerprint reused no tensor?"
+    # Identical revisions fingerprint identically, everywhere.
+    fa = repo.revisions[repo.commit_sha][SHARDS[0]]
+    rec = repo.reconstructions[fa.xet_hash]
+    header = parse_header_prefix(fa.data)
+    assert delta.unchanged_tensor_names(
+        delta.terms_of(rec), rec, header) == set(header.tensors)
+
+
+def test_plan_classification_is_cache_independent(hub, tmp_path):
+    from zest_tpu.parallel.plan import collect_units
+    from zest_tpu.storage import XorbCache
+    from zest_tpu.transfer.bridge import XetBridge
+
+    repo = hub.repos["acme/delta"]
+    base_files = {}
+    for shard in SHARDS:
+        fa = repo.revisions[SHA_A][shard]
+        base_files[shard] = {
+            "terms": delta.terms_of(repo.reconstructions[fa.xet_hash])}
+    base_man = {"format": 1, "repo": "acme/delta", "revision": SHA_A,
+                "files": base_files}
+    recs_b = [repo.reconstructions[repo.revisions[SHA_B][s].xet_hash]
+              for s in SHARDS]
+    files_terms = [(s, delta.terms_of(r))
+                   for s, r in zip(SHARDS, recs_b)]
+    units = [(hh, fi) for (hh, _s), fi in collect_units(recs_b)]
+
+    cold = delta.build_plan(base_man, files_terms, units=units)
+    # Warm cache: pull revision A first, then rebuild the plan against
+    # that cache — classification must be IDENTICAL (stale accounting
+    # may differ; changed_keys may not).
+    res = _pull(hub, tmp_path, SHA_A)
+    bridge_cfg = _cfg(hub, tmp_path)
+    warm = delta.build_plan(base_man, files_terms, units=units,
+                            cache=XorbCache(bridge_cfg))
+    assert cold.changed_keys == warm.changed_keys
+    assert cold.changed_bytes == warm.changed_bytes
+    assert 0 < cold.delta_bytes_ratio < 0.10
+    assert set(cold.per_file) == set(SHARDS)
+    assert cold.total_bytes == sum(
+        r.total_bytes for r in recs_b)
+    # Warm A cache holds every unchanged unit: nothing is stale.
+    assert warm.stale_units == 0
+    # Deterministic changed-unit order.
+    assert cold.changed_units == sorted(
+        cold.changed_units, key=lambda u: (u[0], u[1].range.start))
+    del res
+    # XetBridge import kept honest (plan never needed one).
+    assert XetBridge is not None
+
+
+def test_coop_plan_over_changed_units_fingerprint_agrees(hub):
+    import random
+
+    from zest_tpu.parallel.plan import collect_units
+    from zest_tpu.transfer.coop import CoopPlan
+
+    repo = hub.repos["acme/delta"]
+    base_files = {
+        s: {"terms": delta.terms_of(
+            repo.reconstructions[repo.revisions[SHA_A][s].xet_hash])}
+        for s in SHARDS}
+    base_man = {"format": 1, "repo": "acme/delta", "revision": SHA_A,
+                "files": base_files}
+    recs_b = [repo.reconstructions[repo.revisions[SHA_B][s].xet_hash]
+              for s in SHARDS]
+    units = [(hh, fi) for (hh, _s), fi in collect_units(recs_b)]
+    plan = delta.build_plan(
+        base_man, [(s, delta.terms_of(r))
+                   for s, r in zip(SHARDS, recs_b)], units=units)
+    assert plan.changed_units
+
+    p1 = CoopPlan.build(recs_b, 4, units=plan.changed_units)
+    shuffled = list(plan.changed_units)
+    random.Random(7).shuffle(shuffled)
+    p2 = CoopPlan.build(list(reversed(recs_b)), 4, units=shuffled)
+    # The satellite: hosts with differently-warm caches (and any input
+    # order) agree byte-for-byte on the changed-set ownership plan.
+    assert p1.fingerprint() == p2.fingerprint()
+    assert len(p1.units) == len(plan.changed_units)
+    # And it is NOT the full-set plan: unchanged units never shard.
+    assert p1.fingerprint() != CoopPlan.build(recs_b, 4).fingerprint()
+
+
+def test_changed_units_order_through_shared_priority_key(hub):
+    """The delta subset inherits the ONE shared landing-priority sort:
+    coop's ``_layer_order`` over changed units puts first-layer-serving
+    units first — same key the solo warm sorts with."""
+    from zest_tpu.models.direct import (
+        unit_layer_priorities,
+        unit_priority_sort_key,
+    )
+    from zest_tpu.models.safetensors_io import parse_header_prefix
+    from zest_tpu.parallel.plan import collect_units
+    from zest_tpu.transfer.coop import _layer_order
+
+    repo = hub.repos["acme/delta"]
+    rwh = [(repo.reconstructions[repo.revisions[SHA_B][s].xet_hash],
+            parse_header_prefix(repo.revisions[SHA_B][s].data))
+           for s in SHARDS]
+    prio = unit_layer_priorities(rwh)
+    recs_b = [r for r, _h in rwh]
+    base_files = {
+        s: {"terms": delta.terms_of(
+            repo.reconstructions[repo.revisions[SHA_A][s].xet_hash])}
+        for s in SHARDS}
+    plan = delta.build_plan(
+        {"format": 1, "repo": "acme/delta", "revision": SHA_A,
+         "files": base_files},
+        [(s, delta.terms_of(r)) for s, r in zip(SHARDS, recs_b)],
+        units=[(hh, fi) for (hh, _s), fi in collect_units(recs_b)])
+    ordered = _layer_order(plan.changed_units, prio)
+    key = unit_priority_sort_key(prio)
+    assert ordered == sorted(plan.changed_units, key=key)
+    assert len(ordered) == len(plan.changed_units)
+
+
+# ── End-to-end: identity + schema ──
+
+
+def test_hot_swap_digest_identical_and_changed_bytes_only(hub, tmp_path):
+    res_a = _pull(hub, tmp_path / "d", SHA_A, device="tpu")
+    base = res_a.params
+    res_b = _pull(hub, tmp_path / "d", SHA_B, device="tpu",
+                  base_params=base, base_revision=SHA_A)
+    cold = _pull(hub, tmp_path / "cold", SHA_B, device="tpu")
+    try:
+        d = res_b.stats["delta"]
+        assert d["base_revision"] == SHA_A
+        # Changed-bytes-only fetch, asserted from FetchStats: the
+        # network moved only the changed units' (compressed) bytes.
+        fetched = res_b.stats["fetch"]["bytes"]["cdn"]
+        assert fetched <= d["changed_bytes"] * 1.1
+        assert fetched < 0.10 * TOTAL_B
+        assert d["fetched_bytes"] == fetched
+        assert 0 < d["delta_bytes_ratio"] < 0.10
+        # Hot swap: headline + evidence + consumed base.
+        assert res_b.stats["time_to_swap_s"] == \
+            res_b.stats["time_to_hbm_s"]
+        swap = res_b.stats["hbm"]["swap"]
+        assert swap["reused_tensors"] > 0
+        assert swap["reused_tensors"] == d["tensors"]["reused"]
+        assert not base, "base params must be consumed"
+        # Byte identity with a cold pull of B, both places bytes land.
+        assert params_digest(res_b.params) == params_digest(cold.params)
+        for name, data in FILES_B.items():
+            assert (res_b.snapshot_dir / name).read_bytes() == data, name
+        # Cold pull of B in a fresh cache grew no delta keys (no base
+        # evidence there).
+        assert "delta" not in cold.stats
+        assert "time_to_swap_s" not in cold.stats
+    finally:
+        res_a.params = None
+        res_b.params = None
+        cold.params = None
+
+
+def test_non_streamed_hot_swap_identical(hub, tmp_path):
+    kw = {"cfg_kw": {"land_stream": False}}
+    res_a = _pull(hub, tmp_path / "d", SHA_A, device="tpu", **kw)
+    base = res_a.params
+    res_b = _pull(hub, tmp_path / "d", SHA_B, device="tpu",
+                  base_params=base, base_revision=SHA_A, **kw)
+    cold = _pull(hub, tmp_path / "cold", SHA_B, device="tpu", **kw)
+    try:
+        assert res_b.stats["hbm"]["swap"]["reused_tensors"] > 0
+        assert not base
+        assert res_b.stats["time_to_swap_s"] is not None
+        assert params_digest(res_b.params) == params_digest(cold.params)
+    finally:
+        res_a.params = None
+        res_b.params = None
+        cold.params = None
+
+
+def test_base_params_without_base_revision_raises(hub, tmp_path):
+    """Tensor reuse is judged against the named revision's manifest —
+    guessing (newest manifest) could diff against a revision the
+    resident tree does not hold and silently reuse wrong bytes."""
+    with pytest.raises(ValueError, match="base_revision"):
+        _pull(hub, tmp_path, SHA_B, device="tpu", base_params={})
+
+
+def test_dtype_mismatch_reuses_nothing_but_stays_correct(hub, tmp_path):
+    """A delta pull landing at a different --dtype than the base tree
+    must not mix dtypes: nothing short-circuits, and the result is
+    byte-identical to a cold pull at the new dtype."""
+    res_a = _pull(hub, tmp_path / "d", SHA_A, device="tpu")  # bf16 tree
+    base = res_a.params
+    kw = {"cfg_kw": {"land_dtype": "f32"}}
+    res_b = _pull(hub, tmp_path / "d", SHA_B, device="tpu",
+                  base_params=base, base_revision=SHA_A, **kw)
+    cold = _pull(hub, tmp_path / "cold", SHA_B, device="tpu", **kw)
+    try:
+        # The dtype guard re-landed EVERYTHING: still a swap (the base
+        # tree was superseded and consumed), but zero tensors reused —
+        # and the result matches a cold pull at the new dtype exactly.
+        swap = res_b.stats["hbm"]["swap"]
+        assert swap["reused_tensors"] == 0
+        assert not base, "superseded base tree must still be consumed"
+        assert res_b.stats["time_to_swap_s"] is not None
+        assert params_digest(res_b.params) == params_digest(cold.params)
+    finally:
+        res_a.params = None
+        res_b.params = None
+        cold.params = None
+
+
+def test_fresh_mesh_delta_identical(hub, tmp_path):
+    """No resident base tree: the delta still plans (network moves only
+    changed bytes) but every tensor lands fresh — no swap keys."""
+    res_a = _pull(hub, tmp_path / "d", SHA_A, device="tpu")
+    res_a.params = None  # the mesh "lost" the tree; cache remains
+    res_b = _pull(hub, tmp_path / "d", SHA_B, device="tpu")
+    cold = _pull(hub, tmp_path / "cold", SHA_B, device="tpu")
+    try:
+        d = res_b.stats["delta"]
+        assert res_b.stats["fetch"]["bytes"]["cdn"] < 0.10 * TOTAL_B
+        assert "tensors" not in d
+        assert "time_to_swap_s" not in res_b.stats
+        assert "swap" not in res_b.stats["hbm"]
+        assert params_digest(res_b.params) == params_digest(cold.params)
+    finally:
+        res_b.params = None
+        cold.params = None
+
+
+def test_plain_pull_delta_stats_and_resume_after_interrupt(
+        hub, tmp_path, monkeypatch):
+    """A non-device delta pull: the plan still gates the network, and a
+    mid-delta failure leaves a resumable state — the re-pull converges
+    byte-exact (idempotence over the content-addressed cache)."""
+    import zest_tpu.transfer.pull as pull_mod
+
+    _pull(hub, tmp_path, SHA_A)
+    victim = SHARDS[-1]
+    orig = pull_mod._pull_xet_file
+
+    def sabotaged(bridge, par, hub_, cfg, repo_id, revision, entry, dest,
+                  log, **kw):
+        if entry.path == victim and revision == SHA_B:
+            raise RuntimeError("injected mid-delta failure")
+        return orig(bridge, par, hub_, cfg, repo_id, revision, entry,
+                    dest, log, **kw)
+
+    monkeypatch.setattr(pull_mod, "_pull_xet_file", sabotaged)
+    with pytest.raises(RuntimeError, match="injected mid-delta"):
+        _pull(hub, tmp_path, SHA_B)
+    monkeypatch.setattr(pull_mod, "_pull_xet_file", orig)
+    res = _pull(hub, tmp_path, SHA_B)
+    assert "delta" in res.stats
+    for name, data in FILES_B.items():
+        assert (res.snapshot_dir / name).read_bytes() == data, name
+    # Both revisions' manifests persist for the NEXT delta.
+    cfg = _cfg(hub, tmp_path)
+    assert delta.load_manifest(cfg, "acme/delta", SHA_A)
+    assert delta.load_manifest(cfg, "acme/delta", SHA_B)
+
+
+def test_missing_base_evidence_degrades_with_flight_event(hub, tmp_path):
+    from zest_tpu import telemetry
+
+    telemetry.recorder.reset()
+    res_a = _pull(hub, tmp_path, SHA_A, device="tpu")
+    base = res_a.params
+    # Wipe the manifests: the rev-A evidence is gone.
+    import shutil
+
+    shutil.rmtree(delta.manifest_dir(_cfg(hub, tmp_path)))
+    res_b = _pull(hub, tmp_path, SHA_B, device="tpu",
+                  base_params=base, base_revision=SHA_A)
+    try:
+        assert "delta" not in res_b.stats
+        assert "time_to_swap_s" not in res_b.stats
+        assert base, "degraded pull must leave the base tree alone"
+        kinds = [e["kind"] for e in telemetry.recorder.tail()]
+        assert "delta_degraded" in kinds
+    finally:
+        res_a.params = None
+        res_b.params = None
+        base.clear()
+
+
+def test_complete_snapshot_hot_swap_degrades_loudly(hub, tmp_path):
+    """Both snapshots fully materialized: the direct landing defers to
+    disk staging, so the short-circuit can't run — the pull must SAY so
+    (flight event + log) and leave the base tree alone, not silently
+    return a second full tree."""
+    from zest_tpu import telemetry
+
+    res_a = _pull(hub, tmp_path, SHA_A, device="tpu")
+    res_b1 = _pull(hub, tmp_path, SHA_B, device="tpu")  # materializes B
+    res_b1.params = None
+    telemetry.recorder.reset()
+    base = res_a.params
+    res_b2 = _pull(hub, tmp_path, SHA_B, device="tpu",
+                   base_params=base, base_revision=SHA_A)
+    try:
+        assert base, "base tree must be left untouched"
+        assert "time_to_swap_s" not in res_b2.stats
+        events = [e for e in telemetry.recorder.tail()
+                  if e["kind"] == "delta_degraded"]
+        assert events and events[0]["reason"] == \
+            "snapshot already complete"
+        assert params_digest(res_b2.params) is not None
+    finally:
+        res_a.params = None
+        res_b2.params = None
+        base.clear()
+
+
+# ── Chaos: corruption through a delta fetch ──
+
+
+@pytest.mark.chaos
+def test_chunk_corrupt_through_delta_attributed_and_healed(tmp_path):
+    """A peer serving flipped bytes for the CHANGED units of a delta
+    pull: corruption is attributed at the trust boundary, the unit
+    heals from CDN, and the landed tree + files come out byte-exact —
+    the delta changed what is fetched, never the trust model."""
+    from zest_tpu import faults
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    repo = _make_repo()
+    faults.reset()
+    with FixtureHub(repo) as hub:
+        def cfg_for(name):
+            return Config(hf_home=tmp_path / name / "hf",
+                          cache_dir=tmp_path / name / "zest",
+                          hf_token="hf_test", endpoint=hub.url)
+
+        seed_cfg = cfg_for("seeder")
+        pull_model(seed_cfg, "acme/delta", revision=SHA_B, no_p2p=True,
+                   log=_quiet)
+        server = BtServer(seed_cfg)
+        port = server.start()
+        try:
+            cfg = cfg_for("leecher")
+            pull_model(cfg, "acme/delta", revision=SHA_A, no_p2p=True,
+                       log=_quiet)
+            faults.install(f"chunk_corrupt:1.0@127.0.0.1:{port}",
+                           seed=1337)
+            swarm = SwarmDownloader(cfg)
+            swarm.add_direct_peer("127.0.0.1", port)
+            try:
+                result = pull_model(cfg, "acme/delta", revision=SHA_B,
+                                    swarm=swarm, log=_quiet)
+            finally:
+                swarm.close()
+        finally:
+            server.shutdown()
+            faults.reset()
+
+    assert "delta" in result.stats
+    for name, data in FILES_B.items():
+        assert (result.snapshot_dir / name).read_bytes() == data, name
+    assert result.stats["faults"]["chunk_corrupt"] >= 1
+    assert result.stats["swarm"]["corrupt_from_peer"] >= 1
+    assert result.stats["fetch"]["bytes"]["cdn"] > 0
+
+
+# ── Knob-off + env parsing ──
+
+
+def test_knob_off_restores_schema_and_writes_no_manifest(hub, tmp_path):
+    kw = {"cfg_kw": {"delta_pull": False}}
+    _pull(hub, tmp_path, SHA_A, device="tpu", **kw).params = None
+    res_off = _pull(hub, tmp_path, SHA_B, device="tpu", **kw)
+    base_line = _pull(hub, tmp_path / "ref", SHA_B, device="tpu", **kw)
+    try:
+        assert "delta" not in res_off.stats
+        assert "time_to_swap_s" not in res_off.stats
+        assert "swap" not in res_off.stats["hbm"]
+        # Schema identical to a pre-delta pull of the same shape.
+        assert set(res_off.stats) == set(base_line.stats)
+        assert not delta.manifest_dir(_cfg(hub, tmp_path)).exists()
+        for name, data in FILES_B.items():
+            assert (res_off.snapshot_dir / name).read_bytes() == data
+    finally:
+        res_off.params = None
+        base_line.params = None
+
+
+def test_config_delta_env_parsing():
+    base = {"HF_HOME": "/tmp/x", "ZEST_CACHE_DIR": "/tmp/y"}
+    assert Config.load(base).delta_pull is True
+    assert Config.load({**base, "ZEST_DELTA": "0"}).delta_pull is False
+    assert Config.load({**base, "ZEST_DELTA": "1"}).delta_pull is True
+    # The rollback knob parses STRICTLY: a typo must raise, never
+    # silently keep deltas on.
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_DELTA": "false"})
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_DELTA": "off"})
+
+
+# ── zest diff (dry run) ──
+
+
+def test_diff_cli_dry_run_no_payload_fetch(hub, tmp_path, monkeypatch,
+                                           capsys):
+    from zest_tpu import cli
+
+    monkeypatch.setenv("HF_HOME", str(tmp_path / "hf"))
+    monkeypatch.setenv("ZEST_CACHE_DIR", str(tmp_path / "zest"))
+    monkeypatch.setenv("HF_TOKEN", "hf_test")
+    monkeypatch.setenv("HF_ENDPOINT", hub.url)
+    seen_before = len(hub.requests_seen)
+    rc = cli.main(["diff", f"acme/delta@{SHA_A}",
+                   f"acme/delta@{SHA_B}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"delta acme/delta@{SHA_A} -> acme/delta@{SHA_B}" in out
+    assert "bytes changed" in out
+    # Dry run: metadata only — not one payload byte moved.
+    new_requests = hub.requests_seen[seen_before:]
+    assert not any("/xorbs/" in r for r in new_requests), new_requests
+    assert any("/v1/reconstructions/" in r for r in new_requests)
+    # --json round-trips the plan summary.
+    rc = cli.main(["diff", f"acme/delta@{SHA_A}",
+                   f"acme/delta@{SHA_B}", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert 0 < doc["delta_bytes_ratio"] < 0.10
+    assert set(doc["files"]) == set(SHARDS)
+
+
+def test_stats_watch_delta_line():
+    from zest_tpu.cli import _stats_watch_lines
+
+    lines = _stats_watch_lines(
+        {"landing": {"first_layer_s": 1.2, "time_to_hbm_s": 6.0,
+                     "delta_ratio": 0.021, "swap_s": 0.8}},
+        {"version": "x"})
+    dline = [ln for ln in lines if ln.startswith("delta:")]
+    assert dline and "fetched=2.1% of bytes" in dline[0]
+    assert "swap=0.8s" in dline[0]

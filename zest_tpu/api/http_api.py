@@ -309,6 +309,15 @@ class HttpApi:
                 "zest_last_pull_ring_stalls"):
             if value:
                 landing["ring_stalls"] = int(value)
+        # Delta-pull line (ISSUE 10): the last pull's network-fetched
+        # fraction (0.0 is meaningful — fully reused — so the sentinel
+        # for "not a delta" is -1, not 0) and the hot-swap wall.
+        last_delta = self._metric_samples("zest_last_pull_delta_ratio")
+        if last_delta and last_delta[0][1] >= 0:
+            landing["delta_ratio"] = round(last_delta[0][1], 4)
+        last_swap = self._metric_samples("zest_last_pull_swap_seconds")
+        if last_swap and last_swap[0][1] > 0:
+            landing["swap_s"] = round(last_swap[0][1], 3)
         if landing:
             payload["landing"] = landing
 
@@ -775,6 +784,10 @@ async function tick(){
     ' ('+(L.first_layer_ratio*100).toFixed(0)+'% of hbm)':'')]);
   if(L.time_to_hbm_s!=null) crows.push(['time_to_hbm_s',L.time_to_hbm_s]);
   if(L.ring_stalls!=null) crows.push(['ring_stalls',L.ring_stalls]);
+  // Delta line (ISSUE 10): last pull's fetched fraction + hot-swap wall.
+  if(L.delta_ratio!=null)
+   crows.push(['delta_fetched',(L.delta_ratio*100).toFixed(1)+'% of bytes']);
+  if(L.swap_s!=null) crows.push(['time_to_swap_s',L.swap_s]);
   if(c.peer_served_ratio!=null)
    crows.push(['peer_served_ratio',(c.peer_served_ratio*100).toFixed(1)+'%']);
   for(const [t,b] of Object.entries(c.tier_bytes||{}))

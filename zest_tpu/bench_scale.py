@@ -48,7 +48,39 @@ import time
 
 import numpy as np
 
-__all__ = ["llama_checkpoint_files", "bench_gb_pull", "bench_coop_pull"]
+__all__ = ["llama_checkpoint_files", "mutate_tensors", "bench_gb_pull",
+           "bench_coop_pull", "bench_delta_pull"]
+
+
+def mutate_tensors(tensors: dict, fraction: float, seed: int = 1) -> None:
+    """Perturb ~``fraction`` of the checkpoint's BYTES in place —
+    the deterministic "revision B" generator (ISSUE 10): same names,
+    shapes, and dtypes, with seeded contiguous byte runs XOR-flipped in
+    a seeded subset of tensors. Localized updates are the shape a
+    fine-tune/RL delta actually has, and localization is what keeps the
+    CDC chunk damage proportional to the byte fraction (every chunk a
+    run touches changes, ±1 boundary chunk per run) — the property the
+    delta-pull bench and smoke gates measure against.
+
+    Spread over ~4 tensors when the budget allows, so the delta is
+    neither one trivially contiguous region nor a scatter that would
+    dirty every chunk."""
+    total = sum(int(a.nbytes) for a in tensors.values())
+    budget = max(1, int(total * fraction))
+    rng = np.random.default_rng([int(seed), 0xDE17A])
+    names = list(tensors)
+    per = max(1, budget // 4)
+    for k in rng.permutation(len(names)):
+        if budget <= 0:
+            break
+        flat = tensors[names[k]].reshape(-1).view(np.uint8)
+        take = min(int(flat.size), per, budget) or 1
+        start = int(rng.integers(0, flat.size - take + 1))
+        # XOR with bytes in [1, 255]: every touched byte provably
+        # changes (a 0 patch byte would silently no-op).
+        flat[start:start + take] ^= rng.integers(
+            1, 256, take, dtype=np.uint8)
+        budget -= take
 
 # Llama-8B geometry (hidden/FFN/heads as in Llama-3-8B; vocab reduced to
 # keep the embedding from dominating a small-N-layer checkpoint).
@@ -81,7 +113,9 @@ _EDGE_BYTES = _edge_bytes(_HIDDEN, _VOCAB)
 def llama_checkpoint_files(gb: float, seed: int = 0,
                            shard_bytes: int = 700 * 1024 * 1024,
                            scale: int = 1,
-                           smooth: bool = False) -> dict[str, bytes]:
+                           smooth: bool = False,
+                           mutate_fraction: float | None = None,
+                           mutate_seed: int = 1) -> dict[str, bytes]:
     """Synthetic Llama-shaped checkpoint of ~``gb`` GB as HF repo files.
 
     Real tensor names and Llama-8B shapes (so the landing registry
@@ -103,6 +137,13 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
     rounds; the cooperative bench uses ``smooth=True`` because its
     compressed-on-the-wire evidence is only visible when the payload
     compresses at all.
+
+    ``mutate_fraction`` derives the deterministic "revision B" of the
+    same checkpoint (ISSUE 10): the base tensors are generated
+    identically from ``seed``, then :func:`mutate_tensors` flips
+    ~that fraction of the bytes (seeded by ``mutate_seed``; shapes
+    unchanged) — the 1%-changed revision the delta-pull bench diffs
+    against the base.
     """
     from zest_tpu.models.safetensors_io import write_safetensors
 
@@ -146,6 +187,8 @@ def llama_checkpoint_files(gb: float, seed: int = 0,
         tensors[f"{p}.post_attention_layernorm.weight"] = t(hidden)
     tensors["model.norm.weight"] = t(hidden)
     tensors["lm_head.weight"] = t(vocab, hidden)
+    if mutate_fraction:
+        mutate_tensors(tensors, mutate_fraction, seed=mutate_seed)
 
     config = {
         "model_type": "llama",
@@ -355,6 +398,124 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
     if errors:
         out["errors"] = errors
     return out
+
+
+def bench_delta_pull(gb: float = 2.0, runs: int = 3,
+                     chunks_per_xorb: int = 512, scale: int = 2,
+                     mutate_fraction: float = 0.01,
+                     budget_s: float | None = None) -> dict:
+    """Delta pull vs cold pull (ISSUE 10 acceptance bench).
+
+    Per run: a cold ``--device`` pull of revision A (the baseline
+    ``time_to_hbm_s``), then a delta pull of the seeded
+    ``mutate_fraction``-changed revision B into the SAME cache with the
+    resident rev-A tree hot-swapped in place. Headlines:
+    ``delta_bytes_ratio`` (network-fetched fraction — the ≤3% gate on a
+    1%-changed revision), ``time_to_swap_s`` vs the cold median (the
+    ≤0.3× gate), and ``digest_identical`` — the swapped tree's
+    ``params_digest`` against a cold pull of B (checked once; it costs
+    a third full pull)."""
+    import sys
+
+    tests_dir = str(pathlib.Path(__file__).resolve().parent.parent
+                    / "tests")
+    sys.path.insert(0, tests_dir)
+    try:
+        from fixtures import FixtureHub, FixtureRepo
+    finally:
+        try:
+            sys.path.remove(tests_dir)
+        except ValueError:
+            pass
+
+    from zest_tpu.config import Config
+    from zest_tpu.models.loader import params_digest
+    from zest_tpu.transfer.pull import pull_model
+
+    t_bench0 = time.perf_counter()
+    files_a = llama_checkpoint_files(gb, scale=scale)
+    files_b = llama_checkpoint_files(gb, scale=scale,
+                                     mutate_fraction=mutate_fraction)
+    total = sum(len(b) for b in files_b.values())
+    repo = FixtureRepo("bench/delta-llama", files_a,
+                       chunks_per_xorb=chunks_per_xorb)
+    sha_a = repo.commit_sha
+    sha_b = repo.add_revision(files_b)
+    gc.collect()
+
+    quiet = {"log": lambda *a, **k: None}
+    cold_s: list[float] = []
+    swap_s: list[float] = []
+    ratios: list[float] = []
+    fetched: list[int] = []
+    reused_tensors: list[int] = []
+    digest_identical = None
+    with FixtureHub(repo) as hub:
+        for run_i in range(runs):
+            if run_i and budget_s is not None \
+                    and time.perf_counter() - t_bench0 > budget_s:
+                break  # keep what's measured (bench_gb_pull's rule)
+            _settle_page_cache(False)
+            with tempfile.TemporaryDirectory() as root:
+                rootp = pathlib.Path(root)
+                cfg = Config(hf_home=rootp / "hf",
+                             cache_dir=rootp / "zest",
+                             hf_token="hf_test", endpoint=hub.url)
+                res_a = pull_model(cfg, "bench/delta-llama",
+                                   revision=sha_a, device="tpu",
+                                   no_p2p=True, **quiet)
+                cold_s.append(res_a.stats["time_to_hbm_s"])
+                _settle_page_cache(False)
+                res_b = pull_model(cfg, "bench/delta-llama",
+                                   revision=sha_b, device="tpu",
+                                   no_p2p=True,
+                                   base_params=res_a.params,
+                                   base_revision=sha_a, **quiet)
+                d = res_b.stats.get("delta") or {}
+                swap_s.append(res_b.stats.get("time_to_swap_s")
+                              or res_b.stats["time_to_hbm_s"])
+                ratios.append(d.get("fetched_ratio",
+                                    d.get("delta_bytes_ratio", 1.0)))
+                fetched.append(d.get("fetched_bytes", 0))
+                reused_tensors.append(
+                    (d.get("tensors") or {}).get("reused", 0))
+                if digest_identical is None:
+                    dig_swap = params_digest(res_b.params)
+                    with tempfile.TemporaryDirectory() as root2:
+                        r2 = pathlib.Path(root2)
+                        cfg2 = Config(hf_home=r2 / "hf",
+                                      cache_dir=r2 / "zest",
+                                      hf_token="hf_test",
+                                      endpoint=hub.url)
+                        res_cold = pull_model(cfg2, "bench/delta-llama",
+                                              revision=sha_b,
+                                              device="tpu", no_p2p=True,
+                                              **quiet)
+                        digest_identical = (
+                            params_digest(res_cold.params) == dig_swap)
+                        res_cold.params = None
+                res_a.params = None
+                res_b.params = None
+                del res_a, res_b
+                gc.collect()
+
+    med_cold = statistics.median(cold_s)
+    med_swap = statistics.median(swap_s)
+    return {
+        "checkpoint_gb": round(total / 1e9, 3),
+        "mutate_fraction": mutate_fraction,
+        "runs": len(swap_s),
+        "cold_time_to_hbm_s": round(med_cold, 3),
+        "time_to_swap_s": round(med_swap, 3),
+        "time_to_swap_runs_s": [round(t, 3) for t in swap_s],
+        "speedup_vs_cold": round(med_cold / med_swap, 2)
+        if med_swap else None,
+        "swap_ratio": round(med_swap / med_cold, 3) if med_cold else None,
+        "delta_bytes_ratio": round(statistics.median(ratios), 4),
+        "fetched_bytes": int(statistics.median(fetched)),
+        "tensors_reused": int(statistics.median(reused_tensors)),
+        "digest_identical": digest_identical,
+    }
 
 
 def _settle_page_cache(drop: bool) -> str:

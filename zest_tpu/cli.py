@@ -259,6 +259,18 @@ def _print_pull_stats(stats: dict) -> None:
         p = stats["pod"]
         print(f"  Pod round:  {p['filled']}/{p['units']} units over "
               f"{p['slots']} slots, gather {p['gather_s']}s")
+    if "delta" in stats:
+        d = stats["delta"]
+        line = (f"  Delta:      {d['changed_bytes']} of "
+                f"{d['total_bytes']} bytes changed vs "
+                f"{d['base_revision'][:12]} "
+                f"({d['delta_bytes_ratio']:.1%})")
+        if "fetched_bytes" in d:
+            line += f"; fetched {d['fetched_bytes']} bytes"
+        if "tensors" in d:
+            line += (f"; {d['tensors']['reused']} tensors reused, "
+                     f"{d['tensors']['landed']} landed")
+        print(line)
     if "hbm" in stats:
         h = stats["hbm"]
         if "error" in h:
@@ -272,6 +284,9 @@ def _print_pull_stats(stats: dict) -> None:
         if fl is not None and hbm_s:
             print(f"  First layer: {fl}s of {hbm_s}s to HBM "
                   f"({fl / hbm_s:.0%})")
+        swap_s = stats.get("time_to_swap_s")
+        if swap_s is not None:
+            print(f"  Hot swap:   mesh swapped in {swap_s}s")
 
 
 def cmd_generate(args) -> int:
@@ -560,6 +575,13 @@ def _stats_watch_lines(debug: dict, status: dict) -> list[str]:
         if "ring_stalls" in landing:
             lane += f"  ring_stalls={landing['ring_stalls']}"
         lines.append(lane)
+        if "delta_ratio" in landing or "swap_s" in landing:
+            dline = "delta:"
+            if "delta_ratio" in landing:
+                dline += f" fetched={landing['delta_ratio']:.1%} of bytes"
+            if "swap_s" in landing:
+                dline += f"  swap={landing['swap_s']}s"
+            lines.append(dline)
     coop = debug.get("coop") or {}
     if coop:
         ratio = coop.get("peer_served_ratio")
@@ -753,6 +775,39 @@ def cmd_trace(args) -> int:
         print(f"error: pull failed: {failed}", file=sys.stderr)
         return 1
     print(f"✓ {args.repo} -> {res.snapshot_dir}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    """``zest diff REPO@revA REPO@revB`` — dry-run the DeltaPlan
+    against the local cache: changed/unchanged chunk counts, byte
+    totals, and per-file delta ratios, without fetching a single
+    payload byte (reconstruction metadata only; local manifests answer
+    fully offline)."""
+    from zest_tpu.transfer import delta
+
+    def parse_spec(spec: str) -> tuple[str, str]:
+        repo, sep, rev = spec.partition("@")
+        return (repo, rev) if sep and rev else (repo, "main")
+
+    repo_a, rev_a = parse_spec(args.base)
+    repo_b, rev_b = parse_spec(args.target)
+    cfg = Config.load()
+    try:
+        cfg.model_cache_dir(repo_a)
+        cfg.model_cache_dir(repo_b)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    try:
+        out = delta.diff_revisions(cfg, repo_a, rev_a, repo_b, rev_b)
+    except Exception as exc:  # noqa: BLE001 - CLI boundary
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(delta.format_diff(out))
     return 0
 
 
@@ -965,6 +1020,16 @@ def build_parser() -> argparse.ArgumentParser:
                          help="offline: merge per-host trace exports "
                               "into --out (no pull)")
     trace_p.set_defaults(fn=cmd_trace)
+    diff_p = sub.add_parser(
+        "diff", help="chunk-level delta between two revisions "
+                     "(dry-run; metadata only, no payload fetch)")
+    diff_p.add_argument("base", metavar="REPO@REV",
+                        help="base revision (what is cached/resident)")
+    diff_p.add_argument("target", metavar="REPO@REV",
+                        help="target revision (what a pull would fetch)")
+    diff_p.add_argument("--json", action="store_true")
+    diff_p.set_defaults(fn=cmd_diff)
+
     models_p = sub.add_parser(
         "models", help="list pulled models and xorb cache totals")
     models_p.add_argument("--json", action="store_true")

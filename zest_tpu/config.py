@@ -80,6 +80,14 @@ DEFAULT_LAND_RING_BYTES = 512 * 1024 * 1024
 # tensors; bytes are the binding constraint for checkpoint-shaped
 # tensors.
 DEFAULT_LAND_RING_SLOTS = 64
+# Delta pulls (transfer.delta, ISSUE 10): with 1 (default) every pull
+# persists a revision manifest and a pull of revision B over a cached
+# revision A plans a chunk-level delta — unchanged bytes serve from the
+# local cache with zero network, a resident rev-A param tree hot-swaps
+# at tensor granularity (time_to_swap_s), and stats gain a "delta"
+# block. 0 restores the pre-delta behavior bit-for-bit (no manifests,
+# no delta stats keys).
+DEFAULT_DELTA = True
 
 _REPO_RE = re.compile(r"^[\w.\-]+/[\w.\-]+$")
 
@@ -195,6 +203,8 @@ class Config:
     land_stream: bool = DEFAULT_LAND_STREAM
     land_ring_bytes: int = DEFAULT_LAND_RING_BYTES
     land_ring_slots: int = DEFAULT_LAND_RING_SLOTS
+    # Delta pulls (see DEFAULT_DELTA above).
+    delta_pull: bool = DEFAULT_DELTA
     # Background materialization lane (see DEFAULT_FILES_* above).
     files_async: bool = DEFAULT_FILES_ASYNC
     files_workers: int = DEFAULT_FILES_WORKERS
@@ -297,6 +307,12 @@ class Config:
             land_ring_slots=max(1, int(
                 env.get("ZEST_LAND_RING_SLOTS",
                         DEFAULT_LAND_RING_SLOTS))),
+            # Strict like ZEST_LAND_STREAM: ZEST_DELTA is the delta
+            # rollback knob — "false"/a typo must raise, never silently
+            # keep deltas on.
+            delta_pull=_strict_bool(
+                "ZEST_DELTA",
+                env.get("ZEST_DELTA", "1" if DEFAULT_DELTA else "0")),
             files_async=env.get(
                 "ZEST_FILES_ASYNC",
                 "1" if DEFAULT_FILES_ASYNC else "0").strip() != "0",

@@ -709,6 +709,8 @@ def _stage_streaming(
     tensor_gate=None,
     on_first_layer=None,
     stream_file_sink=None,
+    preloaded=None,
+    swap_from=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """The ring scheduler: decode of tensor N+k overlaps the device
     transfer of tensor N, in layer order, through a :class:`HostRing`
@@ -722,6 +724,7 @@ def _stage_streaming(
     from zest_tpu.models.registry import first_layer_names, order_names
 
     t0 = time.monotonic()
+    preloaded = preloaded or {}
     # Slot reuse is only safe when the device transfer COPIES: the CPU
     # backend zero-copy-aliases aligned host buffers into the committed
     # arrays (see HostRing), so there every slot is single-use.
@@ -729,6 +732,9 @@ def _stage_streaming(
                     reuse=jax.default_backend() != "cpu")
     group_bytes = max(1, min(_STREAM_COMMIT_BYTES, ring.budget_bytes // 4))
     group_count = max(1, ring.max_slots // 4)
+    # first_set is judged over ALL tensors — delta-preloaded ones
+    # included: "first layer resident" is about what a forward pass can
+    # touch, not about which bytes this landing happened to move.
     all_names = frozenset(
         name for _r, h in recs_with_headers for name in h.tensors)
     first_set = first_layer_names(all_names)
@@ -763,6 +769,12 @@ def _stage_streaming(
         run_lo = run_hi = None
         prev_name = None
         for name in order_names(header.tensors):
+            if name in preloaded:
+                # Delta short-circuit (ISSUE 10): the tensor's chunk
+                # cover is unchanged from the resident base revision —
+                # no fetch gate, no decode, no device_put. The gap it
+                # leaves in the file span naturally cuts the run.
+                continue
             lo, hi = header.tensors[name].file_range(header.data_start)
             # Hard boundary at the first-layer-set edge: a shard
             # smaller than run_cap would otherwise be ONE run, so the
@@ -914,9 +926,14 @@ def _stage_streaming(
         except BaseException as exc:  # noqa: BLE001 - consumer re-raises
             q.put(exc)
 
-    params: dict[str, jax.Array] = {}
-    committed_names: set[str] = set()
-    fired = not first_set
+    params: dict[str, jax.Array] = dict(preloaded)
+    committed_names: set[str] = set(preloaded)
+    fired = not first_set or first_set <= committed_names
+    if fired and first_set and preloaded and on_first_layer is not None:
+        # The whole first-layer set rode the delta short-circuit: it is
+        # resident NOW (the base revision's identical bytes), so the
+        # stat honestly fires at landing start.
+        on_first_layer()
     batch: dict[str, np.ndarray] = {}
     batch_slots: list[_RingSlot] = []
     batch_bytes = 0
@@ -931,6 +948,12 @@ def _stage_streaming(
         for s in slots:
             s.release()
         committed_names.update(names)
+        if swap_from:
+            # In-place hot-swap: the replacement is resident — release
+            # the superseded base tensors NOW, so HBM peak stays ~one
+            # tree + one in-flight commit group instead of two trees.
+            for n in names:
+                swap_from.pop(n, None)
         if (not fired and first_set
                 and first_set <= committed_names):
             fired = True
@@ -1048,7 +1071,27 @@ def _stage_streaming(
     stats["decode_ahead"] = True
     stats["streamed"] = True
     stats["ring"] = ring.summary()
+    if preloaded or swap_from is not None:
+        # A consumed base tree IS a hot-swap even when nothing reused
+        # (e.g. the dtype guard re-landed everything): the mesh ends
+        # holding the new revision and the old arrays were released
+        # progressively.
+        stats["swap"] = _swap_stats(preloaded, params)
     return params, stats
+
+
+def _swap_stats(preloaded: dict, params: dict) -> dict:
+    """The hot-swap evidence block under ``stats["hbm"]["swap"]``: how
+    much of the tree rode the per-tensor short-circuit (reused — zero
+    decode/verify/transfer) vs actually landed."""
+    reused_bytes = sum(int(a.nbytes) for a in preloaded.values())
+    return {
+        "reused_tensors": len(preloaded),
+        "reused_bytes": reused_bytes,
+        "landed_tensors": len(params) - len(preloaded),
+        "landed_bytes": sum(int(a.nbytes) for a in params.values())
+        - reused_bytes,
+    }
 
 
 def stage_cached_to_hbm(
@@ -1068,6 +1111,8 @@ def stage_cached_to_hbm(
     tensor_gate=None,
     on_first_layer=None,
     stream_file_sink=None,
+    preloaded=None,
+    swap_from=None,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -1126,6 +1171,19 @@ def stage_cached_to_hbm(
     ring) and is mutually exclusive with the shard-level
     ``on_host_ready`` write-behind; with ``stream`` off the PR-1
     shard-level double buffer runs unchanged, stats schema included.
+
+    **Delta hot-swap** (``preloaded``/``swap_from``, ISSUE 10):
+    ``preloaded`` maps tensor names to ALREADY-RESIDENT device arrays
+    whose bytes the delta plan proved unchanged from the base revision
+    — they skip fetch gating, decode, verify, and ``device_put``
+    entirely and appear in the returned tree as-is (the per-tensor
+    short-circuit). ``swap_from``, when given, is the base revision's
+    param dict, CONSUMED in place: each changed tensor's superseded
+    base array is popped the moment its replacement's transfer drains,
+    so a live mesh swaps revisions at ~one-tree HBM peak instead of
+    two. ``stats["swap"]`` records the reused/landed split. Both paths
+    (streaming and shard-level) honor them; byte identity with a cold
+    landing of the new revision is pinned by ``params_digest`` tests.
     """
     import contextlib
     from concurrent.futures import ThreadPoolExecutor
@@ -1156,11 +1214,21 @@ def stage_cached_to_hbm(
             prefetch_next, decode_workers, clock,
             ring_bytes, ring_slots,
             tensor_gate=tensor_gate, on_first_layer=on_first_layer,
-            stream_file_sink=stream_file_sink)
+            stream_file_sink=stream_file_sink,
+            preloaded=preloaded, swap_from=swap_from)
 
     t0 = time.monotonic()
-    params: dict[str, jax.Array] = {}
+    preloaded = preloaded or {}
+    params: dict[str, jax.Array] = dict(preloaded)
     n = len(recs_with_headers)
+    predicate = None
+    if preloaded:
+        # Per-tensor short-circuit, shard-level flavor: only changed
+        # tensors decode (land_tensors predicate); the whole-shard
+        # single-read lane is traded away exactly where most of the
+        # shard would be skipped anyway.
+        def predicate(name, _skip=frozenset(preloaded)):
+            return name not in _skip
 
     def decode(i: int) -> dict:
         if prefetch_next is not None:
@@ -1169,13 +1237,21 @@ def stage_cached_to_hbm(
         with (clock("decode") if clock is not None
               else contextlib.nullcontext()):
             host = land_tensors(bridge.cache, rec, header, bridge=bridge,
-                                workers=decode_workers)
+                                workers=decode_workers,
+                                predicate=predicate)
         if clock is not None:
             clock.note_bytes("decode",
                              sum(int(a.nbytes) for a in host.values()))
         if on_host_ready is not None:
             on_host_ready(i, host)
         return host
+
+    def commit(host: dict) -> None:
+        params.update(commit_tensors(host, mesh, rules, dtype=dtype,
+                                     donate=True))
+        if swap_from:
+            for name in host:
+                swap_from.pop(name, None)
 
     pipelined = bool(decode_ahead) and n > 1
     # GC frozen over the whole decode→commit window (see _gc_frozen):
@@ -1198,18 +1274,18 @@ def stage_cached_to_hbm(
                     # load_checkpoint's note: amortized transfer setup,
                     # file-bounded host peak); async dispatch means this
                     # returns while the transfer is still draining.
-                    params.update(commit_tensors(host, mesh, rules,
-                                                 dtype=dtype, donate=True))
+                    commit(host)
                     del host
         else:
             for i in range(n):
                 host = decode(i)
-                params.update(commit_tensors(host, mesh, rules, dtype=dtype,
-                                             donate=True))
+                commit(host)
                 del host
         for arr in params.values():
             arr.block_until_ready()
         dt = time.monotonic() - t0
     stats = _commit_stats(params, dt, mesh, direct=True)
     stats["decode_ahead"] = pipelined
+    if preloaded or swap_from is not None:
+        stats["swap"] = _swap_stats(preloaded, params)
     return params, stats

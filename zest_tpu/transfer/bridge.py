@@ -268,6 +268,17 @@ class XetBridge:
                 cached = self._recons.setdefault(file_hash_hex, cached)
         return cached
 
+    def known_reconstruction(
+        self, file_hash_hex: str
+    ) -> recon.Reconstruction | None:
+        """The memoized reconstruction, or None — never a CAS round
+        trip. The delta manifest writer runs at pull exit, where every
+        file the pull touched is already memoized; a file that is NOT
+        (fully-skipped resume pull) means the manifest would be
+        incomplete and the writer declines instead of fetching."""
+        with self._recons_lock:
+            return self._recons.get(file_hash_hex)
+
     # ── The waterfall (reference: xet_bridge.zig:149-218) ──
 
     def fetch_xorb_for_term(

@@ -137,13 +137,24 @@ class CoopPlan:
 
     @staticmethod
     def build(recs: list[Reconstruction], n_hosts: int,
-              quarantined=frozenset()) -> "CoopPlan":
+              quarantined=frozenset(), units=None) -> "CoopPlan":
+        """``units`` restricts the plan to an explicit
+        ``[(hash_hex, FetchInfo)]`` subset — the delta pull's
+        content-changed units (transfer.delta). The subset MUST be a
+        pure function of content-addressed metadata, never of local
+        cache state: the fingerprint below is the cross-host agreement
+        proof, and hosts with differently-warm caches still compute the
+        identical changed set from the same two revisions."""
         if n_hosts <= 0:
             raise ValueError("n_hosts must be positive")
         alive = tuple(h for h in range(n_hosts) if h not in set(quarantined))
         if not alive:
             raise CoopUnavailable("every host is quarantined")
-        units = tuple(collect_units(recs))
+        if units is not None:
+            units = tuple(sorted(
+                ((hh, fi.range.start), fi) for hh, fi in units))
+        else:
+            units = tuple(collect_units(recs))
         order = sorted(
             units,
             key=lambda u: (-(u[1].url_range_end - u[1].url_range_start),
@@ -283,6 +294,7 @@ def coop_round(
     dcn_pool: DcnPool | None = None,
     trace_id: str | None = None,
     priorities: dict | None = None,
+    units=None,
     log=None,
 ) -> dict:
     """One cooperative round: plan -> fetch (my ~1/N) -> exchange.
@@ -317,6 +329,13 @@ def coop_round(
     it (tests pin the fingerprint unchanged), so hosts may even
     disagree about priorities (they don't — the key is a pure function
     of content-addressed metadata) without breaking the exchange.
+
+    ``units`` restricts the round to an explicit unit subset — the
+    delta pull's content-changed set (transfer.delta): the ownership
+    plan (and its fingerprint) is built over ONLY those units, so hosts
+    with differently-warm caches still agree, and unchanged bytes never
+    cross the exchange wire. Per-host stale units (evicted locally) are
+    each host's own waterfall problem, never the plan's.
     """
     if trace_id is None:
         trace_id = _derive_trace_id(recs)
@@ -325,7 +344,8 @@ def coop_round(
             return _coop_round(bridge, recs, host_index, n_hosts,
                                host_addrs or {}, budget_bytes, server,
                                quarantined, entries_map, deadline_s,
-                               dcn_pool, trace_id, priorities, log)
+                               dcn_pool, trace_id, priorities, units,
+                               log)
 
 
 def _derive_trace_id(recs) -> str:
@@ -352,7 +372,8 @@ def _layer_order(units, priorities):
 
 def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
                 budget_bytes, server, quarantined, entries_map,
-                deadline_s, dcn_pool, trace_id, priorities, log) -> dict:
+                deadline_s, dcn_pool, trace_id, priorities, unit_subset,
+                log) -> dict:
     from zest_tpu.transfer.pull import ByteBudget
 
     t0 = time.monotonic()
@@ -368,7 +389,8 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     q = set(quarantined or ())
     q |= quarantined_hosts(swarm_health, peers)
     q.discard(host_index)  # we are demonstrably alive
-    plan = CoopPlan.build(recs, n_hosts, frozenset(q))
+    plan = CoopPlan.build(recs, n_hosts, frozenset(q),
+                          units=unit_subset)
     if entries_map is None:
         entries_map = _entries_by_hash(recs)
 

@@ -1,0 +1,520 @@
+"""Delta pulls: chunk-level revision diffs over the content-addressed cache.
+
+Fine-tune/RL loops re-pull checkpoints that are ~99% identical to what is
+already cached (and often already resident in HBM), yet a plain pull of
+revision B over a cached revision A re-plans every byte as if the host
+were cold. The CAS layer's CDC chunking makes revision-to-revision deltas
+structurally cheap — B's reconstruction references mostly the same xorb
+chunk ranges A's did — so the delta machinery here is *planning and
+evidence*, never a new byte-moving tier:
+
+- **Manifests** — every pull persists a tiny JSON manifest (file → term
+  list) under ``cache_dir/manifests/``. That is the "revision A
+  evidence" a later pull of B diffs against; without it the delta plan
+  degrades to a full pull (recorded as a ``delta_degraded`` flight
+  event, never an error).
+- **:class:`DeltaPlan`** — partitions revision B's fetch units into
+  *changed* (chunk ranges B references that A never did — a pure
+  function of the two revisions' content-addressed metadata, so every
+  host of a cooperative pull computes the same set regardless of how
+  warm its cache is) and *reused* (already referenced by A; normally a
+  local cache hit, counted *stale* when evicted). Only changed + stale
+  bytes flow through the waterfall/coop tiers; ``delta_bytes_ratio``
+  is the headline.
+- **Per-tensor fingerprints** — a tensor's bytes are identified by the
+  canonical (xorb hash, chunk range, intra-segment offsets) cover of
+  its file span: equal covers ⇒ byte-identical tensors, by content
+  addressing. The landing uses the comparison to *short-circuit*
+  decode + verify + ``device_put`` for tensors an already-resident
+  revision-A tree holds unchanged (the in-place hot-swap,
+  models.loader).
+
+Everything here is conservative by construction: any metadata mismatch
+(re-sharded files, shifted headers, missing manifests) classifies as
+*changed*, which costs work, never correctness — the landing decodes
+from the verified cache either way, and ``params_digest`` pins the
+swapped tree byte-identical to a cold pull of B.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from zest_tpu import telemetry
+from zest_tpu.cas import hashing
+from zest_tpu.cas import reconstruction as recon
+
+_M_DELTA_BYTES = telemetry.counter(
+    "zest_delta_bytes_total",
+    "Checkpoint bytes classified by the delta plan: reused = served "
+    "from the local cache with zero network, fetched = changed (or "
+    "evicted) bytes that crossed the waterfall/coop tiers",
+    ("kind",))
+
+MANIFEST_FORMAT = 1
+
+
+# ── Revision manifests: the persisted rev-A evidence ──
+
+
+def manifest_dir(cfg) -> Path:
+    return cfg.cache_dir / "manifests"
+
+
+def manifest_path(cfg, repo_id: str, commit_sha: str) -> Path:
+    """``manifests/models--{org}--{name}@{sha}.json`` — same repo-dir
+    naming the HF cache uses, so the manifest set is greppable next to
+    the snapshots it describes."""
+    safe = "models--" + repo_id.replace("/", "--")
+    return manifest_dir(cfg) / f"{safe}@{commit_sha}.json"
+
+
+def terms_of(rec: recon.Reconstruction) -> list[list]:
+    """A reconstruction's terms in the manifest wire shape:
+    ``[hash_hex, chunk_start, chunk_end, unpacked_length]``."""
+    return [[t.hash_hex, t.range.start, t.range.end, t.unpacked_length]
+            for t in rec.terms]
+
+
+def save_manifest(cfg, repo_id: str, commit_sha: str, entries,
+                  rec_of) -> bool:
+    """Persist this revision's file → term-list map (atomic write).
+
+    ``rec_of(entry)`` returns the entry's resolved Reconstruction or
+    None. A manifest is only written when EVERY xet file's terms are
+    known — a partial manifest would make a future delta plan classify
+    the missing files' unchanged chunks as changed (costing re-fetch)
+    or, worse, be mistaken for complete evidence. Returns whether a
+    manifest was written."""
+    files: dict[str, dict] = {}
+    for entry in entries:
+        if not entry.is_xet:
+            continue
+        rec = rec_of(entry)
+        if rec is None:
+            return False
+        files[entry.path] = {
+            "size": int(entry.size),
+            "xet_hash": entry.xet_hash,
+            "terms": terms_of(rec),
+        }
+    doc = {
+        "format": MANIFEST_FORMAT,
+        "repo": repo_id,
+        "revision": commit_sha,
+        "saved_at": round(time.time(), 3),
+        "files": files,
+    }
+    from zest_tpu import storage
+
+    storage.atomic_write(manifest_path(cfg, repo_id, commit_sha),
+                         json.dumps(doc).encode())
+    return True
+
+
+def load_manifest(cfg, repo_id: str, commit_sha: str) -> dict | None:
+    try:
+        doc = json.loads(
+            manifest_path(cfg, repo_id, commit_sha).read_text())
+    except (OSError, ValueError):
+        return None
+    if (not isinstance(doc, dict)
+            or doc.get("format") != MANIFEST_FORMAT
+            or not isinstance(doc.get("files"), dict)):
+        return None
+    return doc
+
+
+def find_base_manifest(cfg, repo_id: str, commit_sha: str,
+                       base_revision: str | None = None) -> dict | None:
+    """The revision-A evidence for a pull of ``commit_sha``.
+
+    With an explicit ``base_revision`` (ref name or sha) only that
+    revision's manifest qualifies — refs resolve through the HF refs
+    file the previous pull wrote (``storage.read_ref``), which still
+    points at A because this pull updates it only at exit. Without one,
+    the newest manifest of the same repo that is NOT this revision wins
+    (the fine-tune-loop common case: the previous iteration)."""
+    from zest_tpu import storage
+
+    if base_revision:
+        sha = base_revision
+        if not manifest_path(cfg, repo_id, sha).exists():
+            # Not a sha with a manifest: treat it as a ref name the
+            # previous pull recorded (refs/main still points at A —
+            # this pull rewrites it only at exit).
+            sha = storage.read_ref(cfg, repo_id, base_revision) \
+                or base_revision
+        if sha == commit_sha:
+            return None
+        return load_manifest(cfg, repo_id, sha)
+    prefix = "models--" + repo_id.replace("/", "--") + "@"
+    root = manifest_dir(cfg)
+    best: tuple[float, Path] | None = None
+    try:
+        candidates = list(root.iterdir())
+    except OSError:
+        return None
+    for p in candidates:
+        if not p.name.startswith(prefix) or not p.name.endswith(".json"):
+            continue
+        sha = p.name[len(prefix):-len(".json")]
+        if sha == commit_sha:
+            continue
+        try:
+            mtime = p.stat().st_mtime
+        except OSError:
+            continue
+        if best is None or mtime > best[0]:
+            best = (mtime, p)
+    if best is None:
+        return None
+    sha = best[1].name[len(prefix):-len(".json")]
+    return load_manifest(cfg, repo_id, sha)
+
+
+# ── Canonical segments + per-tensor fingerprints ──
+
+
+def _canonical_segments(terms) -> list[tuple[int, int, str, int, int]]:
+    """Merge a term list into canonical ``(file_lo, file_hi, xorb_hex,
+    chunk_start, chunk_end)`` segments: adjacent terms referencing
+    contiguous chunk ranges of the same xorb collapse into one. Two
+    revisions that cut the same underlying chunk runs into differently
+    sized terms (A: one whole-xorb term; B: the same chunks split
+    around an interleaved reused run) then compare equal where their
+    bytes are equal — the property the fingerprint needs. ``terms`` is
+    the manifest wire shape (``terms_of``)."""
+    segs: list[tuple[int, int, str, int, int]] = []
+    off = 0
+    for hh, s, e, n in terms:
+        hi = off + int(n)
+        if segs:
+            p_lo, p_hi, p_hex, p_cs, p_ce = segs[-1]
+            if p_hex == hh and p_ce == s and p_hi == off:
+                segs[-1] = (p_lo, hi, hh, p_cs, e)
+                off = hi
+                continue
+        segs.append((off, hi, hh, int(s), int(e)))
+        off = hi
+    return segs
+
+
+def tensor_fingerprints(terms, header) -> dict[str, str]:
+    """name → content fingerprint of the tensor's backing chunk cover.
+
+    The fingerprint hashes the tensor's dtype, shape, and the canonical
+    segment windows covering its file span: (xorb hash, chunk range,
+    byte window within the segment). Chunk content is content-addressed,
+    so equal fingerprints between two revisions mean byte-identical
+    tensor data — the per-tensor merkle comparison the hot-swap
+    short-circuits on. Computed for revision A from its *manifest*
+    terms against revision B's header spans (same-shape revisions share
+    header layout byte-for-byte; a revision that moved tensor offsets
+    compares unequal everywhere, which is the conservative answer)."""
+    segs = _canonical_segments(terms)
+    starts = [s[0] for s in segs]
+    out: dict[str, str] = {}
+    for name, info in header.tensors.items():
+        lo, hi = info.file_range(header.data_start)
+        parts = [name, info.dtype, repr(tuple(info.shape))]
+        j = max(0, bisect.bisect_right(starts, lo) - 1)
+        covered = lo
+        while j < len(segs) and segs[j][0] < hi:
+            s_lo, s_hi, hh, cs, ce = segs[j]
+            if s_hi > lo:
+                if max(lo, s_lo) != covered:
+                    break  # gap: cover incomplete
+                parts.append(
+                    f"{hh}:{cs}:{ce}:{max(lo, s_lo) - s_lo}"
+                    f":{min(hi, s_hi) - s_lo}")
+                covered = min(hi, s_hi)
+            j += 1
+        if covered < hi:
+            # Span not fully covered by the terms (foreign/partial
+            # manifest): a unique token keeps it from matching anything.
+            parts.append(f"uncovered:{covered}:{hi}")
+        out[name] = hashing.blake3_hash(
+            "|".join(parts).encode()).hex()
+    return out
+
+
+def unchanged_tensor_names(base_terms, rec: recon.Reconstruction,
+                           header) -> set[str]:
+    """Tensors of ``header`` whose bytes are provably identical between
+    the base revision (``base_terms``, manifest shape) and ``rec`` —
+    the short-circuit set: skip decode + verify + device_put and reuse
+    the resident array."""
+    fa = tensor_fingerprints(base_terms, header)
+    fb = tensor_fingerprints(terms_of(rec), header)
+    return {n for n, fp in fb.items() if fa.get(n) == fp}
+
+
+# ── The delta plan ──
+
+
+def _coverage_map(manifest: dict) -> dict[str, list[tuple[int, int]]]:
+    """xorb hex → merged, sorted chunk-range intervals the base
+    revision referenced anywhere (cross-file: a chunk range reused from
+    ANY base file is local)."""
+    raw: dict[str, list[tuple[int, int]]] = {}
+    for f in manifest.get("files", {}).values():
+        for hh, s, e, _n in f.get("terms", []):
+            raw.setdefault(hh, []).append((int(s), int(e)))
+    out: dict[str, list[tuple[int, int]]] = {}
+    for hh, ivs in raw.items():
+        ivs.sort()
+        merged: list[tuple[int, int]] = []
+        for s, e in ivs:
+            if merged and s <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((s, e))
+        out[hh] = merged
+    return out
+
+
+def _covered(cov: dict[str, list[tuple[int, int]]], hh: str,
+             s: int, e: int) -> bool:
+    ivs = cov.get(hh)
+    if not ivs:
+        return False
+    i = bisect.bisect_right(ivs, (s, float("inf"))) - 1
+    return i >= 0 and ivs[i][0] <= s and e <= ivs[i][1]
+
+
+@dataclass
+class DeltaPlan:
+    """Chunk-level revision diff of a pull against its base manifest.
+
+    ``changed_*`` is a pure function of the two revisions'
+    content-addressed metadata — identical on every host regardless of
+    cache warmth, which is what lets the cooperative ownership plan
+    hash only the changed unit set and still fingerprint-agree across
+    differently-warm hosts. ``stale_*`` is the per-host correction:
+    content-unchanged units this host's cache no longer holds (evicted
+    since the base pull) — they re-fetch through the ordinary
+    waterfall, never through the coop plan."""
+
+    base_revision: str
+    total_bytes: int = 0          # unpacked checkpoint bytes (B)
+    changed_bytes: int = 0        # content-changed unpacked bytes
+    total_chunks: int = 0
+    changed_chunks: int = 0
+    stale_units: int = 0
+    stale_bytes: int = 0          # wire-size estimate of evicted units
+    per_file: dict[str, dict] = field(default_factory=dict)
+    changed_keys: frozenset = frozenset()
+    changed_units: list = field(default_factory=list)
+    # Unit keys both content-unchanged AND locally present (stat-level
+    # locate at plan time): the warm fetch can skip even hit-TESTING
+    # them — `_already_cached` reads and frame-parses the whole ~32 MB
+    # entry per unit, which on a 2 GB delta pull re-reads the entire
+    # cache just to learn what the plan already knew. A key that lies
+    # (entry evicted/corrupt after the stat) costs nothing but a
+    # per-term waterfall fetch at decode time — the landing's existing
+    # terminal fallback ("resolved never means guaranteed cached").
+    reused_local_keys: frozenset = frozenset()
+
+    @property
+    def reused_bytes(self) -> int:
+        return self.total_bytes - self.changed_bytes
+
+    @property
+    def delta_bytes_ratio(self) -> float:
+        """Content-changed fraction of the checkpoint — the headline:
+        what fraction of bytes a warm delta pull must move at all."""
+        return (self.changed_bytes / self.total_bytes
+                if self.total_bytes else 0.0)
+
+    def summary(self) -> dict:
+        out = {
+            "base_revision": self.base_revision,
+            "total_bytes": self.total_bytes,
+            "changed_bytes": self.changed_bytes,
+            "reused_bytes": self.reused_bytes,
+            "delta_bytes_ratio": round(self.delta_bytes_ratio, 4),
+            "chunks": {"total": self.total_chunks,
+                       "changed": self.changed_chunks},
+            "changed_units": len(self.changed_units),
+            "files": self.per_file,
+        }
+        if self.stale_units:
+            out["stale_units"] = self.stale_units
+            out["stale_bytes"] = self.stale_bytes
+        return out
+
+
+def build_plan(base_manifest: dict, files_terms, units=None,
+               cache=None) -> DeltaPlan:
+    """Diff revision B against the base manifest.
+
+    ``files_terms`` is ``[(path, terms)]`` in the manifest wire shape
+    (``terms_of``); ``units`` the deduped ``[(hash_hex, FetchInfo)]``
+    fetch units of B (``parallel.plan.collect_units``) when the caller
+    has real reconstructions — they feed ``changed_units`` (the set the
+    cooperative plan shards) and, with ``cache``, the stale-unit
+    accounting. Emits the ``zest_delta_bytes_total`` counters."""
+    with telemetry.span("delta.plan",
+                        base=base_manifest.get("revision", "")):
+        plan = _build_plan(base_manifest, files_terms, units, cache)
+    _M_DELTA_BYTES.inc(plan.reused_bytes, kind="reused")
+    _M_DELTA_BYTES.inc(plan.changed_bytes + plan.stale_bytes,
+                       kind="fetched")
+    return plan
+
+
+def _build_plan(base_manifest, files_terms, units, cache) -> DeltaPlan:
+    cov = _coverage_map(base_manifest)
+    plan = DeltaPlan(base_revision=base_manifest.get("revision", ""))
+    for path, terms in files_terms:
+        f_bytes = f_changed = f_chunks = f_chunks_changed = 0
+        for hh, s, e, n in terms:
+            n, nchunks = int(n), int(e) - int(s)
+            f_bytes += n
+            f_chunks += nchunks
+            if not _covered(cov, hh, int(s), int(e)):
+                f_changed += n
+                f_chunks_changed += nchunks
+        plan.total_bytes += f_bytes
+        plan.changed_bytes += f_changed
+        plan.total_chunks += f_chunks
+        plan.changed_chunks += f_chunks_changed
+        plan.per_file[path] = {
+            "bytes": f_bytes,
+            "bytes_changed": f_changed,
+            "chunks": f_chunks,
+            "chunks_changed": f_chunks_changed,
+            "ratio": round(f_changed / f_bytes, 4) if f_bytes else 0.0,
+        }
+    if units is not None:
+        changed = [(hh, fi) for hh, fi in units
+                   if not _covered(cov, hh, fi.range.start, fi.range.end)]
+        # Deterministic order (the coop plan sorts again internally;
+        # this is the waterfall/diff display order).
+        changed.sort(key=lambda u: (u[0], u[1].range.start))
+        plan.changed_units = changed
+        plan.changed_keys = frozenset(
+            (hh, fi.range.start) for hh, fi in changed)
+        if cache is not None:
+            present = set()
+            for hh, fi in units:
+                key = (hh, fi.range.start)
+                if key in plan.changed_keys:
+                    continue
+                if cache.locate_with_range(hh, fi.range.start) is None:
+                    plan.stale_units += 1
+                    plan.stale_bytes += (fi.url_range_end
+                                         - fi.url_range_start)
+                else:
+                    present.add(key)
+            plan.reused_local_keys = frozenset(present)
+    return plan
+
+
+# Delta landing order note: there is deliberately NO delta-specific
+# ordering helper. The changed-unit subset inherits the one shared
+# ``models.direct.unit_priority_sort_key`` everywhere units are
+# iterated — the solo warm sorts its (skip-filtered) shard units with
+# it, and coop_round's ``_layer_order`` sorts both phases with it —
+# so a delta that touches layer 0 still lands it first and
+# ``time_to_first_layer_s`` stays meaningful, with one definition of
+# the order instead of two.
+
+
+# ── `zest diff`: the dry-run CLI surface ──
+
+
+def _resolve_spec_sha(cfg, hub, repo_id: str, rev: str) -> str:
+    """Revision spec → commit sha, offline-first: a local manifest or
+    refs entry answers without the hub."""
+    from zest_tpu import storage
+
+    if load_manifest(cfg, repo_id, rev) is not None:
+        return rev
+    ref = storage.read_ref(cfg, repo_id, rev)
+    if ref:
+        return ref
+    return hub.resolve_revision(repo_id, rev)
+
+
+def _revision_terms(cfg, hub, repo_id: str, sha: str):
+    """``(files_terms, units)`` for one revision: the local manifest
+    when present (zero network), else KB-scale metadata fetches
+    (reconstructions only — never payloads)."""
+    man = load_manifest(cfg, repo_id, sha)
+    if man is not None:
+        return ([(p, f["terms"]) for p, f in sorted(man["files"].items())],
+                None, man)
+    from zest_tpu.parallel.plan import collect_units
+    from zest_tpu.transfer.bridge import XetBridge
+
+    bridge = XetBridge(cfg, swarm=None)
+    try:
+        bridge.authenticate(repo_id, sha, hub=hub)
+        files_terms, recs = [], []
+        for entry in hub.list_files(repo_id, sha):
+            if not entry.is_xet:
+                continue
+            rec = bridge.get_reconstruction(entry.xet_hash)
+            files_terms.append((entry.path, terms_of(rec)))
+            recs.append(rec)
+    finally:
+        bridge.close()
+    units = [(hh, fi) for (hh, _s), fi in collect_units(recs)]
+    man = {"format": MANIFEST_FORMAT, "repo": repo_id, "revision": sha,
+           "files": {p: {"terms": t} for p, t in files_terms}}
+    return files_terms, units, man
+
+
+def diff_revisions(cfg, repo_a: str, rev_a: str, repo_b: str,
+                   rev_b: str) -> dict:
+    """Dry-run the DeltaPlan for ``repo_b@rev_b`` over ``repo_a@rev_a``
+    against the local cache: changed/unchanged chunk counts, byte
+    totals, per-file ratios — metadata only, no payload fetch."""
+    from zest_tpu.cas.hub import HubClient
+
+    hub = HubClient(cfg)
+    sha_a = _resolve_spec_sha(cfg, hub, repo_a, rev_a)
+    sha_b = _resolve_spec_sha(cfg, hub, repo_b, rev_b)
+    _ft_a, _units_a, man_a = _revision_terms(cfg, hub, repo_a, sha_a)
+    ft_b, units_b, _man_b = _revision_terms(cfg, hub, repo_b, sha_b)
+    from zest_tpu.storage import XorbCache
+
+    plan = build_plan(man_a, ft_b, units=units_b, cache=XorbCache(cfg))
+    out = plan.summary()
+    out.update({"base": f"{repo_a}@{sha_a}", "target": f"{repo_b}@{sha_b}"})
+    return out
+
+
+def format_diff(out: dict) -> str:
+    """Human table for ``zest diff`` (kept pure for tests)."""
+    lines = [f"delta {out['base']} -> {out['target']}"]
+    width = max([len(p) for p in out["files"]] + [4])
+    for path, f in sorted(out["files"].items()):
+        lines.append(
+            f"  {path:<{width}}  chunks {f['chunks_changed']:>6}/"
+            f"{f['chunks']:<6}  bytes {f['bytes_changed']:>12}/"
+            f"{f['bytes']:<12}  {f['ratio']:>7.2%}")
+    chunks = out["chunks"]
+    total_line = (
+        f"total: {out['changed_bytes']} of {out['total_bytes']} bytes "
+        f"changed ({out['delta_bytes_ratio']:.2%}); "
+        f"{chunks['changed']}/{chunks['total']} chunks")
+    if out["changed_units"] or not out["changed_bytes"]:
+        # Unit counts exist only when real fetch_info was resolved
+        # (manifest-only diffs classify terms, not units).
+        total_line += (f"; {out['changed_units']} fetch unit(s) "
+                       "would hit the network")
+    lines.append(total_line)
+    if out.get("stale_units"):
+        lines.append(
+            f"stale: {out['stale_units']} unchanged unit(s) "
+            f"(~{out['stale_bytes']} wire bytes) evicted locally — "
+            "a delta pull would re-fetch them too")
+    return "\n".join(lines)

@@ -51,6 +51,15 @@ _M_LAST_RING_STALLS = telemetry.gauge(
     "Ring producer stalls during the most recent streaming landing "
     "(the cumulative zest_land_ring_stalls_total would misattribute "
     "earlier pulls' stalls to the last one)")
+_M_LAST_DELTA_RATIO = telemetry.gauge(
+    "zest_last_pull_delta_ratio",
+    "Network-fetched fraction of the most recent pull's checkpoint "
+    "bytes when a delta plan ran (0.0 = fully reused from the local "
+    "cache); -1 when the last pull was not a delta")
+_M_LAST_SWAP = telemetry.gauge(
+    "zest_last_pull_swap_seconds",
+    "time_to_swap_s of the most recent in-place hot-swap delta pull "
+    "(0 when the last pull was not a hot-swap)")
 _M_STAGE_SECONDS = telemetry.histogram(
     "zest_stage_seconds", "Per-entry stage wall time", ("stage",))
 _M_STAGE_BYTES = telemetry.counter(
@@ -727,8 +736,35 @@ def pull_model(
     coop_hosts: int | None = None,
     coop_index: int | None = None,
     coop_addrs: dict[int, tuple[str, int]] | None = None,
+    base_params: dict | None = None,
+    base_revision: str | None = None,
     log=print,
 ) -> PullResult:
+    """Pull ``repo_id@revision`` (see module docstring).
+
+    **Delta hot-swap** (ISSUE 10): ``base_params``, when given with
+    ``device="tpu"``, is an already-resident param tree of a previously
+    pulled revision of the same repo (``base_revision`` — a ref or sha
+    — names which one, and is required with it). The landing then
+    short-circuits every tensor whose chunk cover is unchanged (reusing
+    the resident array) and lands only changed tensors; the base dict
+    is CONSUMED — superseded arrays are popped as replacements commit,
+    so a live mesh swaps revisions at ~one-tree HBM peak. The returned
+    ``PullResult.params`` is the complete revision-``revision`` tree,
+    byte-identical to a cold pull (``params_digest``), and stats gain
+    ``time_to_swap_s`` next to ``time_to_hbm_s``. With ``ZEST_DELTA=0``
+    (or missing base evidence — recorded as a ``delta_degraded``
+    flight event) the pull degrades to a full pull and ``base_params``
+    is left untouched. ``base_revision`` is REQUIRED with
+    ``base_params``: tensor reuse is judged against that revision's
+    manifest, and guessing (e.g. newest manifest) could diff against a
+    revision the resident tree does not hold — reusing wrong bytes
+    silently."""
+    if base_params is not None and not base_revision:
+        raise ValueError(
+            "base_params requires base_revision: tensor reuse is only "
+            "sound against the manifest of the revision the resident "
+            "tree actually holds")
     t0 = time.monotonic()
     # The coop stage installs this pull's fleet trace context (host +
     # trace_id); restore the previous one at exit so a long-lived
@@ -747,6 +783,7 @@ def pull_model(
                                  no_p2p, pod, pods, pod_index, pod_addrs,
                                  (coop, coop_hosts, coop_index,
                                   coop_addrs),
+                                 base_params, base_revision,
                                  log, t0)
         except BaseException as exc:
             _M_PULLS.inc(outcome="error")
@@ -789,6 +826,8 @@ def _pull_model(
     pod_index: int | None,
     pod_addrs: dict[int, tuple[str, int]] | None,
     coop_args: tuple,
+    base_params: dict | None,
+    base_revision: str | None,
     log,
     t0: float,
 ) -> PullResult:
@@ -912,6 +951,85 @@ def _pull_model(
                     pass
 
             term_pool.submit(_prefetch_headers)
+        # ── Delta plan (ISSUE 10) ──
+        # A pull of revision B over a locally-evidenced revision A diffs
+        # the two term lists BEFORE any byte moves: content-unchanged
+        # units serve from the cache with zero network, the cooperative
+        # plan (below) shards only the changed set, and the landing's
+        # per-tensor short-circuit rides the same evidence. Knob-off
+        # (ZEST_DELTA=0) restores the pre-delta pull bit-for-bit —
+        # no plan, no manifest, no new stats keys.
+        from zest_tpu.transfer import delta as delta_mod
+
+        delta_plan = None
+        delta_base = None
+        delta_net_before = None
+        if getattr(cfg, "delta_pull", True):
+            delta_base = delta_mod.find_base_manifest(
+                cfg, repo_id, commit_sha, base_revision)
+            if delta_base is None:
+                if base_params is not None or base_revision:
+                    # The caller expected a delta (a resident tree / an
+                    # explicit base) but the rev-A evidence is gone:
+                    # degrade to a full pull, loudly and on the flight
+                    # recorder — never guess at what changed.
+                    telemetry.record("delta_degraded", repo=repo_id,
+                                     revision=commit_sha,
+                                     reason="missing rev-A manifest")
+                    log("delta: no base-revision manifest — running a "
+                        "full pull", file=sys.stderr)
+                    base_params = None
+            else:
+                pending_xet = [e for e in files if e.is_xet
+                               and not _is_complete(snapshot_dir, e)]
+                if not pending_xet and base_params is not None:
+                    # Snapshot already fully materialized: the direct
+                    # landing (and with it the per-tensor short-circuit)
+                    # won't run — it defers to the disk path for
+                    # complete files. Say so instead of silently
+                    # returning a SECOND full tree next to the caller's
+                    # resident one.
+                    telemetry.record("delta_degraded", repo=repo_id,
+                                     revision=commit_sha,
+                                     reason="snapshot already complete")
+                    log("delta: snapshot already materialized — "
+                        "hot-swap skipped (disk staging), base params "
+                        "left untouched", file=sys.stderr)
+                if pending_xet:
+                    try:
+                        from zest_tpu.parallel.plan import collect_units
+
+                        with clock("cas_metadata"):
+                            ensure_auth()
+                            delta_recs = _hdr_fan(
+                                lambda e: bridge.get_reconstruction(
+                                    e.xet_hash), pending_xet)
+                        units = [(hh, fi) for (hh, _s), fi
+                                 in collect_units(delta_recs)]
+                        delta_plan = delta_mod.build_plan(
+                            delta_base,
+                            [(e.path, delta_mod.terms_of(r))
+                             for e, r in zip(pending_xet, delta_recs)],
+                            units=units, cache=bridge.cache)
+                        # Network baseline for the measured
+                        # fetched-bytes evidence (tests/smoke assert
+                        # changed-bytes-only from FetchStats).
+                        delta_net_before = (bridge.stats.bytes_from_peer
+                                            + bridge.stats.bytes_from_cdn)
+                    except Exception as exc:  # noqa: BLE001 - plan is advisory
+                        telemetry.record(
+                            "delta_degraded", repo=repo_id,
+                            reason=f"plan: {type(exc).__name__}")
+                        log(f"delta plan unavailable ({exc}); running "
+                            "a full pull", file=sys.stderr)
+                        delta_plan = None
+        elif base_params is not None:
+            log("delta disabled (ZEST_DELTA=0); base params ignored, "
+                "running a full pull", file=sys.stderr)
+            base_params = None
+        if delta_plan is None:
+            base_params = None
+
         # Pod pre-pass (BASELINE config #3): one collective round fills the
         # cache so the per-file loop below hits tier 1 for planned bytes.
         # Defaults on for --device=tpu; force with ZEST_TPU_POD=1/0.
@@ -973,9 +1091,21 @@ def _pull_model(
                         except Exception:  # noqa: BLE001 - order is advisory
                             prio = None
                     try:
+                        # Delta interop: the ownership plan shards ONLY
+                        # the content-changed unit set — a pure function
+                        # of the two revisions, so hosts with
+                        # differently-warm caches still fingerprint-
+                        # agree (transfer.delta). Landing order comes
+                        # from ``priorities``: coop_round routes BOTH
+                        # phases through the shared
+                        # unit_priority_sort_key, changed subset or not.
                         coop_stats = _coop_stage(
                             bridge, recs, cfg, coop_cfg, repo_id,
-                            commit_sha, log, priorities=prio)
+                            commit_sha, log, priorities=prio,
+                            units=(delta_plan.changed_units
+                                   if delta_plan is not None
+                                   and delta_plan.changed_units
+                                   else None))
                     except Exception as exc:  # noqa: BLE001
                         log(f"cooperative pull unavailable ({exc}); "
                             "continuing with the per-host waterfall",
@@ -1035,6 +1165,8 @@ def _pull_model(
                 land_dtype, log, clock,
                 file_pipeline=file_pipeline, ensure_auth=ensure_auth,
                 early_cfg=early_cfg,
+                delta_state=((delta_base, base_params, delta_plan)
+                             if delta_plan is not None else None),
             )
             authenticated = authenticated or bridge.cas is not None
             if hbm_stats is not None:
@@ -1065,6 +1197,34 @@ def _pull_model(
     bridge.close()  # release hedge threads (no-op unless a deadline hedged)
 
     storage.write_ref(cfg, repo_id, revision, commit_sha)
+
+    if getattr(cfg, "delta_pull", True):
+        # Persist this revision's manifest — the rev-A evidence a later
+        # delta pull diffs against. Best-effort and complete-or-nothing:
+        # a fully-skipped resume pull has no reconstructions memoized
+        # (and its original pull already wrote one), and a partial
+        # manifest would poison future plans (transfer.delta).
+        from zest_tpu.transfer import delta as delta_mod
+
+        def _rec_of(entry):
+            rec = bridge.known_reconstruction(entry.xet_hash)
+            if rec is not None or bridge.cas is None:
+                # Unauthenticated (fully-skipped resume): decline — the
+                # original pull already wrote this manifest.
+                return rec
+            try:
+                # Partially-resumed pull: the completed files' recs were
+                # never needed for bytes — one KB-scale metadata round
+                # trip each, at pull exit, keeps the manifest complete.
+                return bridge.get_reconstruction(entry.xet_hash)
+            except Exception:  # noqa: BLE001 - complete-or-nothing
+                return None
+
+        try:
+            delta_mod.save_manifest(cfg, repo_id, commit_sha, files,
+                                    _rec_of)
+        except Exception as exc:  # noqa: BLE001 - evidence is advisory
+            log(f"delta manifest not saved ({exc})", file=sys.stderr)
 
     elapsed = time.monotonic() - t0
     stats = {
@@ -1108,6 +1268,46 @@ def _pull_model(
         # gauge with it, for the same staleness reason.
         _M_LAST_TTFL.set(0.0)
         _M_LAST_RING_STALLS.set(0.0)
+    if delta_plan is not None:
+        dsum = delta_plan.summary()
+        if delta_net_before is not None:
+            # Measured, not planned: the bytes that actually crossed
+            # the network (FetchStats peer+CDN delta, plus the coop
+            # exchange's DCN wire bytes) — the changed-bytes-only
+            # evidence the smoke gate asserts.
+            fetched = (bridge.stats.bytes_from_peer
+                       + bridge.stats.bytes_from_cdn) - delta_net_before
+            if coop_stats is not None:
+                fetched += (coop_stats.get("exchange") or {}).get(
+                    "wire_bytes", 0)
+            dsum["fetched_bytes"] = fetched
+            if delta_plan.total_bytes:
+                dsum["fetched_ratio"] = round(
+                    fetched / delta_plan.total_bytes, 4)
+        swap = (hbm_stats or {}).get("swap")
+        if swap:
+            dsum["tensors"] = {"reused": swap["reused_tensors"],
+                               "landed": swap["landed_tensors"]}
+        stats["delta"] = dsum
+        _M_LAST_DELTA_RATIO.set(
+            dsum.get("fetched_ratio", dsum["delta_bytes_ratio"]))
+        if swap and time_to_hbm is not None:
+            # In-place hot-swap headline (ISSUE 10): the instant the
+            # mesh held the COMPLETE new revision — reused tensors
+            # resident throughout, changed ones landed at tensor
+            # granularity into the existing tree's footprint.
+            stats["time_to_swap_s"] = round(time_to_hbm, 3)
+            _M_LAST_SWAP.set(time_to_hbm)
+        else:
+            _M_LAST_SWAP.set(0.0)
+        if swap and base_params:
+            # Tensors the new revision dropped entirely: release them
+            # so the consumed-base contract holds ("the base dict is
+            # empty when the swap returns").
+            base_params.clear()
+    else:
+        _M_LAST_DELTA_RATIO.set(-1.0)
+        _M_LAST_SWAP.set(0.0)
     if coop_stats is not None:
         stats["coop"] = coop_stats
         # Headline stat (README schema note): the fraction of this
@@ -1183,6 +1383,7 @@ def _try_direct_stage(
     file_pipeline: _FilePipeline | None = None,
     ensure_auth=None,
     early_cfg=None,
+    delta_state=None,
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
@@ -1252,6 +1453,60 @@ def _try_direct_stage(
                                early_cfg=early_cfg)
         recs_only = [r for r, _h in recs_with_headers]
 
+        # ── Per-tensor delta short-circuit + in-place hot-swap ──
+        # With a resident base tree, every tensor whose canonical chunk
+        # cover is unchanged between the base manifest and this
+        # revision (transfer.delta — content-addressed, so equal covers
+        # mean byte-identical data) is REUSED as-is: no fetch gate, no
+        # decode, no verify, no device_put. The base dict is consumed
+        # in place as changed tensors' replacements commit.
+        preloaded: dict = {}
+        swap_from = None
+        skip_keys: frozenset = frozenset()
+        if delta_state is not None:
+            from zest_tpu.transfer import delta as delta_mod
+
+            import numpy as _np
+
+            d_base, d_params, d_plan = delta_state
+            skip_keys = d_plan.reused_local_keys
+            if d_params:
+                swap_from = d_params
+                base_files = d_base.get("files") or {}
+
+                def _landed_dtype(info):
+                    """The dtype this landing would commit the tensor
+                    at — commit_tensors' cast rule (non-integer tensors
+                    cast to the landing dtype; int/bool keep theirs)."""
+                    src = info.np_dtype
+                    if dtype is None or _np.issubdtype(src, _np.integer) \
+                            or src == _np.bool_:
+                        return src
+                    return _np.dtype(dtype)
+
+                for entry, (rec, header) in zip(st, recs_with_headers):
+                    bf = base_files.get(entry.path)
+                    if not bf:
+                        continue
+                    for name in delta_mod.unchanged_tensor_names(
+                            bf["terms"], rec, header):
+                        arr = d_params.get(name)
+                        if arr is None:
+                            continue
+                        info = header.tensors[name]
+                        if tuple(getattr(arr, "shape", ())) \
+                                != tuple(info.shape) \
+                                or _np.dtype(getattr(arr, "dtype", None)) \
+                                != _landed_dtype(info):
+                            # Base tree disagrees (re-sharded shape, or
+                            # it landed under a different --dtype than
+                            # this pull would): re-land — a mixed-dtype
+                            # tree would break the cold-pull identity.
+                            continue
+                        preloaded[name] = arr
+                for name in preloaded:
+                    d_params.pop(name, None)  # moved into the new tree
+
         if stream_on:
             # ── Streaming landing (ISSUE 8) ──
             # Tensor-granularity flow through the loader's HostRing:
@@ -1270,7 +1525,8 @@ def _try_direct_stage(
             pipeline = _PipelinedWarm(bridge, recs_only,
                                       evidence_recs=evidence_recs,
                                       unit_priorities=priorities,
-                                      streaming=True, clock=clock)
+                                      streaming=True, clock=clock,
+                                      skip_keys=skip_keys)
 
             def tensor_gate(i, name, cancel=None, _req=required,
                             _p=pipeline):
@@ -1288,13 +1544,23 @@ def _try_direct_stage(
                 def stream_file_sink(i, _reader, _st=st,
                                      _rwh=recs_with_headers):
                     rec, header = _rwh[i]
+                    if preloaded and any(n in preloaded
+                                         for n in header.tensors):
+                        # A delta shard decodes only its changed
+                        # tensors — the sink could never assemble the
+                        # whole file from ring slots; the cache lane
+                        # materializes it instead (all units local).
+                        return None
                     return _StreamFileSink(file_pipeline, bridge,
                                            _st[i], rec, header,
                                            snapshot_dir, clock)
 
             clock.ensure("fetch")  # warm threads clock it; key must exist
             pipeline.poke(0)
-            with clock("hbm_commit"):
+            with clock("hbm_commit"), \
+                    (telemetry.span("delta.swap",
+                                    reused=len(preloaded))
+                     if preloaded else contextlib.nullcontext()):
                 params, hbm_stats = stage_cached_to_hbm(
                     bridge, recs_with_headers, mesh=mesh, rules=rules,
                     dtype=dtype,
@@ -1304,6 +1570,8 @@ def _try_direct_stage(
                     tensor_gate=tensor_gate,
                     on_first_layer=on_first_layer,
                     stream_file_sink=stream_file_sink,
+                    preloaded=preloaded or None,
+                    swap_from=swap_from,
                 )
             if first_layer_at:
                 # Monotonic instant the first-token-capable set became
@@ -1324,6 +1592,10 @@ def _try_direct_stage(
                 def on_host_ready(i, host, _st=st, _rwh=recs_with_headers):
                     rec, header = _rwh[i]
                     entry = _st[i]
+                    if preloaded and any(n in preloaded
+                                         for n in header.tensors):
+                        return  # delta shard: host dict is partial —
+                        # the cache lane materializes the file instead
 
                     def write(entry, _rec=rec, _h=header, _host=host):
                         dest = snapshot_dir / entry.path
@@ -1343,10 +1615,14 @@ def _try_direct_stage(
                     file_pipeline.submit_prepared(entry, write)
 
             pipeline = _PipelinedWarm(bridge, recs_only,
-                                      evidence_recs=evidence_recs)
+                                      evidence_recs=evidence_recs,
+                                      skip_keys=skip_keys)
             with clock("fetch"):
                 pipeline.ensure(0)
-            with clock("hbm_commit"):
+            with clock("hbm_commit"), \
+                    (telemetry.span("delta.swap",
+                                    reused=len(preloaded))
+                     if preloaded else contextlib.nullcontext()):
                 params, hbm_stats = stage_cached_to_hbm(
                     bridge, recs_with_headers, mesh=mesh, rules=rules,
                     dtype=dtype,
@@ -1354,6 +1630,8 @@ def _try_direct_stage(
                     on_host_ready=on_host_ready,
                     clock=clock,
                     stream=False,
+                    preloaded=preloaded or None,
+                    swap_from=swap_from,
                 )
         # Join the warm threads before reading their stats: the
         # streaming tensor gate releases the moment a unit resolves —
@@ -1394,7 +1672,8 @@ class _PipelinedWarm:
 
     def __init__(self, bridge, recs, evidence_recs=None,
                  unit_priorities=None, streaming: bool = False,
-                 clock: StageClock | None = None):
+                 clock: StageClock | None = None,
+                 skip_keys: frozenset | None = None):
         import threading
 
         from zest_tpu.transfer.federated import _entries_by_hash
@@ -1424,6 +1703,15 @@ class _PipelinedWarm:
         self.streaming = streaming
         self.unit_priorities = unit_priorities
         self.clock = clock
+        # Delta fast path (ISSUE 10): unit keys the plan proved
+        # content-unchanged AND locally present are excluded from the
+        # warm entirely — `_already_cached`'s per-unit full-entry read
+        # + frame parse would otherwise re-read the whole checkpoint's
+        # cache on a 1%-changed pull. Skipped units resolve (gates
+        # release) immediately; a stale skip self-serves through the
+        # landing's per-term waterfall, the same terminal fallback a
+        # failed warm already uses.
+        self.skip_keys = frozenset(skip_keys or ())
         self._cv = threading.Condition()
         self._units_done: set[tuple[str, int]] = set()
         self._shards_done: set[int] = set()
@@ -1445,14 +1733,26 @@ class _PipelinedWarm:
 
     def _shard_units(self, i: int):
         """Shard ``i``'s fetch units in landing-priority order (file
-        order when no priorities were given). Unknown units sort last."""
+        order when no priorities were given), minus the delta skip set
+        (those are marked resolved by the caller). Unknown units sort
+        last."""
         from zest_tpu.models.direct import unit_priority_sort_key
         from zest_tpu.parallel.plan import collect_units
 
-        units = [(key[0], fi) for key, fi in collect_units([self.recs[i]])]
+        units = [(key[0], fi) for key, fi in collect_units([self.recs[i]])
+                 if key not in self.skip_keys]
         if self.unit_priorities:
             units.sort(key=unit_priority_sort_key(self.unit_priorities))
         return units
+
+    def _mark_skipped(self, i: int) -> None:
+        """Resolve shard ``i``'s delta-skipped units without touching
+        their cache entries (gates on them release immediately)."""
+        from zest_tpu.parallel.plan import collect_units
+
+        for key, _fi in collect_units([self.recs[i]]):
+            if key in self.skip_keys:
+                self._mark_unit(key)
 
     def _mark_unit(self, key) -> None:
         with self._cv:
@@ -1474,6 +1774,8 @@ class _PipelinedWarm:
             if self.streaming:
                 import contextlib as _ctx
 
+                if self.skip_keys:
+                    self._mark_skipped(i)
                 with (self.clock("fetch") if self.clock is not None
                       else _ctx.nullcontext()):
                     self.stats.append(warm_units_parallel(
@@ -1484,7 +1786,9 @@ class _PipelinedWarm:
             else:
                 self.stats.append(warm_units_parallel(
                     self.bridge, [self.recs[i]],
-                    entries_map=self.entries_map))
+                    entries_map=self.entries_map,
+                    units=(self._shard_units(i) if self.skip_keys
+                           else None)))
         except Exception:  # noqa: BLE001 - landing self-serves misses
             self.stats.append({"units": 0, "bytes": 0, "failed": 0,
                                "prefetch_error": True})
@@ -1621,7 +1925,7 @@ def _resolve_coop(cfg, coop, coop_hosts, coop_index, coop_addrs, log):
 
 
 def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log,
-                priorities=None):
+                priorities=None, units=None):
     """Run the cooperative round, discovering peer DCN endpoints over
     the jax.distributed KV store when no explicit addr map was given
     (the zero-config multi-host TPU job path). The DCN listener binds
@@ -1688,6 +1992,7 @@ def _coop_stage(bridge, recs, cfg, coop_cfg, repo_id, commit_sha, log,
                       budget_bytes=cfg.coop_inflight_bytes,
                       trace_id=trace_id,
                       priorities=priorities,
+                      units=units,
                       log=lambda m: log(m))
 
 
