@@ -1,0 +1,138 @@
+"""CI gate for the critical-path analyzer (ISSUE 11).
+
+A traced 64 MiB synthetic ``--device`` pull against the loopback
+fixture hub must produce a ``stats["critical_path"]`` report that
+
+- covers >=90% of ``time_to_hbm_s`` (the attribution is the pull, not
+  a sliver of it),
+- has a stage split that sums to the path length (the blame tiles the
+  wall — no double counting, no dropped segments),
+- is reproduced by the analyzer run over the *exported* trace doc
+  (``zest analyze`` path): same stages within tolerance,
+
+and an injected ``cdn_503`` chaos run must shift blame toward the
+fetch stage — the analyzer's whole point is that a degraded CDN shows
+up as fetch blame without a human reading the trace.
+
+Usage: python scripts/critpath_smoke.py [--size BYTES]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+
+def traced_pull(hub, repo_id: str, files: dict, fault_spec=None):
+    from zest_tpu import faults, telemetry
+    from zest_tpu.config import Config
+    from zest_tpu.telemetry import trace as trace_mod
+    from zest_tpu.transfer.pull import pull_model
+
+    telemetry.reset_all()
+    telemetry.set_enabled(True)
+    tracer = trace_mod.install(None)
+    if fault_spec:
+        faults.install(fault_spec, seed=1337)
+    else:
+        faults.reset()
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+            cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                         hf_token="hf_test", endpoint=hub.url)
+            res = pull_model(cfg, repo_id, device="tpu", no_p2p=True,
+                             log=lambda *a, **k: None)
+            return res.stats, tracer
+    finally:
+        faults.reset()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=float, default=0.064,
+                    help="checkpoint GB (default 0.064 = 64 MiB)")
+    args = ap.parse_args()
+
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.telemetry import critpath
+
+    files = llama_checkpoint_files(args.size,
+                                   shard_bytes=8 * 1024 * 1024, scale=8)
+    repo = FixtureRepo("smoke/critpath", files, chunks_per_xorb=16)
+
+    def fail(msg: str, blob=None) -> int:
+        print(f"CRITPATH SMOKE FAILED: {msg}", file=sys.stderr)
+        if blob is not None:
+            print(json.dumps(blob, indent=2, default=str),
+                  file=sys.stderr)
+        return 1
+
+    with FixtureHub(repo) as hub:
+        stats, tracer = traced_pull(hub, "smoke/critpath", files)
+        cp = stats.get("critical_path")
+        if not cp:
+            return fail("traced pull carried no stats['critical_path']",
+                        sorted(stats))
+        tth = stats.get("time_to_hbm_s")
+        if tth is None:
+            return fail("no time_to_hbm_s on a --device pull", stats)
+        # Gate 1: the attributed path covers >=90% of the landing wall.
+        if cp["path_s"] < 0.9 * tth:
+            return fail(f"path {cp['path_s']}s < 90% of "
+                        f"time_to_hbm_s {tth}s", cp)
+        # Gate 2: the stage split sums to the path length (the blame
+        # tiles the wall; rounding tolerance only).
+        split_sum = sum(cp["stages"].values())
+        if abs(split_sum - cp["path_s"]) > 0.01 + 1e-4 * len(cp["stages"]):
+            return fail(f"stage split sums to {split_sum:.4f}s, path is "
+                        f"{cp['path_s']}s", cp)
+        # Gate 3: the exported-doc analyzer (the `zest analyze` path)
+        # reproduces the live split.
+        doc = tracer.to_chrome()
+        offline = critpath.analyze_doc(doc)
+        for stage, sec in cp["stages"].items():
+            got = offline["stages"].get(stage, 0.0)
+            if abs(got - sec) > 0.02 + 0.02 * sec:
+                return fail(
+                    f"offline analyzer disagrees on {stage}: live "
+                    f"{sec}s vs exported {got}s",
+                    {"live": cp["stages"], "offline": offline["stages"]})
+        clean_fetch = cp["stages"].get("fetch", 0.0) / cp["path_s"]
+
+        # Gate 4: chaos attribution — a flapping CDN must shift blame
+        # toward fetch (503s burn retry+backoff wall inside the fetch
+        # spans; everything else is unchanged).
+        chaos_stats, _ = traced_pull(hub, "smoke/critpath", files,
+                                     fault_spec="cdn_503:0.35")
+        ccp = chaos_stats.get("critical_path")
+        if not ccp:
+            return fail("chaos pull carried no critical_path")
+        if not chaos_stats.get("faults", {}).get("cdn_503"):
+            return fail("cdn_503 never fired — chaos run is vacuous",
+                        chaos_stats.get("faults"))
+        chaos_fetch = ccp["stages"].get("fetch", 0.0) / ccp["path_s"]
+        if not chaos_fetch > clean_fetch:
+            return fail(
+                f"injected cdn_503 did not shift blame to fetch: "
+                f"clean {clean_fetch:.1%} vs chaos {chaos_fetch:.1%}",
+                {"clean": cp["stages"], "chaos": ccp["stages"]})
+
+    print("critpath smoke OK: "
+          f"path {cp['path_s']}s covers {cp['path_s'] / tth:.0%} of "
+          f"time_to_hbm {tth}s; split {cp['stages']}; "
+          f"fetch share {clean_fetch:.1%} -> {chaos_fetch:.1%} under "
+          "cdn_503")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,13 @@ The reference's http_api.zig: loopback-bound HTTP server routing
   (the coordinator scrapes each pod peer's ``/v1/metrics`` and serves
   one aggregated exposition: counters summed, gauges host-labeled,
   derived ``zest_coop_straggler_seconds`` & co — telemetry.fleet).
+- Pull-session surfaces (ISSUE 11): ``GET /v1/pulls`` (active pulls +
+  the recent ring from the process session table), ``GET
+  /v1/pulls/<id>`` detail, and the SSE progress stream ``GET
+  /v1/pulls/<id>/events`` mirroring ``POST /v1/pull``'s event schema —
+  what ``zest ps --watch`` and the dashboard's active-pulls panel
+  render. ``POST /v1/pull`` accepts a ``tenant`` field that labels the
+  session.
 """
 
 from __future__ import annotations
@@ -224,10 +231,49 @@ class HttpApi:
                 # outside the process (ISSUE 4 satellite).
                 payload["peers"] = health.detail()
         payload["telemetry"] = telemetry.status_snapshot()
+        sessions = telemetry.session.SESSIONS
+        payload["pulls"] = {"active": len(sessions.active_ids()),
+                            "recent": len(sessions.recent())}
+        burn = sessions.slo_burn()
+        if burn:
+            payload["slo"] = burn
         fired = faults.counters()
         if fired:
             payload["faults"] = dict(sorted(fired.items()))
         return payload
+
+    # ── Pull sessions (ISSUE 11) ──
+
+    def pulls_payload(self) -> dict:
+        """``GET /v1/pulls``: active + recent sessions, newest first."""
+        return telemetry.session.payload()
+
+    def pull_detail(self, sid: str) -> dict | None:
+        sess = telemetry.session.get(sid)
+        return sess.snapshot(detail=True) if sess is not None else None
+
+    def session_events(self, sid: str):
+        """Generator of SSE progress events for one session (``GET
+        /v1/pulls/<id>/events``), mirroring ``POST /v1/pull``'s schema:
+        ``start`` → [``progress``…] → ``done``/``error``. Progress
+        events fire on phase/version change (the session's condition)
+        with a 1 s heartbeat; the stream ends the moment the session
+        goes terminal — tailing a finished session yields ``start``
+        then the terminal event immediately."""
+        sess = telemetry.session.get(sid)
+        if sess is None:
+            yield {"event": "error", "message": "unknown session"}
+            return
+        yield {"event": "start", **sess.snapshot(detail=True)}
+        while True:
+            snap = sess.snapshot()
+            if snap["status"] != "running":
+                break
+            yield {"event": "progress", **snap}
+            sess.wait(snap["version"], timeout=1.0)
+        final = sess.snapshot(detail=True)
+        yield {"event": "done" if final["status"] == "ok" else "error",
+               **final}
 
     def models_payload(self) -> dict:
         """Pulled models in the HF hub cache (http_api.zig:152-210)."""
@@ -289,35 +335,45 @@ class HttpApi:
 
         # Streaming-landing block (ISSUE 8): the last pull's first-layer
         # vs HBM walls — what the dashboard/`zest stats --watch` render
-        # as "how soon was this model USABLE" — plus the ring stall
-        # counter (a rising value means the device transfer, not the
-        # decode, is the landing's bottleneck).
-        landing: dict = {}
-        last_fl = self._metric_samples("zest_last_pull_first_layer_seconds")
-        if last_fl and last_fl[0][1] > 0:
-            landing["first_layer_s"] = round(last_fl[0][1], 3)
-        last_hbm = self._metric_samples("zest_last_pull_hbm_seconds")
-        if last_hbm and last_hbm[0][1] > 0:
-            landing["time_to_hbm_s"] = round(last_hbm[0][1], 3)
-        if "first_layer_s" in landing and "time_to_hbm_s" in landing:
-            landing["first_layer_ratio"] = round(
-                landing["first_layer_s"] / landing["time_to_hbm_s"], 4)
-        # Per-pull gauge, not zest_land_ring_stalls_total: the
-        # cumulative counter would attribute earlier pulls' stalls to
-        # the last pull's first_layer/hbm walls shown beside it.
-        for _labels, value in self._metric_samples(
-                "zest_last_pull_ring_stalls"):
-            if value:
-                landing["ring_stalls"] = int(value)
-        # Delta-pull line (ISSUE 10): the last pull's network-fetched
-        # fraction (0.0 is meaningful — fully reused — so the sentinel
-        # for "not a delta" is -1, not 0) and the hot-swap wall.
-        last_delta = self._metric_samples("zest_last_pull_delta_ratio")
-        if last_delta and last_delta[0][1] >= 0:
-            landing["delta_ratio"] = round(last_delta[0][1], 4)
-        last_swap = self._metric_samples("zest_last_pull_swap_seconds")
-        if last_swap and last_swap[0][1] > 0:
-            landing["swap_s"] = round(last_swap[0][1], 3)
+        # as "how soon was this model USABLE". Routed through the
+        # SESSION table (ISSUE 11): the `zest_last_pull_*` process
+        # gauges clobber each other under concurrent pulls, so the
+        # block is read from the most recent terminal session — one
+        # pull's values, internally consistent — with the gauges kept
+        # only as a fallback for processes whose session table is
+        # empty (e.g. metrics restored from an older daemon).
+        landing = telemetry.session.last_landing() or {}
+        if not landing:
+            last_fl = self._metric_samples(
+                "zest_last_pull_first_layer_seconds")
+            if last_fl and last_fl[0][1] > 0:
+                landing["first_layer_s"] = round(last_fl[0][1], 3)
+            last_hbm = self._metric_samples("zest_last_pull_hbm_seconds")
+            if last_hbm and last_hbm[0][1] > 0:
+                landing["time_to_hbm_s"] = round(last_hbm[0][1], 3)
+            if "first_layer_s" in landing and "time_to_hbm_s" in landing:
+                landing["first_layer_ratio"] = round(
+                    landing["first_layer_s"] / landing["time_to_hbm_s"],
+                    4)
+            # Per-pull gauge, not zest_land_ring_stalls_total: the
+            # cumulative counter would attribute earlier pulls' stalls
+            # to the last pull's first_layer/hbm walls shown beside it.
+            for _labels, value in self._metric_samples(
+                    "zest_last_pull_ring_stalls"):
+                if value:
+                    landing["ring_stalls"] = int(value)
+            # Delta-pull line (ISSUE 10): the last pull's network-
+            # fetched fraction (0.0 is meaningful — fully reused — so
+            # the sentinel for "not a delta" is -1, not 0) and the
+            # hot-swap wall.
+            last_delta = self._metric_samples(
+                "zest_last_pull_delta_ratio")
+            if last_delta and last_delta[0][1] >= 0:
+                landing["delta_ratio"] = round(last_delta[0][1], 4)
+            last_swap = self._metric_samples(
+                "zest_last_pull_swap_seconds")
+            if last_swap and last_swap[0][1] > 0:
+                landing["swap_s"] = round(last_swap[0][1], 3)
         if landing:
             payload["landing"] = landing
 
@@ -368,7 +424,8 @@ class HttpApi:
                         errors[label] = err
         return fleet.aggregate_prometheus(texts, errors)
 
-    def pull_events(self, repo_id: str, revision: str, device: str | None):
+    def pull_events(self, repo_id: str, revision: str, device: str | None,
+                    tenant: str | None = None):
         """Generator of SSE progress events for one pull."""
         from zest_tpu.transfer.pull import pull_model
 
@@ -387,7 +444,8 @@ class HttpApi:
         def work():
             try:
                 res = pull_model(self.cfg, repo_id, revision=revision,
-                                 device=device, swarm=self.swarm, log=log)
+                                 device=device, swarm=self.swarm,
+                                 tenant=tenant, log=log)
                 result["ok"] = {"snapshot_dir": str(res.snapshot_dir),
                                 "stats": res.stats}
             except Exception as exc:  # noqa: BLE001 - reported to client
@@ -677,6 +735,23 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 tail = 100
             self._json(self.api.debug_payload(tail=tail))
+        elif path == "/v1/pulls":
+            self._json(self.api.pulls_payload())
+        elif path.startswith("/v1/pulls/"):
+            rest = path[len("/v1/pulls/"):].strip("/")
+            if rest.endswith("/events"):
+                sid = rest[:-len("/events")].strip("/")
+                if telemetry.session.get(sid) is None:
+                    self._json({"error": "unknown session"}, 404)
+                else:
+                    self._begin_sse()
+                    self._stream_sse(self.api.session_events(sid))
+            else:
+                detail = self.api.pull_detail(rest)
+                if detail is None:
+                    self._json({"error": "unknown session"}, 404)
+                else:
+                    self._json(detail)
         elif path == "/v1/models":
             self._json(self.api.models_payload())
         elif path == "/":
@@ -697,7 +772,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._begin_sse()
             self._stream_sse(self.api.pull_events(
                 req["repo_id"], req.get("revision", "main"),
-                req.get("device"),
+                req.get("device"), tenant=req.get("tenant"),
             ))
         elif self.path == "/v1/generate":
             req = self._read_json_body()
@@ -753,6 +828,10 @@ DASHBOARD_HTML = """<!doctype html>
 </style></head><body>
 <h1>zest-tpu <span id="ver" class="k"></span></h1>
 <div class="card"><table id="status"></table></div>
+<div class="card"><h2 style="font-size:1.05rem">Pulls</h2>
+<table id="pulls"><thead><tr><th>id</th><th>repo</th><th>tenant</th>
+<th>phase</th><th>progress</th><th>elapsed</th></tr></thead>
+<tbody></tbody></table></div>
 <div class="card"><h2 style="font-size:1.05rem">Cooperative pull</h2>
 <table id="coop"></table>
 <h3 style="font-size:.95rem;margin-bottom:.2rem">Flight recorder</h3>
@@ -769,6 +848,24 @@ async function tick(){
    .map(([k,v])=>`<tr><td class="k">${k}</td><td><code>${
      typeof v==='object'?JSON.stringify(v):v}</code></td></tr>`).join('');
   document.getElementById('status').innerHTML=rows;
+  // Active-pulls panel (ISSUE 11): the live session table — running
+  // pulls with phase/progress/ETA, then the most recent finished ones.
+  // esc(): tenant (and repo) are free-form client-supplied strings
+  // rendered via innerHTML — unescaped they'd be a stored-XSS vector
+  // against the operator's dashboard session.
+  const esc=v=>String(v??'').replace(/[&<>"']/g,c=>'&#'+c.charCodeAt(0)+';');
+  const P=await (await fetch('/v1/pulls')).json();
+  const prow=s=>{
+   const pct=s.progress!=null?(s.progress*100).toFixed(0)+'%':'';
+   const eta=s.eta_s!=null?' (eta '+Number(s.eta_s)+'s)':'';
+   const st=s.status==='running'?s.phase:s.status;
+   return `<tr><td><code>${esc(s.id)}</code></td><td>${esc(s.repo)}</td>
+    <td>${esc(s.tenant||'')}</td><td class="k">${esc(st)}</td>
+    <td>${pct}${eta}</td><td>${Number(s.elapsed_s)}s</td></tr>`;
+  };
+  document.querySelector('#pulls tbody').innerHTML=
+   [...(P.active||[]),...(P.recent||[]).slice(0,4)].map(prow).join('')
+   ||'<tr><td colspan="6">no pulls yet</td></tr>';
   const m=await (await fetch('/v1/models')).json();
   document.querySelector('#models tbody').innerHTML=m.models.map(x=>
    `<tr><td>${x.repo_id}</td><td><code>${(x.revision||'').slice(0,12)}</code>

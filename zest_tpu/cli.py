@@ -636,6 +636,97 @@ def _stats_watch(cfg: Config, interval: float = 1.0,
         return 0
 
 
+def _ps_lines(payload: dict) -> list[str]:
+    """One ``zest ps`` frame from the ``/v1/pulls`` document (pure —
+    testable). Active sessions first, then the recent ring."""
+    rows = [("ID", "REPO@REV", "TENANT", "STATUS", "PHASE", "PROG",
+             "ELAPSED")]
+
+    def row(s: dict) -> tuple:
+        rev = str(s.get("revision", ""))[:12]
+        prog = ""
+        if s.get("progress") is not None:
+            prog = f"{s['progress']:.0%}"
+            if s.get("eta_s") is not None:
+                prog += f" eta {s['eta_s']}s"
+        status = s.get("status", "?")
+        if s.get("slo") and any(v.get("breached")
+                                for v in s["slo"].values()):
+            status += "!slo"
+        return (s.get("id", "?"), f"{s.get('repo', '?')}@{rev}",
+                s.get("tenant") or "-", status, s.get("phase", ""),
+                prog, f"{s.get('elapsed_s', 0)}s")
+
+    for s in payload.get("active") or []:
+        rows.append(row(s))
+    for s in payload.get("recent") or []:
+        rows.append(row(s))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    if len(rows) == 1:
+        lines.append("no pull sessions (daemon idle, or ZEST_TELEMETRY=0)")
+    burn = payload.get("slo") or {}
+    if burn:
+        lines.append("slo burn: " + "  ".join(
+            f"{k}={v['breaches']}/{v['pulls']} ({v['burn']:.1%})"
+            for k, v in sorted(burn.items())))
+    return lines
+
+
+def cmd_ps(args) -> int:
+    """``zest ps [--watch]`` — the daemon's live pull sessions
+    (``GET /v1/pulls``): id, repo@rev, tenant, phase, progress/ETA,
+    plus the recent ring and the SLO burn line."""
+    cfg = Config.load()
+    frames = 0
+    try:
+        while True:
+            payload = _daemon_get(cfg, "/v1/pulls")
+            if payload is None:
+                print("daemon not running", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(payload, indent=2))
+            else:
+                if args.watch and sys.stdout.isatty():
+                    sys.stdout.write("\x1b[H\x1b[2J")
+                print("\n".join(_ps_lines(payload)))
+            frames += 1
+            if not args.watch or (args.count and frames >= args.count):
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_analyze(args) -> int:
+    """``zest analyze <trace.json>`` — automated critical-path
+    attribution over a completed trace export (solo or a
+    ``zest trace --merge``d multi-host doc): the blame-attributed
+    longest path through the span DAG, per-stage and per-tier
+    exclusive seconds, and the top blocking spans. The
+    bottleneck-attribution tool of record (SCALING.md)."""
+    from zest_tpu.telemetry import critpath
+
+    try:
+        doc = json.loads(Path(args.trace).read_text())
+    except (OSError, ValueError) as exc:
+        print(f"error: cannot read trace: {exc}", file=sys.stderr)
+        return 1
+    try:
+        report = critpath.analyze_doc(doc, host=args.host,
+                                      top_k=args.top)
+    except critpath.AnalyzeError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print("\n".join(critpath.render_text(report)))
+    return 0
+
+
 def cmd_debug(args) -> int:
     """Dump the daemon's ``/v1/debug`` surface — the flight-recorder
     tail, live coop summary, quarantine list — to stdout or, with
@@ -996,6 +1087,30 @@ def build_parser() -> argparse.ArgumentParser:
     debug_p.add_argument("--tail", type=int, default=100,
                          help="recorder events to include (default 100)")
     debug_p.set_defaults(fn=cmd_debug)
+
+    ps_p = sub.add_parser(
+        "ps", help="list the daemon's pull sessions (live + recent)")
+    ps_p.add_argument("--json", action="store_true",
+                      help="raw /v1/pulls document")
+    ps_p.add_argument("--watch", action="store_true",
+                      help="live redraw (Ctrl-C exits)")
+    ps_p.add_argument("--interval", type=float, default=1.0,
+                      help="redraw interval seconds (default 1.0)")
+    ps_p.add_argument("--count", type=int, default=0,
+                      help="with --watch: stop after N frames")
+    ps_p.set_defaults(fn=cmd_ps)
+
+    analyze_p = sub.add_parser(
+        "analyze", help="critical-path attribution over a trace export")
+    analyze_p.add_argument("trace", metavar="TRACE.json",
+                           help="a zest trace export (solo or merged)")
+    analyze_p.add_argument("--json", action="store_true")
+    analyze_p.add_argument("--host", default=None,
+                           help="merged docs: analyze this host's spans "
+                                "(default: the dominant pull's host)")
+    analyze_p.add_argument("--top", type=int, default=8,
+                           help="top blocking spans to list (default 8)")
+    analyze_p.set_defaults(fn=cmd_analyze)
 
     trace_p = sub.add_parser(
         "trace", help="pull with the span tracer on; write a Chrome trace")

@@ -11,6 +11,7 @@ no counterpart for (SURVEY.md section 2, row 2).
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import re
 from pathlib import Path
@@ -116,6 +117,21 @@ def _parse_coop_addrs(spec: str) -> dict[int, tuple[str, int]]:
             idx, addr = parse_host_addr(part)
             out[idx] = addr
     return out
+
+
+def _opt_pos_float(env: dict[str, str], name: str) -> float | None:
+    """Optional positive float knob: unset/empty/0 = unarmed (None); a
+    malformed OR negative value raises (same typo discipline as
+    _strict_bool — a mistyped SLO budget must not silently disarm the
+    SLO, and a sign slip is a typo, not "off")."""
+    raw = env.get(name)
+    if raw is None or not raw.strip():
+        return None
+    v = float(raw)
+    if v < 0 or not math.isfinite(v):
+        raise ValueError(f"{name} must be a finite value >= 0 "
+                         f"(0 = unarmed), got {raw!r}")
+    return v if v > 0 else None
 
 
 def _strict_bool(name: str, value: str) -> bool:
@@ -246,6 +262,17 @@ class Config:
     # as this process' configuration.
     telemetry_enabled: bool = True
     trace_path: str | None = None
+    # Pull-session observability (telemetry.session; ISSUE 11): the
+    # tenant label stamped on this process' pull sessions
+    # (``ZEST_TENANT``; the API's ``tenant`` field overrides per pull),
+    # and the SLO budgets in seconds — time-to-HBM and time-to-first-
+    # layer (``ZEST_SLO_TTHBM_S`` / ``ZEST_SLO_TTFL_S``; unset/0 =
+    # unarmed). A breached budget bumps zest_slo_breaches_total{slo}
+    # and records an slo_breach flight event carrying the session id
+    # and the critical-path analyzer's top blamed stage.
+    tenant: str | None = None
+    slo_tthbm_s: float | None = None
+    slo_ttfl_s: float | None = None
 
     # ── Construction ──
 
@@ -342,6 +369,9 @@ class Config:
             telemetry_enabled=env.get("ZEST_TELEMETRY", "").strip().lower()
             not in _TELEMETRY_OFF_VALUES,
             trace_path=env.get("ZEST_TRACE") or None,
+            tenant=env.get("ZEST_TENANT") or None,
+            slo_tthbm_s=_opt_pos_float(env, "ZEST_SLO_TTHBM_S"),
+            slo_ttfl_s=_opt_pos_float(env, "ZEST_SLO_TTFL_S"),
         )
 
     # ── Path builders (reference: src/config.zig:95-133) ──

@@ -21,6 +21,14 @@ Five pieces, zero dependencies, all thread-safe:
   last N notable events (strikes, quarantines, fallbacks, faults,
   verify rejections, budget declines), served at ``GET /v1/debug``
   and dumped as a JSON crash report on pull failure / SIGTERM.
+- **Pull sessions** (:mod:`.session`): every pull as a first-class
+  observable — a bounded table of live + recent sessions (id,
+  repo@sha, tenant, phase, byte progress, ETA, terminal stats) behind
+  ``GET /v1/pulls``, its SSE progress stream, and ``zest ps``.
+- **Critical-path attribution** (:mod:`.critpath`): the automated
+  analyzer over completed trace docs — blame-attributed longest path,
+  per-stage/per-tier exclusive seconds, ``stats["critical_path"]``,
+  and ``zest analyze``.
 - **The switch** (:mod:`.state`): ``ZEST_TELEMETRY=0`` turns the whole
   layer into flag checks; tracing additionally requires ``ZEST_TRACE``.
 
@@ -52,6 +60,8 @@ from zest_tpu.telemetry import state as _state
 from zest_tpu.telemetry import trace as trace  # noqa: PLC0414
 from zest_tpu.telemetry import recorder as recorder  # noqa: PLC0414
 from zest_tpu.telemetry.recorder import record  # noqa: F401
+from zest_tpu.telemetry import session as session  # noqa: PLC0414
+from zest_tpu.telemetry import critpath as critpath  # noqa: PLC0414
 
 __all__ = [
     "REGISTRY",
@@ -64,6 +74,7 @@ __all__ = [
     "Span",
     "Tracer",
     "counter",
+    "critpath",
     "enabled",
     "gauge",
     "histogram",
@@ -71,6 +82,7 @@ __all__ = [
     "recorder",
     "render_prometheus",
     "reset_all",
+    "session",
     "set_enabled",
     "span",
     "status_snapshot",
@@ -102,3 +114,4 @@ def reset_all() -> None:
     trace.clear_context()
     REGISTRY.reset()
     recorder.reset()
+    session.reset()

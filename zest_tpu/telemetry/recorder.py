@@ -46,6 +46,28 @@ from zest_tpu.telemetry import state, trace
 ENV_EVENTS = "ZEST_RECORDER_EVENTS"
 DEFAULT_EVENTS = 512
 
+# Session attribution (ISSUE 11): an injected ``fn() -> session id or
+# None``
+# the session table registers at import — the recorder must not import
+# the session module (it would invert the package's dependency order),
+# but every event a busy daemon records should say WHICH pull it
+# belongs to.
+_session_resolver = None
+
+
+def set_session_resolver(fn) -> None:
+    global _session_resolver
+    _session_resolver = fn
+
+
+def _current_session() -> str | None:
+    if _session_resolver is None:
+        return None
+    try:
+        return _session_resolver()
+    except Exception:  # noqa: BLE001 - attribution must never break recording
+        return None
+
 
 class FlightRecorder:
     """Thread-safe bounded event ring for one process."""
@@ -69,6 +91,9 @@ class FlightRecorder:
         ctx = trace.current_context()
         if ctx:
             ev.update({k: v for k, v in ctx.items() if k not in ev})
+        sid = _current_session()
+        if sid is not None and "session" not in ev:
+            ev["session"] = sid
         for k, v in fields.items():
             if v is None:
                 continue
@@ -107,6 +132,9 @@ class FlightRecorder:
         }
         if ctx:
             doc["context"] = ctx
+        sid = _current_session()
+        if sid is not None:
+            doc["session"] = sid
         return doc
 
     def dump(self, path: str | os.PathLike, reason: str = "") -> str:

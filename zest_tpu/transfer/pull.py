@@ -69,6 +69,10 @@ _M_FILES_BYTES = telemetry.counter(
     "zest_files_bytes_total",
     "HF-cache bytes materialized by the background files lane, by lane",
     ("lane",))
+_M_SLO_BREACHES = telemetry.counter(
+    "zest_slo_breaches_total",
+    "Pulls that breached an armed SLO budget (ZEST_SLO_TTHBM_S / "
+    "ZEST_SLO_TTFL_S)", ("slo",))
 
 
 class PullResult:
@@ -125,10 +129,23 @@ class StageClock:
         self._lock = threading.Lock()
         self._intervals: dict[str, list[tuple[float, float]]] = {}
         self._bytes: dict[str, int] = {}
+        # Coarse stage-entry/exit observer (the pull session's live
+        # ``phase``, ISSUE 11): a handful of calls per pull, never per
+        # chunk — and never allowed to break the pull itself.
+        self.observer = None
+
+    def _notify(self, stage: str, entered: bool) -> None:
+        obs = self.observer
+        if obs is not None:
+            try:
+                obs(stage, entered)
+            except Exception:  # noqa: BLE001 - observers are advisory
+                pass
 
     @contextlib.contextmanager
     def __call__(self, stage: str):
         t0 = time.monotonic()
+        self._notify(stage, True)
         try:
             with telemetry.span(f"stage.{stage}"):
                 yield
@@ -137,6 +154,7 @@ class StageClock:
             with self._lock:
                 self._intervals.setdefault(stage, []).append((t0, t1))
             _M_STAGE_SECONDS.observe(t1 - t0, stage=stage)
+            self._notify(stage, False)
 
     def ensure(self, stage: str) -> None:
         """Materialize a stage key even when nothing entered it (an
@@ -352,6 +370,12 @@ class _FilePipeline:
         # (refetched through the 3-deep chain + regular files).
         self.lane_bytes: dict[str, int] = {}
         self._pending_commits: list[tuple[str, Path]] = []
+        # Session attribution for worker threads (ISSUE 11): pool
+        # threads outlive any one task, so each task re-binds the
+        # session id the pipeline was built under — recorder events
+        # from file workers (budget declines, fault sites downstream)
+        # then attribute to the right pull even with several running.
+        self._session_id = telemetry.session.current_id()
         self._lock = threading.Lock()
         self._cancel = threading.Event()
         self._futures: dict[str, object] = {}
@@ -461,6 +485,7 @@ class _FilePipeline:
         return True
 
     def _run_prepared(self, entry, prepared) -> None:
+        telemetry.session.use(self._session_id)
         try:
             if self._cancel.is_set():
                 return
@@ -481,6 +506,7 @@ class _FilePipeline:
                 self.downloaded += 1
 
     def _run(self, entry) -> None:
+        telemetry.session.use(self._session_id)
         if self._cancel.is_set():
             return
         if self.skip_check is not None and self.skip_check(entry):
@@ -738,9 +764,18 @@ def pull_model(
     coop_addrs: dict[int, tuple[str, int]] | None = None,
     base_params: dict | None = None,
     base_revision: str | None = None,
+    tenant: str | None = None,
     log=print,
 ) -> PullResult:
     """Pull ``repo_id@revision`` (see module docstring).
+
+    **Session** (ISSUE 11): every pull registers in the process-global
+    session table (:mod:`zest_tpu.telemetry.session`) — live phase,
+    byte progress and ETA while running, terminal status + the stats
+    dict after — behind ``GET /v1/pulls`` / ``zest ps``. ``tenant``
+    labels the session (falls back to ``cfg.tenant`` /
+    ``ZEST_TENANT``); with ``ZEST_TELEMETRY=0`` no session is
+    registered and the pull is bit-for-bit the pre-session pull.
 
     **Delta hot-swap** (ISSUE 10): ``base_params``, when given with
     ``device="tpu"``, is an already-resident param tree of a previously
@@ -766,6 +801,13 @@ def pull_model(
             "sound against the manifest of the revision the resident "
             "tree actually holds")
     t0 = time.monotonic()
+    # Session registration (ISSUE 11): identity + live progress for the
+    # whole pull; `bind` stamps this thread's recorder events with the
+    # session id (worker pools re-bind from a captured id). None with
+    # telemetry off — every session call below no-ops on None.
+    sess = telemetry.session.begin(
+        repo_id, revision,
+        tenant=tenant or getattr(cfg, "tenant", None), device=device)
     # The coop stage installs this pull's fleet trace context (host +
     # trace_id); restore the previous one at exit so a long-lived
     # daemon's NEXT pull never records under a stale identity (spans
@@ -776,42 +818,108 @@ def pull_model(
     # nests under this one, which is also what makes the acceptance
     # criterion trivial to check — the trace's union coverage must be
     # ~the pull's wall time, because this span IS the pull's wall time.
-    with telemetry.span("pull", repo=repo_id, revision=revision,
-                        device=device or "") as _root:
+    with telemetry.session.bind(sess.id if sess else None), \
+            telemetry.span("pull", repo=repo_id, revision=revision,
+                           device=device or "") as _root:
         try:
             result = _pull_model(cfg, repo_id, revision, device, swarm,
                                  no_p2p, pod, pods, pod_index, pod_addrs,
                                  (coop, coop_hosts, coop_index,
                                   coop_addrs),
                                  base_params, base_revision,
-                                 log, t0)
+                                 log, t0, session=sess)
         except BaseException as exc:
-            _M_PULLS.inc(outcome="error")
-            # Flight-recorder crash report (ISSUE 7): the last N notable
-            # events — strikes, fallbacks, faults, declines — dumped as
-            # one artifact next to the cache, so a failed pull's triage
-            # starts from the ordered event tail instead of log
-            # archaeology. Best-effort; never masks the real failure.
-            telemetry.record("pull_failed", repo=repo_id,
-                             error=type(exc).__name__)
-            path = telemetry.recorder.dump_crash_report(
-                cfg.cache_dir, f"pull {repo_id} failed: "
-                f"{type(exc).__name__}")
-            if path:
-                try:
-                    log(f"flight-recorder crash report: {path}",
-                        file=sys.stderr)
-                except TypeError:
-                    pass  # log doubles without file= keep the dump
+            # The finally guarantees the session reaches its terminal
+            # state even when the crash-report bookkeeping below raises
+            # (e.g. a caller-supplied log whose stream is gone) — a
+            # skipped finish would strand a phantom "running" session
+            # in /v1/pulls forever, same hazard the success path guards.
+            try:
+                _M_PULLS.inc(outcome="error")
+                # Flight-recorder crash report (ISSUE 7): the last N
+                # notable events — strikes, fallbacks, faults, declines
+                # — dumped as one artifact next to the cache, so a
+                # failed pull's triage starts from the ordered event
+                # tail instead of log archaeology. Best-effort; never
+                # masks the real failure.
+                telemetry.record("pull_failed", repo=repo_id,
+                                 error=type(exc).__name__)
+                path = telemetry.recorder.dump_crash_report(
+                    cfg.cache_dir, f"pull {repo_id} failed: "
+                    f"{type(exc).__name__}")
+                if path:
+                    try:
+                        log(f"flight-recorder crash report: {path}",
+                            file=sys.stderr)
+                    except TypeError:
+                        pass  # log doubles without file= keep the dump
+            finally:
+                telemetry.session.finish(
+                    sess, "error", error=f"{type(exc).__name__}: {exc}")
             raise
         finally:
             telemetry.trace.replace_context(_prev_ctx)
-    _M_PULLS.inc(outcome="ok")
-    _M_PULL_SECONDS.observe(time.monotonic() - t0)
-    tth = result.stats.get("time_to_hbm_s")
-    if tth is not None:
-        _M_TTH_SECONDS.observe(tth)
+    # Critical-path attribution (ISSUE 11): a traced pull's stats carry
+    # the analyzer's blame report — computed AFTER the root span closed
+    # (the analyzer needs the complete window), pinned to THIS pull's
+    # own root span so a daemon's accumulated tracer can never hand
+    # this pull a concurrent pull's root/window. The finally guarantees
+    # the session reaches its terminal state even when this post-span
+    # bookkeeping is interrupted (Ctrl-C here would otherwise leave a
+    # phantom "running" session in /v1/pulls forever) — the pull itself
+    # HAS succeeded by this point.
+    try:
+        tracer = telemetry.trace.active()
+        if tracer is not None and telemetry.enabled():
+            try:
+                cp = telemetry.critpath.analyze_tracer(tracer,
+                                                       root_span=_root)
+            except Exception:  # noqa: BLE001 - attribution is advisory
+                cp = None
+            if cp is not None:
+                result.stats["critical_path"] = cp
+        _check_slos(cfg, repo_id, result.stats, sess)
+        _M_PULLS.inc(outcome="ok")
+        _M_PULL_SECONDS.observe(time.monotonic() - t0)
+        tth = result.stats.get("time_to_hbm_s")
+        if tth is not None:
+            _M_TTH_SECONDS.observe(tth)
+    finally:
+        telemetry.session.finish(sess, "ok", stats=result.stats)
     return result
+
+
+def _check_slos(cfg: Config, repo_id: str, stats: dict, sess) -> None:
+    """Per-session SLO breach detection (ISSUE 11): compare the pull's
+    headline walls against the armed budgets (``ZEST_SLO_TTHBM_S`` /
+    ``ZEST_SLO_TTFL_S``); a breach bumps
+    ``zest_slo_breaches_total{slo}`` and records a flight-recorder
+    event carrying the session id and the critical-path analyzer's top
+    blamed stage (when the pull ran traced). Burn bookkeeping lives on
+    the session table (``/v1/pulls``'s ``slo`` block)."""
+    budgets = (
+        ("tthbm", getattr(cfg, "slo_tthbm_s", None),
+         stats.get("time_to_hbm_s")),
+        ("ttfl", getattr(cfg, "slo_ttfl_s", None),
+         stats.get("time_to_first_layer_s")),
+    )
+    cp_stages = (stats.get("critical_path") or {}).get("stages") or {}
+    blamed = max(cp_stages, key=cp_stages.get) if cp_stages else None
+    for slo, budget, actual in budgets:
+        if not budget or actual is None:
+            continue
+        breached = actual > budget
+        if sess is not None:
+            telemetry.session.SESSIONS.note_slo(slo, breached)
+            sess.note_slo(slo, {"budget_s": budget, "actual_s": actual,
+                                "breached": breached})
+        if breached:
+            _M_SLO_BREACHES.inc(slo=slo)
+            telemetry.record(
+                "slo_breach", slo=slo, repo=repo_id,
+                budget_s=budget, actual_s=actual,
+                session=sess.id if sess is not None else None,
+                blamed_stage=blamed)
 
 
 def _pull_model(
@@ -830,6 +938,7 @@ def _pull_model(
     base_revision: str | None,
     log,
     t0: float,
+    session=None,
 ) -> PullResult:
     # Validate the landing dtype BEFORE any network work: a config typo
     # (ZEST_TPU_DTYPE=fp16) must fail fast here, not be swallowed by the
@@ -857,15 +966,26 @@ def _pull_model(
                          name="zest-jax-warm").start()
     hub = HubClient(cfg)
     clock = StageClock()
+    if session is not None:
+        # The session watches the pull's existing instrumentation: the
+        # clock's stage observer drives the live phase, and snapshot
+        # reads pull byte counters lazily — no new hot-path work.
+        session.attach(clock=clock)
 
     with clock("resolve"):
         commit_sha = hub.resolve_revision(repo_id, revision)
         files = hub.list_files(repo_id, revision)
     snapshot_dir = cfg.model_snapshot_dir(repo_id, commit_sha)
+    if session is not None:
+        session.set_revision(commit_sha)
+        session.set_total_bytes(sum(
+            e.size for e in files if not _is_complete(snapshot_dir, e)))
 
     if swarm is None and not no_p2p:
         swarm = _default_swarm(cfg)
     bridge = XetBridge(cfg, swarm=swarm)
+    if session is not None:
+        session.attach(fetch_stats=bridge.stats)
     # Per-pull wall-clock budget (ZEST_PULL_DEADLINE_S; off by default).
     # Armed BEFORE authenticate() so the CAS client inherits it; the
     # swarm receives it per call from the bridge.
@@ -1023,6 +1143,16 @@ def _pull_model(
                         log(f"delta plan unavailable ({exc}); running "
                             "a full pull", file=sys.stderr)
                         delta_plan = None
+                    if delta_plan is not None and session is not None:
+                        # Progress denominator = the bytes this pull
+                        # will actually move: content-unchanged reused
+                        # units never touch FetchStats, so against the
+                        # full incomplete-file total a 5%-changed delta
+                        # pull would sit at ~5% "progress" until the
+                        # instant it finished.
+                        session.set_total_bytes(
+                            delta_plan.changed_bytes
+                            + delta_plan.stale_bytes)
         elif base_params is not None:
             log("delta disabled (ZEST_DELTA=0); base params ignored, "
                 "running a full pull", file=sys.stderr)
@@ -1712,6 +1842,10 @@ class _PipelinedWarm:
         # landing's per-term waterfall, the same terminal fallback a
         # failed warm already uses.
         self.skip_keys = frozenset(skip_keys or ())
+        # Warm threads are spawned per shard; re-bind the owning pull's
+        # session id so their recorder events (fallbacks, strikes
+        # downstream) attribute correctly under concurrent pulls.
+        self._session_id = telemetry.session.current_id()
         self._cv = threading.Condition()
         self._units_done: set[tuple[str, int]] = set()
         self._shards_done: set[int] = set()
@@ -1767,6 +1901,7 @@ class _PipelinedWarm:
     def _run(self, i: int) -> None:
         from zest_tpu.transfer.federated import warm_units_parallel
 
+        telemetry.session.use(self._session_id)
         try:
             # entries_map = ALL shards: the full-vs-partial cache-key
             # decision must see cross-shard dedup, or a xorb shared
