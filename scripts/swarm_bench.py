@@ -1,0 +1,107 @@
+"""SWARM artifact driver: the fleet-scale chaos capacity model
+(ROADMAP item 4, ISSUE 12).
+
+Writes ``SWARM_r12.json``-style artifacts with three sections over one
+M-puller x K-seeder loopback swarm served through the production upload
+policy (choke/unchoke reciprocity, shaped upload buckets, per-request
+deadlines):
+
+- ``clean``        — no faults, unshaped: the ceiling (and the
+  solo-pull honesty row: with every seed knob unset the serving path is
+  the pre-policy server);
+- ``shaped``       — CDN token-bucketed to a WAN-ish shared rate,
+  seeders shaped to their upload knob: the asymmetry under which the
+  peer tier IS the capacity;
+- ``shaped_chaos`` — the same links plus the injected ``ZEST_FAULTS``
+  matrix (serving-side corruption, seeder stalls, choke flaps, CDN
+  503s): the headline block — swarm-wide peer_served_ratio, p50/p99
+  pull latency, upload-fairness skew, and corrupt_bytes_admitted
+  (must be 0) under failure.
+
+Usage: python scripts/swarm_bench.py [--out SWARM_r12.json]
+       [--mb 64] [--pullers 6] [--seeders 4] [--cdn-mbps 8]
+       [--seed-mbps 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+FAULT_SPEC = ("upload_corrupt:0.02,seeder_stall:0.05@0.3,"
+              "seeder_choke_flap:0.1,cdn_503:0.1")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SWARM_r12.json")
+    ap.add_argument("--mb", type=float, default=64.0)
+    ap.add_argument("--pullers", type=int, default=6)
+    ap.add_argument("--seeders", type=int, default=4)
+    ap.add_argument("--cdn-mbps", type=float, default=8.0,
+                    help="shaped CDN rate, MB/s shared across the swarm")
+    ap.add_argument("--seed-mbps", type=float, default=24.0,
+                    help="per-seeder upload cap (ZEST_SEED_RATE_BPS)")
+    ap.add_argument("--faults", default=FAULT_SPEC)
+    ap.add_argument("--seed", type=int, default=1337)
+    args = ap.parse_args()
+
+    from zest_tpu.bench_scale import bench_swarm
+
+    gb = args.mb / 1024.0
+    common = dict(gb=gb, m_pullers=args.pullers, k_seeders=args.seeders,
+                  scale=4, chunks_per_xorb=16)
+    out: dict = {
+        "bench": "swarm_capacity",
+        "requested_mb": args.mb,
+        "pullers": args.pullers,
+        "seeders": args.seeders,
+        # Honesty note: pullers, seeders, and the shaped CDN all share
+        # ONE machine's cores and loopback, so absolute walls are
+        # pessimistic vs a real fleet; the ratio/fairness/corruption
+        # numbers are topology-level and transfer.
+        "note": "single-box loopback swarm; ratios and fairness are the "
+                "signal, absolute walls are not",
+    }
+    print("clean (unshaped, no faults)...")
+    out["clean"] = bench_swarm(**common)
+    print(json.dumps(out["clean"], indent=1))
+    print("shaped (WAN CDN + shaped seeders, no faults)...")
+    out["shaped"] = bench_swarm(
+        **common,
+        shaped_bps=int(args.cdn_mbps * 1e6),
+        seed_rate_bps=int(args.seed_mbps * 1e6))
+    print(json.dumps(out["shaped"], indent=1))
+    print("shaped_chaos (the capacity headline)...")
+    out["shaped_chaos"] = bench_swarm(
+        **common,
+        shaped_bps=int(args.cdn_mbps * 1e6),
+        seed_rate_bps=int(args.seed_mbps * 1e6),
+        fault_spec=args.faults, fault_seed=args.seed)
+    print(json.dumps(out["shaped_chaos"], indent=1))
+
+    chaos = out["shaped_chaos"]
+    out["gates"] = {
+        "peer_served_ratio_ge_0.85": (
+            chaos["peer_served_ratio"] is not None
+            and chaos["peer_served_ratio"] >= 0.85),
+        "corrupt_bytes_admitted_eq_0":
+            chaos["corrupt_bytes_admitted"] == 0,
+        "fairness_skew_le_2.0": (
+            chaos["upload_fairness"]["skew"] is not None
+            and chaos["upload_fairness"]["skew"] <= 2.0),
+        "all_faults_fired": set(
+            c.split(":")[0] for c in args.faults.split(",")
+        ) <= set(chaos["faults_fired"]),
+    }
+    pathlib.Path(args.out).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {args.out}; gates: {out['gates']}")
+    return 0 if all(out["gates"].values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,140 @@
+"""CI smoke for the seeding tier + swarm capacity model (ISSUE 12).
+
+Two gates, both on one loopback box:
+
+1. **Chaos swarm** — ``bench_scale.bench_swarm`` at M=4 pullers x K=3
+   seeders with an injected fault mix (serving-side corruption, seeder
+   stalls, choke flaps, CDN 503s) over the production upload policy:
+
+   - swarm-wide ``peer_served_ratio >= 0.8`` — the seeding tier carries
+     the fleet even under faults;
+   - ``corrupt_bytes_admitted == 0`` — every pulled file byte-compared
+     against the fixture source (faults may slow the swarm, never
+     poison it);
+   - every fault named in the injected spec actually FIRED (a chaos
+     gate that never provokes anything passes for the wrong reason);
+   - at least one pull was answered (pulls_completed == M).
+
+2. **Rate enforcement** — a seeder configured via the real
+   ``ZEST_SEED_RATE_BPS`` env knob (through ``Config.load``, proving
+   the wiring, not just the field) serves a ~1.5 MB xorb to a direct
+   BT-wire fetch; the transfer must take at least 80% of the
+   token-bucket floor (bytes minus burst, over rate) and the bytes
+   must be exact — the knob is provably enforced within +-20%.
+
+Exit 0 on success; prints the offending block and fails otherwise.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+FAULT_SPEC = ("upload_corrupt:0.02,seeder_stall:0.05@0.3,"
+              "seeder_choke_flap:0.1,cdn_503:0.1")
+RATE_BPS = 1_500_000
+
+
+def check_swarm() -> None:
+    from zest_tpu.bench_scale import bench_swarm
+
+    r = bench_swarm(gb=0.032, m_pullers=4, k_seeders=3, scale=4,
+                    chunks_per_xorb=16, fault_spec=FAULT_SPEC,
+                    fault_seed=1337)
+    print(json.dumps(r, indent=1))
+    assert r["pulls_completed"] == 4, f"pulls failed: {r.get('errors')}"
+    assert r["corrupt_bytes_admitted"] == 0, (
+        f"CORRUPT BYTES ADMITTED: {r['corrupt_bytes_admitted']}")
+    ratio = r["peer_served_ratio"]
+    assert ratio is not None and ratio >= 0.8, (
+        f"peer_served_ratio {ratio} < 0.8 under the fault mix")
+    wanted = {clause.split(":")[0] for clause in FAULT_SPEC.split(",")}
+    fired = set(r["faults_fired"])
+    assert wanted <= fired, (
+        f"faults never fired: {sorted(wanted - fired)} "
+        f"(a chaos gate that provokes nothing proves nothing)")
+    skew = r["upload_fairness"]["skew"]
+    assert skew is not None and skew <= 2.0, (
+        f"upload fairness skew {skew} — one seeder is carrying the swarm")
+    print(f"swarm gate OK: ratio={ratio} skew={skew} "
+          f"faults={sorted(fired)}")
+
+
+def check_rate_enforced() -> None:
+    import os
+
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu import storage
+    from zest_tpu.cas import hashing
+    from zest_tpu.cas.xorb import XorbReader
+    from zest_tpu.config import Config
+    from zest_tpu.p2p import peer_id as peer_id_mod
+    from zest_tpu.p2p.peer import BtPeer
+    from zest_tpu.transfer.pull import pull_model
+    from zest_tpu.transfer.server import BtServer
+
+    import tempfile
+
+    files = {"config.json": b"{}",
+             "model.safetensors": os.urandom(1_500_000)}
+    repo = FixtureRepo("smoke/seed-rate", files, chunks_per_xorb=64)
+    with FixtureHub(repo) as hub, tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        env = dict(os.environ)
+        env.update({
+            "HF_HOME": str(rootp / "hf"),
+            "ZEST_CACHE_DIR": str(rootp / "zest"),
+            "HF_ENDPOINT": hub.url,
+            "HF_TOKEN": "hf_test",
+            "ZEST_LISTEN_PORT": "0",
+            "ZEST_SEED_RATE_BPS": str(RATE_BPS),
+        })
+        cfg = Config.load(env)
+        assert cfg.seed_rate_bps == RATE_BPS, "env knob not wired"
+        pull_model(cfg, "smoke/seed-rate", no_p2p=True,
+                   log=lambda *a, **k: None)
+        server = BtServer(cfg)
+        port = server.start()
+        try:
+            cache = storage.XorbCache(cfg)
+            key = max(storage.list_cached_xorbs(cfg),
+                      key=lambda k: len(cache.get(k) or b""))
+            blob = cache.get(key)
+            n = len(XorbReader(blob))
+            xorb_hash = hashing.hex_to_hash(key)
+            peer = BtPeer.connect(
+                "127.0.0.1", port,
+                peer_id_mod.compute_info_hash(xorb_hash),
+                peer_id_mod.generate())
+            try:
+                t0 = time.monotonic()
+                result = peer.request_chunk(xorb_hash, 0, n)
+                elapsed = time.monotonic() - t0
+            finally:
+                peer.close()
+        finally:
+            server.shutdown()
+        assert result.data == blob, "shaped transfer corrupted bytes"
+        floor = (len(blob) - RATE_BPS / 4) / RATE_BPS
+        assert elapsed >= 0.8 * floor, (
+            f"ZEST_SEED_RATE_BPS not enforced: {len(blob)}B in "
+            f"{elapsed:.3f}s (floor {floor:.3f}s)")
+        observed = len(blob) / elapsed
+        print(f"rate gate OK: {len(blob)}B in {elapsed:.3f}s = "
+              f"{observed / 1e6:.2f} MB/s vs knob {RATE_BPS / 1e6:.2f} "
+              f"MB/s (floor {floor:.3f}s)")
+
+
+def main() -> int:
+    check_swarm()
+    check_rate_enforced()
+    print("swarm chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

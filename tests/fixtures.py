@@ -211,39 +211,11 @@ class FixtureRepo:
         )
 
 
-class _TokenBucket:
-    """Global token-bucket shaper for the hub's CDN data plane.
-
-    Models a WAN-shaped origin: every connection draws from ONE bucket
-    (`rate_bps` across the whole hub, like a CDN egress allocation or a
-    saturated uplink), so N concurrent pullers share the rate instead
-    of each getting it — exactly the asymmetry the reference's tier-3
-    scenarios measure P2P against (DESIGN.md scenario table) and the
-    loopback harness couldn't reproduce (VERDICT r5 Missing #1).
-    Thread-safe; allows short bursts up to ~250 ms of rate so framing
-    overhead doesn't distort small responses."""
-
-    def __init__(self, rate_bps: int):
-        import time
-
-        self.rate = max(1, int(rate_bps))
-        self.capacity = max(64 * 1024, self.rate // 4)
-        self.tokens = float(self.capacity)
-        self._t = time.monotonic()
-        self._lock = threading.Lock()
-
-    def acquire(self, n: int) -> None:
-        import time
-
-        with self._lock:
-            now = time.monotonic()
-            self.tokens = min(self.capacity,
-                              self.tokens + (now - self._t) * self.rate)
-            self._t = now
-            self.tokens -= n
-            wait = -self.tokens / self.rate if self.tokens < 0 else 0.0
-        if wait > 0:
-            time.sleep(wait)
+# The hub's CDN shaper, promoted to production code (zest_tpu.shaping)
+# so the seeding server's upload policy, bench_scale, and the chaos
+# bench share one implementation; kept as a thin re-export for older
+# call sites.
+from zest_tpu.shaping import TokenBucket as _TokenBucket  # noqa: E402
 
 
 class FixtureHub:

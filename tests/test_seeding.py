@@ -1,0 +1,716 @@
+"""Seeding-tier upload policy tests (ISSUE 12).
+
+The serving half of "the package IS the seeder": rate shaping through
+the shared token bucket, choke/unchoke reciprocity over the health
+registry's served-bytes book, per-request deadlines with serving-side
+strike attribution, quarantine-aware content refusal, graceful drain,
+and the chaos fault sites that exercise all of it.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from zest_tpu import faults, storage
+from zest_tpu.cas import hashing
+from zest_tpu.config import Config
+from zest_tpu.p2p import peer_id as peer_id_mod
+from zest_tpu.p2p.health import PROVENANCE, ContentProvenance, HealthRegistry
+from zest_tpu.p2p.peer import (
+    BtPeer,
+    ContentRefusedError,
+    PeerChokedError,
+)
+from zest_tpu.shaping import TokenBucket
+from zest_tpu.transfer.pull import pull_model
+from zest_tpu.transfer.server import BtServer, _ChokeBook
+from zest_tpu.transfer.swarm import SwarmDownloader
+
+from fixtures import FixtureHub, FixtureRepo
+
+FILES = {
+    "config.json": b'{"model_type": "seedtest"}',
+    "model.safetensors": os.urandom(1_500_000),
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    # chunks_per_xorb high enough that the checkpoint lands as ONE big
+    # xorb (~1.5 MB): the shaping/drain tests need a transfer long
+    # enough to time, and the single-xorb shape is the worst case for
+    # fairness anyway.
+    repo = FixtureRepo("acme/seed-model", FILES, chunks_per_xorb=64)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.install(None)
+    PROVENANCE.reset()
+    yield
+    faults.install(None)
+    PROVENANCE.reset()
+
+
+def _cfg(hub, root, **kw):
+    return Config(
+        hf_home=root / "hf",
+        cache_dir=root / "zest",
+        hf_token="hf_test",
+        endpoint=hub.url,
+        listen_port=0,
+        **kw,
+    )
+
+
+def _warm_seeder(hub, root, **cfg_kw):
+    cfg = _cfg(hub, root, **cfg_kw)
+    pull_model(cfg, "acme/seed-model", no_p2p=True)
+    return cfg
+
+
+def _largest_cached_xorb(cfg):
+    cache = storage.XorbCache(cfg)
+    best, best_len = None, -1
+    for key in storage.list_cached_xorbs(cfg):
+        blob = cache.get(key)
+        if blob is not None and len(blob) > best_len:
+            best, best_len = key, len(blob)
+    return best
+
+
+# ── shaping.TokenBucket (promoted from tests/fixtures) ──
+
+
+def test_token_bucket_enforces_rate():
+    bucket = TokenBucket(1_000_000, capacity=50_000)
+    t0 = time.monotonic()
+    sent = 0
+    while sent < 400_000:
+        assert bucket.acquire(50_000)
+        sent += 50_000
+    elapsed = time.monotonic() - t0
+    # 400 KB minus the 50 KB burst at 1 MB/s >= ~0.35 s.
+    assert elapsed >= 0.25, f"rate not enforced: {elapsed:.3f}s"
+    assert elapsed < 2.0
+
+
+def test_token_bucket_give_up_rolls_back():
+    bucket = TokenBucket(10_000, capacity=1_000)
+    assert bucket.acquire(1_000)  # drain the burst
+    # 100k tokens at 10kB/s = 10s wait; a 50ms deadline must refuse...
+    assert not bucket.acquire(100_000,
+                              give_up_at=time.monotonic() + 0.05)
+    # ...and roll the debit back: a small acquire is near-instant again.
+    t0 = time.monotonic()
+    assert bucket.acquire(500)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_fixtures_reexport_is_the_shared_bucket():
+    import fixtures
+
+    assert fixtures._TokenBucket is TokenBucket
+
+
+# ── _ChokeBook (reciprocity ranking + optimistic rotation) ──
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_choke_book_all_unchoked_under_capacity():
+    book = _ChokeBook(slots=4, health=None)
+    for i in range(5):  # slots + 1
+        book.register(i, ("h", i))
+    assert all(book.slot(i) == "reciprocal" for i in range(5))
+    assert book.counts() == (5, 0)
+
+
+def test_choke_book_reciprocity_ranks_by_served_bytes():
+    clock = _Clock()
+    health = HealthRegistry(time_fn=clock)
+    book = _ChokeBook(slots=2, health=health, rechoke_s=10.0,
+                      time_fn=clock)
+    for i in range(5):
+        book.register(i, ("h", i))
+    health.record_success(("h", 3), nbytes=5_000_000)
+    health.record_success(("h", 1), nbytes=2_000_000)
+    clock.t += 11  # force a re-rank
+    assert book.slot(3) == "reciprocal"
+    assert book.slot(1) == "reciprocal"
+    unchoked, choked = book.counts()
+    assert (unchoked, choked) == (3, 2)  # 2 reciprocal + 1 optimistic
+    optimistic = [i for i in (0, 2, 4) if book.slot(i) == "optimistic"]
+    assert len(optimistic) == 1
+
+
+def test_choke_book_optimistic_slot_rotates():
+    clock = _Clock()
+    health = HealthRegistry(time_fn=clock)
+    book = _ChokeBook(slots=1, health=health, rechoke_s=5.0,
+                      time_fn=clock)
+    for i in range(4):
+        book.register(i, ("h", i))
+    health.record_success(("h", 0), nbytes=1_000_000)  # permanent winner
+    seen = set()
+    for _ in range(6):
+        clock.t += 6
+        for i in (1, 2, 3):
+            if book.slot(i) == "optimistic":
+                seen.add(i)
+    assert seen == {1, 2, 3}, f"rotation stuck: only {seen} got the slot"
+
+
+def test_choke_book_unregister_reranks():
+    book = _ChokeBook(slots=1, health=None)
+    for i in range(4):
+        book.register(i, ("h", i))
+    choked = [i for i in range(4) if book.slot(i) is None]
+    assert choked
+    for i in choked:
+        book.unregister(i)
+    remaining = [i for i in range(4) if i not in choked]
+    assert all(book.slot(i) is not None for i in remaining)
+
+
+# ── ContentProvenance ──
+
+
+def test_provenance_record_clear_and_bound():
+    book = ContentProvenance(capacity=3)
+    for i in range(5):
+        book.record(f"x{i}", ("peer", i))
+    assert len(book) == 3
+    assert book.source("x0") is None  # oldest aged out
+    assert book.source("x4") == ("peer", 4)
+    book.clear("x4")
+    assert book.source("x4") is None
+    book.record("y", None)  # no source, no entry
+    assert book.source("y") is None
+
+
+# ── Server integration (loopback) ──
+
+
+def test_default_knobs_preserve_loopback_pull(hub, tmp_path):
+    """Acceptance pin: with every seed knob unset the serving path is
+    behaviorally identical to the pre-policy server — a leecher pull is
+    all-peer, zero CDN xorbs, bytes exact."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    try:
+        leech = _cfg(hub, tmp_path / "leech")
+        swarm = SwarmDownloader(leech)
+        swarm.add_direct_peer("127.0.0.1", port)
+        try:
+            result = pull_model(leech, "acme/seed-model", swarm=swarm)
+        finally:
+            swarm.close()
+        for name, want in FILES.items():
+            assert (result.snapshot_dir / name).read_bytes() == want
+        assert result.stats["fetch"]["xorbs"]["cdn"] == 0
+        assert result.stats["fetch"]["bytes"]["peer"] > 0
+        # No seeding keys leak into PULL stats (serving economics are
+        # server-side state, surfaced via /v1/status).
+        assert "seeding" not in result.stats
+        st = server.get_stats()
+        assert st.chunks_served > 0
+        assert st.bytes_served > 0
+        assert st.refused_quarantined == 0
+        assert st.uploads_expired == 0
+    finally:
+        server.shutdown()
+
+
+def test_upload_rate_enforced_within_20pct(hub, tmp_path):
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_rate_bps = 1_500_000
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    try:
+        key = _largest_cached_xorb(seeder_cfg)
+        blob = storage.XorbCache(seeder_cfg).get(key)
+        assert len(blob) > 1_000_000, "fixture xorb too small to time"
+        from zest_tpu.cas.xorb import XorbReader
+
+        n = len(XorbReader(blob))
+        xorb_hash = hashing.hex_to_hash(key)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(xorb_hash),
+            peer_id_mod.generate(),
+        )
+        try:
+            t0 = time.monotonic()
+            result = peer.request_chunk(xorb_hash, 0, n)
+            elapsed = time.monotonic() - t0
+        finally:
+            peer.close()
+        assert result.data == blob
+        # Burst capacity is rate/4; the remainder must flow at the knob.
+        floor = (len(blob) - seeder_cfg.seed_rate_bps / 4) \
+            / seeder_cfg.seed_rate_bps
+        assert elapsed >= 0.8 * floor, (
+            f"shaping not enforced: {len(blob)}B in {elapsed:.3f}s "
+            f"(expected >= {floor:.3f}s)")
+    finally:
+        server.shutdown()
+
+
+def test_choke_flap_pull_survives_without_strikes(hub, tmp_path):
+    """A seeder that chokes every request (seeder_choke_flap at 1.0)
+    must cost the leecher nothing but a tier change: the pull completes
+    via CDN, the choked denials are counted distinctly, and the seeder
+    is NOT struck or quarantined — choking is policy, not failure."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    faults.install("seeder_choke_flap:1.0")
+    try:
+        leech = _cfg(hub, tmp_path / "leech")
+        swarm = SwarmDownloader(leech)
+        swarm.add_direct_peer("127.0.0.1", port)
+        try:
+            result = pull_model(leech, "acme/seed-model", swarm=swarm)
+        finally:
+            swarm.close()
+        for name, want in FILES.items():
+            assert (result.snapshot_dir / name).read_bytes() == want
+        assert result.stats["swarm"]["peer_choked"] > 0
+        assert result.stats["swarm"]["peers_quarantined"] == 0
+        assert result.stats["fetch"]["bytes"]["cdn"] > 0
+        addr = ("127.0.0.1", port)
+        assert not swarm.health.is_quarantined(addr)
+        detail = {r["peer"]: r for r in swarm.health.detail()}
+        row = detail.get(f"127.0.0.1:{port}")
+        assert row is None or row["strikes"] == 0
+        assert faults.counters().get("seeder_choke_flap", 0) > 0
+    finally:
+        faults.install(None)
+        server.shutdown()
+
+
+def test_seeder_stall_expires_without_blaming_reader(hub, tmp_path):
+    """seeder_stall past the request deadline: the upload slot frees
+    and the connection drops — but the reader is NOT struck, because
+    the stall was the server's own (an injected fault / its queue), not
+    the reader's. Misattribution here would quarantine healthy leechers
+    whenever the seeder itself is congested."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_request_deadline_s = 0.2
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    faults.install("seeder_stall:1.0@0.6")
+    try:
+        key = storage.list_cached_xorbs(seeder_cfg)[0]
+        xorb_hash = hashing.hex_to_hash(key)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(xorb_hash),
+            peer_id_mod.generate(),
+            listen_port=7777,  # our serving identity, for attribution
+        )
+        try:
+            with pytest.raises(Exception):  # conn dropped mid-protocol
+                peer.request_chunk(xorb_hash, 0, 1)
+        finally:
+            peer.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if server.get_stats().uploads_expired:
+                break
+            time.sleep(0.02)
+        st = server.get_stats()
+        assert st.uploads_expired >= 1
+        rows = {r["peer"]: r for r in server.health.detail()}
+        assert "127.0.0.1:7777" not in rows, (
+            f"reader blamed for the server's own stall: {rows}")
+        assert faults.counters().get("seeder_stall", 0) >= 1
+    finally:
+        faults.install(None)
+        server.shutdown()
+
+
+def test_stalled_reader_struck_with_distinct_kind(hub, tmp_path):
+    """A reader that stops draining its socket mid-upload (tiny RCVBUF,
+    never recv()s) times the send out at the request deadline: the
+    upload expires AND the reader is struck with ``stalled_reader`` —
+    the genuinely-their-fault case, visible in health.detail()."""
+    import socket as _socket
+
+    from zest_tpu.p2p import wire
+
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_request_deadline_s = 0.5
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    try:
+        key = _largest_cached_xorb(seeder_cfg)
+        blob = storage.XorbCache(seeder_cfg).get(key)
+        from zest_tpu.cas.xorb import XorbReader
+
+        n = len(XorbReader(blob))
+        xorb_hash = hashing.hex_to_hash(key)
+        sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        # A few KB of receive window: the ~1.5 MB response must block
+        # the server's send once our window + its buffer fill.
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+        sock.connect(("127.0.0.1", port))
+        stream = wire.SocketStream(sock)
+        try:
+            from zest_tpu.p2p import bep_xet
+            from zest_tpu.p2p.peer import LOCAL_UT_XET_ID
+
+            info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+            stream.send_handshake(info_hash, peer_id_mod.generate())
+            stream.recv_handshake()
+            stream.send_raw(wire.encode_extended(
+                0, bep_xet.make_ext_handshake(LOCAL_UT_XET_ID, 7778)))
+            stream.send_raw(bep_xet.encode_framed(
+                LOCAL_UT_XET_ID,
+                bep_xet.ChunkRequest(1, xorb_hash, 0, n)))
+            # ...and never read: the server's send must hit its
+            # deadline and attribute the stall to US.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if server.get_stats().uploads_expired:
+                    break
+                time.sleep(0.05)
+        finally:
+            stream.close()
+        assert server.get_stats().uploads_expired >= 1
+        rows = {r["peer"]: r for r in server.health.detail()}
+        row = rows.get("127.0.0.1:7778")
+        assert row is not None, f"no stalled-reader strike: {rows}"
+        assert row["strike_kinds"].get("stalled_reader", 0) >= 1
+    finally:
+        server.shutdown()
+
+
+def test_upload_corrupt_detected_healed_never_admitted(hub, tmp_path):
+    """Serving-side corruption (upload_corrupt at 1.0): every peer
+    response is poisoned — the leecher's verify tiers must reject at
+    the trust boundary, strike/quarantine the seeder, heal via CDN,
+    and land byte-exact files. corrupt-bytes-admitted == 0 is THE
+    seeding-tier invariant."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    faults.install("upload_corrupt:1.0")
+    try:
+        leech = _cfg(hub, tmp_path / "leech")
+        swarm = SwarmDownloader(leech)
+        swarm.add_direct_peer("127.0.0.1", port)
+        try:
+            result = pull_model(leech, "acme/seed-model", swarm=swarm)
+        finally:
+            swarm.close()
+        for name, want in FILES.items():
+            got = (result.snapshot_dir / name).read_bytes()
+            assert got == want, f"{name}: corrupt bytes admitted"
+        detected = (
+            result.stats["swarm"]["corrupt_from_peer"]
+            + result.stats["fetch"]["resilience"]["corrupt_from_peer"])
+        assert detected > 0, "corruption was never even detected"
+        assert faults.counters().get("upload_corrupt", 0) > 0
+    finally:
+        faults.install(None)
+        server.shutdown()
+
+
+def test_quarantined_source_content_refused(hub, tmp_path):
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    try:
+        keys = storage.list_cached_xorbs(seeder_cfg)
+        suspect, clean = keys[0], keys[1] if len(keys) > 1 else None
+        bad_peer = ("10.0.0.9", 6881)
+        PROVENANCE.record(suspect, bad_peer)
+        for _ in range(3):
+            server.health.record_failure(bad_peer, kind="corrupt")
+        assert server.health.is_quarantined(bad_peer)
+
+        xorb_hash = hashing.hex_to_hash(suspect)
+        peer = BtPeer.connect(
+            "127.0.0.1", port,
+            peer_id_mod.compute_info_hash(xorb_hash),
+            peer_id_mod.generate(),
+        )
+        try:
+            with pytest.raises(ContentRefusedError):
+                peer.request_chunk(xorb_hash, 0, 1)
+            if clean is not None:
+                # Unsuspected content still serves on the same conn.
+                from zest_tpu.cas.xorb import XorbReader
+
+                blob = storage.XorbCache(seeder_cfg).get(clean)
+                n = len(XorbReader(blob))
+                res = peer.request_chunk(hashing.hex_to_hash(clean), 0, n)
+                assert res.data == blob
+        finally:
+            peer.close()
+        assert server.get_stats().refused_quarantined == 1
+    finally:
+        server.shutdown()
+
+
+def test_refusal_degrades_to_cdn_in_full_pull(hub, tmp_path):
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    try:
+        bad_peer = ("10.0.0.9", 6881)
+        for key in storage.list_cached_xorbs(seeder_cfg):
+            PROVENANCE.record(key, bad_peer)
+        for _ in range(3):
+            server.health.record_failure(bad_peer, kind="corrupt")
+
+        leech = _cfg(hub, tmp_path / "leech")
+        swarm = SwarmDownloader(leech)
+        swarm.add_direct_peer("127.0.0.1", port)
+        try:
+            result = pull_model(leech, "acme/seed-model", swarm=swarm)
+        finally:
+            swarm.close()
+        for name, want in FILES.items():
+            assert (result.snapshot_dir / name).read_bytes() == want
+        assert result.stats["swarm"]["peer_refusals"] > 0
+        assert result.stats["fetch"]["bytes"]["cdn"] > 0
+        # A deliberate refusal is not a failure: the seeder stays clean.
+        assert not swarm.health.is_quarantined(("127.0.0.1", port))
+    finally:
+        server.shutdown()
+
+
+def test_graceful_drain_completes_inflight_upload(hub, tmp_path):
+    """Shutdown mid-upload: the in-flight response finishes whole
+    within the drain window — never a truncated-but-accepted blob."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_rate_bps = 1_500_000  # ~1s transfer: shutdown lands mid-flight
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    key = _largest_cached_xorb(seeder_cfg)
+    blob = storage.XorbCache(seeder_cfg).get(key)
+    from zest_tpu.cas.xorb import XorbReader
+
+    n = len(XorbReader(blob))
+    xorb_hash = hashing.hex_to_hash(key)
+    peer = BtPeer.connect(
+        "127.0.0.1", port,
+        peer_id_mod.compute_info_hash(xorb_hash), peer_id_mod.generate(),
+    )
+    got: list = [None]
+    err: list = [None]
+
+    def fetch():
+        try:
+            got[0] = peer.request_chunk(xorb_hash, 0, n)
+        except Exception as exc:  # noqa: BLE001 - asserted below
+            err[0] = exc
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    time.sleep(0.25)  # the shaped upload is now mid-frame
+    server.shutdown(drain_s=10.0)
+    t.join(timeout=15)
+    peer.close()
+    assert not t.is_alive()
+    assert err[0] is None, f"drained upload failed: {err[0]!r}"
+    assert got[0].data == blob, "drained upload delivered wrong bytes"
+    # And the listener really is closed.
+    import socket as _socket
+
+    with pytest.raises(OSError):
+        s = _socket.create_connection(("127.0.0.1", port), timeout=0.5)
+        s.close()
+        raise OSError("port still accepting")  # reached only if connect worked
+
+
+def test_abrupt_shutdown_never_truncates_accepted(hub, tmp_path):
+    """Even with drain_s=0 (abort), a cut upload surfaces as a WIRE
+    error at the puller, never as short-but-accepted data: the frame
+    length prefix makes truncation loud."""
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_rate_bps = 300_000  # slow enough to cut mid-frame
+    server = BtServer(seeder_cfg)
+    port = server.start()
+    key = _largest_cached_xorb(seeder_cfg)
+    blob = storage.XorbCache(seeder_cfg).get(key)
+    from zest_tpu.cas.xorb import XorbReader
+
+    n = len(XorbReader(blob))
+    xorb_hash = hashing.hex_to_hash(key)
+    peer = BtPeer.connect(
+        "127.0.0.1", port,
+        peer_id_mod.compute_info_hash(xorb_hash), peer_id_mod.generate(),
+    )
+    got: list = [None]
+    err: list = [None]
+
+    def fetch():
+        try:
+            got[0] = peer.request_chunk(xorb_hash, 0, n)
+        except Exception as exc:  # noqa: BLE001 - asserted below
+            err[0] = exc
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    time.sleep(0.3)
+    server.shutdown(drain_s=0.0)
+    t.join(timeout=15)
+    peer.close()
+    assert not t.is_alive()
+    if got[0] is not None:  # the send won the race: must be whole
+        assert got[0].data == blob
+    else:
+        assert err[0] is not None  # loud failure, not silent truncation
+
+
+# ── Surfaces ──
+
+
+def test_status_payload_seeding_block(hub, tmp_path):
+    from zest_tpu.api.http_api import HttpApi
+
+    seeder_cfg = _warm_seeder(hub, tmp_path / "seeder")
+    seeder_cfg.seed_rate_bps = 123_000
+    server = BtServer(seeder_cfg)
+    server.start()
+    try:
+        api = HttpApi(seeder_cfg, bt_server=server)
+        payload = api.status_payload()
+        seeding = payload["seeding"]
+        assert seeding["rate_bps"] == 123_000
+        assert seeding["slots"] == seeder_cfg.seed_slots
+        for field in ("active_leechers", "unchoked", "choked",
+                      "chunks_served", "bytes_served", "choke_events",
+                      "refused_quarantined", "uploads_expired"):
+            assert field in seeding
+    finally:
+        server.shutdown()
+
+
+def test_stats_watch_renders_seed_line():
+    from zest_tpu.cli import _stats_watch_lines
+
+    lines = _stats_watch_lines({}, {
+        "version": "t", "seeding": {
+            "active_leechers": 2, "unchoked": 2, "choked": 1,
+            "chunks_served": 7, "bytes_served": 12345,
+            "choke_events": 3, "refused_quarantined": 1,
+            "uploads_expired": 2, "rate_bps": 1000,
+        }})
+    seed = [ln for ln in lines if ln.startswith("seed:")]
+    assert seed, lines
+    assert "12345B in 7 chunks" in seed[0]
+    assert "unchoked=2/3" in seed[0]
+    assert "refused=1" in seed[0]
+    assert "rate=1000B/s" in seed[0]
+
+
+def test_seed_env_knobs_parse_and_raise():
+    env = {"ZEST_SEED_RATE_BPS": "1000000", "ZEST_SEED_PEER_BPS": "2000",
+           "ZEST_SEED_SLOTS": "3", "ZEST_SEED_DEADLINE_S": "1.5",
+           "ZEST_SEED_DRAIN_S": "2"}
+    cfg = Config.load(env)
+    assert cfg.seed_rate_bps == 1_000_000
+    assert cfg.seed_peer_bps == 2_000
+    assert cfg.seed_slots == 3
+    assert cfg.seed_request_deadline_s == 1.5
+    assert cfg.seed_drain_s == 2.0
+    # Unset = policy off / defaults.
+    cfg = Config.load({})
+    assert cfg.seed_rate_bps == 0
+    assert cfg.seed_peer_bps == 0
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_RATE_BPS": "fast"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_SLOTS": "many"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_DEADLINE_S": "soon"})
+    # A sign slip must raise, never silently mean "unshaped"/"tiny".
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_RATE_BPS": "-25000000"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_PEER_BPS": "-1"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_SLOTS": "0"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_DEADLINE_S": "-3"})
+    with pytest.raises(ValueError):
+        Config.load({"ZEST_SEED_DRAIN_S": "-1"})
+
+
+def test_tracker_uploaded_counter_reads_seed_metric():
+    """The announce's ``uploaded`` counter is live seeding economics:
+    TrackerClient reads zest_seed_bytes_total from the process registry
+    (the counter BtServer bumps per upload) with no extra plumbing."""
+    from zest_tpu import telemetry
+    from zest_tpu.p2p.tracker import TrackerClient
+
+    client = TrackerClient("http://tracker.invalid/announce", b"p" * 20)
+    base = client.uploaded_total()
+    telemetry.counter(
+        "zest_seed_bytes_total",
+        "Payload bytes served by the seeding tier, by unchoke slot kind",
+        ("peer_state",)).inc(4321, peer_state="reciprocal")
+    assert client.uploaded_total() == base + 4321
+    client.uploaded = 79  # out-of-process base stays additive
+    assert client.uploaded_total() == base + 4321 + 79
+
+
+def test_bench_swarm_tiny_end_to_end():
+    """The capacity model at toy scale: M=2 × K=2, fault mix on, shaped
+    seeders — swarm-wide ratio, fairness skew, zero corrupt admitted,
+    every fault fired."""
+    from zest_tpu.bench_scale import bench_swarm
+
+    r = bench_swarm(gb=0.008, m_pullers=2, k_seeders=2, scale=2,
+                    chunks_per_xorb=16,
+                    fault_spec="upload_corrupt:0.02,seeder_choke_flap:0.1",
+                    fault_seed=7)
+    assert r["pulls_completed"] == 2
+    assert r["corrupt_bytes_admitted"] == 0
+    assert r["peer_served_ratio"] is not None
+    assert r["peer_served_ratio"] >= 0.5
+    assert r["faults_fired"].get("seeder_choke_flap", 0) > 0
+    assert r["upload_fairness"]["skew"] is not None
+    assert r["pull_latency_s"]["p50"] is not None
+
+
+def test_token_bucket_refund_restores_tokens():
+    bucket = TokenBucket(10_000, capacity=1_000)
+    assert bucket.acquire(1_000)   # drain the burst
+    bucket.refund(1_000)
+    t0 = time.monotonic()
+    assert bucket.acquire(1_000)   # refunded: immediate again
+    assert time.monotonic() - t0 < 0.05
+    bucket.refund(10_000_000)      # clamped at capacity, never above
+    assert bucket.tokens <= bucket.capacity
+
+
+def test_provenance_multi_source_any_quarantined_refuses():
+    """One key can carry several unproven contributors; a later
+    recording must not displace an earlier peer's attribution, and
+    the refusal check is 'ANY source quarantined'."""
+    book = ContentProvenance()
+    book.record("xx", ("p1", 1))
+    book.record("xx", ("p2", 2))
+    book.record("xx", ("p1", 1))  # dedup: no growth
+    assert book.sources("xx") == (("p1", 1), ("p2", 2))
+    assert book.source("xx") == ("p2", 2)  # latest
+    h = HealthRegistry(strikes_to_quarantine=1)
+    h.record_failure(("p1", 1))
+    assert any(h.is_quarantined(s) for s in book.sources("xx"))

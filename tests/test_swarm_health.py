@@ -326,3 +326,165 @@ def test_successful_discovery_keeps_full_ttl(tmp_path):
     for _ in range(3):
         assert swarm.discover_peers(b"i" * 20) == [("peer", 1)]
     assert source.calls == 1
+
+
+# ── Reciprocity book, strike kinds, transition events (ISSUE 12) ──
+
+
+def test_served_bytes_accumulates_and_decays(reg, clock):
+    reg.record_success(A, nbytes=1_000_000)
+    assert reg.served_bytes(A) == pytest.approx(1_000_000)
+    reg.record_success(A, nbytes=500_000)
+    assert reg.served_bytes(A) == pytest.approx(1_500_000, rel=1e-3)
+    clock.t += 120.0  # one reciprocity tau: ~1/e remains
+    assert reg.served_bytes(A) == pytest.approx(1_500_000 / 2.718, rel=0.01)
+    assert reg.served_bytes(B) == 0.0  # stranger
+
+
+def test_strike_kinds_visible_in_detail(reg):
+    reg.record_failure(A, kind="seed_stall")
+    reg.record_failure(A, kind="corrupt")
+    reg.record_failure(B, kind="io")
+    rows = {r["peer"]: r for r in reg.detail()}
+    assert rows["a:1"]["strike_kinds"] == {"corrupt": 1, "seed_stall": 1}
+    assert rows["b:2"]["strike_kinds"] == {"io": 1}
+
+
+def test_transition_events_quarantine_then_probation(reg, clock):
+    events = []
+    reg.subscribe(lambda ev, addr: events.append((ev, addr)))
+    for _ in range(3):
+        reg.record_failure(A)
+    assert events == [("quarantined", A)]
+    # The window expires; the FIRST observation (a partition or
+    # is_quarantined query) flips the peer to probation — once.
+    clock.t += 10.1
+    reg.partition([A, B])
+    reg.partition([A])
+    assert events == [("quarantined", A), ("probation", A)]
+    # Probation re-admit semantics: one more strike re-quarantines.
+    assert reg.record_failure(A)
+    assert events[-1] == ("quarantined", A)
+
+
+def test_probation_success_clears_to_full_strikes(reg, clock):
+    for _ in range(3):
+        reg.record_failure(A)
+    clock.t += 10.1
+    assert not reg.is_quarantined(A)       # re-admitted on probation
+    reg.record_success(A, rtt_s=0.01)      # good behavior clears it
+    assert not reg.record_failure(A)       # 1 of 3 again, no trip
+    assert not reg.record_failure(A)
+    assert reg.record_failure(A)           # full K strikes needed anew
+
+
+def test_listener_exception_does_not_break_recording(reg):
+    reg.subscribe(lambda ev, addr: (_ for _ in ()).throw(RuntimeError()))
+    for _ in range(3):
+        reg.record_failure(A)              # must not raise
+    assert reg.is_quarantined(A)
+
+
+class RecordingSource:
+    def __init__(self):
+        self.announces = []
+
+    def find_peers(self, info_hash):
+        return []
+
+    def announce(self, info_hash, port):
+        self.announces.append((info_hash, port))
+
+
+def _eventually(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_quarantine_transition_triggers_reannounce(tmp_path, clock):
+    """Quarantine-aware announce: a breaker trip (and the later
+    probation re-admit) replays the announce for every swarm this host
+    registered with. The sweep is asynchronous — the observing thread
+    (a pull worker, a serve loop) must never block on tracker HTTP —
+    so the assertions poll."""
+    source = RecordingSource()
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    health = HealthRegistry(strikes_to_quarantine=2,
+                            quarantine_base_s=10.0, time_fn=clock)
+    swarm = SwarmDownloader(cfg, peer_sources=[source],
+                            pool=ScriptedPool(), health=health)
+    swarm.announce_available(XH, "aa")
+    base = len(source.announces)
+    assert base == 1
+
+    for _ in range(2):
+        health.record_failure(("bad", 9))
+    assert _eventually(                        # quarantine re-announce
+        lambda: len(source.announces) >= base + 1
+        and swarm.stats.reannounces == 1), (
+        source.announces, swarm.stats.reannounces)
+
+    clock.t += 10.1
+    health.partition([("bad", 9)])             # probation observation
+    assert _eventually(
+        lambda: len(source.announces) >= base + 2
+        and swarm.stats.reannounces == 2), (
+        source.announces, swarm.stats.reannounces)
+
+
+def test_reannounce_without_prior_announce_is_noop(tmp_path, clock):
+    source = RecordingSource()
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    health = HealthRegistry(strikes_to_quarantine=2,
+                            quarantine_base_s=10.0, time_fn=clock)
+    swarm = SwarmDownloader(cfg, peer_sources=[source],
+                            pool=ScriptedPool(), health=health)
+    for _ in range(2):
+        health.record_failure(("bad", 9))
+    time.sleep(0.1)  # nothing async should have been spawned either
+    assert source.announces == []
+    assert swarm.stats.reannounces == 0
+
+
+def test_io_timeout_after_lease_attributed_as_seed_stall(tmp_path):
+    """A peer that leases fine but times out mid-request stalled AS A
+    SEEDER — struck with the distinct seed_stall kind (health.detail()
+    separates 'serves, slowly-to-death' from 'unreachable'). A connect
+    failure stays kind 'error'."""
+    pool = ScriptedPool()
+    stall_peer = FakePeer(lambda *a: (_ for _ in ()).throw(
+        TimeoutError("stalled serving us")))
+    pool.scripts[("stall", 1)] = [stall_peer]
+    pool.scripts[("dead", 2)] = [ConnectionRefusedError("refused")]
+    swarm = _swarm(tmp_path, pool)
+    swarm.add_direct_peer("stall", 1)
+    assert swarm.try_peer_download(XH, "aa", 0, 1) is None
+    swarm.add_direct_peer("dead", 2)
+    assert swarm.try_peer_download(XH, "aa", 0, 1) is None
+    rows = {r["peer"]: r for r in swarm.health.detail()}
+    assert rows["stall:1"]["strike_kinds"].get("seed_stall", 0) >= 1
+    assert "error" not in rows["stall:1"]["strike_kinds"]
+    assert rows["dead:2"]["strike_kinds"] == {"error": 1}
+
+
+def test_close_unsubscribes_from_shared_registry(tmp_path, clock):
+    """A closed swarm must not keep re-announcing on a shared
+    registry's later transitions (zombie announces for a listen_port
+    nobody serves)."""
+    source = RecordingSource()
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    health = HealthRegistry(strikes_to_quarantine=2,
+                            quarantine_base_s=10.0, time_fn=clock)
+    swarm = SwarmDownloader(cfg, peer_sources=[source],
+                            pool=ScriptedPool(), health=health)
+    swarm.announce_available(XH, "aa")
+    swarm.close()
+    for _ in range(2):
+        health.record_failure(("bad", 9))
+    time.sleep(0.1)  # an async sweep would have landed by now
+    assert len(source.announces) == 1  # only the original announce
+    assert swarm.stats.reannounces == 0

@@ -182,6 +182,20 @@ class HttpApi:
             registry.gauge(
                 "zest_bt_active_peers",
                 "Active inbound BT-wire connections").set(bt.active_peers)
+            # Seeding-tier live state (ISSUE 12): who we feed and whom
+            # the reciprocity policy is currently holding back.
+            registry.gauge(
+                "zest_seed_active_leechers",
+                "Leechers connected to the seeding server"
+            ).set(bt.active_peers)
+            registry.gauge(
+                "zest_seed_choked_peers",
+                "Leechers currently choked by the upload policy"
+            ).set(bt.choked_peers)
+            registry.gauge(
+                "zest_seed_unchoked_peers",
+                "Leechers currently holding unchoke slots"
+            ).set(bt.unchoked_peers)
 
     @property
     def port(self) -> int:
@@ -205,6 +219,22 @@ class HttpApi:
             "listen_port": self.cfg.listen_port,
             "http_port": self.port,
         }
+        if bt is not None:
+            # Seeding economics (ISSUE 12): the upload policy's live
+            # view — slots, choke churn, refusals, shaped-rate knobs.
+            payload["seeding"] = {
+                "active_leechers": bt.active_peers,
+                "unchoked": bt.unchoked_peers,
+                "choked": bt.choked_peers,
+                "chunks_served": bt.chunks_served,
+                "bytes_served": bt.bytes_served,
+                "choke_events": bt.choke_events,
+                "refused_quarantined": bt.refused_quarantined,
+                "uploads_expired": bt.uploads_expired,
+                "rate_bps": self.cfg.seed_rate_bps or None,
+                "peer_bps": self.cfg.seed_peer_bps or None,
+                "slots": self.cfg.seed_slots,
+            }
         if self.dcn_server is not None and self.dcn_server.port is not None:
             d = self.dcn_server.stats
             payload["dcn"] = {
@@ -892,6 +922,15 @@ async function tick(){
   if(c.exchange_wall_s!=null)
    crows.push(['exchange_wall_s',c.exchange_wall_s]);
   if(c.fallbacks!=null) crows.push(['fallbacks',c.fallbacks]);
+  // Seeding line (ISSUE 12): upload policy at a glance — served bytes,
+  // unchoked/choked split, refusals of quarantined-source content.
+  const SD=s.seeding||{};
+  if(SD.chunks_served!=null)
+   crows.push(['seeding',SD.bytes_served.toLocaleString()+' B in '
+    +SD.chunks_served+' chunks; unchoked '+SD.unchoked+'/'
+    +(SD.unchoked+SD.choked)+(SD.refused_quarantined?
+    '; refused '+SD.refused_quarantined:'')+(SD.rate_bps?
+    '; shaped '+SD.rate_bps+' B/s':'')]);
   const q=(d.quarantined_peers||[]).map(p=>p.peer).join(', ');
   if(crows.length||q) crows.push(['quarantined',q||'none']);
   document.getElementById('coop').innerHTML=crows.map(([k,v])=>

@@ -49,7 +49,7 @@ import time
 import numpy as np
 
 __all__ = ["llama_checkpoint_files", "mutate_tensors", "bench_gb_pull",
-           "bench_coop_pull", "bench_delta_pull"]
+           "bench_coop_pull", "bench_delta_pull", "bench_swarm"]
 
 
 def mutate_tensors(tensors: dict, fraction: float, seed: int = 1) -> None:
@@ -397,6 +397,193 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
                       if coop_wall > 0 else None)
     if errors:
         out["errors"] = errors
+    return out
+
+
+def bench_swarm(gb: float = 0.064, m_pullers: int = 4, k_seeders: int = 4,
+                fault_spec: str | None = None, fault_seed: int = 1337,
+                shaped_bps: int | None = None,
+                seed_rate_bps: int | None = None,
+                seed_peer_bps: int | None = None,
+                seed_slots: int | None = None,
+                chunks_per_xorb: int = 16, scale: int = 8) -> dict:
+    """Fleet-scale chaos capacity model (ROADMAP item 4, ISSUE 12).
+
+    M concurrent pullers × K always-on seeders × an injected
+    ``ZEST_FAULTS`` matrix × shaped links — the swarm the ≥90%
+    peer-served BASELINE claim must survive OUTSIDE loopback-perfect
+    conditions. Phases:
+
+    1. **Warm** (unshaped, unmetered): each seeder pulls the checkpoint
+       via CDN once — the steady-state fleet where every node already
+       seeds what it cached.
+    2. **Measured**: the CDN re-opens behind a global
+       ``shaped_bps`` token bucket (one WAN-rate origin for everyone),
+       each seeder serves through the production upload policy
+       (``seed_rate_bps``/``seed_peer_bps``/``seed_slots`` — the
+       ZEST_SEED_* knobs), the fault matrix arms, and M pullers race
+       concurrent full pulls with all K seeders as direct peers
+       (candidate order rotated per puller so load spreads by policy,
+       not by list position).
+
+    Reported: swarm-wide ``peer_served_ratio`` (sum of peer bytes over
+    peer+cdn), per-pull p50/p99 wall, ``upload_fairness_skew``
+    (max/mean of per-seeder served bytes — the choke policy's
+    worst-case concentration), ``corrupt_bytes_admitted`` (every pulled
+    file byte-compared against the fixture source — MUST be 0: faults
+    may slow the swarm, never poison it), corruption detections/heals,
+    and the fired-fault counters proving the matrix actually ran."""
+    import tempfile as _tempfile
+    import threading
+
+    from zest_tpu import faults
+    from zest_tpu.config import Config
+    from zest_tpu.p2p.health import PROVENANCE
+    from zest_tpu.transfer.pull import pull_model
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    fixtures = _import_fixtures()
+    repo_id = "bench/swarm-llama"
+    files = llama_checkpoint_files(gb, scale=scale, smooth=True,
+                                   shard_bytes=16 * 1024 * 1024)
+    total = sum(len(b) for b in files.values())
+    repo = fixtures.FixtureRepo(repo_id, files,
+                                chunks_per_xorb=chunks_per_xorb)
+    quiet = {"log": lambda *a, **k: None}
+
+    out: dict = {
+        "model_bytes": total,
+        "pullers": m_pullers,
+        "seeders": k_seeders,
+        "cdn_bps": shaped_bps,
+        "seed_rate_bps": seed_rate_bps,
+        "seed_peer_bps": seed_peer_bps,
+        "faults": fault_spec,
+        "fault_seed": fault_seed if fault_spec else None,
+    }
+    with _tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+
+        def seeder_cfg(i: int) -> Config:
+            cfg = Config(hf_home=rootp / f"seed{i}/hf",
+                         cache_dir=rootp / f"seed{i}/zest",
+                         hf_token="hf_test", endpoint="unused",
+                         listen_port=0)
+            if seed_rate_bps:
+                cfg.seed_rate_bps = seed_rate_bps
+            if seed_peer_bps:
+                cfg.seed_peer_bps = seed_peer_bps
+            if seed_slots:
+                cfg.seed_slots = seed_slots
+            return cfg
+
+        # Phase 1: warm the seeder fleet against an UNSHAPED origin
+        # (fleet steady state is not what's being measured).
+        scfgs = [seeder_cfg(i) for i in range(k_seeders)]
+        with fixtures.FixtureHub(repo) as warm_hub:
+            for cfg in scfgs:
+                cfg.endpoint = warm_hub.url
+                pull_model(cfg, repo_id, no_p2p=True, **quiet)
+
+        servers = [BtServer(cfg) for cfg in scfgs]
+        ports = [s.start() for s in servers]
+        PROVENANCE.reset()
+        faults.install(fault_spec, fault_seed)
+        walls: list[float] = [0.0] * m_pullers
+        stats: list[dict | None] = [None] * m_pullers
+        corrupt_admitted = [0] * m_pullers
+        errors: list[str] = []
+
+        try:
+            with fixtures.FixtureHub(repo,
+                                     throttle_bps=shaped_bps) as hub:
+                def pull_run(i: int) -> None:
+                    cfg = Config(hf_home=rootp / f"pull{i}/hf",
+                                 cache_dir=rootp / f"pull{i}/zest",
+                                 hf_token="hf_test", endpoint=hub.url)
+                    swarm = SwarmDownloader(cfg)
+                    for j in range(k_seeders):
+                        k = (i + j) % k_seeders
+                        swarm.add_direct_peer("127.0.0.1", ports[k])
+                    t0 = time.perf_counter()
+                    try:
+                        res = pull_model(cfg, repo_id, swarm=swarm,
+                                         **quiet)
+                        walls[i] = time.perf_counter() - t0
+                        stats[i] = res.stats
+                        for name, want in files.items():
+                            got = (res.snapshot_dir / name).read_bytes()
+                            if got != want:
+                                corrupt_admitted[i] += sum(
+                                    a != b for a, b in zip(got, want)
+                                ) + abs(len(got) - len(want))
+                    except Exception as exc:  # noqa: BLE001 - reported
+                        errors.append(f"puller {i}: {exc}")
+                    finally:
+                        swarm.close()
+
+                threads = [threading.Thread(target=pull_run, args=(i,))
+                           for i in range(m_pullers)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                swarm_wall = time.perf_counter() - t0
+            fired = faults.counters()
+        finally:
+            faults.install(None)
+            for s in servers:
+                s.shutdown()
+
+        seeder_bytes = [s.get_stats().bytes_served for s in servers]
+        seeder_stats = [s.get_stats() for s in servers]
+        done = [s for s in stats if s]
+        peer = sum(s["fetch"]["bytes"].get("peer", 0) for s in done)
+        cdn = sum(s["fetch"]["bytes"].get("cdn", 0) for s in done)
+        ok_walls = sorted(w for w, s in zip(walls, stats) if s)
+        mean_served = (sum(seeder_bytes) / len(seeder_bytes)
+                       if seeder_bytes else 0)
+        out.update({
+            "swarm_wall_s": round(swarm_wall, 3),
+            "pulls_completed": len(done),
+            "peer_served_ratio": (round(peer / (peer + cdn), 4)
+                                  if peer + cdn else None),
+            "pull_latency_s": {
+                "p50": round(ok_walls[len(ok_walls) // 2], 3)
+                if ok_walls else None,
+                "p99": round(ok_walls[min(len(ok_walls) - 1,
+                                          int(len(ok_walls) * 0.99))], 3)
+                if ok_walls else None,
+            },
+            "upload_fairness": {
+                "per_seeder_bytes": seeder_bytes,
+                "skew": (round(max(seeder_bytes) / mean_served, 3)
+                         if mean_served else None),
+            },
+            "corrupt_bytes_admitted": sum(corrupt_admitted),
+            # The swarm counter alone: every peer-attributed detection
+            # lands there via report_corrupt (the bridge's own
+            # resilience counter records the SAME events — summing
+            # both would double-count).
+            "corrupt_detected": sum(
+                s.get("swarm", {}).get("corrupt_from_peer", 0)
+                for s in done),
+            "choke_events": sum(s.choke_events for s in seeder_stats),
+            "uploads_expired": sum(s.uploads_expired
+                                   for s in seeder_stats),
+            "refused_quarantined": sum(s.refused_quarantined
+                                       for s in seeder_stats),
+            "faults_fired": dict(sorted(fired.items())),
+        })
+        if seed_rate_bps and ok_walls:
+            # Observed per-seeder upload rate vs the knob — the smoke
+            # gate's ±20% enforcement evidence.
+            out["upload_fairness"]["observed_bps"] = [
+                round(b / swarm_wall) for b in seeder_bytes]
+        if errors:
+            out["errors"] = errors
     return out
 
 

@@ -379,13 +379,30 @@ def cmd_serve(args) -> int:
     from zest_tpu.transfer.dcn import DcnServer
     from zest_tpu.transfer.server import BtServer
 
+    from zest_tpu.p2p.health import HealthRegistry
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
     registry = storage.XorbRegistry()
     n = registry.scan(cfg)
     print(f"indexed {n} cached xorbs")
 
-    bt = BtServer(cfg)
+    # One health registry for the whole daemon: the serving tier's
+    # reciprocity/unchoke ranking, stalled-reader strikes, and the
+    # quarantine oracle behind source-refusal all read/write the same
+    # book the pull side writes. The shared SwarmDownloader below is
+    # what feeds it — HttpApi threads it into every /v1/pull
+    # (pull_model(swarm=)), so bytes peers serve US rank their unchoke
+    # slots and a peer quarantined mid-pull is refused by the server.
+    health = HealthRegistry()
+    swarm = SwarmDownloader(cfg, health=health)
+    bt = BtServer(cfg, health=health)
     port = bt.start()
-    print(f"seeding on :{port} (BT wire)")
+    shaped = ""
+    if cfg.seed_rate_bps or cfg.seed_peer_bps:
+        shaped = (f", shaped {cfg.seed_rate_bps or '∞'} B/s global"
+                  f" / {cfg.seed_peer_bps or '∞'} B/s per-peer")
+    print(f"seeding on :{port} (BT wire, {cfg.seed_slots}+1 upload "
+          f"slots{shaped})")
 
     # Same cache, second transport: the lean chunk RPC other zest hosts
     # use across DCN (foreign BT clients keep the wire protocol above).
@@ -399,7 +416,7 @@ def cmd_serve(args) -> int:
 
     _write_pid_file(cfg)
     api = HttpApi(cfg, bt_server=bt, registry=registry,
-                  dcn_server=dcn_server)
+                  dcn_server=dcn_server, swarm=swarm)
     api.start()
     # Record the BOUND port (http_port=0 binds ephemeral): status/stop/
     # the Python client resolve it via Config.effective_http_port.
@@ -426,6 +443,7 @@ def cmd_serve(args) -> int:
         api.close()
         dcn_server.shutdown()
         bt.shutdown()
+        swarm.close()
         _remove_pid_file(cfg)
     return 0
 
@@ -595,6 +613,21 @@ def _stats_watch_lines(debug: dict, status: dict) -> list[str]:
             + (f"  fallbacks={coop['fallbacks']}"
                if "fallbacks" in coop else "")
             + (f"  [{tiers}]" if tiers else ""))
+    seeding = status.get("seeding") or {}
+    if seeding.get("chunks_served") or seeding.get("active_leechers"):
+        sline = (f"seed: {seeding.get('bytes_served', 0)}B in "
+                 f"{seeding.get('chunks_served', 0)} chunks  "
+                 f"unchoked={seeding.get('unchoked', 0)}"
+                 f"/{seeding.get('unchoked', 0) + seeding.get('choked', 0)}")
+        if seeding.get("choke_events"):
+            sline += f"  choke_events={seeding['choke_events']}"
+        if seeding.get("refused_quarantined"):
+            sline += f"  refused={seeding['refused_quarantined']}"
+        if seeding.get("uploads_expired"):
+            sline += f"  expired={seeding['uploads_expired']}"
+        if seeding.get("rate_bps"):
+            sline += f"  rate={seeding['rate_bps']}B/s"
+        lines.append(sline)
     quarantined = debug.get("quarantined_peers") or []
     if quarantined:
         lines.append("quarantined: "

@@ -81,6 +81,20 @@ DEFAULT_LAND_RING_BYTES = 512 * 1024 * 1024
 # tensors; bytes are the binding constraint for checkpoint-shaped
 # tensors.
 DEFAULT_LAND_RING_SLOTS = 64
+# Seeding tier (transfer.server, ISSUE 12): the upload policy of the
+# always-on seeder. ZEST_SEED_RATE_BPS caps this host's TOTAL upload
+# rate (one shaping.TokenBucket across every leecher; 0 = unshaped),
+# ZEST_SEED_PEER_BPS caps any single leecher (fairness under one
+# aggressive puller; 0 = unshaped). ZEST_SEED_SLOTS is the reciprocity
+# K: the K peers that served US the most bytes recently hold unchoke
+# slots, plus ONE optimistic-unchoke rotation slot (BEP-XET heritage);
+# it also bounds concurrent in-flight uploads (K+1 transfer slots).
+# ZEST_SEED_DEADLINE_S bounds one chunk response end-to-end so a
+# stalled reader can't pin an upload slot; ZEST_SEED_DRAIN_S bounds the
+# graceful-shutdown drain of in-flight responses.
+DEFAULT_SEED_SLOTS = 8
+DEFAULT_SEED_DEADLINE_S = 30.0
+DEFAULT_SEED_DRAIN_S = 5.0
 # Delta pulls (transfer.delta, ISSUE 10): with 1 (default) every pull
 # persists a revision manifest and a pull of revision B over a cached
 # revision A plans a chunk-level delta — unchanged bytes serve from the
@@ -132,6 +146,36 @@ def _opt_pos_float(env: dict[str, str], name: str) -> float | None:
         raise ValueError(f"{name} must be a finite value >= 0 "
                          f"(0 = unarmed), got {raw!r}")
     return v if v > 0 else None
+
+
+def _strict_nonneg_int(env: dict[str, str], name: str,
+                       default: int = 0, floor: int = 0) -> int:
+    """Integer knob where a NEGATIVE value raises instead of silently
+    clamping to ``floor`` — the seed-rate sign-slip discipline: a
+    mistyped ``ZEST_SEED_RATE_BPS=-25000000`` silently meaning
+    "unshaped" would pass every test while the fleet saturates
+    uplinks (same rationale as _opt_pos_float)."""
+    raw = env.get(name)
+    if raw is None or not raw.strip():
+        return default
+    v = int(raw)
+    if v < floor:
+        raise ValueError(f"{name} must be an integer >= {floor}, "
+                         f"got {raw!r}")
+    return v
+
+
+def _strict_pos_float(env: dict[str, str], name: str,
+                      default: float, floor: float = 0.0) -> float:
+    """Float knob; values below ``floor`` (or non-finite) raise."""
+    raw = env.get(name)
+    if raw is None or not raw.strip():
+        return default
+    v = float(raw)
+    if v < floor or not math.isfinite(v):
+        raise ValueError(f"{name} must be a finite value >= {floor}, "
+                         f"got {raw!r}")
+    return v
 
 
 def _strict_bool(name: str, value: str) -> bool:
@@ -219,6 +263,12 @@ class Config:
     land_stream: bool = DEFAULT_LAND_STREAM
     land_ring_bytes: int = DEFAULT_LAND_RING_BYTES
     land_ring_slots: int = DEFAULT_LAND_RING_SLOTS
+    # Seeding-tier upload policy (see DEFAULT_SEED_* above).
+    seed_rate_bps: int = 0
+    seed_peer_bps: int = 0
+    seed_slots: int = DEFAULT_SEED_SLOTS
+    seed_request_deadline_s: float = DEFAULT_SEED_DEADLINE_S
+    seed_drain_s: float = DEFAULT_SEED_DRAIN_S
     # Delta pulls (see DEFAULT_DELTA above).
     delta_pull: bool = DEFAULT_DELTA
     # Background materialization lane (see DEFAULT_FILES_* above).
@@ -334,6 +384,18 @@ class Config:
             land_ring_slots=max(1, int(
                 env.get("ZEST_LAND_RING_SLOTS",
                         DEFAULT_LAND_RING_SLOTS))),
+            # Seeding knobs: malformed AND negative values raise — a
+            # sign-slipped rate silently meaning "unshaped" would pass
+            # every test while the fleet saturates uplinks.
+            seed_rate_bps=_strict_nonneg_int(env, "ZEST_SEED_RATE_BPS"),
+            seed_peer_bps=_strict_nonneg_int(env, "ZEST_SEED_PEER_BPS"),
+            seed_slots=_strict_nonneg_int(
+                env, "ZEST_SEED_SLOTS", DEFAULT_SEED_SLOTS, floor=1),
+            seed_request_deadline_s=_strict_pos_float(
+                env, "ZEST_SEED_DEADLINE_S", DEFAULT_SEED_DEADLINE_S,
+                floor=0.1),
+            seed_drain_s=_strict_pos_float(
+                env, "ZEST_SEED_DRAIN_S", DEFAULT_SEED_DRAIN_S),
             # Strict like ZEST_LAND_STREAM: ZEST_DELTA is the delta
             # rollback knob — "false"/a typo must raise, never silently
             # keep deltas on.

@@ -28,6 +28,17 @@ Registered fault names (injection sites):
 ``cdn_503``         ``CasClient`` GET observes an injected 503
 ``cdn_reset``       ``CasClient`` GET raises a connection reset
 ``dcn_reset``       ``DcnChannel.send_request`` dies mid-channel
+``seeder_stall``    ``BtServer._respond`` sleeps *arg* seconds (2.0)
+                    mid-upload — the per-request deadline must free the
+                    slot WITHOUT blaming the reader (the server
+                    stalled); pullers that time out on a leased peer
+                    strike it as ``seed_stall``
+``seeder_choke_flap``  ``_ChokeBook.slot`` reports a spurious one-query
+                    choke — the requester's swarm must move on without
+                    a strike and the pull must still complete
+``upload_corrupt``  ``BtServer._respond`` flips a byte in the served
+                    payload — the puller's verify tiers must reject it
+                    (corrupt-bytes-admitted stays 0) and heal via CDN
 ==================  =====================================================
 
 Determinism: each fault keeps a monotonically increasing trial counter;

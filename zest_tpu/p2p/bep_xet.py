@@ -24,6 +24,12 @@ from zest_tpu.version import CLIENT_STRING
 
 EXTENSION_NAME = b"ut_xet"
 
+# CHUNK_ERROR codes the seeding tier emits (the wire format leaves
+# codes free-form; these two are load-bearing for the requester's
+# candidate handling — see transfer.swarm):
+ERR_CHOKED = 1         # upload policy denied a slot — peer healthy, retry elsewhere
+ERR_NOT_AVAILABLE = 2  # content refused (quarantined source) — loud, never served
+
 
 class XetMessageError(ValueError):
     pass

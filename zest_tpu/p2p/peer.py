@@ -38,6 +38,21 @@ class ChunkNotFoundError(PeerError):
     """Peer answered CHUNK_NOT_FOUND — connection stays healthy."""
 
 
+class PeerChokedError(PeerError):
+    """Peer answered CHUNK_ERROR(CHOKED): its upload policy denied us a
+    slot right now. The peer is healthy and HAS the data — the swarm
+    moves to the next candidate without a health strike (striking a
+    seeder for enforcing fairness would quarantine the whole tier under
+    load)."""
+
+
+class ContentRefusedError(ChunkNotFoundError):
+    """Peer answered CHUNK_ERROR(NOT_AVAILABLE): it is refusing to serve
+    this content (quarantined-source bytes it cannot vouch for). Treated
+    like CHUNK_NOT_FOUND — healthy peer, no strike, next tier serves —
+    but kept distinct so stats/triage show the refusal was deliberate."""
+
+
 @dataclass(frozen=True)
 class ChunkResult:
     data: bytes
@@ -237,6 +252,13 @@ class BtPeer:
                     "peer does not have chunk", xet.request_id
                 )
             if isinstance(xet, bep_xet.ChunkError):
+                if xet.error_code == bep_xet.ERR_CHOKED:
+                    raise PeerChokedError(
+                        "peer choked us", xet.request_id)
+                if xet.error_code == bep_xet.ERR_NOT_AVAILABLE:
+                    raise ContentRefusedError(
+                        "peer refused content (quarantined source)",
+                        xet.request_id)
                 raise PeerError(
                     f"peer error {xet.error_code}: "
                     f"{xet.message.decode(errors='replace')}"

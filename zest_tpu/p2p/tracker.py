@@ -105,6 +105,30 @@ class TrackerClient:
         self.listen_port = listen_port
         self.timeout = timeout
         self.last_interval = 1800
+        # Seeding-tier accounting (ISSUE 12): every announce's
+        # ``uploaded`` counter reports this process' seed-served bytes
+        # so the tracker's economics view sees the host as the seeder
+        # it is. The number is read live from the process metrics
+        # registry (``zest_seed_bytes_total`` — the counter BtServer
+        # bumps per upload), so it needs no plumbing between the server
+        # and whichever swarm/CLI constructed this client; ``uploaded``
+        # is an additive base for callers with out-of-process counts.
+        # Quarantine/probation transitions re-announce through the same
+        # path (transfer.swarm subscribes to the health registry and
+        # replays ``announce`` per registered swarm), so the refreshed
+        # registration carries current counters too.
+        self.uploaded = 0
+
+    def uploaded_total(self) -> int:
+        """``uploaded`` base + the live seeding counter."""
+        from zest_tpu import telemetry
+
+        served = 0
+        for m in telemetry.REGISTRY.metrics():
+            if m.name == "zest_seed_bytes_total":
+                served = int(sum(v for _labels, v in m.samples()))
+                break
+        return self.uploaded + served
 
     def announce_event(
         self,
@@ -130,12 +154,14 @@ class TrackerClient:
 
     def find_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
         try:
-            return self.announce_event(info_hash, self.listen_port).peers
+            return self.announce_event(info_hash, self.listen_port,
+                                       uploaded=self.uploaded_total()).peers
         except TrackerError:
             return []
 
     def announce(self, info_hash: bytes, port: int) -> None:
         try:
-            self.announce_event(info_hash, port, Event.STARTED)
+            self.announce_event(info_hash, port, Event.STARTED,
+                                uploaded=self.uploaded_total())
         except TrackerError:
             pass  # announce is best-effort; CDN fallback keeps pulls alive

@@ -26,6 +26,7 @@ from zest_tpu.cas.client import CasClient
 from zest_tpu.cas.hub import HubClient
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.config import Config
+from zest_tpu.p2p.health import PROVENANCE
 from zest_tpu.storage import XorbCache
 
 # Process-wide mirrors of the per-session FetchStats: the session object
@@ -337,6 +338,22 @@ class XetBridge:
                         rec, hash_hex, peer_result.chunk_offset,
                         peer_result.data,
                     )
+                    # Provenance for the seeding tier (ISSUE 12): a blob
+                    # admitted WITHOUT a whole-xorb merkle proof keeps
+                    # its source on record, so the server can refuse to
+                    # re-serve it if that peer is later quarantined.
+                    # Clearing uses the EVIDENCE-GATED predicate (same
+                    # as the cache write above): under
+                    # evidence_incomplete even a root-verified blob is
+                    # cached under a partial key and does NOT displace
+                    # other peers' unproven ranges — their suspicion
+                    # must survive.
+                    if self.whole_xorb_provable(
+                            self._known_entries(rec, hash_hex),
+                            peer_result.chunk_offset):
+                        PROVENANCE.clear(hash_hex)
+                    else:
+                        PROVENANCE.record(hash_hex, peer_result.addr)
                     return XorbFetchResult(
                         peer_result.data, local_start, local_end,
                         source="peer", peer_addr=peer_result.addr,
@@ -414,6 +431,14 @@ class XetBridge:
             sp.add_bytes(len(data))
         self.stats.record("cdn", len(data))
         self._cache_fetched(rec, hash_hex, fi.range.start, data)
+        # Clear suspicion only when this CDN write provably replaced the
+        # WHOLE xorb (the full cache key): a partial-range refetch
+        # leaves other peer-sourced ranges of the same xorb in cache,
+        # and wiping the book would let the server re-serve them after
+        # their source is quarantined.
+        if self.whole_xorb_provable(self._known_entries(rec, hash_hex),
+                                    fi.range.start):
+            PROVENANCE.clear(hash_hex)
         if self.swarm is not None:
             self.swarm.announce_available(term.xorb_hash, hash_hex)
         return XorbFetchResult(

@@ -33,7 +33,12 @@ from zest_tpu import faults, telemetry
 from zest_tpu.config import Config
 from zest_tpu.p2p import peer_id as peer_id_mod
 from zest_tpu.p2p.health import HealthRegistry
-from zest_tpu.p2p.peer import ChunkNotFoundError, PeerError
+from zest_tpu.p2p.peer import (
+    ChunkNotFoundError,
+    ContentRefusedError,
+    PeerChokedError,
+    PeerError,
+)
 from zest_tpu.p2p.pool import PeerPool
 
 DISCOVERY_TTL_S = 30.0
@@ -65,11 +70,14 @@ class SwarmStats:
     peer_attempts: int = 0
     peer_failures: int = 0
     peer_retries: int = 0          # stale-pooled-socket reconnect retries
+    peer_choked: int = 0           # upload-policy denials (no strike)
+    peer_refusals: int = 0         # quarantined-source refusals (no strike)
     peers_quarantined: int = 0     # circuit-breaker trips
     corrupt_from_peer: int = 0     # corruption attributions from the bridge
     chunks_from_peers: int = 0
     bytes_from_peers: int = 0
     announces: int = 0
+    reannounces: int = 0           # quarantine/probation-driven re-announces
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def bump(self, name: str, amount: int = 1) -> None:
@@ -86,11 +94,14 @@ class SwarmStats:
             "peer_attempts": self.peer_attempts,
             "peer_failures": self.peer_failures,
             "peer_retries": self.peer_retries,
+            "peer_choked": self.peer_choked,
+            "peer_refusals": self.peer_refusals,
             "peers_quarantined": self.peers_quarantined,
             "corrupt_from_peer": self.corrupt_from_peer,
             "chunks_from_peers": self.chunks_from_peers,
             "bytes_from_peers": self.bytes_from_peers,
             "announces": self.announces,
+            "reannounces": self.reannounces,
         }
 
 
@@ -122,6 +133,14 @@ class SwarmDownloader:
             bytes, tuple[float, list[tuple[str, int]], float]
         ] = {}
         self._discovery_lock = threading.Lock()
+        # Quarantine-aware announce (ISSUE 12): circuit-breaker
+        # transitions change what this host effectively offers/uses, so
+        # every swarm it has announced to gets a refresh — the tracker's
+        # peer list must not keep routing leechers through a hole.
+        self._announced: set[bytes] = set()
+        self._reannounce_lock = threading.Lock()
+        self._reannounce_pending = False
+        self.health.subscribe(self._on_health_transition)
 
     def add_direct_peer(self, host: str, port: int) -> None:
         """--peer flag path: tried before discovered peers (swarm.zig:279-314)."""
@@ -130,6 +149,10 @@ class SwarmDownloader:
             self.direct_peers.append(addr)
 
     def close(self) -> None:
+        # Detach from the (possibly shared, longer-lived) health
+        # registry first: a closed swarm must not keep re-announcing on
+        # its transitions or be pinned in memory by the listener ref.
+        self.health.unsubscribe(self._on_health_transition)
         self.pool.close_all()
 
     def summary(self) -> dict:
@@ -233,6 +256,7 @@ class SwarmDownloader:
             reused = False
             connect_s = None
             starved = False
+            leased = False
             t_req = t0 = time.monotonic()
             try:
                 connect_t = self.health.connect_timeout(addr)
@@ -249,15 +273,37 @@ class SwarmDownloader:
                     listen_port=self.cfg.listen_port,
                     connect_timeout=connect_t, io_timeout=io_t,
                 )
+                leased = True
                 t_req = time.monotonic()
                 if not reused:
                     connect_s = t_req - t0
                 result = peer.request_chunk(xorb_hash, range_start, range_end,
                                             io_timeout=io_t)
+            except ContentRefusedError:
+                # Deliberate refusal (quarantined-source content): the
+                # peer is healthy and honest about it — no strike, the
+                # next candidate/tier serves. Distinct stat so triage
+                # sees refusals, not phantom cache misses.
+                self.stats.bump("peer_refusals")
+                self.health.record_success(
+                    addr, rtt_s=time.monotonic() - t_req,
+                    connect_s=connect_s)
+                return None
             except ChunkNotFoundError:
                 # Peer healthy, xorb absent: keep the connection
                 # (swarm.zig:406-413); counts toward the latency EWMA.
                 self.stats.bump("peer_failures")
+                self.health.record_success(
+                    addr, rtt_s=time.monotonic() - t_req,
+                    connect_s=connect_s)
+                return None
+            except PeerChokedError:
+                # Upload policy denied us a slot: healthy peer enforcing
+                # fairness. Keep the pooled connection (it answered
+                # promptly), no strike — striking seeders under load
+                # would quarantine the whole peer tier exactly when it
+                # matters.
+                self.stats.bump("peer_choked")
                 self.health.record_success(
                     addr, rtt_s=time.monotonic() - t_req,
                     connect_s=connect_s)
@@ -275,11 +321,22 @@ class SwarmDownloader:
                     # quarantining a healthy peer over the deadline's
                     # tail would poison the NEXT pull's candidate list.
                     return None
-                if self.health.record_failure(addr):
+                # Serving-side attribution (ISSUE 12): a peer that
+                # timed out AFTER a successful lease stalled *as a
+                # seeder* mid-request — struck with the distinct
+                # ``seed_stall`` kind so health.detail() separates "it
+                # serves, slowly-to-death" from "it is unreachable".
+                kind = ("seed_stall"
+                        if leased and isinstance(_exc, TimeoutError)
+                        else "error")
+                if self.health.record_failure(addr, kind=kind):
                     self.stats.bump("peers_quarantined")
                 return None
+            # nbytes feeds the reciprocity book: the seeding tier
+            # unchokes the peers that served US the most bytes recently.
             self.health.record_success(
-                addr, rtt_s=time.monotonic() - t_req, connect_s=connect_s)
+                addr, rtt_s=time.monotonic() - t_req, connect_s=connect_s,
+                nbytes=len(result.data))
             data = result.data
             if faults.fire("chunk_corrupt", key=f"{host}:{port}"):
                 data = faults.corrupt(data)
@@ -301,6 +358,7 @@ class SwarmDownloader:
 
     def announce_available(self, xorb_hash: bytes, hash_hex: str) -> None:
         info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+        self._announced.add(info_hash)
         for source in self.peer_sources:
             try:
                 source.announce(info_hash, self.cfg.listen_port)
@@ -308,6 +366,41 @@ class SwarmDownloader:
                 continue
         if self.peer_sources:
             self.stats.bump("announces")
+
+    def _on_health_transition(self, event: str, addr: tuple[str, int]) -> None:
+        """Quarantine-aware announce: a breaker trip or probation
+        re-admit refreshes every swarm this host has announced to (the
+        tracker tier of :mod:`zest_tpu.p2p.tracker` treats each announce
+        as a registration, so a refresh both re-registers us and pulls a
+        peer list that routes around the transition). The sweep runs on
+        a background thread — N announced swarms × blocking tracker
+        HTTP calls must never stall the observing thread (a pull worker
+        or a serve loop) — and concurrent transitions coalesce into the
+        one in-flight sweep. Best-effort, like every announce."""
+        if not self.peer_sources or not self._announced:
+            return
+        telemetry.record("swarm_reannounce", reason=event,
+                         peer=f"{addr[0]}:{addr[1]}",
+                         swarms=len(self._announced))
+        with self._reannounce_lock:
+            if self._reannounce_pending:
+                return  # the in-flight sweep re-registers everything
+            self._reannounce_pending = True
+        threading.Thread(target=self._reannounce_sweep,
+                         name="zest-reannounce", daemon=True).start()
+
+    def _reannounce_sweep(self) -> None:
+        try:
+            for info_hash in list(self._announced):
+                for source in self.peer_sources:
+                    try:
+                        source.announce(info_hash, self.cfg.listen_port)
+                    except Exception:
+                        continue
+            self.stats.bump("reannounces")
+        finally:
+            with self._reannounce_lock:
+                self._reannounce_pending = False
 
     def announce_xorbs(self, hash_hexes: list[str]) -> int:
         """``zest seed`` path: announce every cached xorb (main.zig:307-369)."""
