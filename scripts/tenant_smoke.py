@@ -1,0 +1,77 @@
+"""CI smoke for the multi-tenant pull service (ISSUE 13).
+
+4 tenants x 2 overlapping ~64 MiB revisions (revision B chunk-dedups
+against A) pulled concurrently through ONE process' shared pools over
+a shaped loopback CDN, with one tenant killed mid-pull. The gates:
+
+- **duplicate-fetch ratio ~0**: every (xorb, byte-range) unit crosses
+  the CDN exactly once across all tenants (singleflight dedupe + the
+  shared verified cache). The gate allows the acceptance criterion's
+  0.02 — a transport-level timeout under the shaped link can
+  legitimately retry one unit — and most runs measure exactly 0.0;
+- **digest identity**: every surviving tenant's snapshot is
+  byte-identical to a solo pull of the same revision — concurrency
+  admitted no corrupt byte;
+- **tenant fault isolation**: the killed tenant finishes with the
+  ``cancelled`` terminal status (not ``error``) and every other
+  tenant's pull succeeds unharmed;
+- **pinned survival**: the induced disk-pressure phase evicts under
+  live pins without touching a single pinned entry.
+
+(The ``ZEST_TENANCY=0`` knob-off byte/schema identity is pinned by
+``tests/test_tenancy.py``, which runs in the test job — not here.)
+
+Exit 0 on success; any broken invariant prints the offending block
+and fails the step.
+"""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from zest_tpu.bench_scale import bench_tenants  # noqa: E402
+
+
+def main() -> int:
+    out = bench_tenants(
+        gb=0.064,
+        k_tenants=4,
+        n_models=2,
+        max_pulls=3,
+        shaped_bps=64_000_000,
+        fault_spec=None,      # chaos coverage lives in the full bench
+        disk_pressure=True,
+        kill_tenant=True,
+        chunks_per_xorb=16,
+        scale=8,
+    )
+    gates = out["gates"]
+    checks = {
+        "duplicate_fetch_ratio_ok":
+            gates["duplicate_fetch_ratio"] <= 0.02,
+        "all_digests_identical": gates["zero_corrupt"],
+        "killed_tenant_isolated": gates["killed_isolated"],
+        "pinned_never_evicted": gates["pinned_never_evicted"],
+        "dedupe_hits_nonzero":
+            out["saturation"]["dedupe"]["dedupe_hits"] > 0,
+    }
+    print(json.dumps({"gates": gates,
+                      "saturation": {
+                          k: out["saturation"][k]
+                          for k in ("p50_pull_s", "p99_pull_s",
+                                    "cdn_fetches", "distinct_units",
+                                    "dedupe", "statuses")},
+                      "checks": checks}, indent=2))
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"FAILED gates: {failed}", file=sys.stderr)
+        print(json.dumps(out, indent=2), file=sys.stderr)
+        return 1
+    print("tenant smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

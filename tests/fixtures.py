@@ -232,6 +232,11 @@ class FixtureHub:
     def __init__(self, *repos: FixtureRepo, throttle_bps: int | None = None):
         self.repos = {r.repo_id: r for r in repos}
         self.requests_seen: list[str] = []
+        # (path, Range header) per /xorbs/ data-plane fetch: the
+        # duplicate-fetch evidence at UNIT granularity — two requests
+        # for different chunk ranges of one xorb are distinct fetch
+        # units, not duplicates (the tenancy dedupe gate counts these).
+        self.xorb_fetches: list[tuple[str, str]] = []
         self.throttle = _TokenBucket(throttle_bps) if throttle_bps else None
         fixture = self
 
@@ -267,6 +272,9 @@ class FixtureHub:
 
             def do_GET(self):
                 fixture.requests_seen.append(f"GET {self.path}")
+                if self.path.startswith("/xorbs/"):
+                    fixture.xorb_fetches.append(
+                        (self.path, self.headers.get("Range") or ""))
                 fixture._handle_get(self)
 
             def do_POST(self):

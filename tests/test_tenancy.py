@@ -1,0 +1,808 @@
+"""Multi-tenant pull service (ISSUE 13): the concurrent-daemon suite.
+
+The contract under test: concurrent pulls of overlapping models run
+over shared, globally-budgeted pools — ONE network fetch per xorb
+range process-wide (singleflight; losers read the winner's cache
+entry), fair per-tenant admission with typed backpressure, LRU cache
+eviction that never touches pinned entries, and tenant fault
+isolation (a cancelled session releases its slot/pins and detaches
+from shared flights without poisoning them) — while ``ZEST_TENANCY=0``
+restores fully independent pulls bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import os
+import threading
+import time
+
+import pytest
+
+from zest_tpu import storage, telemetry
+from zest_tpu.config import Config
+from zest_tpu.telemetry import session as session_mod
+from zest_tpu.transfer import tenancy
+from zest_tpu.transfer.pull import pull_model
+from zest_tpu.transfer.tenancy import (
+    AdmissionController,
+    AdmissionRejected,
+    CacheEvictor,
+    CancelToken,
+    PinBook,
+    PullCancelled,
+    Singleflight,
+)
+
+from fixtures import FixtureHub, FixtureRepo
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.reset_all()
+    tenancy.reset()
+    yield
+    telemetry.reset_all()
+    tenancy.reset()
+
+
+# Two revisions sharing most content: the "overlapping model sets"
+# shape (IOTA) — rev B chunk-dedups against rev A's xorbs, so the two
+# pulls contend for the same fetch units. Payloads are seeded random
+# bytes: incompressible, so a shaped (throttle_bps) hub actually
+# bounds the wire rate — compressible fixtures would LZ4 down to
+# nothing and finish before a mid-pull cancel can land.
+import random as _random
+
+_MODEL_A = _random.Random(7).randbytes(768 * 1024)
+BASE_FILES = {
+    "config.json": b'{"model_type": "test"}',
+    "model.safetensors": _MODEL_A,
+    "tokenizer.json": b'{"tok": 1}' * 64,
+}
+REV_B_FILES = dict(BASE_FILES)
+REV_B_FILES["model.safetensors"] = (
+    _MODEL_A[:-65536] + _random.Random(8).randbytes(65536)
+)
+
+
+def _cfg(hub, root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+def _digests(snapshot_dir) -> dict:
+    out = {}
+    for f in sorted(snapshot_dir.rglob("*")):
+        if f.is_file():
+            out[str(f.relative_to(snapshot_dir))] = hashlib.sha256(
+                f.read_bytes()).hexdigest()
+    return out
+
+
+def _xorb_gets(hub) -> list[tuple[str, str]]:
+    """Data-plane fetches at UNIT granularity: (path, byte range)."""
+    return list(hub.xorb_fetches)
+
+
+# ── Tentpole (a): singleflight fetch dedupe ──
+
+
+class TestSingleflightUnit:
+    def test_leader_then_waiter_done(self):
+        sf = Singleflight()
+        role, flight = sf.join("k")
+        assert role == "lead"
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(sf.wait(sf.join("k")[1])))
+        t.start()
+        time.sleep(0.05)
+        sf.resolve(flight)
+        t.join(2)
+        assert got == ["done"]
+        # The table is empty again: a later miss starts a fresh flight.
+        assert sf.join("k")[0] == "lead"
+
+    def test_failed_flight_propagates_one_typed_error(self):
+        sf = Singleflight()
+        _role, flight = sf.join("k")
+        outcomes = []
+        t = threading.Thread(
+            target=lambda: outcomes.append(sf.wait(sf.join("k")[1])))
+        t.start()
+        time.sleep(0.05)
+        boom = RuntimeError("cdn exploded")
+        sf.fail(flight, boom)
+        t.join(2)
+        assert outcomes == ["failed"]
+        assert flight.error is boom
+
+    def test_cancelled_leader_hands_off_to_live_waiter(self):
+        sf = Singleflight()
+        _role, flight = sf.join("k")
+        outcomes = []
+
+        def waiter():
+            _r, f = sf.join("k")
+            outcomes.append(sf.wait(f))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        sf.abdicate(flight)  # the leader's session was cancelled
+        t.join(2)
+        assert outcomes == ["lead"]  # the waiter now owns the fetch
+
+    def test_abdicate_with_no_waiters_dissolves(self):
+        sf = Singleflight()
+        _role, flight = sf.join("k")
+        sf.abdicate(flight)
+        assert sf.join("k")[0] == "lead"  # fresh flight, not poisoned
+
+    def test_cancelled_waiter_detaches_without_poisoning(self):
+        sf = Singleflight()
+        _role, flight = sf.join("k")
+        token = CancelToken()
+        outcomes = []
+        t = threading.Thread(
+            target=lambda: outcomes.append(
+                sf.wait(sf.join("k")[1], cancel=token)))
+        t.start()
+        time.sleep(0.05)
+        token.cancel()
+        t.join(2)
+        assert outcomes == ["cancelled"]
+        # The flight is untouched: a new waiter still resolves normally.
+        got = []
+        t2 = threading.Thread(
+            target=lambda: got.append(sf.wait(sf.join("k")[1])))
+        t2.start()
+        sf.resolve(flight)
+        t2.join(2)
+        assert got == ["done"]
+
+
+class TestConcurrentOverlappingPulls:
+    def test_one_fetch_per_shared_xorb_and_identical_digests(self, tmp_path):
+        repo = FixtureRepo("acme/tenants", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        rev_b = repo.add_revision(dict(REV_B_FILES))
+        rev_a = repo._rev_order[0]
+        with FixtureHub(repo) as hub:
+            # Solo reference digests, one fresh cfg per revision.
+            solo = {}
+            for i, rev in enumerate((rev_a, rev_b)):
+                cfg = _cfg(hub, tmp_path / f"solo{i}")
+                res = pull_model(cfg, "acme/tenants", revision=rev,
+                                 no_p2p=True, log=lambda *a, **k: None)
+                solo[rev] = _digests(res.snapshot_dir)
+            hub.requests_seen.clear()
+            hub.xorb_fetches.clear()
+
+            # Concurrent overlapping pulls, one shared cfg/cache.
+            cfg = _cfg(hub, tmp_path / "shared")
+            results: dict = {}
+            barrier = threading.Barrier(2)
+
+            def pull(rev, tenant):
+                barrier.wait()
+                res = pull_model(cfg, "acme/tenants", revision=rev,
+                                 no_p2p=True, tenant=tenant,
+                                 log=lambda *a, **k: None)
+                results[rev] = res
+
+            ts = [threading.Thread(target=pull, args=(rev_a, "t-a")),
+                  threading.Thread(target=pull, args=(rev_b, "t-b"))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert set(results) == {rev_a, rev_b}
+
+            # Byte-identical to the solo pulls.
+            for rev, res in results.items():
+                assert _digests(res.snapshot_dir) == solo[rev]
+
+            # Exactly one fetch per distinct xorb GET: the overlapping
+            # units were either deduped in flight or served from the
+            # other pull's cache entry — never fetched twice.
+            gets = _xorb_gets(hub)
+            assert len(gets) == len(set(gets)), (
+                f"duplicate xorb fetches: {sorted(gets)}")
+
+    def test_knob_off_pulls_are_independent_and_schema_identical(
+            self, tmp_path):
+        repo = FixtureRepo("acme/knoboff", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            on = pull_model(_cfg(hub, tmp_path / "on"), "acme/knoboff",
+                            no_p2p=True, log=lambda *a, **k: None)
+            off_cfg = _cfg(hub, tmp_path / "off", tenancy_enabled=False)
+            off = pull_model(off_cfg, "acme/knoboff", no_p2p=True,
+                             log=lambda *a, **k: None)
+        # Byte identity.
+        assert _digests(on.snapshot_dir) == _digests(off.snapshot_dir)
+        # Stats schema identity: tenancy adds NO keys to pull stats.
+        assert set(on.stats) == set(off.stats)
+        # files_pipeline reports the same (per-pull) budget bound.
+        assert (on.stats["files_pipeline"]["budget_bytes"]
+                == off.stats["files_pipeline"]["budget_bytes"])
+
+    def test_knob_off_status_has_no_tenancy_block(self, tmp_path):
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "z",
+                     tenancy_enabled=False)
+        assert tenancy.summary(cfg) is None
+        # And even after another (knob-on) cfg configured the state,
+        # a knob-off caller still sees None.
+        on_cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "z")
+        tenancy.state(on_cfg)
+        assert tenancy.summary(cfg) is None
+        assert tenancy.summary(on_cfg) is not None
+
+
+# ── Tentpole (b): admission control ──
+
+
+class TestAdmission:
+    def test_immediate_admit_within_budget(self):
+        c = AdmissionController(max_pulls=2, max_queue=4)
+        c.acquire("a")
+        c.acquire("b")
+        assert c.summary()["active"] == 2
+
+    def test_fair_queue_deficit_round_robin(self):
+        c = AdmissionController(max_pulls=1, max_queue=8)
+        c.acquire("warm")  # hold the only slot
+        order: list[str] = []
+        lock = threading.Lock()
+
+        def enter(name, tenant):
+            c.acquire(tenant)
+            with lock:
+                order.append(name)
+
+        # Tenant A queues three sessions BEFORE tenant B's single one:
+        # DRR must still alternate — B's pull cannot starve behind A's
+        # queue depth.
+        threads = []
+        for name, tenant in (("a1", "a"), ("a2", "a"), ("a3", "a"),
+                             ("b1", "b")):
+            t = threading.Thread(target=enter, args=(name, tenant))
+            t.start()
+            threads.append(t)
+            # Deterministic queue order: wait until this waiter is
+            # actually parked before starting the next.
+            deadline = time.monotonic() + 2
+            want = len(threads)
+            while c.summary()["queued"] < want \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+        for i in range(4):
+            c.release()
+            deadline = time.monotonic() + 2
+            while len(order) < i + 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+        for t in threads:
+            t.join(2)
+        assert order == ["a1", "b1", "a2", "a3"]
+
+    def test_queue_full_rejects_typed_with_retry_after(self):
+        c = AdmissionController(max_pulls=1, max_queue=0)
+        c.acquire("a")
+        with pytest.raises(AdmissionRejected) as ei:
+            c.acquire("b")
+        assert ei.value.retry_after_s >= 1.0
+        assert c.summary()["rejected_total"] == 1
+
+    def test_cancel_while_queued_leaves_the_queue(self):
+        c = AdmissionController(max_pulls=1, max_queue=4)
+        c.acquire("a")
+        token = CancelToken()
+        errs = []
+
+        def waiter():
+            try:
+                c.acquire("b", cancel=token)
+            except PullCancelled as exc:
+                errs.append(exc)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 2
+        while c.summary()["queued"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        token.cancel("test abort")
+        t.join(2)
+        assert len(errs) == 1
+        assert c.summary()["queued"] == 0
+        # The slot was never consumed: release + re-acquire still works.
+        c.release()
+        c.acquire("c")
+
+    def test_queued_phase_visible_on_session(self):
+        c = AdmissionController(max_pulls=1, max_queue=4)
+        c.acquire("a")
+        sess = session_mod.begin("x/y", "main", tenant="b")
+        done = threading.Event()
+
+        def waiter():
+            c.acquire("b", session=sess)
+            done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 2
+        while sess.snapshot()["phase"] != "queued" \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sess.snapshot()["phase"] == "queued"
+        c.release()
+        assert done.wait(2)
+        assert sess.snapshot()["phase"] == "starting"
+        t.join(2)
+
+    def test_pull_model_rejects_when_saturated(self, tmp_path):
+        repo = FixtureRepo("acme/reject", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            cfg = _cfg(hub, tmp_path, tenant_max_pulls=1, tenant_queue=0)
+            st = tenancy.state(cfg)
+            st.controller.acquire("hog")  # saturate the only slot
+            try:
+                with pytest.raises(AdmissionRejected):
+                    pull_model(cfg, "acme/reject", no_p2p=True,
+                               log=lambda *a, **k: None)
+            finally:
+                st.controller.release()
+        # The rejected session is terminal "rejected" — typed
+        # backpressure, distinct from error (alerts must not fire for
+        # the 429 contract working) — never stranded running.
+        recent = session_mod.SESSIONS.recent()
+        assert recent and recent[0].snapshot()["status"] == "rejected"
+
+
+# ── Tentpole (c): eviction + pinning ──
+
+
+def _fake_entry(cache_dir, hash_hex, size, age_s):
+    p = cache_dir / hash_hex[:2] / hash_hex
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(b"x" * size)
+    old = time.time() - age_s
+    os.utime(p, (old, old))
+    return p
+
+
+class TestEviction:
+    def test_lru_eviction_never_evicts_pinned(self, tmp_path):
+        cache = tmp_path / "xorbs"
+        pinned_hash = "aa" + "0" * 62
+        old_hash = "bb" + "1" * 62
+        new_hash = "cc" + "2" * 62
+        p_pin = _fake_entry(cache, pinned_hash, 4096, age_s=300)
+        p_old = _fake_entry(cache, old_hash, 4096, age_s=200)
+        p_new = _fake_entry(cache, new_hash, 4096, age_s=10)
+        pins = PinBook()
+        pins.pin("sess:1", [pinned_hash])
+        ev = CacheEvictor(cache, high_bytes=10000, low_bytes=8192,
+                          pins=pins)
+        freed = ev.maybe_evict()
+        assert freed > 0
+        assert p_pin.exists(), "pinned entry was evicted"
+        assert not p_old.exists(), "LRU victim survived"
+        assert ev.pinned_survivals >= 1
+        assert p_new.exists()  # newest entry untouched at the low mark
+        assert ev.usage_bytes() <= 8192
+
+    def test_partial_entries_pin_under_their_xorb_hash(self, tmp_path):
+        cache = tmp_path / "xorbs"
+        h = "dd" + "3" * 62
+        part = cache / h[:2] / f"{h}.4"
+        part.parent.mkdir(parents=True)
+        part.write_bytes(b"y" * 2048)
+        os.utime(part, (time.time() - 100, time.time() - 100))
+        pins = PinBook()
+        pins.pin("sess:1", [h])
+        ev = CacheEvictor(cache, high_bytes=1024, low_bytes=512,
+                          pins=pins)
+        ev.maybe_evict()
+        assert part.exists()
+
+    def test_enospc_trigger_evicts_unconditionally(self, tmp_path):
+        cache = tmp_path / "xorbs"
+        _fake_entry(cache, "ee" + "4" * 62, 1024, age_s=50)
+        ev = CacheEvictor(cache, high_bytes=1 << 30, low_bytes=0,
+                          pins=PinBook())
+        assert ev.maybe_evict() == 0       # well under the watermark
+        # ENOSPC overrides the watermark: frees down to half usage.
+        assert ev.on_enospc() is True
+        assert ev.usage_bytes() == 0
+
+    def test_eviction_events_reach_the_flight_recorder(self, tmp_path):
+        cache = tmp_path / "xorbs"
+        _fake_entry(cache, "ff" + "5" * 62, 2048, age_s=50)
+        ev = CacheEvictor(cache, high_bytes=1024, low_bytes=0,
+                          pins=PinBook())
+        ev.maybe_evict()
+        kinds = [e["kind"] for e in telemetry.recorder.tail()]
+        assert "cache_evict" in kinds
+
+    def test_reads_touch_mtime_so_eviction_is_lru_not_fifo(
+            self, tmp_path):
+        # A recently-READ entry must outlive a cold entry written
+        # later: cache reads freshen mtime (storage._touch_for_lru),
+        # so the evictor's oldest-mtime-first pass is true LRU.
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "z")
+        cache = storage.XorbCache(cfg)
+        hot_hash = "aa" + "6" * 62
+        cold_hash = "bb" + "7" * 62
+        cache.put(hot_hash, b"h" * 2048)
+        p_hot = cfg.xorb_cache_path(hot_hash)
+        old = time.time() - 500
+        os.utime(p_hot, (old, old))
+        cache.put(cold_hash, b"c" * 2048)
+        p_cold = cfg.xorb_cache_path(cold_hash)
+        os.utime(p_cold, (time.time() - 100,) * 2)
+        assert cache.get(hot_hash) is not None  # the READ freshens it
+        ev = CacheEvictor(cfg.xorb_cache_dir(), high_bytes=2048,
+                          low_bytes=2048, pins=PinBook())
+        ev.maybe_evict()
+        assert p_hot.exists(), "recently-read entry was evicted (FIFO)"
+        assert not p_cold.exists()
+
+    def test_release_unpins(self):
+        pins = PinBook()
+        pins.pin("sess:1", ["h1", "h2"])
+        pins.pin("sess:2", ["h2"])
+        pins.release("sess:1")
+        assert not pins.pinned("h1")
+        assert pins.pinned("h2")  # still held by sess:2
+        pins.release("sess:2")
+        assert not pins.pinned("h2")
+
+    def test_eviction_mid_pull_degrades_to_refetch(self, tmp_path):
+        # Pull once (cache warm), delete every cache entry (the
+        # eviction), pull into a fresh hf_home with the SAME zest
+        # cache: the pull must refetch, not fail or corrupt.
+        repo = FixtureRepo("acme/evict", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            cfg = _cfg(hub, tmp_path)
+            res1 = pull_model(cfg, "acme/evict", no_p2p=True,
+                              log=lambda *a, **k: None)
+            d1 = _digests(res1.snapshot_dir)
+            for sub in cfg.xorb_cache_dir().iterdir():
+                for f in sub.iterdir():
+                    f.unlink()
+            cfg2 = Config(hf_home=tmp_path / "hf2",
+                          cache_dir=cfg.cache_dir,
+                          hf_token="hf_test", endpoint=hub.url)
+            res2 = pull_model(cfg2, "acme/evict", no_p2p=True,
+                              log=lambda *a, **k: None)
+            assert _digests(res2.snapshot_dir) == d1
+
+
+# ── Tentpole (d) + satellite: cancellation / fault isolation ──
+
+
+class TestCancellation:
+    def test_cancel_mid_pull_terminal_status_cancelled(self, tmp_path):
+        repo = FixtureRepo("acme/cancel", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        # Shaped CDN so the pull is slow enough to cancel mid-flight;
+        # narrow fetch width so later terms enter the bridge (and its
+        # per-term cancellation point) AFTER the token fires — at the
+        # default 16-wide pool this small fixture would have every term
+        # already in flight before the cancel lands.
+        with FixtureHub(repo, throttle_bps=200_000) as hub:
+            cfg = _cfg(hub, tmp_path, max_concurrent_downloads=2)
+            token = CancelToken()
+            errs: list = []
+
+            def run():
+                try:
+                    pull_model(cfg, "acme/cancel", no_p2p=True,
+                               cancel=token, log=lambda *a, **k: None)
+                except PullCancelled as exc:
+                    errs.append(exc)
+
+            t = threading.Thread(target=run)
+            t.start()
+            deadline = time.monotonic() + 10
+            while not session_mod.SESSIONS.active_ids() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.3)  # let it get into the transfer
+            token.cancel("test kill")
+            t.join(30)
+        assert len(errs) == 1
+        recent = session_mod.SESSIONS.recent()
+        assert recent and recent[0].snapshot()["status"] == "cancelled"
+        # Fault isolation: the admission slot was released.
+        assert tenancy.state(cfg).controller.summary()["active"] == 0
+        # No half-written complete-named files: only .tmp- temps are
+        # ever partial, and those are discarded on abort.
+        snap_root = cfg.hub_dir()
+        leftovers = [p for p in snap_root.rglob("*.safetensors")
+                     if p.is_file()
+                     and p.stat().st_size
+                     != len(BASE_FILES["model.safetensors"])]
+        assert leftovers == []
+
+    def test_cancelled_tenant_leaves_concurrent_tenant_unharmed(
+            self, tmp_path):
+        repo = FixtureRepo("acme/iso", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        rev_b = repo.add_revision(dict(REV_B_FILES))
+        rev_a = repo._rev_order[0]
+        with FixtureHub(repo) as hub:
+            solo_cfg = _cfg(hub, tmp_path / "solo")
+            solo = _digests(pull_model(
+                solo_cfg, "acme/iso", revision=rev_b, no_p2p=True,
+                log=lambda *a, **k: None).snapshot_dir)
+
+            cfg = _cfg(hub, tmp_path / "shared")
+            token = CancelToken()
+            token.cancel("pre-cancelled tenant")  # dies at first boundary
+            survivor: dict = {}
+
+            def victim():
+                with pytest.raises(PullCancelled):
+                    pull_model(cfg, "acme/iso", revision=rev_a,
+                               no_p2p=True, tenant="victim",
+                               cancel=token, log=lambda *a, **k: None)
+
+            def healthy():
+                survivor["res"] = pull_model(
+                    cfg, "acme/iso", revision=rev_b, no_p2p=True,
+                    tenant="healthy", log=lambda *a, **k: None)
+
+            ts = [threading.Thread(target=victim),
+                  threading.Thread(target=healthy)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert _digests(survivor["res"].snapshot_dir) == solo
+
+    def test_delete_endpoint_fires_token(self, tmp_path):
+        from zest_tpu.api.http_api import HttpApi
+
+        cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "z")
+        api = HttpApi(cfg)
+        try:
+            sess = session_mod.begin("a/b", "main")
+            sess.cancel_token = CancelToken()
+            payload, code = api.cancel_pull(sess.id)
+            assert code == 202 and payload["status"] == "cancelling"
+            assert sess.cancel_token.fired
+            # Unknown id.
+            assert api.cancel_pull("nope")[1] == 404
+            # Terminal session: 409.
+            session_mod.finish(sess, "cancelled", error="test")
+            assert api.cancel_pull(sess.id)[1] == 409
+        finally:
+            api.close()
+
+
+# ── Satellite: ENOSPC → CacheFullError ──
+
+
+class _FlakyDisk:
+    """Monkeypatched os.fdopen whose first ``failures`` write attempts
+    raise ENOSPC — the deterministic stand-in for a full disk."""
+
+    def __init__(self, failures: int):
+        self.left = failures
+
+    def install(self, monkeypatch):
+        real = os.fdopen
+        flaky = self
+
+        def fake(fd, *a, **kw):
+            f = real(fd, *a, **kw)
+            if flaky.left > 0:
+                flaky.left -= 1
+
+                class _Full:
+                    def __enter__(self_inner):
+                        return self_inner
+
+                    def __exit__(self_inner, *exc):
+                        f.close()
+                        return False
+
+                    def write(self_inner, b):
+                        raise OSError(errno.ENOSPC,
+                                      "No space left on device")
+
+                return _Full()
+            return f
+
+        monkeypatch.setattr(storage.os, "fdopen", fake)
+
+
+class TestCacheFull:
+    def test_typed_error_cleans_temps_and_fires_event(
+            self, tmp_path, monkeypatch):
+        _FlakyDisk(failures=10).install(monkeypatch)
+        dest = tmp_path / "cache" / "aa" / "entry"
+        with pytest.raises(storage.CacheFullError):
+            storage.atomic_write(dest, b"payload")
+        assert not dest.exists()
+        assert list(dest.parent.glob(".tmp-*")) == []
+        kinds = [e["kind"] for e in telemetry.recorder.tail()]
+        assert "disk_pressure" in kinds
+
+    def test_eviction_hook_earns_one_retry(self, tmp_path, monkeypatch):
+        _FlakyDisk(failures=1).install(monkeypatch)
+        calls = []
+        storage.set_disk_full_hook(lambda: calls.append(1) or True)
+        dest = tmp_path / "cache" / "aa" / "entry"
+        storage.atomic_write(dest, b"payload")  # retry succeeds
+        assert dest.read_bytes() == b"payload"
+        assert calls == [1]
+
+    def test_stream_write_is_typed_but_not_retried(
+            self, tmp_path, monkeypatch):
+        _FlakyDisk(failures=1).install(monkeypatch)
+        dest = tmp_path / "cache" / "aa" / "entry"
+        with pytest.raises(storage.CacheFullError):
+            storage.atomic_write_stream(dest, iter([b"chunk"]))
+        assert not dest.exists()
+
+    def test_bridge_fetch_survives_cache_full(self, tmp_path,
+                                              monkeypatch):
+        # ENOSPC on the xorb-cache write must NOT fail the pull: the
+        # fetched bytes are served uncached (graceful degradation).
+        repo = FixtureRepo("acme/full", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            cfg = _cfg(hub, tmp_path)
+
+            real_put = storage.XorbCache.put
+
+            def full_put(self, hash_hex, data):
+                raise storage.CacheFullError("disk full (test)", None)
+
+            monkeypatch.setattr(storage.XorbCache, "put", full_put)
+            monkeypatch.setattr(storage.XorbCache, "put_partial",
+                                lambda *a, **k: (_ for _ in ()).throw(
+                                    storage.CacheFullError("full", None)))
+            res = pull_model(cfg, "acme/full", no_p2p=True,
+                             log=lambda *a, **k: None)
+            monkeypatch.setattr(storage.XorbCache, "put", real_put)
+            repo_files = repo.files_for(None)
+            for path, fx in repo_files.items():
+                assert (res.snapshot_dir / path).read_bytes() == fx.data
+
+
+# ── Satellite: strict env parsing ──
+
+
+class TestEnvParsing:
+    def test_defaults(self):
+        cfg = Config.load({})
+        assert cfg.tenancy_enabled is True
+        assert cfg.tenant_max_pulls == 4
+        assert cfg.tenant_queue == 16
+        assert cfg.tenant_inflight_bytes == 4 << 30
+        assert cfg.tenant_disk_high == 0
+        assert cfg.tenant_disk_low == 0
+
+    def test_knob_off(self):
+        assert Config.load({"ZEST_TENANCY": "0"}).tenancy_enabled is False
+
+    @pytest.mark.parametrize("env", [
+        {"ZEST_TENANCY": "false"},
+        {"ZEST_TENANCY": "yes"},
+        {"ZEST_TENANT_MAX_PULLS": "-1"},
+        {"ZEST_TENANT_MAX_PULLS": "0"},
+        {"ZEST_TENANT_QUEUE": "-2"},
+        {"ZEST_TENANT_INFLIGHT": "0"},
+        {"ZEST_TENANT_INFLIGHT": "-5"},
+        {"ZEST_TENANT_DISK_HIGH": "-1"},
+        {"ZEST_TENANT_DISK_LOW": "-1"},
+        {"ZEST_TENANT_MAX_PULLS": "two"},
+        # Cross-validation: LOW alone silently disarms; LOW >= HIGH
+        # would trigger eviction passes that free nothing.
+        {"ZEST_TENANT_DISK_LOW": "1024"},
+        {"ZEST_TENANT_DISK_HIGH": "1024",
+         "ZEST_TENANT_DISK_LOW": "2048"},
+        {"ZEST_TENANT_DISK_HIGH": "1024",
+         "ZEST_TENANT_DISK_LOW": "1024"},
+    ])
+    def test_malformed_values_raise(self, env):
+        with pytest.raises(ValueError):
+            Config.load(env)
+
+    def test_explicit_values(self):
+        cfg = Config.load({
+            "ZEST_TENANT_MAX_PULLS": "2",
+            "ZEST_TENANT_QUEUE": "0",
+            "ZEST_TENANT_INFLIGHT": str(1 << 20),
+            "ZEST_TENANT_DISK_HIGH": str(1 << 30),
+            "ZEST_TENANT_DISK_LOW": str(1 << 29),
+        })
+        assert cfg.tenant_max_pulls == 2
+        assert cfg.tenant_queue == 0
+        assert cfg.tenant_inflight_bytes == 1 << 20
+        assert cfg.tenant_disk_high == 1 << 30
+        assert cfg.tenant_disk_low == 1 << 29
+
+
+# ── Satellite: _pull_memo snapshot pinning ──
+
+
+class TestPullMemoPinning:
+    def _api(self, hub, tmp_path):
+        from zest_tpu.api.http_api import HttpApi
+
+        cfg = _cfg(hub, tmp_path)
+        return HttpApi(cfg)
+
+    def test_pinned_key_never_expires_under_a_reader(
+            self, tmp_path, monkeypatch):
+        repo = FixtureRepo("acme/memo", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            api = self._api(hub, tmp_path)
+            try:
+                calls = []
+                import zest_tpu.transfer.pull as pull_mod
+
+                real = pull_mod.pull_model
+
+                def counting(*a, **kw):
+                    calls.append(1)
+                    return real(*a, **kw)
+
+                monkeypatch.setattr(pull_mod, "pull_model", counting)
+                key = ("acme/memo", "main")
+                d1 = api._pull_memo(*key)
+                assert len(calls) == 1
+                # Reader active + TTL expired: must NOT re-pull.
+                api._pin_snapshot(key)
+                api._pulled[key] = (api._pulled[key][0], 0.0)
+                assert api._pull_memo(*key) == d1
+                assert len(calls) == 1
+                # Reader gone: the expired entry re-pulls again.
+                api._unpin_snapshot(key)
+                assert api._pull_memo(*key) == d1
+                assert len(calls) == 2
+            finally:
+                api.close()
+
+    def test_concurrent_misses_share_one_pull(self, tmp_path,
+                                              monkeypatch):
+        repo = FixtureRepo("acme/memo2", dict(BASE_FILES),
+                           chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            api = self._api(hub, tmp_path)
+            try:
+                calls = []
+                import zest_tpu.transfer.pull as pull_mod
+
+                real = pull_mod.pull_model
+
+                def slow(*a, **kw):
+                    calls.append(1)
+                    time.sleep(0.2)
+                    return real(*a, **kw)
+
+                monkeypatch.setattr(pull_mod, "pull_model", slow)
+                got = []
+                ts = [threading.Thread(
+                    target=lambda: got.append(
+                        api._pull_memo("acme/memo2", "main")))
+                    for _ in range(3)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(30)
+                assert len(calls) == 1
+                assert len(set(map(str, got))) == 1
+            finally:
+                api.close()

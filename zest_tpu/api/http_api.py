@@ -28,6 +28,13 @@ The reference's http_api.zig: loopback-bound HTTP server routing
   what ``zest ps --watch`` and the dashboard's active-pulls panel
   render. ``POST /v1/pull`` accepts a ``tenant`` field that labels the
   session.
+- Multi-tenant service surfaces (ISSUE 13): ``DELETE /v1/pulls/<id>``
+  cancels a running session (202; the pull stops at its next stage
+  boundary and finishes ``cancelled``), ``POST /v1/pull`` answers a
+  typed ``429`` + ``Retry-After`` when the admission queue is full, a
+  disconnected ``POST /v1/pull`` SSE client cancels its pull, and
+  ``/v1/status`` gains a ``tenancy{}`` block (admission, dedupe,
+  eviction, pins) when ``ZEST_TENANCY`` is on.
 """
 
 from __future__ import annotations
@@ -42,6 +49,7 @@ from urllib.parse import parse_qs, urlparse
 from zest_tpu import faults, storage, telemetry
 from zest_tpu.config import Config
 from zest_tpu.telemetry import fleet
+from zest_tpu.transfer import tenancy
 from zest_tpu.version import __version__
 
 
@@ -87,6 +95,14 @@ class HttpApi:
         self._gen_loading: dict = {}
         # (repo_id, revision) → (snapshot_dir, expiry); see _pull_memo.
         self._pulled: dict = {}
+        # Snapshot pinning + per-key pull singleflight (ISSUE 13
+        # satellite): a generator streaming from a snapshot pins its
+        # memo key — a pinned entry never TTL-expires, so a concurrent
+        # request can't kick off a re-pull that rewrites the snapshot
+        # under the reader — and concurrent misses for the same
+        # repo@rev share ONE pull_model call instead of racing.
+        self._snapshot_pins: dict = {}
+        self._pull_inflight: dict = {}
 
     # ── Lifecycle ──
 
@@ -270,13 +286,48 @@ class HttpApi:
         fired = faults.counters()
         if fired:
             payload["faults"] = dict(sorted(fired.items()))
+        # Multi-tenant pool state (ISSUE 13): admission (active/queued/
+        # rejects), singleflight dedupe, eviction, pins. Absent with
+        # ZEST_TENANCY=0 (the knob-off schema identity).
+        tn = tenancy.summary(self.cfg)
+        if tn is not None:
+            payload["tenancy"] = tn
         return payload
 
     # ── Pull sessions (ISSUE 11) ──
 
     def pulls_payload(self) -> dict:
-        """``GET /v1/pulls``: active + recent sessions, newest first."""
-        return telemetry.session.payload()
+        """``GET /v1/pulls``: active + recent sessions, newest first,
+        plus the admission queue state (ISSUE 13) so ``zest ps`` and
+        the dashboard can show queued vs active without a second
+        round trip."""
+        doc = telemetry.session.payload()
+        tn = tenancy.summary(self.cfg)
+        if tn is not None:
+            doc["tenancy"] = {"active": tn["active"],
+                              "queued": tn["queued"],
+                              "max_pulls": tn["max_pulls"],
+                              "queue_cap": tn["queue_cap"],
+                              "rejected_total": tn["rejected_total"]}
+        return doc
+
+    def cancel_pull(self, sid: str) -> tuple[dict, int]:
+        """``DELETE /v1/pulls/<id>`` (ISSUE 13 satellite): fire the
+        session's cancel token. The pull stops at its next stage
+        boundary, releases its budget shares and pins, detaches from
+        shared flights, and finishes with the ``cancelled`` terminal
+        status. 404 for unknown ids; 409 when the session is already
+        terminal or carries no token (registered outside pull_model)."""
+        sess = telemetry.session.get(sid)
+        if sess is None:
+            return {"error": "unknown session"}, 404
+        if sess.cancel(reason=f"DELETE /v1/pulls/{sid}"):
+            return {"id": sid, "status": "cancelling"}, 202
+        snap = sess.snapshot()
+        if snap["status"] != "running":
+            return {"id": sid, "error": "already terminal",
+                    "status": snap["status"]}, 409
+        return {"id": sid, "error": "not cancellable"}, 409
 
     def pull_detail(self, sid: str) -> dict | None:
         sess = telemetry.session.get(sid)
@@ -456,12 +507,21 @@ class HttpApi:
 
     def pull_events(self, repo_id: str, revision: str, device: str | None,
                     tenant: str | None = None):
-        """Generator of SSE progress events for one pull."""
+        """Generator of SSE progress events for one pull.
+
+        **Disconnect = cancel** (ISSUE 13 satellite): the generator
+        owns the pull's CancelToken; when the client goes away
+        mid-stream (GeneratorExit from the SSE writer) the token fires
+        and the pull stops at its next stage boundary instead of
+        running to completion unattended. Admission backpressure
+        surfaces typed: a queue-full rejection is an ``error`` event
+        carrying ``code: 429`` + ``retry_after_s``."""
         from zest_tpu.transfer.pull import pull_model
 
         done = threading.Event()
         events: list[dict] = []
         cond = threading.Condition()
+        token = tenancy.CancelToken()
 
         def log(*args, **_kw):
             with cond:
@@ -475,9 +535,14 @@ class HttpApi:
             try:
                 res = pull_model(self.cfg, repo_id, revision=revision,
                                  device=device, swarm=self.swarm,
-                                 tenant=tenant, log=log)
+                                 tenant=tenant, cancel=token, log=log)
                 result["ok"] = {"snapshot_dir": str(res.snapshot_dir),
                                 "stats": res.stats}
+            except tenancy.PullCancelled as exc:
+                result["cancelled"] = str(exc)
+            except tenancy.AdmissionRejected as exc:
+                result["rejected"] = {"message": str(exc),
+                                      "retry_after_s": exc.retry_after_s}
             except Exception as exc:  # noqa: BLE001 - reported to client
                 result["error"] = str(exc)
             finally:
@@ -486,22 +551,37 @@ class HttpApi:
                     cond.notify()
 
         threading.Thread(target=work, daemon=True).start()
-        yield {"event": "start", "repo_id": repo_id, "revision": revision}
-        sent = 0
-        while True:
-            with cond:
-                cond.wait(timeout=1.0)
-                new = events[sent:]
-                sent = len(events)
-            yield from new
-            if done.is_set():
+        try:
+            yield {"event": "start", "repo_id": repo_id,
+                   "revision": revision}
+            sent = 0
+            while True:
                 with cond:
-                    yield from events[sent:]
-                break
-        if "ok" in result:
-            yield {"event": "done", **result["ok"]}
-        else:
-            yield {"event": "error", "message": result.get("error", "?")}
+                    cond.wait(timeout=1.0)
+                    new = events[sent:]
+                    sent = len(events)
+                yield from new
+                if done.is_set():
+                    with cond:
+                        yield from events[sent:]
+                    break
+            if "ok" in result:
+                yield {"event": "done", **result["ok"]}
+            elif "cancelled" in result:
+                yield {"event": "cancelled",
+                       "message": result["cancelled"]}
+            elif "rejected" in result:
+                yield {"event": "error", "code": 429,
+                       **result["rejected"]}
+            else:
+                yield {"event": "error",
+                       "message": result.get("error", "?")}
+        finally:
+            # Reached on normal completion AND on GeneratorExit (the
+            # SSE writer saw the client disconnect). Firing the token
+            # after the pull finished is a no-op.
+            if not done.is_set():
+                token.cancel("client disconnected from /v1/pull stream")
 
     def _generator_for(self, snapshot_dir):
         """Memoized ``(model_type, generate)`` per snapshot.
@@ -557,6 +637,8 @@ class HttpApi:
         from zest_tpu.models.generate import try_tokenizer
 
         yield {"event": "start", "repo_id": repo_id}
+        memo_key = (repo_id, req.get("revision", "main"))
+        self._pin_snapshot(memo_key)
         try:
             snapshot_dir = self._pull_memo(
                 repo_id, req.get("revision", "main")
@@ -592,8 +674,22 @@ class HttpApi:
             yield self._done_event(model_type, out, tok)
         except Exception as exc:  # noqa: BLE001 - reported to client
             yield {"event": "error", "message": str(exc)}
+        finally:
+            self._unpin_snapshot(memo_key)
 
     _PULL_TTL_S = 30.0
+
+    def _pin_snapshot(self, key) -> None:
+        with self._gen_lock:
+            self._snapshot_pins[key] = self._snapshot_pins.get(key, 0) + 1
+
+    def _unpin_snapshot(self, key) -> None:
+        with self._gen_lock:
+            n = self._snapshot_pins.get(key, 0) - 1
+            if n <= 0:
+                self._snapshot_pins.pop(key, None)
+            else:
+                self._snapshot_pins[key] = n
 
     def _pull_memo(self, repo_id: str, revision: str):
         """Snapshot dir for (repo, revision), memoized for a short TTL.
@@ -604,7 +700,15 @@ class HttpApi:
         latency). Serving memoizes the resolved snapshot briefly; the
         TTL bounds staleness for moving revisions (same 30 s figure as
         swarm peer discovery, reference swarm.zig:252), and a snapshot
-        dir that vanished (cache eviction) is a miss regardless."""
+        dir that vanished (cache eviction) is a miss regardless.
+
+        Two safety rules (ISSUE 13 satellite — the TTL evict+insert
+        race): a key PINNED by a live ``_generate_events`` never
+        expires (the generator would otherwise be handed a
+        ``snapshot_dir`` a concurrent re-pull of the same repo@rev is
+        rewriting), and concurrent misses for one key share a single
+        ``pull_model`` call (per-key singleflight) instead of racing
+        two pulls over the same snapshot."""
         import time
 
         from zest_tpu.transfer.pull import pull_model
@@ -613,24 +717,47 @@ class HttpApi:
         # The memo dict is shared across request-handler threads; its
         # read and its evict+insert hold the same lock the generator
         # cache uses. The pull itself runs unlocked — a slow cold pull
-        # must not serialize every other request (worst case two
-        # threads pull the same repo; pull_model is idempotent).
-        with self._gen_lock:
-            hit = self._pulled.get(key)
-            now = time.monotonic()
-            if hit is not None and hit[1] > now and hit[0].is_dir():
-                return hit[0]
-        res = pull_model(self.cfg, repo_id, revision=revision,
-                         swarm=self.swarm, log=lambda *a, **k: None)
-        # Evict expired entries on insert: a long-lived daemon serving
-        # many repos must not grow this dict forever (the generator
-        # cache above is LRU-capped for the same reason).
-        with self._gen_lock:
-            now = time.monotonic()
-            self._pulled = {k: v for k, v in self._pulled.items()
-                            if v[1] > now}
-            self._pulled[key] = (res.snapshot_dir, now + self._PULL_TTL_S)
-        return res.snapshot_dir
+        # must not serialize every other request.
+        while True:
+            with self._gen_lock:
+                hit = self._pulled.get(key)
+                now = time.monotonic()
+                if hit is not None and hit[0].is_dir() and (
+                        hit[1] > now or self._snapshot_pins.get(key)):
+                    return hit[0]
+                pending = self._pull_inflight.get(key)
+                if pending is None:
+                    pending = self._pull_inflight[key] = threading.Event()
+                    leading = True
+                else:
+                    leading = False
+            if not leading:
+                # Another request is mid-pull for this exact key: wait
+                # it out, then re-read the memo it will have inserted
+                # (or lead the retry if it failed).
+                pending.wait()
+                continue
+            try:
+                res = pull_model(self.cfg, repo_id, revision=revision,
+                                 swarm=self.swarm,
+                                 log=lambda *a, **k: None)
+                # Evict expired entries on insert — except pinned keys
+                # (live generators) — so a long-lived daemon serving
+                # many repos doesn't grow this dict forever (the
+                # generator cache above is LRU-capped for the same
+                # reason).
+                with self._gen_lock:
+                    now = time.monotonic()
+                    self._pulled = {
+                        k: v for k, v in self._pulled.items()
+                        if v[1] > now or self._snapshot_pins.get(k)}
+                    self._pulled[key] = (res.snapshot_dir,
+                                         now + self._PULL_TTL_S)
+                return res.snapshot_dir
+            finally:
+                with self._gen_lock:
+                    self._pull_inflight.pop(key, None)
+                pending.set()
 
     @staticmethod
     def _done_event(model_type: str, out, tok) -> dict:
@@ -799,6 +926,24 @@ class _Handler(BaseHTTPRequestHandler):
             req = self._read_json_body()
             if req is None:
                 return
+            # Typed backpressure BEFORE the SSE stream opens (ISSUE
+            # 13): a full admission queue answers a real HTTP 429 with
+            # Retry-After instead of a 200 stream that errors. The
+            # probe is advisory (admission re-checks atomically); the
+            # race just turns a 429 into a typed in-stream error.
+            ok, retry_after = tenancy.can_enqueue(self.api.cfg)
+            if not ok:
+                body = json.dumps({
+                    "error": "admission queue full",
+                    "retry_after_s": retry_after}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After",
+                                 str(int(retry_after) or 1))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             self._begin_sse()
             self._stream_sse(self.api.pull_events(
                 req["repo_id"], req.get("revision", "main"),
@@ -810,6 +955,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._begin_sse()
             self._stream_sse(self.api.generate_events(req["repo_id"], req))
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self.api.http_requests += 1
+        if self.path.startswith("/v1/pulls/"):
+            sid = self.path[len("/v1/pulls/"):].strip("/")
+            payload, code = self.api.cancel_pull(sid)
+            self._json(payload, code)
         else:
             self._json({"error": "not found"}, 404)
 
@@ -843,7 +997,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.flush()
             self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
-            pass  # client went away mid-work; the worker finishes
+            pass  # client went away mid-work
+        finally:
+            # Deterministic generator finalization: a disconnected
+            # client's pull generator must run its cleanup (fire the
+            # cancel token) NOW, not whenever GC gets to it.
+            close = getattr(events, "close", None)
+            if close is not None:
+                close()
 
 
 DASHBOARD_HTML = """<!doctype html>

@@ -699,6 +699,17 @@ def _ps_lines(payload: dict) -> list[str]:
              for r in rows]
     if len(rows) == 1:
         lines.append("no pull sessions (daemon idle, or ZEST_TELEMETRY=0)")
+    # Admission column (ISSUE 13): queued vs active against the budget,
+    # plus total typed-429 rejects — queued sessions also show
+    # individually above with phase "queued".
+    tn = payload.get("tenancy") or {}
+    if tn:
+        line = (f"tenancy: {tn.get('active', 0)}/{tn.get('max_pulls', '?')}"
+                f" active  {tn.get('queued', 0)}/{tn.get('queue_cap', '?')}"
+                " queued")
+        if tn.get("rejected_total"):
+            line += f"  rejected {tn['rejected_total']}"
+        lines.append(line)
     burn = payload.get("slo") or {}
     if burn:
         lines.append("slo burn: " + "  ".join(

@@ -95,6 +95,28 @@ DEFAULT_LAND_RING_SLOTS = 64
 DEFAULT_SEED_SLOTS = 8
 DEFAULT_SEED_DEADLINE_S = 30.0
 DEFAULT_SEED_DRAIN_S = 5.0
+# Multi-tenant pull service (transfer.tenancy, ISSUE 13): shared,
+# globally-budgeted pools for concurrent pulls — singleflight fetch
+# dedupe, fair admission with backpressure, xorb-cache eviction under
+# disk pressure. ZEST_TENANCY=0 restores fully independent pulls
+# (per-pull budgets, no flights table, no queue, no eviction).
+# ZEST_TENANT_MAX_PULLS bounds concurrently-admitted sessions;
+# ZEST_TENANT_QUEUE bounds PARKED sessions (beyond it, a new pull is
+# rejected with a typed 429 + retry-after — backpressure, never
+# unbounded parking); ZEST_TENANT_INFLIGHT is the aggregate in-flight
+# reassembly byte budget shared by every admitted session, STACKED on
+# top of each pull's own ZEST_PULL_INFLIGHT bound (both hold; a
+# single file larger than the whole aggregate budget bypasses the
+# shared tier — it stays bounded by its per-pull budget and the
+# admission slots, where waiting for global-zero inflight would
+# starve it forever);
+# ZEST_TENANT_DISK_HIGH / ZEST_TENANT_DISK_LOW are the xorb-cache
+# byte watermarks: above HIGH, unpinned entries evict LRU-first down
+# to LOW (0 = eviction unarmed; LOW defaults to 80% of HIGH).
+DEFAULT_TENANCY = True
+DEFAULT_TENANT_MAX_PULLS = 4
+DEFAULT_TENANT_QUEUE = 16
+DEFAULT_TENANT_INFLIGHT_BYTES = 4 << 30
 # Delta pulls (transfer.delta, ISSUE 10): with 1 (default) every pull
 # persists a revision manifest and a pull of revision B over a cached
 # revision A plans a chunk-level delta — unchanged bytes serve from the
@@ -269,6 +291,13 @@ class Config:
     seed_slots: int = DEFAULT_SEED_SLOTS
     seed_request_deadline_s: float = DEFAULT_SEED_DEADLINE_S
     seed_drain_s: float = DEFAULT_SEED_DRAIN_S
+    # Multi-tenant pull service (see DEFAULT_TENANT_* above).
+    tenancy_enabled: bool = DEFAULT_TENANCY
+    tenant_max_pulls: int = DEFAULT_TENANT_MAX_PULLS
+    tenant_queue: int = DEFAULT_TENANT_QUEUE
+    tenant_inflight_bytes: int = DEFAULT_TENANT_INFLIGHT_BYTES
+    tenant_disk_high: int = 0
+    tenant_disk_low: int = 0
     # Delta pulls (see DEFAULT_DELTA above).
     delta_pull: bool = DEFAULT_DELTA
     # Background materialization lane (see DEFAULT_FILES_* above).
@@ -345,6 +374,25 @@ class Config:
             except OSError:
                 token = None
 
+        # Eviction watermarks are cross-validated here, not clamped:
+        # LOW >= HIGH would make every watermark pass free zero bytes
+        # (while still paying the cache walk per admission), and LOW
+        # without HIGH silently disarms eviction — both are knob typos
+        # that must fail loud (the same discipline as the strict
+        # bools/ints above).
+        disk_high = _strict_nonneg_int(env, "ZEST_TENANT_DISK_HIGH")
+        disk_low = _strict_nonneg_int(env, "ZEST_TENANT_DISK_LOW")
+        if disk_low and not disk_high:
+            raise ValueError(
+                "ZEST_TENANT_DISK_LOW is set but ZEST_TENANT_DISK_HIGH "
+                "is not: eviction arms on HIGH — a LOW alone would "
+                "silently do nothing")
+        if disk_high and disk_low >= disk_high:
+            raise ValueError(
+                f"ZEST_TENANT_DISK_LOW ({disk_low}) must be below "
+                f"ZEST_TENANT_DISK_HIGH ({disk_high}): an inverted "
+                "pair would trigger eviction passes that free nothing")
+
         return Config(
             hf_home=hf_home,
             cache_dir=cache_dir,
@@ -396,6 +444,25 @@ class Config:
                 floor=0.1),
             seed_drain_s=_strict_pos_float(
                 env, "ZEST_SEED_DRAIN_S", DEFAULT_SEED_DRAIN_S),
+            # Strict like ZEST_LAND_STREAM: ZEST_TENANCY is the
+            # multi-tenant rollback knob — "false"/a typo must raise,
+            # never silently keep shared pools on; the budget knobs
+            # follow the seed-rate sign-slip discipline (a negative
+            # budget silently meaning "tiny"/"unbounded" would pass
+            # every test while the daemon over- or under-admits).
+            tenancy_enabled=_strict_bool(
+                "ZEST_TENANCY",
+                env.get("ZEST_TENANCY", "1" if DEFAULT_TENANCY else "0")),
+            tenant_max_pulls=_strict_nonneg_int(
+                env, "ZEST_TENANT_MAX_PULLS", DEFAULT_TENANT_MAX_PULLS,
+                floor=1),
+            tenant_queue=_strict_nonneg_int(
+                env, "ZEST_TENANT_QUEUE", DEFAULT_TENANT_QUEUE),
+            tenant_inflight_bytes=_strict_nonneg_int(
+                env, "ZEST_TENANT_INFLIGHT",
+                DEFAULT_TENANT_INFLIGHT_BYTES, floor=1),
+            tenant_disk_high=disk_high,
+            tenant_disk_low=disk_low,
             # Strict like ZEST_LAND_STREAM: ZEST_DELTA is the delta
             # rollback knob — "false"/a typo must raise, never silently
             # keep deltas on.

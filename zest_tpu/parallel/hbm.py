@@ -114,13 +114,27 @@ class HbmStagingCache:
         """Device-resident lookup — the input to collectives/ops paths."""
         return self._lookup(hash_hex, range_start if range_start else None)
 
-    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
+    def get_with_range(self, hash_hex: str, range_start: int,
+                       covers=None) -> CacheResult | None:
         """Waterfall-compatible lookup: full entry first, then the partial
-        keyed by ``range_start`` — bytes come back to host for extraction."""
-        entry = self._lookup(hash_hex, range_start)
-        if entry is None:
-            return None
-        return CacheResult(bytes(np.asarray(entry.array)), entry.chunk_offset)
+        keyed by ``range_start`` — bytes come back to host for extraction.
+        ``covers`` follows the XorbCache fall-through contract: a
+        non-covering full entry falls through to the partial instead of
+        shadowing it (storage.XorbCache.get_with_range)."""
+        if covers is None:
+            entry = self._lookup(hash_hex, range_start)
+            if entry is None:
+                return None
+            return CacheResult(bytes(np.asarray(entry.array)),
+                               entry.chunk_offset)
+        for key in (hash_hex, f"{hash_hex}.{range_start}"):
+            entry = self._lookup(key, None)
+            if entry is not None:
+                result = CacheResult(bytes(np.asarray(entry.array)),
+                                     entry.chunk_offset)
+                if covers(result):
+                    return result
+        return None
 
     def has(self, hash_hex: str) -> bool:
         with self._lock:
@@ -164,11 +178,14 @@ class TieredCache:
     def has(self, hash_hex: str) -> bool:
         return self.hbm.has(hash_hex) or self.disk.has(hash_hex)
 
-    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
-        res = self.hbm.get_with_range(hash_hex, range_start)
+    def get_with_range(self, hash_hex: str, range_start: int,
+                       covers=None) -> CacheResult | None:
+        res = self.hbm.get_with_range(hash_hex, range_start,
+                                      covers=covers)
         if res is not None:
             return res
-        res = self.disk.get_with_range(hash_hex, range_start)
+        res = self.disk.get_with_range(hash_hex, range_start,
+                                       covers=covers)
         if res is not None:
             if res.chunk_offset == 0:
                 self.hbm.put(hash_hex, res.data)

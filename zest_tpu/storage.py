@@ -12,6 +12,7 @@ reference's ``writeFileAtomic`` was plain create+write (storage.zig:29-41).
 
 from __future__ import annotations
 
+import errno
 import os
 import tempfile
 import threading
@@ -21,17 +22,72 @@ from pathlib import Path
 from zest_tpu.config import Config
 
 
+class CacheFullError(OSError):
+    """Typed ENOSPC for cache writes (ISSUE 13 satellite): the write's
+    temp file is already cleaned up, a ``disk_pressure`` flight event
+    has fired, and — when a disk-full hook is installed (the tenancy
+    layer's eviction pass) — eviction already ran. Callers on the
+    fetch path treat it as "couldn't cache" and keep serving; a pull
+    that cannot make progress at all fails with THIS error, not a raw
+    mid-pull ``OSError`` over half-written temp files."""
+
+    def __init__(self, msg: str, path: Path | str | None = None):
+        super().__init__(errno.ENOSPC, msg)
+        self.path = str(path) if path is not None else None
+
+
+# The tenancy layer's eviction pass (transfer.tenancy installs it; None
+# when tenancy is off/unconfigured). Called on ENOSPC; returns True
+# when it freed anything, which earns the write exactly one retry.
+_disk_full_hook = None
+
+
+def set_disk_full_hook(fn) -> None:
+    global _disk_full_hook
+    _disk_full_hook = fn
+
+
+def note_disk_full(path) -> bool:
+    """Record disk pressure (flight recorder) and run the eviction
+    hook; True when the hook reports freed space. Shared by every
+    cache-write site that converts ENOSPC to :class:`CacheFullError`."""
+    from zest_tpu import telemetry
+
+    telemetry.record("disk_pressure", path=str(path))
+    hook = _disk_full_hook
+    if hook is None:
+        return False
+    try:
+        return bool(hook())
+    except Exception:  # noqa: BLE001 - eviction is advisory
+        return False
+
+
 def atomic_write(path: Path, data: bytes) -> None:
-    """Write via tmp file + rename so readers never observe partial content."""
-    atomic_write_stream(path, (data,))
+    """Write via tmp file + rename so readers never observe partial
+    content. ENOSPC is typed (:class:`CacheFullError`) and — because
+    the payload is replayable bytes, unlike the streaming variant —
+    retried once after the eviction hook frees space."""
+    try:
+        atomic_write_stream(path, (data,))
+    except CacheFullError:
+        # note_disk_full (and with it the eviction pass) already ran
+        # inside atomic_write_stream; one retry against the freed space.
+        atomic_write_stream(path, (data,), _retry=True)
 
 
-def atomic_write_stream(path: Path, chunks) -> int:
+def atomic_write_stream(path: Path, chunks, _retry: bool = False) -> int:
     """``atomic_write`` fed by an iterator of byte chunks; returns the
     byte count. The GB-scale fetch path streams network bodies straight
     to their cache file through this — each ~1 MiB chunk is written
     while still cache-hot, and no whole-unit buffer is ever built
-    (one full memory pass fewer than fetch-then-put)."""
+    (one full memory pass fewer than fetch-then-put).
+
+    ENOSPC surfaces as :class:`CacheFullError` after the temp file is
+    unlinked and :func:`note_disk_full` ran (``disk_pressure`` event +
+    the tenancy eviction pass); no retry here — the chunk iterator is
+    consumed — callers with replayable payloads retry themselves
+    (:func:`atomic_write`)."""
     path.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
     n = 0
@@ -41,11 +97,17 @@ def atomic_write_stream(path: Path, chunks) -> int:
                 f.write(chunk)
                 n += len(chunk)
         os.replace(tmp, path)
-    except BaseException:
+    except BaseException as exc:
         try:
             os.unlink(tmp)
         except OSError:
             pass
+        if isinstance(exc, OSError) and exc.errno == errno.ENOSPC \
+                and not isinstance(exc, CacheFullError):
+            if not _retry:
+                note_disk_full(path)
+            raise CacheFullError(
+                f"cache write of {path} hit ENOSPC", path) from exc
         raise
     return n
 
@@ -139,6 +201,19 @@ def read_chunk(cfg: Config, chunk_hash: bytes) -> bytes | None:
 # ── Xorb cache (reference: swarm.zig:57-148; LE-u64-hex keys) ──
 
 
+def _touch_for_lru(fileno_or_path) -> None:
+    """Freshen an entry's mtime on READ so the tenancy evictor's
+    oldest-mtime-first pass is true LRU, not write-time FIFO — without
+    this, a hot entry written an hour ago is the first eviction victim
+    while a cold one written a minute ago survives (the same bug PR 1
+    fixed in the peer pool, at the disk tier). Best-effort: one utime
+    syscall per entry read, dwarfed by the MB-scale read itself."""
+    try:
+        os.utime(fileno_or_path)
+    except OSError:
+        pass
+
+
 def _read_with_readahead(path: Path) -> bytes | None:
     """Whole-file read with an aggressive readahead hint (the
     madvise/fadvise WILLNEED from ISSUE 3): GB-scale warm-cache landings
@@ -155,6 +230,7 @@ def _read_with_readahead(path: Path) -> bytes | None:
                                      os.POSIX_FADV_WILLNEED)
                 except OSError:
                     pass  # advisory only; the read below still works
+            _touch_for_lru(f.fileno())
             return f.read()
     except OSError:
         return None
@@ -190,15 +266,30 @@ class XorbCache:
     def get(self, hash_hex: str) -> bytes | None:
         return _read_with_readahead(self._path(hash_hex))
 
-    def get_with_range(self, hash_hex: str, range_start: int) -> CacheResult | None:
+    def get_with_range(self, hash_hex: str, range_start: int,
+                       covers=None) -> CacheResult | None:
         """Full xorb first (offset 0), then exact partial entry
-        ``{hash_hex}.{range_start}`` (reference: swarm.zig:81-95)."""
+        ``{hash_hex}.{range_start}`` (reference: swarm.zig:81-95).
+
+        ``covers`` (optional ``CacheResult -> bool``): the caller's
+        coverage predicate. Without it, the FULL entry — when present —
+        always wins, even if it doesn't actually hold the chunks the
+        caller needs: a full key written from incomplete reference
+        evidence (the resolve-order race, ISSUE 13) would then
+        permanently shadow a correct partial entry at the same hash,
+        turning every read of the uncovered range into a cache miss +
+        refetch. With ``covers``, a non-covering candidate falls
+        through to the next one instead of masking it."""
         data = self.get(hash_hex)
         if data is not None:
-            return CacheResult(data, 0)
+            result = CacheResult(data, 0)
+            if covers is None or covers(result):
+                return result
         data = self.get(f"{hash_hex}.{range_start}")
         if data is not None:
-            return CacheResult(data, range_start)
+            result = CacheResult(data, range_start)
+            if covers is None or covers(result):
+                return result
         return None
 
     def _get_mapped(self, key: str):
@@ -219,6 +310,7 @@ class XorbCache:
                 size = os.fstat(f.fileno()).st_size
                 if size == 0:
                     return memoryview(b"")
+                _touch_for_lru(f.fileno())
                 mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         except (OSError, ValueError):
             return None
@@ -253,9 +345,11 @@ class XorbCache:
         ``.tmp-`` name until its rename)."""
         p = self._path(hash_hex)
         if p.exists():
+            _touch_for_lru(p)
             return p, 0
         p = self._path(f"{hash_hex}.{range_start}")
         if p.exists():
+            _touch_for_lru(p)
             return p, range_start
         return None
 

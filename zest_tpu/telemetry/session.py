@@ -76,9 +76,15 @@ class PullSession:
         self.device = device
         self.started_at = round(time.time(), 6)
         self._t0 = time.monotonic()
-        self.status = "running"  # running | ok | error
+        # running | ok | error | cancelled | rejected (admission 429)
+        self.status = "running"
         self.error: str | None = None
         self.phase = "starting"
+        # The pull's cancellation token (transfer.tenancy.CancelToken),
+        # attached by pull_model so DELETE /v1/pulls/<id> and the SSE
+        # disconnect path can abort the session; None for sessions that
+        # predate the token (or were registered outside pull_model).
+        self.cancel_token = None
         self.total_bytes: int | None = None  # pending payload, when known
         self.stats: dict | None = None       # terminal stats dict ref
         self.slo: dict = {}                  # slo -> breach info
@@ -115,6 +121,26 @@ class PullSession:
             self.version += 1
             self._cv.notify_all()
 
+    def set_phase(self, phase: str) -> None:
+        """Direct phase override for lifecycle states outside the
+        StageClock's view — ``queued`` while parked in the admission
+        queue (ISSUE 13), back to ``starting`` on admit. Stage-observer
+        updates keep flowing through :meth:`_on_stage` unchanged."""
+        with self._cv:
+            if phase != self.phase:
+                self.phase = phase
+                self.version += 1
+                self._cv.notify_all()
+
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Fire the session's cancel token (``DELETE /v1/pulls/<id>``).
+        False when the session has no token or is already terminal."""
+        token = self.cancel_token
+        if token is None or self.status != "running":
+            return False
+        token.cancel(reason)
+        return True
+
     def note_slo(self, slo: str, info: dict) -> None:
         with self._cv:
             self.slo[slo] = dict(info)
@@ -150,6 +176,8 @@ class PullSession:
             self.ended_at = round(time.time(), 6)
             if status == "ok":
                 self.phase = "done"
+            elif status in ("cancelled", "rejected"):
+                self.phase = status
             self.version += 1
             self._cv.notify_all()
 

@@ -15,6 +15,7 @@ frame-stream blob shape, so extraction code is tier-agnostic.
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -27,7 +28,8 @@ from zest_tpu.cas.hub import HubClient
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.config import Config
 from zest_tpu.p2p.health import PROVENANCE
-from zest_tpu.storage import XorbCache
+from zest_tpu.storage import CacheFullError, XorbCache
+from zest_tpu.transfer.tenancy import PullCancelled
 
 # Process-wide mirrors of the per-session FetchStats: the session object
 # stays the per-pull report; these outlive it so the daemon's
@@ -49,6 +51,23 @@ _M_EVENTS = telemetry.counter(
 _HEDGE_PEER_FRACTION = 0.3
 _HEDGE_PEER_WAIT_CAP_S = 10.0
 _HEDGE_PEER_WAIT_FLOOR_S = 0.05
+
+# Serializes partial cache writes PER XORB (64-way striped by hash):
+# entries keyed ``{hash}.{start}`` can collide across different-width
+# units, and the never-narrower check in ``_cache_fetched`` must be
+# atomic with its write across bridges (one bridge per pull session) —
+# but only for the SAME xorb; one global lock would serialize every
+# concurrent session's partial cache writes behind each other's disk
+# I/O.
+_PARTIAL_WRITE_LOCKS = [threading.Lock() for _ in range(64)]
+
+
+def _partial_write_lock(hash_hex: str) -> threading.Lock:
+    try:
+        idx = int(hash_hex[:2], 16) % len(_PARTIAL_WRITE_LOCKS)
+    except ValueError:
+        idx = 0
+    return _PARTIAL_WRITE_LOCKS[idx]
 
 
 class BridgeError(RuntimeError):
@@ -214,6 +233,17 @@ class XetBridge:
         # (transfer.coop): it must outlive the round — peer hosts still
         # mid-exchange read from it — so it lives until close().
         self._coop_server = None
+        # Multi-tenant shared pools (ISSUE 13), wired by pull_model when
+        # tenancy is on; all None ⇒ the pre-tenancy bridge bit-for-bit.
+        # ``flights``: the process-wide Singleflight table deduping
+        # in-flight network fetches across sessions. ``cancel``: this
+        # pull's CancelToken (waiters detach, a cancelled leader hands
+        # off). ``on_reconstruction``: called once per freshly-resolved
+        # reconstruction (the session pins its xorb hashes against
+        # eviction).
+        self.flights = None
+        self.cancel = None
+        self.on_reconstruction = None
 
     def adopt_coop_server(self, server) -> None:
         """Own a coop-round DCN listener until :meth:`close` (see
@@ -267,7 +297,20 @@ class XetBridge:
             cached = self.cas.get_reconstruction(file_hash_hex)
             with self._recons_lock:
                 cached = self._recons.setdefault(file_hash_hex, cached)
+            hook = self.on_reconstruction
+            if hook is not None:
+                try:
+                    hook(cached)  # tenancy: pin this plan's xorbs
+                except Exception:  # noqa: BLE001 - pinning is advisory
+                    pass
         return cached
+
+    def resolved_xorb_hashes(self) -> set[str]:
+        """Every xorb hash referenced by a reconstruction this bridge
+        resolved — the pin set for a landed HBM tree (ISSUE 13)."""
+        with self._recons_lock:
+            return {h for rec in self._recons.values()
+                    for h in rec.fetch_info}
 
     def known_reconstruction(
         self, file_hash_hex: str
@@ -285,6 +328,11 @@ class XetBridge:
     def fetch_xorb_for_term(
         self, term: recon.Term, rec: recon.Reconstruction
     ) -> XorbFetchResult:
+        if self.cancel is not None:
+            # Per-term cancellation point (ISSUE 13): a cancelled
+            # session stops fetching at the next term instead of
+            # finishing whole files it no longer wants.
+            self.cancel.check()
         with telemetry.span("fetch.term", xorb=term.hash_hex) as sp:
             result = self._fetch_xorb_for_term(term, rec)
             sp.set("source", result.source)
@@ -303,17 +351,110 @@ class XetBridge:
             )
 
         # 1. Local cache — full xorb or the partial entry for fi's range.
-        cached = self.cache.get_with_range(hash_hex, fi.range.start)
-        if cached is not None:
-            local_start = term.range.start - cached.chunk_offset
-            local_end = term.range.end - cached.chunk_offset
-            if _blob_covers(cached.data, local_start, local_end):
-                self.stats.record("cache", len(cached.data))
-                return XorbFetchResult(cached.data, local_start, local_end,
-                                       source="cache")
-            # Corrupt/short entry: fall through — a CDN refetch overwrites
-            # the bad cache key, so the tier self-heals.
+        hit = self._cached_term_result(term, fi, hash_hex)
+        if hit is not None:
+            self.stats.record("cache", len(hit.data))
+            return hit
+        # Corrupt/short entry fell through above — a CDN refetch
+        # overwrites the bad cache key, so the tier self-heals.
 
+        # Network tiers, deduped across sessions (ISSUE 13): one flight
+        # per (xorb, range) process-wide — the loser reads the winner's
+        # cache entry instead of refetching.
+        return self._deduped(
+            (hash_hex, fi.range.start, fi.range.end),
+            lambda: self._network_fetch_for_term(term, rec, fi, hash_hex),
+            lambda: self._probe_term(term, fi, hash_hex),
+        )
+
+    def _cached_term_result(self, term: recon.Term, fi: recon.FetchInfo,
+                            hash_hex: str) -> XorbFetchResult | None:
+        """Tier 1 without stats: the covering cache entry as a term
+        result, or None (miss OR structurally-corrupt entry — the
+        caller's network path self-heals the latter). The coverage
+        predicate runs INSIDE the lookup so a non-covering full entry
+        falls through to the exact partial instead of shadowing it."""
+        def covers(res) -> bool:
+            return _blob_covers(res.data,
+                                term.range.start - res.chunk_offset,
+                                term.range.end - res.chunk_offset)
+
+        cached = self.cache.get_with_range(hash_hex, fi.range.start,
+                                           covers=covers)
+        if cached is None:
+            return None
+        return XorbFetchResult(cached.data,
+                               term.range.start - cached.chunk_offset,
+                               term.range.end - cached.chunk_offset,
+                               source="cache")
+
+    def _probe_term(self, term: recon.Term, fi: recon.FetchInfo,
+                    hash_hex: str) -> XorbFetchResult | None:
+        hit = self._cached_term_result(term, fi, hash_hex)
+        if hit is not None:
+            self.stats.record("cache", len(hit.data))
+        return hit
+
+    def _deduped(self, key, fetch_fn, probe_fn):
+        """Run ``fetch_fn`` under the process singleflight table (when
+        wired): the first session to want ``key`` leads; every
+        concurrent session waits, then serves itself from the winner's
+        cache entry via ``probe_fn`` (a probe miss — the entry was
+        evicted in the gap — degrades to a solo refetch, never an
+        error). A failed flight re-raises the leader's typed error in
+        every waiter; a cancelled leader abdicates so a live waiter
+        takes over the fetch instead of the flight failing."""
+        flights = self.flights
+        if flights is None:
+            return fetch_fn()
+        role, flight = flights.join(key)
+        first_lead = role == "lead"
+        while True:
+            if role == "lead":
+                if first_lead:
+                    # Close the miss-then-join race: this session's
+                    # cache check may predate another flight's winner
+                    # writing the entry AND resolving (both strictly
+                    # before table removal) — re-probing here turns
+                    # that window into a hit instead of a duplicate
+                    # fetch. (A promoted waiter skips it: its probe
+                    # semantics are the abdication handoff's.)
+                    hit = probe_fn()
+                    if hit is not None:
+                        flights.resolve(flight)
+                        flights.note_hit()
+                        return hit
+                try:
+                    if self.cancel is not None:
+                        self.cancel.check()
+                    result = fetch_fn()
+                except BaseException as exc:
+                    if isinstance(exc, PullCancelled):
+                        flights.abdicate(flight)
+                    else:
+                        flights.fail(flight, exc)
+                    raise
+                flights.resolve(flight)
+                return result
+            outcome = flights.wait(flight, cancel=self.cancel)
+            if outcome == "lead":
+                role = "lead"
+                continue
+            if outcome == "cancelled":
+                raise PullCancelled(
+                    "cancelled while waiting on a shared fetch")
+            if outcome == "failed":
+                raise flight.error
+            hit = probe_fn()  # "done": the winner's bytes are cached
+            if hit is not None:
+                flights.note_hit()
+                return hit
+            return fetch_fn()  # evicted before we read: refetch solo
+
+    def _network_fetch_for_term(
+        self, term: recon.Term, rec: recon.Reconstruction,
+        fi: recon.FetchInfo, hash_hex: str
+    ) -> XorbFetchResult:
         # 2. Swarm (peers) — request fi's full chunk range so the cached
         #    result can serve future terms that share this fetch_info.
         #    With a deadline armed this tier is hedged: the peer fetch
@@ -538,29 +679,76 @@ class XetBridge:
 
     def _fetch_unit(self, hash_hex: str,
                     fi: recon.FetchInfo) -> tuple[bytes, str]:
-        cached = self.cache.get_with_range(hash_hex, fi.range.start)
-        if cached is not None and cached.chunk_offset <= fi.range.start:
-            lo = fi.range.start - cached.chunk_offset
-            hi = fi.range.end - cached.chunk_offset
-            try:
-                reader = XorbReader(cached.data)  # one parse per hit
-            except Exception:
-                reader = None  # corrupt entry: fall through, CDN self-heals
-            if reader is not None and lo >= 0 and lo < hi <= len(reader):
-                # A covering entry wider than the unit (offset below
-                # fi.range.start, or more chunks than fi.range.end — e.g.
-                # a full xorb cached by an earlier pull while this plan's
-                # unit covers a prefix) is re-framed to exactly the unit's
-                # range: a wider blob would overflow its pool row capacity
-                # and be zero-rowed, refetching from CDN despite the local
-                # hit. Stats count the bytes actually served.
-                if lo == 0 and len(reader) == hi:
-                    data = cached.data
-                else:
-                    data = reader.slice_range(lo, hi)
-                self.stats.record("cache", len(data))
-                return data, "cache"
+        if self.cancel is not None:
+            self.cancel.check()  # per-unit cancellation point
+        data = self._cached_unit(hash_hex, fi)
+        if data is not None:
+            self.stats.record("cache", len(data))
+            return data, "cache"
+        return self._deduped(
+            (hash_hex, fi.range.start, fi.range.end),
+            lambda: self._network_fetch_unit(hash_hex, fi),
+            lambda: self._probe_unit(hash_hex, fi),
+        )
 
+    def _cached_unit(self, hash_hex: str,
+                     fi: recon.FetchInfo) -> bytes | None:
+        """The unit path's tier 1, without stats: the unit's bytes from
+        a covering cache entry, or None (miss or corrupt entry). The
+        coverage predicate runs inside the lookup (fall-through rule —
+        see storage.get_with_range)."""
+        sliced: list[bytes] = []
+
+        def covers(res) -> bool:
+            if res.chunk_offset > fi.range.start:
+                return False
+            lo = fi.range.start - res.chunk_offset
+            hi = fi.range.end - res.chunk_offset
+            try:
+                reader = XorbReader(res.data)  # one parse per hit
+            except Exception:
+                return False  # corrupt entry: fall through, CDN self-heals
+            if not (lo >= 0 and lo < hi <= len(reader)):
+                return False
+            # A covering entry wider than the unit (offset below
+            # fi.range.start, or more chunks than fi.range.end — e.g.
+            # a full xorb cached by an earlier pull while this plan's
+            # unit covers a prefix) is re-framed to exactly the unit's
+            # range: a wider blob would overflow its pool row capacity
+            # and be zero-rowed, refetching from CDN despite the local
+            # hit. Stats count the bytes actually served.
+            sliced.append(res.data if lo == 0 and len(reader) == hi
+                          else reader.slice_range(lo, hi))
+            return True
+
+        if self.cache.get_with_range(hash_hex, fi.range.start,
+                                     covers=covers) is None:
+            return None
+        return sliced[0]
+
+    def _probe_unit(self, hash_hex: str,
+                    fi: recon.FetchInfo) -> tuple[bytes, str] | None:
+        data = self._cached_unit(hash_hex, fi)
+        if data is None:
+            return None
+        self.stats.record("cache", len(data))
+        return data, "cache"
+
+    def _network_fetch_unit(self, hash_hex: str,
+                            fi: recon.FetchInfo) -> tuple[bytes, str]:
+        data, source = self._network_fetch_unit_raw(hash_hex, fi)
+        if self.flights is not None and source != "cache":
+            # Deduped mode: the flight's waiters serve themselves from
+            # the cache the moment we resolve, so the bytes must be
+            # cached HERE (the callers' own cache-write pass runs after
+            # return — too late for a subscribed waiter). Same evidence
+            # rule as every other write site; _cache_fetched absorbs
+            # ENOSPC (the waiters then degrade to their own fetches).
+            self._cache_fetched(None, hash_hex, fi.range.start, data)
+        return data, source
+
+    def _network_fetch_unit_raw(self, hash_hex: str,
+                                fi: recon.FetchInfo) -> tuple[bytes, str]:
         if self.swarm is not None:
             xorb_hash = None
             try:
@@ -604,7 +792,32 @@ class XetBridge:
         warm path's fast lane: callers have already checked the cache
         and peer tiers. ``full_key`` follows the same whole-xorb
         evidence rule as ``_cache_fetched``. Trust model unchanged:
-        cached bytes are BLAKE3-verified at extraction."""
+        cached bytes are BLAKE3-verified at extraction.
+
+        Deduped like the other network tiers (ISSUE 13): the same key
+        space as the term/unit paths, so a warm fetch in one session
+        and a term fetch in another collapse to ONE wire transfer; the
+        waiter's "result" is the size of the entry the winner wrote."""
+        return self._deduped(
+            (hash_hex, fi.range.start, fi.range.end),
+            lambda: self._stream_unit_from_cdn(hash_hex, fi, full_key),
+            lambda: self._probe_stream(hash_hex, fi),
+        )
+
+    def _probe_stream(self, hash_hex: str,
+                      fi: recon.FetchInfo) -> int | None:
+        located = self.cache.locate_with_range(hash_hex, fi.range.start)
+        if located is None:
+            return None
+        try:
+            n = os.stat(located[0]).st_size
+        except OSError:
+            return None  # evicted between locate and stat: refetch
+        self.stats.record("cache", n)
+        return n
+
+    def _stream_unit_from_cdn(self, hash_hex: str, fi: recon.FetchInfo,
+                              full_key: bool) -> int:
         if self.cas is None:
             raise NotAuthenticated("no CAS client")
         with telemetry.span("cdn.stream", xorb=hash_hex) as sp:
@@ -645,16 +858,69 @@ class XetBridge:
         files can look whole from one file's fetch_info (single entry at
         chunk 0) while another file reads its later chunks — caching the
         truncated blob under the full key would shadow those partial
-        entries and advertise an incomplete xorb as seedable."""
-        if self.whole_xorb_provable(self._known_entries(rec, hash_hex),
-                                    chunk_offset):
-            self.cache.put(hash_hex, data)
-        else:
-            self.cache.put_partial(hash_hex, chunk_offset, data)
+        entries and advertise an incomplete xorb as seedable.
 
-    def _known_entries(self, rec: recon.Reconstruction,
+        A cache write hitting ENOSPC (typed CacheFullError — the
+        eviction pass already ran via the storage hook) is ABSORBED:
+        the fetched bytes are in hand and the pull keeps serving, it
+        just doesn't cache this blob (graceful degradation, never a
+        raw mid-pull OSError over half-written temps).
+
+        **Never-narrower rule** (BOTH key kinds): partial entries are
+        keyed by chunk offset only (``{hash}.{start}``), so two fetch
+        units sharing a start but not an end — e.g. revision B
+        referencing chunks [0,1) of a xorb revision A reads as [0,16)
+        — land on the SAME key; and two bridges with different
+        resolve-order evidence can BOTH judge their (different-width)
+        blobs "provably whole" and race the FULL key. Either way a
+        blindly-written narrower blob clobbers the wider one, turning
+        later reads of the wide range into cache misses + duplicate
+        network fetches (exactly the dups the tenancy bench's
+        duplicate-fetch gate caught). The write is skipped when an
+        existing entry at the target offset already covers at least
+        this blob's chunks; the check+write runs under a
+        hash-striped lock because the clobber race is cross-bridge."""
+        self.cache_blob(
+            hash_hex, chunk_offset, data,
+            whole=self.whole_xorb_provable(
+                self._known_entries(rec, hash_hex), chunk_offset))
+
+    def cache_blob(self, hash_hex: str, chunk_offset: int, data: bytes,
+                   whole: bool) -> None:
+        """The ONE guarded cache-write every blob-caching site uses
+        (the term/unit paths here, federated's warm ``_cache_unit``,
+        the pod round): never-narrower check + write under the
+        hash-striped lock, ENOSPC absorbed. ``whole`` is the caller's
+        whole-xorb evidence verdict (full vs partial key)."""
+        try:
+            with _partial_write_lock(hash_hex):
+                existing = self.cache.get_with_range(hash_hex,
+                                                     chunk_offset)
+                if existing is not None \
+                        and existing.chunk_offset <= chunk_offset:
+                    try:
+                        have_end = (existing.chunk_offset
+                                    + len(XorbReader(existing.data)))
+                        new_end = chunk_offset + len(XorbReader(data))
+                        if have_end >= new_end:
+                            return  # existing covers everything we have
+                    except Exception:  # noqa: BLE001 - corrupt: overwrite
+                        pass
+                if whole:
+                    self.cache.put(hash_hex, data)
+                else:
+                    self.cache.put_partial(hash_hex, chunk_offset, data)
+        except CacheFullError:
+            telemetry.record("cache_write_skipped", xorb=hash_hex,
+                             reason="disk_full")
+
+    def _known_entries(self, rec: recon.Reconstruction | None,
                        hash_hex: str) -> list[recon.FetchInfo]:
-        entries = list(rec.fetch_info.get(hash_hex, []))
+        """Every resolved reference to ``hash_hex`` — ``rec``'s (when
+        given) plus the whole memo (``rec=None``: the unit path, which
+        has no single owning reconstruction)."""
+        entries = (list(rec.fetch_info.get(hash_hex, []))
+                   if rec is not None else [])
         with self._recons_lock:
             others = list(self._recons.values())
         for other in others:

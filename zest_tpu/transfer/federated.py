@@ -80,10 +80,16 @@ def _already_cached(bridge, hash_hex: str, fi: FetchInfo) -> bool:
     through a fetch_unit cache hit can be a narrower slice of the cached
     entry (e.g. a full xorb answering a [0,3) unit), and re-putting it
     would evict chunks already local."""
-    entry = bridge.cache.get_with_range(hash_hex, fi.range.start)
-    if entry is None or entry.chunk_offset > fi.range.start:
-        return False
-    return _blob_covers(entry.data, fi.range.end - entry.chunk_offset)
+    def covers(res) -> bool:
+        # Coverage inside the lookup: a non-covering full entry (the
+        # resolve-order truncation race, ISSUE 13) falls through to the
+        # exact partial instead of shadowing it into a refetch.
+        return (res.chunk_offset <= fi.range.start
+                and _blob_covers(res.data,
+                                 fi.range.end - res.chunk_offset))
+
+    return bridge.cache.get_with_range(hash_hex, fi.range.start,
+                                       covers=covers) is not None
 
 
 def _entries_by_hash(recs: list[Reconstruction]) -> dict[str, list[FetchInfo]]:
@@ -101,12 +107,13 @@ def _cache_unit(bridge, entries_map, hash_hex: str, fi: FetchInfo,
     including the bridge's evidence-integrity flag (a pull with
     unresolved aux references forces partial keys everywhere).
     ``provably_whole`` dedupes ranges, so the same whole-xorb reference
-    appearing in several files' fetch_info still counts as whole."""
-    if bridge.whole_xorb_provable(entries_map.get(hash_hex, []),
-                                  chunk_offset):
-        bridge.cache.put(hash_hex, data)
-    else:
-        bridge.cache.put_partial(hash_hex, chunk_offset, data)
+    appearing in several files' fetch_info still counts as whole.
+    Routed through the bridge's guarded write (never-narrower under
+    the hash-striped lock, ENOSPC absorbed)."""
+    bridge.cache_blob(
+        hash_hex, chunk_offset, data,
+        whole=bridge.whole_xorb_provable(entries_map.get(hash_hex, []),
+                                         chunk_offset))
 
 
 def warm_units_parallel(
@@ -210,7 +217,13 @@ def _warm_units_parallel(
                                               fi.range.start)
             return bridge.stream_unit_from_cdn(hash_hex, fi, full)
         data = bridge.fetch_unit(hash_hex, fi)
-        _cache_unit(bridge, entries_map, hash_hex, fi, fi.range.start, data)
+        if bridge.flights is None:
+            # Deduped mode already cached the bytes INSIDE fetch_unit
+            # (waiters probe the cache the moment the flight resolves)
+            # — a second guarded write here would read the just-written
+            # entry back only to skip.
+            _cache_unit(bridge, entries_map, hash_hex, fi,
+                        fi.range.start, data)
         return len(data)
 
     failed_units = []

@@ -177,11 +177,12 @@ def _expert_pod_round(bridge, file_maps, placement, mesh, log,
                 failed += 1
                 continue
             fi = a.fetch_info
-            if bridge.whole_xorb_provable(entries_map.get(a.hash_hex, []),
-                                          fi.range.start):
-                bridge.cache.put(a.hash_hex, data)
-            else:
-                bridge.cache.put_partial(a.hash_hex, fi.range.start, data)
+            # The bridge's guarded write: never-narrower under the
+            # hash-striped lock, ENOSPC absorbed (bridge.cache_blob).
+            bridge.cache_blob(
+                a.hash_hex, fi.range.start, data,
+                whole=bridge.whole_xorb_provable(
+                    entries_map.get(a.hash_hex, []), fi.range.start))
             fetched += 1
             expert_bytes += len(data)
 

@@ -24,8 +24,14 @@ from pathlib import Path
 from zest_tpu import faults, storage, telemetry
 from zest_tpu.cas.hub import HubClient
 from zest_tpu.config import Config
+from zest_tpu.transfer import tenancy
 from zest_tpu.transfer.bridge import XetBridge
 from zest_tpu.transfer.parallel import ParallelDownloader
+from zest_tpu.transfer.tenancy import (  # noqa: F401 - ByteBudget re-export
+    ByteBudget,
+    CancelToken,
+    PullCancelled,
+)
 
 _M_PULLS = telemetry.counter(
     "zest_pulls_total", "Pulls finished, by outcome", ("outcome",))
@@ -263,51 +269,10 @@ def _is_complete(snapshot_dir: Path, entry) -> bool:
     return dest.exists() and dest.stat().st_size == entry.size
 
 
-class ByteBudget:
-    """Counting byte-semaphore bounding in-flight reassembly bytes.
-
-    ``acquire(n)`` blocks while admitting ``n`` more bytes would push the
-    in-flight total past the budget — except when nothing is in flight,
-    where an oversized item (n > budget) is admitted alone rather than
-    deadlocking (the classic bounded-buffer starvation case: a file
-    larger than the whole budget must still be pullable, serially).
-    ``peak_bytes`` records the high-watermark for the bench/tests to
-    assert the bound held."""
-
-    def __init__(self, budget_bytes: int):
-        self.budget_bytes = max(1, int(budget_bytes))
-        self._cv = threading.Condition(threading.Lock())
-        self._inflight = 0
-        self.peak_bytes = 0
-
-    def acquire(self, nbytes: int) -> None:
-        nbytes = max(0, int(nbytes))
-        with self._cv:
-            while (self._inflight > 0
-                   and self._inflight + nbytes > self.budget_bytes):
-                self._cv.wait()
-            self._inflight += nbytes
-            self.peak_bytes = max(self.peak_bytes, self._inflight)
-
-    def try_acquire(self, nbytes: int) -> bool:
-        """Non-blocking :meth:`acquire` (same oversized-alone admission):
-        the async materialization handoff runs in the landing's decode
-        thread, where a blocked acquire would put file writes right back
-        on the time-to-HBM critical path — a full budget means *decline*
-        (the file falls to the post-commit cache lane), never wait."""
-        nbytes = max(0, int(nbytes))
-        with self._cv:
-            if (self._inflight > 0
-                    and self._inflight + nbytes > self.budget_bytes):
-                return False
-            self._inflight += nbytes
-            self.peak_bytes = max(self.peak_bytes, self._inflight)
-            return True
-
-    def release(self, nbytes: int) -> None:
-        with self._cv:
-            self._inflight -= max(0, int(nbytes))
-            self._cv.notify_all()
+# ByteBudget moved to transfer.tenancy (re-exported above): with
+# tenancy on, ONE instance is shared by every admitted session — the
+# aggregate in-flight byte budget — so the class lives with the other
+# shared-pool machinery. Semantics unchanged.
 
 
 class _FilePipeline:
@@ -346,9 +311,19 @@ class _FilePipeline:
     def __init__(self, width: int, budget_bytes: int, clock: StageClock,
                  work, term_executor: ThreadPoolExecutor | None = None,
                  skip_check=None, materialize_workers: int = 1,
-                 async_handoff: bool = True):
+                 async_handoff: bool = True, budget: ByteBudget | None = None,
+                 cancel: CancelToken | None = None):
         self.width = max(1, int(width))
-        self.budget = ByteBudget(budget_bytes)
+        # ``budget``: the tenancy-shared aggregate ByteBudget — the
+        # per-pull budget then STACKS under it (both bounds hold: the
+        # session's own ZEST_PULL_INFLIGHT peak and the process-wide
+        # ZEST_TENANT_INFLIGHT cap). Absent, the per-pull budget alone,
+        # as before. ``cancel``: the session's token, checked per file
+        # so an aborted pull stops submitting work at the next boundary.
+        local_budget = ByteBudget(budget_bytes)
+        self.budget = (local_budget if budget is None
+                       else tenancy.StackedBudget(local_budget, budget))
+        self.cancel_token = cancel
         self.clock = clock
         self.work = work  # work(entry) -> "downloaded" | "skipped"
         # Cheap completeness probe run BEFORE the budget acquire: a
@@ -509,6 +484,11 @@ class _FilePipeline:
         telemetry.session.use(self._session_id)
         if self._cancel.is_set():
             return
+        if self.cancel_token is not None:
+            # Session abort (ISSUE 13): raising here makes join() treat
+            # the cancellation as the first error — queued files drop,
+            # in-flight ones drain atomically, temps are discarded.
+            self.cancel_token.check()
         if self.skip_check is not None and self.skip_check(entry):
             with self._lock:
                 self.skipped += 1
@@ -519,6 +499,8 @@ class _FilePipeline:
         try:
             if self._cancel.is_set():
                 return
+            if self.cancel_token is not None:
+                self.cancel_token.check()
             with self.clock("files"):
                 status = self.work(entry)
         finally:
@@ -765,9 +747,29 @@ def pull_model(
     base_params: dict | None = None,
     base_revision: str | None = None,
     tenant: str | None = None,
+    cancel: CancelToken | None = None,
     log=print,
 ) -> PullResult:
     """Pull ``repo_id@revision`` (see module docstring).
+
+    **Multi-tenant service** (ISSUE 13): with tenancy on (the default;
+    ``ZEST_TENANCY=0`` restores fully independent pulls) the pull is
+    admitted through the process-global controller — it may park in
+    the fair per-tenant queue (session phase ``queued``) or be
+    rejected with a typed :class:`~zest_tpu.transfer.tenancy.
+    AdmissionRejected` when the queue is full — and then runs over the
+    shared pools: the singleflight fetch table (one network fetch per
+    xorb range process-wide), the aggregate in-flight byte budget, and
+    the pinned xorb-cache eviction.
+
+    **Cancellation**: ``cancel`` (a :class:`CancelToken`; one is
+    created and attached to the session when absent, so ``DELETE
+    /v1/pulls/<id>`` always works) aborts the pull at the next stage
+    boundary. A cancelled pull finishes with the ``cancelled``
+    terminal session status — distinct from ``error`` — releases its
+    admission slot, byte shares and pins, and detaches from shared
+    flights without poisoning them (a cancelled flight LEADER hands
+    the fetch to a live waiter).
 
     **Session** (ISSUE 11): every pull registers in the process-global
     session table (:mod:`zest_tpu.telemetry.session`) — live phase,
@@ -801,13 +803,17 @@ def pull_model(
             "sound against the manifest of the revision the resident "
             "tree actually holds")
     t0 = time.monotonic()
+    tenant_label = tenant or getattr(cfg, "tenant", None)
+    if cancel is None:
+        cancel = CancelToken()
     # Session registration (ISSUE 11): identity + live progress for the
     # whole pull; `bind` stamps this thread's recorder events with the
     # session id (worker pools re-bind from a captured id). None with
     # telemetry off — every session call below no-ops on None.
     sess = telemetry.session.begin(
-        repo_id, revision,
-        tenant=tenant or getattr(cfg, "tenant", None), device=device)
+        repo_id, revision, tenant=tenant_label, device=device)
+    if sess is not None:
+        sess.cancel_token = cancel
     # The coop stage installs this pull's fleet trace context (host +
     # trace_id); restore the previous one at exit so a long-lived
     # daemon's NEXT pull never records under a stale identity (spans
@@ -822,40 +828,65 @@ def pull_model(
             telemetry.span("pull", repo=repo_id, revision=revision,
                            device=device or "") as _root:
         try:
-            result = _pull_model(cfg, repo_id, revision, device, swarm,
-                                 no_p2p, pod, pods, pod_index, pod_addrs,
-                                 (coop, coop_hosts, coop_index,
-                                  coop_addrs),
-                                 base_params, base_revision,
-                                 log, t0, session=sess)
+            # Global admission (ISSUE 13): the ticket is held for the
+            # pull's whole run — slot + queue fairness on entry (and a
+            # disk-watermark eviction pass), slot/pin release on exit
+            # however the pull ends. Knob-off, admit() is a no-op
+            # passthrough and the pull is the pre-tenancy pull.
+            with tenancy.admit(cfg, tenant_label, cancel=cancel,
+                               session=sess) as ticket:
+                result = _pull_model(cfg, repo_id, revision, device, swarm,
+                                     no_p2p, pod, pods, pod_index,
+                                     pod_addrs,
+                                     (coop, coop_hosts, coop_index,
+                                      coop_addrs),
+                                     base_params, base_revision,
+                                     log, t0, session=sess,
+                                     cancel=cancel, ticket=ticket)
         except BaseException as exc:
             # The finally guarantees the session reaches its terminal
             # state even when the crash-report bookkeeping below raises
             # (e.g. a caller-supplied log whose stream is gone) — a
             # skipped finish would strand a phantom "running" session
             # in /v1/pulls forever, same hazard the success path guards.
+            cancelled = isinstance(exc, PullCancelled)
+            rejected = isinstance(exc, tenancy.AdmissionRejected)
+            # Deliberate aborts and typed backpressure are NOT errors:
+            # a load-shedding daemon must not fill dashboards/alerts
+            # with "failed" pulls that are the 429 contract working.
+            status = ("cancelled" if cancelled
+                      else "rejected" if rejected else "error")
             try:
-                _M_PULLS.inc(outcome="error")
-                # Flight-recorder crash report (ISSUE 7): the last N
-                # notable events — strikes, fallbacks, faults, declines
-                # — dumped as one artifact next to the cache, so a
-                # failed pull's triage starts from the ordered event
-                # tail instead of log archaeology. Best-effort; never
-                # masks the real failure.
-                telemetry.record("pull_failed", repo=repo_id,
-                                 error=type(exc).__name__)
-                path = telemetry.recorder.dump_crash_report(
-                    cfg.cache_dir, f"pull {repo_id} failed: "
-                    f"{type(exc).__name__}")
-                if path:
-                    try:
-                        log(f"flight-recorder crash report: {path}",
-                            file=sys.stderr)
-                    except TypeError:
-                        pass  # log doubles without file= keep the dump
+                _M_PULLS.inc(outcome=status)
+                if cancelled or rejected:
+                    # Neither is a crash: no flight-recorder dump — a
+                    # deliberate abort (or typed backpressure) must not
+                    # bury real crash reports in noise.
+                    telemetry.record(
+                        "pull_cancelled" if cancelled
+                        else "pull_rejected",
+                        repo=repo_id, reason=str(exc))
+                else:
+                    # Flight-recorder crash report (ISSUE 7): the last N
+                    # notable events — strikes, fallbacks, faults,
+                    # declines — dumped as one artifact next to the
+                    # cache, so a failed pull's triage starts from the
+                    # ordered event tail instead of log archaeology.
+                    # Best-effort; never masks the real failure.
+                    telemetry.record("pull_failed", repo=repo_id,
+                                     error=type(exc).__name__)
+                    path = telemetry.recorder.dump_crash_report(
+                        cfg.cache_dir, f"pull {repo_id} failed: "
+                        f"{type(exc).__name__}")
+                    if path:
+                        try:
+                            log(f"flight-recorder crash report: {path}",
+                                file=sys.stderr)
+                        except TypeError:
+                            pass  # log doubles without file= keep the dump
             finally:
                 telemetry.session.finish(
-                    sess, "error", error=f"{type(exc).__name__}: {exc}")
+                    sess, status, error=f"{type(exc).__name__}: {exc}")
             raise
         finally:
             telemetry.trace.replace_context(_prev_ctx)
@@ -939,6 +970,8 @@ def _pull_model(
     log,
     t0: float,
     session=None,
+    cancel: CancelToken | None = None,
+    ticket=None,
 ) -> PullResult:
     # Validate the landing dtype BEFORE any network work: a config typo
     # (ZEST_TPU_DTYPE=fp16) must fail fast here, not be swallowed by the
@@ -972,9 +1005,17 @@ def _pull_model(
         # reads pull byte counters lazily — no new hot-path work.
         session.attach(clock=clock)
 
+    def _cancel_point() -> None:
+        """Stage-boundary cancellation check (ISSUE 13 satellite):
+        raises PullCancelled the moment the session's token fired."""
+        if cancel is not None:
+            cancel.check()
+
+    _cancel_point()
     with clock("resolve"):
         commit_sha = hub.resolve_revision(repo_id, revision)
         files = hub.list_files(repo_id, revision)
+    _cancel_point()
     snapshot_dir = cfg.model_snapshot_dir(repo_id, commit_sha)
     if session is not None:
         session.set_revision(commit_sha)
@@ -984,6 +1025,23 @@ def _pull_model(
     if swarm is None and not no_p2p:
         swarm = _default_swarm(cfg)
     bridge = XetBridge(cfg, swarm=swarm)
+    # Shared-pool wiring (ISSUE 13): the process singleflight table
+    # (one network fetch per xorb range across every session), this
+    # session's cancel token (waiters detach, a cancelled leader hands
+    # off), the eviction pins (every resolved plan's xorbs stay
+    # unevictable while this session is admitted), and the aggregate
+    # in-flight byte budget the file pipeline draws from. All absent
+    # with ZEST_TENANCY=0 — the bridge then behaves bit-for-bit as
+    # before.
+    shared_budget = None
+    if tenancy.enabled(cfg):
+        _tstate = tenancy.state(cfg)
+        bridge.flights = _tstate.flights
+        shared_budget = _tstate.byte_budget
+        if ticket is not None:
+            bridge.on_reconstruction = (
+                lambda rec: ticket.pin(rec.fetch_info.keys()))
+    bridge.cancel = cancel
     if session is not None:
         session.attach(fetch_stats=bridge.stats)
     # Per-pull wall-clock budget (ZEST_PULL_DEADLINE_S; off by default).
@@ -1016,17 +1074,34 @@ def _pull_model(
 
     def file_work(entry) -> str:
         dest = snapshot_dir / entry.path
-        if _is_complete(snapshot_dir, entry):
-            return "skipped"
-        if entry.is_xet:
-            ensure_auth()
-            _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
-                           entry, dest, log,
-                           lane_note=file_pipeline.note_lane)
-        else:
-            dest.parent.mkdir(parents=True, exist_ok=True)
-            hub.download_regular_file(repo_id, revision, entry.path, dest)
-            file_pipeline.note_lane("waterfall", entry.size)
+        try:
+            if _is_complete(snapshot_dir, entry):
+                return "skipped"
+            if entry.is_xet:
+                ensure_auth()
+                _pull_xet_file(bridge, par, hub, cfg, repo_id, revision,
+                               entry, dest, log,
+                               lane_note=file_pipeline.note_lane)
+            else:
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                hub.download_regular_file(repo_id, revision, entry.path,
+                                          dest)
+                file_pipeline.note_lane("waterfall", entry.size)
+        except OSError as exc:
+            # ENOSPC on an HF-cache write surfaces TYPED (ISSUE 13
+            # satellite): the writers above have already cleaned their
+            # temps; this fires the disk_pressure event + the tenancy
+            # eviction pass and re-raises as CacheFullError instead of
+            # a raw mid-pull OSError.
+            import errno as _errno
+
+            if (getattr(exc, "errno", None) == _errno.ENOSPC
+                    and not isinstance(exc, storage.CacheFullError)):
+                storage.note_disk_full(dest)
+                raise storage.CacheFullError(
+                    f"HF-cache write of {entry.path} hit ENOSPC",
+                    dest) from exc
+            raise
         clock.note_bytes("files", entry.size)
         return "downloaded"
 
@@ -1036,7 +1111,8 @@ def _pull_model(
         skip_check=lambda e: _is_complete(snapshot_dir, e),
         materialize_workers=_resolve_files_workers(
             getattr(cfg, "files_workers", 0)),
-        async_handoff=bool(getattr(cfg, "files_async", True)))
+        async_handoff=bool(getattr(cfg, "files_async", True)),
+        budget=shared_budget, cancel=cancel)
 
     try:
         # config.json feeds family dispatch twice (pod pre-pass, landing
@@ -1169,6 +1245,7 @@ def _pull_model(
         fed = pods is not None and pods > 1 and pod_index is not None
         coop_cfg = _resolve_coop(cfg, *coop_args, log=log)
         pod_stats = fed_stats = coop_stats = None
+        _cancel_point()
         if pod or fed or coop_cfg:
             pending = [
                 e for e in files
@@ -1275,6 +1352,7 @@ def _pull_model(
         mesh = None
         time_to_hbm = hbm_done_at = None
         time_to_first_layer = None
+        _cancel_point()
         if device == "tpu":
             if cfg.mesh.mesh_axes:
                 from zest_tpu.parallel.mesh import mesh_from_config
@@ -1313,6 +1391,7 @@ def _pull_model(
         # Tail pass: everything not already riding the pipeline (the whole
         # repo, for a plain pull) — submit is path-deduped, then the join is
         # the stage barrier. Workers time themselves under clock("files").
+        _cancel_point()
         for entry in files:
             file_pipeline.submit(entry)
         clock.ensure("files")
@@ -1494,6 +1573,12 @@ def _pull_model(
             hbm_stats = {"error": str(exc), "direct": False}
     if hbm_stats is not None:
         stats["hbm"] = hbm_stats
+    if ticket is not None and hbm_params is not None:
+        # Live-HBM-tree pin (ISSUE 13): the manifest evidence a later
+        # delta/hot-swap of this repo will diff against must survive
+        # this session's own pins releasing — replaced when a newer
+        # revision of the same repo lands.
+        ticket.pin_tree(repo_id, bridge.resolved_xorb_hashes())
 
     # Chaos-run evidence (ISSUE 4 satellite): per-fault fired counts, so
     # a chaos test asserts "the fault actually fired" directly instead
@@ -2591,11 +2676,17 @@ def _write_file_from_cache(bridge, xet_hash: str, dest: Path,
 def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log,
                    lane_note=None):
     """Cache-direct fast lane, then the 3-deep fallback chain
-    (reference: main.zig:232-256)."""
+    (reference: main.zig:232-256). A session cancellation
+    (PullCancelled, ISSUE 13) is NOT a tier failure — it re-raises
+    instead of falling to the next tier, or a cancelled pull would
+    grind through every fallback (ending at a plain CDN download of
+    the very file it was told to stop fetching)."""
     try:
         if _write_file_from_cache(bridge, entry.xet_hash, dest,
                                   lane_note=lane_note):
             return
+    except PullCancelled:
+        raise
     except Exception as exc:  # noqa: BLE001 - fast lane is optional
         log(f"cache-direct write of {entry.path} failed ({exc}); "
             "taking the waterfall chain", file=sys.stderr)
@@ -2604,12 +2695,16 @@ def _pull_xet_file(bridge, par, hub, cfg, repo_id, revision, entry, dest, log,
     try:
         par.reconstruct_to_file(entry.xet_hash, dest)
         return
+    except PullCancelled:
+        raise
     except Exception as exc:  # noqa: BLE001 - any failure falls through
         log(f"parallel fetch of {entry.path} failed ({exc}); "
             "retrying sequentially", file=sys.stderr)
     try:
         bridge.reconstruct_to_file(entry.xet_hash, dest)
         return
+    except PullCancelled:
+        raise
     except Exception as exc:  # noqa: BLE001
         log(f"sequential fetch of {entry.path} failed ({exc}); "
             "falling back to plain download", file=sys.stderr)
