@@ -1,20 +1,27 @@
-"""MULTIHOST artifact driver: cooperative pull vs per-host CDN,
-unshaped AND WAN-shaped (VERDICT r5 item 3 + ROADMAP item 1).
+"""MULTIHOST artifact driver: cooperative pull vs per-host CDN, and
+the collective vs point-to-point exchange race (ROADMAP items 1+3,
+ISSUE 14).
 
-Writes ``MULTIHOST_r06.json``-style artifacts with two sections:
+Writes ``MULTIHOST_r14.json``-style artifacts with two sections:
 
 - ``unshaped`` — CDN at loopback speed (the honesty rows: on one
   machine everything is CPU/disk-bound and cooperation's win is
   modest);
 - ``shaped``  — the hub's CDN data plane token-bucketed to a WAN-ish
-  shared rate while the DCN exchange stays at loopback speed: the
-  asymmetry the reference's tier-3 scenario table measures, under
-  which the per-host baseline pays N x model_bytes through the shaped
-  pipe and the cooperative pull pays ~1x + a loopback exchange of
-  *compressed* frames.
+  shared rate AND the DCN hub shaped (per-host serve-rate token bucket
+  + one WAN round trip charged per request *window*, keyed on the v2
+  wire tag): the asymmetry the reference's tier-3 scenario table
+  measures. Under it the per-host baseline pays N x model_bytes
+  through the shaped CDN, the cooperative pull pays ~1x + an exchange
+  of *compressed* frames, and the exchange block races the PR-6
+  point-to-point windows (per-owner windows + NOT_FOUND retry rounds,
+  each paying the window RTT) against the collective's O(log N)
+  pre-sized phase windows — same bytes, same peer_served_ratio, fewer
+  round trips.
 
-Usage: python scripts/coop_bench.py [--out MULTIHOST_r06.json]
-       [--mb 64] [--hosts 8] [--cdn-mbps 16]
+Usage: python scripts/coop_bench.py [--out MULTIHOST_r14.json]
+       [--mb 64] [--hosts 8] [--cdn-mbps 16] [--dcn-rtt-ms 150]
+       [--dcn-mbps 0] [--topology 0,0,0,0,1,1,1,1]
 """
 
 from __future__ import annotations
@@ -29,13 +36,25 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="MULTIHOST_r06.json")
-    ap.add_argument("--mb", type=float, default=64.0,
+    ap.add_argument("--out", default="MULTIHOST_r14.json")
+    ap.add_argument("--mb", type=float, default=32.0,
                     help="checkpoint megabytes")
     ap.add_argument("--hosts", type=int, default=8)
     ap.add_argument("--cdn-mbps", type=float, default=4.0,
                     help="shaped CDN rate, MB/s shared across hosts "
                          "(~32 Mbps: a WAN-class origin allocation)")
+    ap.add_argument("--dcn-rtt-ms", type=float, default=150.0,
+                    help="shaped DCN: one WAN round trip charged per "
+                         "request window (v2 wire-tag boundary)")
+    ap.add_argument("--dcn-mbps", type=float, default=3.0,
+                    help="shaped DCN: per-host cross-slice serve "
+                         "rate, MB/s (0 = rate-unshaped, RTT only); "
+                         "with --topology, intra-slice links stay "
+                         "unshaped (the ICI-vs-DCN asymmetry)")
+    ap.add_argument("--topology", default=None,
+                    help="ZEST_COOP_TOPOLOGY-grammar slice spec "
+                         "(e.g. 0,0,0,0,1,1,1,1) for ici/dcn link "
+                         "classes")
     ap.add_argument("--skip-unshaped", action="store_true")
     args = ap.parse_args()
 
@@ -57,14 +76,19 @@ def main() -> int:
         print(f"[coop-bench] unshaped: {args.hosts} hosts, "
               f"{args.mb} MB ...", flush=True)
         out["unshaped"] = bench_coop_pull(gb=args.mb / 1000.0,
-                                          n_hosts=args.hosts)
+                                          n_hosts=args.hosts,
+                                          topology=args.topology)
         print(json.dumps(out["unshaped"], indent=1), flush=True)
     rate = int(args.cdn_mbps * 1e6)
-    print(f"[coop-bench] shaped: CDN {args.cdn_mbps} MB/s shared ...",
-          flush=True)
-    out["shaped"] = bench_coop_pull(gb=args.mb / 1000.0,
-                                    n_hosts=args.hosts,
-                                    shaped_bps=rate)
+    print(f"[coop-bench] shaped: CDN {args.cdn_mbps} MB/s shared, "
+          f"DCN rtt {args.dcn_rtt_ms} ms/window"
+          + (f" @ {args.dcn_mbps} MB/s/host" if args.dcn_mbps else "")
+          + " ...", flush=True)
+    out["shaped"] = bench_coop_pull(
+        gb=args.mb / 1000.0, n_hosts=args.hosts, shaped_bps=rate,
+        dcn_rtt_s=args.dcn_rtt_ms / 1000.0,
+        dcn_bps=int(args.dcn_mbps * 1e6) or None,
+        topology=args.topology)
     print(json.dumps(out["shaped"], indent=1), flush=True)
 
     sh = out["shaped"]
@@ -78,6 +102,28 @@ def main() -> int:
     if not (wire.get("compressed_ratio") or 1.0) < 1.0:
         print("FAIL: exchange wire bytes not smaller than unpacked — "
               "compressed frames did not cross the wire",
+              file=sys.stderr)
+        ok = False
+    # ISSUE 14 acceptance: the collective exchange beats the
+    # point-to-point exchange wall >=1.3x on the shaped sim, at equal
+    # peer_served_ratio and with zero per-unit request round trips.
+    xch = sh.get("exchange") or {}
+    if (xch.get("collective_speedup") or 0) < 1.3:
+        print(f"FAIL: collective exchange speedup "
+              f"{xch.get('collective_speedup')} < 1.3 over "
+              "point-to-point", file=sys.stderr)
+        ok = False
+    cxb = (xch.get("collective") or {}).get("collective") or {}
+    if cxb.get("unit_round_trips", -1) != 0:
+        print(f"FAIL: collective leg made "
+              f"{cxb.get('unit_round_trips')} per-unit round trips "
+              "(want 0)", file=sys.stderr)
+        ok = False
+    p_ratio = (xch.get("p2p") or {}).get("peer_served_ratio")
+    c_ratio = (xch.get("collective") or {}).get("peer_served_ratio")
+    if p_ratio != c_ratio:
+        print(f"FAIL: peer_served_ratio diverged between exchange "
+              f"legs (p2p {p_ratio} vs collective {c_ratio})",
               file=sys.stderr)
         ok = False
     pathlib.Path(args.out).write_text(json.dumps(out, indent=1) + "\n")

@@ -17,6 +17,18 @@ the round concurrently. Asserts, schema- and content-level:
   (the fixture is generated compressible, as real checkpoints are);
 - zero exchange fallbacks on the healthy path.
 
+Collective-exchange gates (ISSUE 14) — the 8-host round runs the
+plan-derived hypercube schedule by default:
+
+- ``stats["coop"]["collective"]`` shows 3 phases, no abort, and ZERO
+  per-unit request round trips, asserted twice: the stats field and
+  the wire-tag counter of an injected per-peer DcnPool (every window
+  tagged, window count == phases + barrier retries);
+- the same ``params_digest`` identity as above covers the collective
+  leg (the main pull IS collective now), and the chaos leg asserts a
+  ``collective_abort`` flight-recorder event on an injected
+  ``dcn_reset`` mid-phase before the CDN fallback heals the round.
+
 Fleet-observability gates (ISSUE 7) — the run is TRACED, and after the
 pull the per-host spans merge into ONE Perfetto doc that must show:
 
@@ -56,7 +68,7 @@ def main() -> int:
     from zest_tpu.telemetry import trace as trace_mod
     from zest_tpu.transfer.bridge import XetBridge
     from zest_tpu.transfer.coop import coop_round
-    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.dcn import DcnPool, DcnServer
     from zest_tpu.transfer.pull import pull_model
 
     files = llama_checkpoint_files(0.064, shard_bytes=16 * 1024 * 1024,
@@ -111,6 +123,9 @@ def main() -> int:
 
         peer_results: list = [None] * N_HOSTS
         peer_errors: list[str] = []
+        # Peer 1 runs over an injected pool whose wire-tag counters
+        # prove the collective leg's zero-per-unit-round-trip claim.
+        tag_pool = DcnPool()
 
         def run_peer(idx: int, bridge) -> None:
             try:
@@ -119,7 +134,8 @@ def main() -> int:
                         if e.is_xet]
                 peer_results[idx] = coop_round(
                     bridge, recs, idx, N_HOSTS, addrs,
-                    trace_id=trace_id)
+                    trace_id=trace_id,
+                    dcn_pool=tag_pool if idx == 1 else None)
             except Exception as exc:  # noqa: BLE001 - reported below
                 peer_errors.append(f"host {idx}: {exc!r}")
 
@@ -134,6 +150,7 @@ def main() -> int:
                          coop_addrs=addrs, log=lambda *a, **k: None)
         for t in threads:
             t.join(timeout=180)
+        tag_pool.close()
         for s in servers:
             s.shutdown()
 
@@ -157,6 +174,44 @@ def main() -> int:
                 f"exchange wire carried {ex['wire_bytes']} bytes for "
                 f"{ex.get('unpacked_bytes')} unpacked — frames were "
                 "not compressed on the wire", coop)
+
+        # ── Collective-exchange gates (ISSUE 14) ──
+        cx = coop.get("collective")
+        if not cx:
+            return fail("8-host round did not take the collective "
+                        "exchange", coop)
+        if cx.get("schedule") != "hypercube" or cx.get("phases") != 3:
+            return fail(f"expected a 3-phase hypercube at 8 hosts, got "
+                        f"{cx.get('schedule')}/{cx.get('phases')}", cx)
+        if cx.get("aborted"):
+            return fail(f"collective aborted on the healthy path "
+                        f"({cx['aborted']})", cx)
+        if cx.get("unit_round_trips") != 0:
+            return fail(f"{cx['unit_round_trips']} per-unit round "
+                        "trips in the collective leg (want 0)", cx)
+        # peer_results is indexed by HOST index (run_peer stores at
+        # idx), so enumerate already yields the right host number.
+        for i, r in enumerate(peer_results):
+            pcx = (r or {}).get("collective") or {}
+            if r and (pcx.get("aborted") or not pcx):
+                return fail(f"host {i} collective degraded", r)
+        # Wire-tag counter: every window peer 1 sent was a tagged
+        # batched window — the per-unit request/reply shape never hit
+        # the wire — and the window count is exactly phases + barrier
+        # retries.
+        tc = tag_pool.counters
+        pcx = peer_results[1]["collective"]
+        if tc["untagged_windows"] != 0:
+            return fail(f"{tc['untagged_windows']} untagged windows "
+                        "on the collective leg", tc)
+        # <= not ==: a phase whose whole block set was already cached
+        # (a whole-xorb admit covering sibling units) issues zero
+        # windows — fewer windows than phases is fine, more means
+        # per-unit round trips crept back.
+        if not 0 < tc["windows"] <= pcx["phases"] + pcx["retry_windows"]:
+            return fail(f"window count {tc['windows']} outside "
+                        f"(0, phases {pcx['phases']} + retries "
+                        f"{pcx['retry_windows']}]", tc)
         if not (stats.get("hbm") or {}).get("direct"):
             return fail("coop pull did not take the direct landing",
                         stats.get("hbm"))
@@ -183,7 +238,7 @@ def main() -> int:
                         f"minted {trace_id}", coop)
         for i, r in enumerate(peer_results):
             if r and r.get("trace_id") != trace_id:
-                return fail(f"host {i+1} trace_id diverged", r)
+                return fail(f"host {i} trace_id diverged", r)
         doc = tracer.to_chrome()
         per_host = fleet.split_hosts(doc, default_host=0)
         merged = fleet.merge_traces(per_host)
@@ -240,6 +295,9 @@ def main() -> int:
         if "fault_fired" not in kinds or "cdn_fallback" not in kinds:
             return fail(f"flight recorder missed the chaos story: "
                         f"{kinds[-20:]}")
+        if "collective_abort" not in kinds:
+            return fail("dcn_reset mid-phase left no collective_abort "
+                        f"in the flight recorder: {kinds[-20:]}")
         dump_path = recorder.RECORDER.dump(rootp / "recorder.json",
                                            reason="injected dcn_reset")
         dumped = json.loads(pathlib.Path(dump_path).read_text())
@@ -251,7 +309,10 @@ def main() -> int:
         print("coop smoke OK: host-0 peer_served_ratio "
               f"{ratio:.3f}, exchange {ex['units']} units / "
               f"{ex['wire_bytes']} wire bytes "
-              f"({ex['unpacked_bytes']} unpacked), peers "
+              f"({ex['unpacked_bytes']} unpacked), collective "
+              f"{cx['schedule']} x{cx['phases']} phases "
+              f"({tc['windows']} tagged windows, 0 per-unit round "
+              f"trips), peers "
               f"{peer_ratios}, HBM digest {coop_digest[:16]} == solo; "
               f"merged trace: {len(meta['merged_hosts'])} host tracks, "
               f"{meta['flow_links']} flow links, trace_id {trace_id[:8]}…; "

@@ -1,0 +1,578 @@
+"""Collective-native coop exchange (transfer.collective; ISSUE 14).
+
+Covers the ISSUE-14 acceptance surface:
+
+- schedule/matrix determinism: every host derives the same phase
+  schedule and N×N byte matrix purely from the plan (rec reorder and
+  repeated builds agree), every foreign unit is requested exactly once
+  per host, and per-owner received bytes equal the plan's ownership
+  rows — including under quarantine re-shard;
+- topology awareness: ``ZEST_COOP_TOPOLOGY`` slice ids class each
+  phase link ici (intra-slice) vs dcn (cross-slice), strictly parsed;
+- the round end-to-end over real loopback DCN sockets at hypercube
+  (4, 8 hosts) and ring (3 hosts) shapes: fully cached everywhere,
+  compressed frames on the wire, zero per-unit round trips (wire-tag
+  counters), byte-identical reconstruction;
+- degradation: a dead host mid-phase aborts the collective into the
+  point-to-point ladder (the round still completes everywhere), and a
+  corrupt frame is rejected at the receive-side verify boundary then
+  healed;
+- ``ZEST_COOP_COLLECTIVE=0`` schema equality with the PR-6 exchange;
+- the exchange stats ledger: tier attribution exactly tiles delivered
+  bytes, including the mid-round re-delivery race (ISSUE 14 satellite).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fixtures import FixtureHub, FixtureRepo
+
+from zest_tpu import faults
+from zest_tpu.cas.hub import HubClient
+from zest_tpu.config import Config, parse_topology
+from zest_tpu.transfer.collective import (
+    CollectiveSchedule,
+    CollectiveUnavailable,
+    matrix_skew,
+    slice_topology,
+    transfer_matrix,
+    units_by_owner,
+)
+from zest_tpu.transfer.coop import (
+    CoopPlan,
+    _collect_clock_offsets,
+    _ExchangeStats,
+    coop_round,
+)
+from zest_tpu.transfer.dcn import DcnPool, DcnServer
+
+REPO_ID = "acme/collective-model"
+
+# Compressible payload: the compressed-through-the-collective evidence
+# (wire < unpacked) must be visible, as on real checkpoints.
+_PAYLOAD = np.random.default_rng(7).integers(
+    0, 4, 1_500_000, dtype=np.uint8).tobytes()
+FILES = {
+    "config.json": b'{"model_type": "collective"}',
+    "model.safetensors": _PAYLOAD,
+}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo(REPO_ID, FILES, chunks_per_xorb=2)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _bridge(hub, root, collective=True):
+    from zest_tpu.transfer.bridge import XetBridge
+
+    cfg = Config(hf_home=root / "hf", cache_dir=root / "zest",
+                 hf_token="hf_test", endpoint=hub.url, dcn_port=0,
+                 coop_collective=collective)
+    b = XetBridge(cfg)
+    b.authenticate(REPO_ID)
+    return b
+
+
+def _recs(bridge):
+    return [bridge.get_reconstruction(e.xet_hash)
+            for e in HubClient(bridge.cfg).list_files(REPO_ID)
+            if e.is_xet]
+
+
+def _run_hosts(hub, tmp_path, n, round_kwargs=None, skip=(),
+               collective=True, pools=None):
+    """n concurrent in-process hosts (own cache + DCN server each);
+    ``pools`` maps host index → an injected DcnPool whose wire-tag
+    counters the test inspects afterwards."""
+    bridges, servers, addrs = [], [], {}
+    for i in range(n):
+        b = _bridge(hub, tmp_path / f"h{i}", collective=collective)
+        bridges.append(b)
+        if i in skip:
+            addrs[i] = ("127.0.0.1", 1)  # nothing listens
+            servers.append(None)
+        else:
+            s = DcnServer(b.cfg, b.cache)
+            addrs[i] = ("127.0.0.1", s.start())
+            servers.append(s)
+    results: list = [None] * n
+    errors: list = []
+
+    def run(i):
+        try:
+            kwargs = dict(round_kwargs or {})
+            if pools and i in pools:
+                kwargs["dcn_pool"] = pools[i]
+            results[i] = coop_round(bridges[i], _recs(bridges[i]), i, n,
+                                    addrs, server=servers[i], **kwargs)
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n) if i not in skip]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for s in servers:
+        if s is not None:
+            s.shutdown()
+    assert not errors, errors
+    return bridges, results
+
+
+def _assert_fully_cached(bridge, root):
+    """Every xet file reconstructs byte-exactly with zero CDN traffic —
+    the params-identity proof at cache level (the TPU-landed digest
+    identity rides the same bytes; coop_smoke pins it end-to-end)."""
+    before = bridge.stats.bytes_from_cdn
+    for e in HubClient(bridge.cfg).list_files(REPO_ID):
+        if e.is_xet:
+            out = root / "check.bin"
+            bridge.reconstruct_to_file(e.xet_hash, out)
+            assert out.read_bytes() == FILES[e.path]
+    assert bridge.stats.bytes_from_cdn == before, \
+        "reconstruction hit CDN: cache incomplete after the round"
+
+
+def _requested_keys(plan, host, topology):
+    """Unit keys host ``host`` requests across its whole schedule."""
+    sched = CollectiveSchedule.build(plan, host, topology)
+    blocks = units_by_owner(plan)
+    keys = []
+    for ph in sched.phases:
+        for o in ph.owners:
+            keys.extend((hh, fi.range.start) for hh, fi in blocks[o])
+    return keys
+
+
+# ── Schedule + matrix determinism ──
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 6, 8])
+def test_every_unit_requested_exactly_once(hub, tmp_path, n):
+    """Per host: the union of phase request sets is exactly the foreign
+    unit set, each unit once — the "every byte sent exactly once"
+    invariant, and per-owner received bytes therefore equal the plan's
+    ownership rows by construction."""
+    b = _bridge(hub, tmp_path)
+    plan = CoopPlan.build(_recs(b), n)
+    topo = (0,) * n
+    for host in plan.alive:
+        keys = _requested_keys(plan, host, topo)
+        foreign = sorted(k for k, _fi in plan.units
+                         if plan.owners[k] != host)
+        assert sorted(keys) == foreign
+        assert len(keys) == len(set(keys))
+
+
+def test_matrix_deterministic_under_rec_reorder(hub, tmp_path):
+    b = _bridge(hub, tmp_path)
+    recs = _recs(b)
+    topo = (0, 0, 1, 1)
+    m1 = transfer_matrix(CoopPlan.build(recs, 4), topo)
+    m2 = transfer_matrix(CoopPlan.build(list(reversed(recs)), 4), topo)
+    assert m1 == m2
+    assert len(m1) == 4 and all(len(row) == 4 for row in m1)
+    assert all(m1[h][h] == 0 for h in range(4)), "no self-traffic"
+    assert matrix_skew(m1) >= 1.0
+
+
+def test_matrix_quarantine_reshard(hub, tmp_path):
+    """A quarantined host leaves the schedule entirely (zero row AND
+    column) and every unit is still requested exactly once by every
+    alive host — the re-shard covers 100% of the plan."""
+    b = _bridge(hub, tmp_path)
+    recs = _recs(b)
+    plan = CoopPlan.build(recs, 4, quarantined={2})
+    topo = (0,) * 4
+    m = transfer_matrix(plan, topo)
+    assert all(v == 0 for v in m[2]), "quarantined host sends nothing"
+    assert all(row[2] == 0 for row in m), "nobody sends to it"
+    for host in plan.alive:
+        keys = _requested_keys(plan, host, topo)
+        foreign = sorted(k for k, _fi in plan.units
+                         if plan.owners[k] != host)
+        assert sorted(keys) == foreign
+    # kind flips to ring at 3 alive hosts (not a power of two)
+    assert CollectiveSchedule.build(plan, 0, topo).kind == "ring"
+
+
+def test_schedule_shapes_and_links(hub, tmp_path):
+    b = _bridge(hub, tmp_path)
+    plan = CoopPlan.build(_recs(b), 4)
+    # flat topology → plain hypercube
+    s_flat = CollectiveSchedule.build(plan, 0, (0,) * 4)
+    assert s_flat.kind == "hypercube"
+    assert len(s_flat.phases) == 2
+    assert all(ph.link == "ici" for ph in s_flat.phases)
+    # 2 slices x 2 hosts → hierarchical: one cross-slice counterpart
+    # phase (DCN), then one intra-slice spread phase (ICI)
+    topo = (0, 0, 1, 1)
+    s0 = CollectiveSchedule.build(plan, 0, topo)
+    assert s0.kind == "hierarchical"
+    assert len(s0.phases) == 2
+    assert s0.phases[0].partner == 2 and s0.phases[0].link == "dcn"
+    assert s0.phases[0].owners == (2,), \
+        "cross phase imports only the counterpart's OWN block"
+    assert s0.phases[1].partner == 1 and s0.phases[1].link == "ici"
+    assert sorted(s0.phases[1].owners) == [1, 3], \
+        "intra phase spreads the partner's whole counterpart group"
+    s_ring = CollectiveSchedule.build(CoopPlan.build(_recs(b), 3), 1,
+                                      (0, 0, 0))
+    assert s_ring.kind == "ring"
+    assert len(s_ring.phases) == 2
+    assert all(ph.partner == 0 for ph in s_ring.phases), \
+        "ring pulls from the constant left neighbor"
+
+
+def test_hierarchical_schedule_minimizes_cross_slice_bytes(hub,
+                                                           tmp_path):
+    """The topology preference rule in byte form: at 2 slices x 4
+    hosts, a host's cross-slice (DCN-class) receive bytes are ~1/7 of
+    its foreign bytes (its counterpart's block only) — vs 4/7 for the
+    flat point-to-point/hypercube exchange — and the aggregate DCN
+    traffic is ONE copy of each slice's data."""
+    b = _bridge(hub, tmp_path)
+    plan = CoopPlan.build(_recs(b), 8)
+    topo = (0, 0, 0, 0, 1, 1, 1, 1)
+    blocks = units_by_owner(plan)
+    bb = {h: sum(fi.url_range_end - fi.url_range_start
+                 for _hh, fi in us) for h, us in blocks.items()}
+    total = sum(bb.values())
+    for host in plan.alive:
+        sched = CollectiveSchedule.build(plan, host, topo)
+        assert sched.kind == "hierarchical"
+        assert len(sched.phases) == 3  # 1 cross + 2 intra
+        dcn = sum(bb[o] for ph in sched.phases if ph.link == "dcn"
+                  for o in ph.owners)
+        counterpart = sched.phases[0].owners[0]
+        assert dcn == bb[counterpart], \
+            "cross-slice receive = exactly the counterpart's block"
+        assert dcn < total / 4
+        # every foreign unit still arrives exactly once
+        keys = _requested_keys(plan, host, topo)
+        foreign = sorted(k for k, _fi in plan.units
+                        if plan.owners[k] != host)
+        assert sorted(keys) == foreign
+    m = transfer_matrix(plan, topo)
+    cross = sum(m[s][d] for s in range(8) for d in range(8)
+                if topo[s] != topo[d])
+    assert cross == total, \
+        "aggregate DCN traffic is one copy of each slice's data"
+
+
+def test_schedule_unavailable_cases(hub, tmp_path):
+    b = _bridge(hub, tmp_path)
+    plan = CoopPlan.build(_recs(b), 4, quarantined={1, 2, 3})
+    with pytest.raises(CollectiveUnavailable):
+        CollectiveSchedule.build(plan, 0, (0,) * 4)  # alone
+    with pytest.raises(CollectiveUnavailable):
+        CollectiveSchedule.build(CoopPlan.build(_recs(b), 4), 9,
+                                 (0,) * 4)  # not in the plan
+
+
+# ── Topology resolution (strict knobs) ──
+
+
+def test_topology_env_override_and_strictness():
+    assert slice_topology(4, env={"ZEST_COOP_TOPOLOGY": "0,0,1,1"}) \
+        == (0, 0, 1, 1)
+    assert slice_topology(3, env={}) == (0, 0, 0)  # flat default
+    with pytest.raises(ValueError):
+        slice_topology(4, env={"ZEST_COOP_TOPOLOGY": "0,0,nope,1"})
+    with pytest.raises(ValueError):
+        # length disagreement is a config error, not a guess
+        slice_topology(4, env={"ZEST_COOP_TOPOLOGY": "0,0,1"})
+    cfg = Config(hf_home="/tmp/x", cache_dir="/tmp/y",
+                 coop_topology=(0, 1))
+    assert slice_topology(2, cfg=cfg, env={}) == (0, 1)
+    with pytest.raises(ValueError):
+        parse_topology("0,-1")
+    with pytest.raises(ValueError):
+        parse_topology("")
+
+
+def test_config_collective_env_parsing():
+    base = {"HF_HOME": "/tmp/x", "ZEST_CACHE_DIR": "/tmp/y"}
+    cfg = Config.load(base)
+    assert cfg.coop_collective is True and cfg.coop_topology is None
+    off = Config.load({**base, "ZEST_COOP_COLLECTIVE": "0"})
+    assert off.coop_collective is False
+    topo = Config.load({**base, "ZEST_COOP_TOPOLOGY": "0, 0, 1, 1"})
+    assert topo.coop_topology == (0, 0, 1, 1)
+    for bad in ("false", "yes", "2", " "):
+        with pytest.raises(ValueError):
+            Config.load({**base, "ZEST_COOP_COLLECTIVE": bad})
+    with pytest.raises(ValueError):
+        Config.load({**base, "ZEST_COOP_TOPOLOGY": "a,b"})
+
+
+# ── The round, end to end ──
+
+
+@pytest.mark.parametrize("n,kind,phases", [(3, "ring", 2),
+                                           (4, "hypercube", 2)])
+def test_collective_round_end_to_end(hub, tmp_path, n, kind, phases):
+    pools = {i: DcnPool() for i in range(n)}
+    try:
+        bridges, results = _run_hosts(hub, tmp_path, n, pools=pools)
+        for i, (b, r) in enumerate(zip(bridges, results)):
+            cx = r.get("collective")
+            assert cx, r
+            assert cx["schedule"] == kind
+            assert cx["phases"] == phases
+            assert len(cx["phase_wall_s"]) == phases
+            assert "aborted" not in cx, cx
+            assert cx["unit_round_trips"] == 0
+            assert r["fallbacks"] == 0, r
+            assert r["exchange"]["units"] > 0
+            assert 0 < r["exchange"]["wire_bytes"] \
+                < r["exchange"]["unpacked_bytes"]
+            assert sum(cx["link_bytes"].values()) \
+                == r["exchange"]["wire_bytes"], \
+                "link-class bytes must tile the exchange wire"
+            assert r["peer_served_ratio"] >= 0.6, r
+            _assert_fully_cached(b, tmp_path / f"h{i}")
+        # Zero per-unit request round trips: every window the round's
+        # pool sent carried a wire tag (the batched-window shape), and
+        # the healthy path needed no more windows than phases plus
+        # barrier retries.
+        for i, pool in pools.items():
+            if results[i] is None:
+                continue
+            c = pool.counters
+            assert c["untagged_windows"] == 0, (i, c)
+            assert c["windows"] == c["tagged_windows"]
+            cx = results[i]["collective"]
+            assert c["windows"] == cx["windows"], (i, c, cx)
+            # <= not ==: a phase fully covered by earlier whole-xorb
+            # admits issues zero windows; more windows than
+            # phases + barrier retries would mean per-unit round
+            # trips crept back.
+            assert 0 < cx["windows"] \
+                <= cx["phases"] + cx["retry_windows"], (i, cx)
+        # disjoint fetch shares: ~1 copy total left the CDN
+        total_cdn = sum(b.stats.bytes_from_cdn for b in bridges)
+        assert total_cdn <= results[0]["plan"]["total_bytes"] * 1.05
+    finally:
+        for pool in pools.values():
+            pool.close()
+
+
+def test_collective_eight_host_hypercube(hub, tmp_path):
+    bridges, results = _run_hosts(hub, tmp_path, 8)
+    for i, (b, r) in enumerate(zip(bridges, results)):
+        cx = r.get("collective")
+        assert cx and cx["schedule"] == "hypercube"
+        assert cx["phases"] == 3 and "aborted" not in cx, cx
+        assert r["fallbacks"] == 0, r
+        _assert_fully_cached(b, tmp_path / f"h{i}")
+
+
+def test_collective_matches_p2p_and_solo_bytes(hub, tmp_path):
+    """Identity across strategies: collective round, point-to-point
+    round, and a solo full-waterfall warm all end with byte-identical
+    reconstructions (the cache-level params_digest identity; the smoke
+    pins the TPU-landed digest on top of the same bytes)."""
+    from zest_tpu.transfer.federated import warm_units_parallel
+
+    _bridges, _results = _run_hosts(hub, tmp_path / "cx", 2)
+    _bridges2, _results2 = _run_hosts(hub, tmp_path / "p2p", 2,
+                                      collective=False)
+    solo = _bridge(hub, tmp_path / "solo")
+    warm_units_parallel(solo, _recs(solo))
+    _assert_fully_cached(solo, tmp_path / "solo")
+    _assert_fully_cached(_bridges[0], tmp_path / "cx" / "h0")
+    _assert_fully_cached(_bridges2[0], tmp_path / "p2p" / "h0")
+    assert _results[0].get("collective")
+    assert "collective" not in _results2[0]
+
+
+def test_collective_dead_host_degrades_to_p2p_ladder(hub, tmp_path):
+    """A dead partner mid-phase aborts the collective into the
+    point-to-point exchange, which degrades the dead host's units to
+    CDN — every live host still completes, and a live host may even
+    receive the dead share FORWARDED by a peer that healed it first."""
+    n = 4
+    bridges, results = _run_hosts(hub, tmp_path, n, skip={3})
+    aborted = [r for r in results if r and
+               (r.get("collective") or {}).get("aborted")]
+    assert aborted, "no host observed the dead partner"
+    assert any(3 in (r["exchange"].get("dead_hosts") or [])
+               for r in results if r)
+    assert sum(r["fallbacks"] for r in results if r) > 0, \
+        "the dead share never healed through the ladder"
+    for i in range(3):
+        _assert_fully_cached(bridges[i], tmp_path / f"h{i}")
+
+
+def test_collective_corrupt_frame_rejected_and_healed(hub, tmp_path):
+    """A byte-flipped frame crossing the collective fails the
+    receive-side whole-xorb verification (the fused device pass on
+    TPU), is never cached, and heals from CDN."""
+    from zest_tpu.transfer.federated import warm_units_parallel
+
+    b0 = _bridge(hub, tmp_path / "owner")
+    recs0 = _recs(b0)
+    plan = CoopPlan.build(recs0, 2)
+    owned = plan.for_host(0)
+    assert owned
+    warm_units_parallel(b0, recs0, units=owned)
+    hh, fi = owned[0]
+    entry = b0.cache.get_with_range(hh, fi.range.start)
+    bad = bytearray(entry.data)
+    bad[len(bad) // 2] ^= 0xFF
+    b0.cache.put(hh, bytes(bad))
+
+    server = DcnServer(b0.cfg, b0.cache)
+    port = server.start()
+    try:
+        b1 = _bridge(hub, tmp_path / "puller")
+        r = coop_round(b1, _recs(b1), 1, 2, {0: ("127.0.0.1", port)})
+        assert r.get("collective"), r
+        assert r["exchange"]["verify_rejected"] >= 1, r
+        assert r["fallbacks"] >= 1, r
+        _assert_fully_cached(b1, tmp_path / "puller")
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.chaos
+def test_collective_chaos_dcn_reset_mid_phase(hub, tmp_path):
+    """An injected ``dcn_reset`` inside a phase window aborts the
+    collective and the full ladder completes the round from CDN —
+    counted, never a hang, never corruption."""
+    faults.install("dcn_reset:1.0", seed=1337)
+    bridges, results = _run_hosts(hub, tmp_path, 2)
+    assert faults.counters().get("dcn_reset", 0) > 0
+    for i, (b, r) in enumerate(zip(bridges, results)):
+        assert (r.get("collective") or {}).get("aborted"), r
+        assert r["fallbacks"] > 0, r
+        assert r["exchange"]["units"] == 0, r
+        _assert_fully_cached(b, tmp_path / f"h{i}")
+
+
+# ── Knob-off schema equality (the PR-6 pin) ──
+
+
+def test_knob_off_schema_identical_to_p2p(hub, tmp_path):
+    """ZEST_COOP_COLLECTIVE=0: the round stats schema is byte-identical
+    to the PR-6 point-to-point exchange — exact top-level and exchange
+    key sets, no "collective" block anywhere."""
+    _bridges, results = _run_hosts(hub, tmp_path, 2, collective=False)
+    for r in results:
+        assert set(r) == {"host", "hosts", "trace_id", "plan", "fetch",
+                          "exchange", "fallbacks", "own_server",
+                          "peer_served_ratio", "elapsed_s",
+                          "clock_offsets"}, sorted(r)
+        assert set(r["exchange"]) == {
+            "units", "wire_bytes", "unpacked_bytes", "fallback_units",
+            "fallback_bytes", "verify_rejected", "retries",
+            "budget_bytes", "inflight_peak_bytes"}, sorted(r["exchange"])
+
+
+# ── Exchange-stats ledger (ISSUE 14 satellite: exact tier tiling) ──
+
+
+def test_exchange_ledger_tiles_on_redelivery():
+    """A unit re-delivered by the fallback after the exchange already
+    booked it (the mid-round eviction race) must REPLACE its booking:
+    wire + fallback bytes tile the delivered total instead of
+    double-counting the aborted delivery."""
+    ex = _ExchangeStats()
+    ex.book_exchange(("aa", 0), 100, 400)
+    ex.book_exchange(("bb", 0), 50, 200, link="ici")
+    assert (ex.units, ex.wire_bytes, ex.unpacked_bytes) == (2, 150, 600)
+    # the race: unit aa evicted, fallback refetches it from CDN
+    ex.book_fallback(("aa", 0), "cdn", 110)
+    assert (ex.units, ex.wire_bytes, ex.unpacked_bytes) == (1, 50, 200)
+    assert (ex.fallback_units, ex.fallback_bytes) == (1, 110)
+    assert ex.fallback_tiers == {"cdn": 110}
+    s = ex.summary()
+    assert s["reattributed"] == 1
+    assert s["wire_bytes"] + s["fallback_bytes"] == 50 + 110
+    # and the other direction: an exchange delivery superseding a
+    # fallback booking (a later phase re-serves an evicted unit)
+    ex.book_exchange(("aa", 0), 100, 400)
+    assert ex.fallback_tiers == {}
+    assert (ex.fallback_units, ex.fallback_bytes) == (0, 0)
+    assert ex.summary()["reattributed"] == 2
+    assert ex.wire_bytes + ex.fallback_bytes == 150
+
+
+def test_exchange_ledger_absent_without_race(hub, tmp_path):
+    """Schema guard: healthy rounds never grow a "reattributed" key —
+    the ledger is invisible unless the race actually happened."""
+    _bridges, results = _run_hosts(hub, tmp_path, 2)
+    for r in results:
+        assert "reattributed" not in r["exchange"], r["exchange"]
+
+
+# ── Clock-offset collection (ISSUE 14 satellite) ──
+
+
+def test_clock_offsets_dial_undialed_peers_named_and_bounded(tmp_path):
+    """Peers the exchange never opened a channel to get a hello dialed
+    by named ``zest-coop-clk-*`` workers, joined under one bounded
+    deadline — and a hung hello (a listener that never speaks) cannot
+    hold the round past the bound."""
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 dcn_port=0)
+    servers = [DcnServer(cfg), DcnServer(cfg)]
+    peers = {i: ("127.0.0.1", s.start()) for i, s in enumerate(servers)}
+    names: list[str] = []
+    orig_init = threading.Thread.__init__
+
+    def spy_init(self, *args, **kwargs):
+        if str(kwargs.get("name", "")).startswith("zest-coop-clk-"):
+            names.append(kwargs["name"])
+        orig_init(self, *args, **kwargs)
+
+    pool = DcnPool(timeout=5.0)
+    out: dict = {}
+    threading.Thread.__init__ = spy_init
+    try:
+        _collect_clock_offsets(pool, peers, out)
+    finally:
+        threading.Thread.__init__ = orig_init
+        pool.close()
+        for s in servers:
+            s.shutdown()
+    assert sorted(out) == [0, 1], out
+    assert sorted(names) == ["zest-coop-clk-0", "zest-coop-clk-1"]
+    for row in out.values():
+        assert "offset_s" in row and "rtt_s" in row
+
+    # hung hello: accepts the TCP connect but never answers the hello
+    mute = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    mute.bind(("127.0.0.1", 0))
+    mute.listen(1)
+    try:
+        pool2 = DcnPool(timeout=30.0)
+        t0 = time.monotonic()
+        out2: dict = {}
+        _collect_clock_offsets(
+            pool2, {0: ("127.0.0.1", mute.getsockname()[1])}, out2,
+            timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0, "hung hello held the round"
+        assert out2 == {}
+        pool2.close()
+    finally:
+        mute.close()

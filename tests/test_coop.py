@@ -78,14 +78,18 @@ def _recs(bridge):
             if e.is_xet]
 
 
-def _run_hosts(hub, tmp_path, n, round_kwargs=None, skip=()):
+def _run_hosts(hub, tmp_path, n, round_kwargs=None, skip=(),
+               collective=True):
     """n concurrent in-process hosts, each with its own cache + DCN
     server (the MULTICHIP-dryrun multi-host shape); returns (bridges,
     results). Hosts in ``skip`` get an addr map entry pointing at a
-    dead port but run no round (the dead-host scenario)."""
+    dead port but run no round (the dead-host scenario).
+    ``collective=False`` pins the PR-6 point-to-point exchange (the
+    ZEST_COOP_COLLECTIVE=0 ladder)."""
     bridges, servers, addrs = [], [], {}
     for i in range(n):
         b = _bridge(hub, tmp_path / f"h{i}")
+        b.cfg.coop_collective = collective
         bridges.append(b)
         if i in skip:
             addrs[i] = ("127.0.0.1", 1)  # nothing listens
@@ -224,11 +228,15 @@ def test_coop_round_single_host_skips(hub, tmp_path):
 
 
 def test_coop_dead_host_degrades_to_cdn(hub, tmp_path):
-    """Host 2 is in the addr map but dead: its units degrade to the
-    per-host CDN fallback on every other host; the round completes and
-    every live host still ends fully cached."""
+    """Point-to-point ladder (ZEST_COOP_COLLECTIVE=0 semantics): host 2
+    is in the addr map but dead — its units degrade to the per-host CDN
+    fallback on every other host; the round completes and every live
+    host still ends fully cached. (The collective-mode dead-host story
+    — a live host can receive the dead host's share FORWARDED by a peer
+    that already healed it — is covered in test_collective.py.)"""
     n = 3
-    bridges, results = _run_hosts(hub, tmp_path, n, skip={2})
+    bridges, results = _run_hosts(hub, tmp_path, n, skip={2},
+                                  collective=False)
     for i in (0, 1):
         r = results[i]
         assert r["fallbacks"] > 0, r

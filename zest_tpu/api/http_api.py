@@ -411,6 +411,29 @@ class HttpApi:
         for labels, value in self._metric_samples(
                 "zest_coop_fallbacks_total"):
             coop["fallbacks"] = int(value)
+        # Collective-exchange line (ISSUE 14): last round's phase
+        # count/wall and cumulative wire bytes per link class — what
+        # the dashboard coop panel and `zest stats --watch` render as
+        # the "bytes moved as collectives over ICI/DCN" evidence.
+        collective: dict = {}
+        phases = self._metric_samples("zest_coop_collective_phases")
+        if phases and phases[0][1] > 0:
+            collective["phases"] = int(phases[0][1])
+        cwall = self._metric_samples(
+            "zest_coop_collective_wall_seconds")
+        if cwall and cwall[0][1] > 0:
+            collective["wall_s"] = round(cwall[0][1], 3)
+        link_bytes = {}
+        for labels, value in self._metric_samples(
+                "zest_coop_collective_bytes_total"):
+            link_bytes[labels.get("link", "")] = int(value)
+        if link_bytes:
+            collective["link_bytes"] = link_bytes
+        for _labels, value in self._metric_samples(
+                "zest_coop_collective_aborts_total"):
+            collective["aborts"] = int(value)
+        if collective:
+            coop["collective"] = collective
         if coop:
             payload["coop"] = coop
 
@@ -1082,6 +1105,15 @@ async function tick(){
    crows.push(['bytes['+t+']',b.toLocaleString()]);
   if(c.exchange_wall_s!=null)
    crows.push(['exchange_wall_s',c.exchange_wall_s]);
+  // Collective-exchange line (ISSUE 14): phase count/wall + per-link
+  // (ici vs dcn) wire bytes of the plan-derived all-to-all.
+  const CX=c.collective||{};
+  if(CX.phases!=null)
+   crows.push(['collective',CX.phases+' phase(s)'
+    +(CX.wall_s!=null?' in '+CX.wall_s+'s':'')
+    +(CX.aborts?'; '+CX.aborts+' abort(s)':'')]);
+  for(const [lk,b] of Object.entries(CX.link_bytes||{}))
+   crows.push(['collective_bytes['+lk+']',b.toLocaleString()]);
   if(c.fallbacks!=null) crows.push(['fallbacks',c.fallbacks]);
   // Seeding line (ISSUE 12): upload policy at a glance — served bytes,
   // unchoked/choked split, refusals of quarantined-source content.

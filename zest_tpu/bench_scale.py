@@ -248,33 +248,47 @@ def _import_fixtures():
 
 def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
                     shaped_bps: int | None = None,
-                    chunks_per_xorb: int = 16, scale: int = 8) -> dict:
-    """Multi-host cooperative pull vs the per-host-CDN baseline
-    (ROADMAP item 1's acceptance bench; headline: peer_served_ratio).
+                    chunks_per_xorb: int = 16, scale: int = 8,
+                    dcn_rtt_s: float = 0.0,
+                    dcn_bps: int | None = None,
+                    topology: str | None = None) -> dict:
+    """Multi-host cooperative pull vs the per-host-CDN baseline, plus
+    the collective-vs-point-to-point exchange race (ROADMAP items 1+3;
+    headlines: peer_served_ratio and the exchange speedup).
 
     ``n_hosts`` simulated hosts (isolated cache dirs + bridges, DCN
     servers on loopback — the same in-process multi-host shape the
-    MULTICHIP dryrun uses) race two strategies to a fully-populated
-    verified cache on EVERY host:
+    MULTICHIP dryrun uses) race to a fully-populated verified cache on
+    EVERY host:
 
     - **baseline**: each host independently fetches all units from the
-      (optionally shaped) CDN — today's per-host waterfall;
-    - **coop**: each host fetches its ~1/N plan share, then the DCN
-      exchange redistributes compressed frames (transfer.coop).
+      (optionally shaped) CDN — the per-host waterfall;
+    - **coop**: each host fetches its ~1/N plan share, then the
+      collective exchange redistributes compressed frames
+      (transfer.collective over transfer.coop);
+    - **exchange race** (``exchange`` block): with every host's plan
+      share pre-warmed (so the round wall IS the exchange wall) and
+      the DCN hub shaped — ``dcn_bps`` token-buckets each host's serve
+      plane, ``dcn_rtt_s`` charges one WAN round trip per request
+      WINDOW — the point-to-point exchange and the collective run the
+      same redistribution; the collective's O(log N) pre-sized phase
+      windows against the P2P path's per-owner windows + NOT_FOUND
+      retry rounds is exactly what the RTT term measures.
 
     ``shaped_bps`` token-buckets the hub's CDN data plane *globally*
-    (one WAN-rate origin shared by all hosts; peers stay loopback) —
-    the asymmetry under which cooperation's N-fold CDN-demand cut turns
-    into wall-clock. The wire block records compressed bytes crossing
-    the exchange vs their unpacked size — the EQuARX-grounded
-    compressed-on-the-wire evidence."""
+    (one WAN-rate origin shared by all hosts) — the asymmetry under
+    which cooperation's N-fold CDN-demand cut turns into wall-clock.
+    The wire block records compressed bytes crossing the exchange vs
+    their unpacked size — the EQuARX-grounded compressed-in-flight
+    evidence. ``topology`` is a ZEST_COOP_TOPOLOGY-grammar slice spec
+    classing exchange links ici/dcn."""
     import tempfile as _tempfile
     import threading
 
     from zest_tpu.cas.hub import HubClient
-    from zest_tpu.config import Config
+    from zest_tpu.config import Config, parse_topology
     from zest_tpu.transfer.bridge import XetBridge
-    from zest_tpu.transfer.coop import coop_round
+    from zest_tpu.transfer.coop import CoopPlan, coop_round
     from zest_tpu.transfer.dcn import DcnServer
     from zest_tpu.transfer.federated import warm_units_parallel
 
@@ -285,11 +299,14 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
     total = sum(len(b) for b in files.values())
     repo = fixtures.FixtureRepo(repo_id, files,
                                 chunks_per_xorb=chunks_per_xorb)
+    topo = parse_topology(topology) if topology else None
 
-    def make_host(root: pathlib.Path, tag: str, i: int):
+    def make_host(root: pathlib.Path, tag: str, i: int,
+                  collective: bool = True):
         cfg = Config(hf_home=root / f"{tag}{i}/hf",
                      cache_dir=root / f"{tag}{i}/zest",
-                     hf_token="hf_test", endpoint=hub.url, dcn_port=0)
+                     hf_token="hf_test", endpoint=hub.url, dcn_port=0,
+                     coop_collective=collective, coop_topology=topo)
         bridge = XetBridge(cfg)
         bridge.authenticate(repo_id)
         recs = [bridge.get_reconstruction(e.xet_hash)
@@ -301,7 +318,114 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
         "hosts": n_hosts,
         "chunks_per_xorb": chunks_per_xorb,
         "cdn_bps": shaped_bps,
+        "dcn_shaping": {"rtt_s": dcn_rtt_s, "bps": dcn_bps},
+        "topology": topology,
     }
+    errors: list[str] = []
+
+    def coop_leg(rootp, tag, collective, prewarm):
+        """One n-host cooperative round; returns (wall, per-host walls,
+        per-host stats). ``prewarm`` warms each host's own plan share
+        first so the timed wall is the exchange, not the CDN fetch."""
+        hosts = [make_host(rootp, tag, i, collective=collective)
+                 for i in range(n_hosts)]
+        servers, addrs = [], {}
+        for i, (bridge, _recs) in enumerate(hosts):
+            # With a topology, shaping narrows to cross-slice (DCN-
+            # class) links: intra-slice serving stays loopback-fast,
+            # exactly the ICI-vs-DCN asymmetry of a real pod.
+            s = DcnServer(bridge.cfg, bridge.cache,
+                          rate_bps=dcn_bps or 0,
+                          window_rtt_s=dcn_rtt_s,
+                          shape_slices=topo, shape_host=i)
+            addrs[i] = ("127.0.0.1", s.start())
+            servers.append(s)
+        if prewarm:
+            def warm(i):
+                bridge, recs = hosts[i]
+                plan = CoopPlan.build(recs, n_hosts)
+                warm_units_parallel(bridge, recs,
+                                    units=plan.for_host(i))
+            ws = [threading.Thread(target=warm, args=(i,))
+                  for i in range(n_hosts)]
+            for t in ws:
+                t.start()
+            for t in ws:
+                t.join()
+        results: list[dict | None] = [None] * n_hosts
+        walls = [0.0] * n_hosts
+
+        def run(i):
+            bridge, recs = hosts[i]
+            t0 = time.perf_counter()
+            try:
+                results[i] = coop_round(bridge, recs, i, n_hosts,
+                                        addrs, server=servers[i])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"{tag} host {i}: {exc}")
+            walls[i] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        for s in servers:
+            s.shutdown()
+        for b, _r in hosts:
+            b.close()
+        return wall, walls, results
+
+    def summarize(wall, results):
+        done = [r for r in results if r]
+        ratios = sorted(r["peer_served_ratio"] for r in done) or [0.0]
+        wire = sum(r["exchange"]["wire_bytes"] for r in done)
+        unpacked = sum(r["exchange"]["unpacked_bytes"] for r in done)
+        cx = [r.get("collective") for r in done if r.get("collective")]
+        block = {
+            "wall_s": round(wall, 3),
+            "hosts_completed": len(done),
+            "peer_served_ratio": ratios[len(ratios) // 2],
+            "peer_served_ratio_min": ratios[0],
+            "cdn_bytes": sum(
+                r["fetch"]["tiers"].get("cdn", 0)
+                + r["exchange"].get("fallback_tiers", {}).get("cdn", 0)
+                for r in done),
+            "fallbacks": sum(r["fallbacks"] for r in done),
+            "plan_skew": done[0]["plan"]["skew"] if done else None,
+            "wire": {
+                "dcn_bytes": wire,
+                "unpacked_bytes": unpacked,
+                # <1.0 = compressed frames crossed the exchange, not
+                # expanded tensors (bf16 random data compresses
+                # little; real checkpoints more).
+                "compressed_ratio": round(wire / unpacked, 4)
+                if unpacked else None,
+            },
+            "gbps_per_host": round(total / wall / 1e9, 4)
+            if wall > 0 else None,
+        }
+        if cx:
+            block["collective"] = {
+                "schedule": cx[0]["schedule"],
+                "phases": cx[0]["phases"],
+                "windows": sum(c["windows"] for c in cx),
+                "retry_windows": sum(c["retry_windows"] for c in cx),
+                "unit_round_trips": sum(c["unit_round_trips"]
+                                        for c in cx),
+                "matrix_skew": cx[0]["matrix_skew"],
+                "link_bytes": {
+                    lk: sum(c["link_bytes"].get(lk, 0) for c in cx)
+                    for lk in ("ici", "dcn")},
+                "barrier_wait_s": round(
+                    sum(c["barrier_wait_s"] for c in cx), 3),
+                "aborts": sum(1 for c in cx if c.get("aborted")),
+            }
+        return block
+
     with fixtures.FixtureHub(repo, throttle_bps=shaped_bps) as hub, \
             _tempfile.TemporaryDirectory() as root:
         rootp = pathlib.Path(root)
@@ -309,7 +433,6 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
         # Baseline: every host pulls everything through the CDN.
         hosts = [make_host(rootp, "base", i) for i in range(n_hosts)]
         walls = [0.0] * n_hosts
-        errors: list[str] = []
 
         def base_run(i):
             bridge, recs = hosts[i]
@@ -338,64 +461,31 @@ def bench_coop_pull(gb: float = 0.064, n_hosts: int = 8,
         for b, _r in hosts:
             b.close()
 
-        # Cooperative: fetch 1/N each + compressed exchange.
-        hosts = [make_host(rootp, "coop", i) for i in range(n_hosts)]
-        servers, addrs = [], {}
-        for i, (bridge, _recs) in enumerate(hosts):
-            s = DcnServer(bridge.cfg, bridge.cache)
-            addrs[i] = ("127.0.0.1", s.start())
-            servers.append(s)
-        results: list[dict | None] = [None] * n_hosts
+        # Cooperative end-to-end (collective exchange): 1/N fetch each
+        # + the phase-scheduled redistribution.
+        coop_wall, _cw, coop_results = coop_leg(rootp, "coop",
+                                                collective=True,
+                                                prewarm=False)
+        out["coop"] = summarize(coop_wall, coop_results)
+        out["speedup"] = (round(base_wall / coop_wall, 2)
+                          if coop_wall > 0 else None)
 
-        def coop_run(i):
-            bridge, recs = hosts[i]
-            try:
-                results[i] = coop_round(bridge, recs, i, n_hosts, addrs,
-                                        server=servers[i])
-            except Exception as exc:  # noqa: BLE001
-                errors.append(f"coop host {i}: {exc}")
-
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=coop_run, args=(i,))
-                   for i in range(n_hosts)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        coop_wall = time.perf_counter() - t0
-        for s in servers:
-            s.shutdown()
-        for b, _r in hosts:
-            b.close()
-
-    done = [r for r in results if r]
-    ratios = sorted(r["peer_served_ratio"] for r in done) or [0.0]
-    wire = sum(r["exchange"]["wire_bytes"] for r in done)
-    unpacked = sum(r["exchange"]["unpacked_bytes"] for r in done)
-    out["coop"] = {
-        "wall_s": round(coop_wall, 3),
-        "hosts_completed": len(done),
-        "peer_served_ratio": ratios[len(ratios) // 2],
-        "peer_served_ratio_min": ratios[0],
-        "cdn_bytes": sum(
-            r["fetch"]["tiers"].get("cdn", 0)
-            + r["exchange"].get("fallback_tiers", {}).get("cdn", 0)
-            for r in done),
-        "fallbacks": sum(r["fallbacks"] for r in done),
-        "plan_skew": done[0]["plan"]["skew"] if done else None,
-        "wire": {
-            "dcn_bytes": wire,
-            "unpacked_bytes": unpacked,
-            # <1.0 = compressed frames crossed the exchange, not
-            # expanded tensors (bf16 random data compresses little;
-            # real checkpoints more).
-            "compressed_ratio": round(wire / unpacked, 4)
-            if unpacked else None,
-        },
-        "gbps_per_host": round(total / coop_wall / 1e9, 4),
-    }
-    out["speedup"] = (round(base_wall / coop_wall, 2)
-                      if coop_wall > 0 else None)
+        # Exchange race: pre-warmed shares, shaped DCN — the wall IS
+        # the exchange. Point-to-point first, then the collective.
+        p2p_wall, p2p_walls, p2p_results = coop_leg(
+            rootp, "xp2p", collective=False, prewarm=True)
+        col_wall, col_walls, col_results = coop_leg(
+            rootp, "xcol", collective=True, prewarm=True)
+        out["exchange"] = {
+            "p2p": summarize(p2p_wall, p2p_results),
+            "collective": summarize(col_wall, col_results),
+            "p2p_wall_s": round(p2p_wall, 3),
+            "collective_wall_s": round(col_wall, 3),
+            "p2p_host_wall_max_s": round(max(p2p_walls), 3),
+            "collective_host_wall_max_s": round(max(col_walls), 3),
+            "collective_speedup": round(p2p_wall / col_wall, 2)
+            if col_wall > 0 else None,
+        }
     if errors:
         out["errors"] = errors
     return out

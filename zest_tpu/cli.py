@@ -250,6 +250,20 @@ def _print_pull_stats(stats: dict) -> None:
               f"({ex.get('wire_bytes', 0)} wire bytes), "
               f"{c.get('fallbacks', 0)} fallback — peer-served "
               f"{c.get('peer_served_ratio', 0.0):.1%}")
+        cx = c.get("collective")
+        if cx:
+            links = " ".join(f"{lk}={b}" for lk, b in
+                             sorted((cx.get("link_bytes") or {}).items())
+                             if b)
+            line = (f"  Collective: {cx.get('schedule')} "
+                    f"{cx.get('phases', 0)} phase(s), "
+                    f"{cx.get('windows', 0)} window(s)")
+            if links:
+                line += f" [{links}]"
+            if cx.get("aborted"):
+                line += (f" — aborted ({cx['aborted']}), degraded to "
+                         "point-to-point")
+            print(line)
     if "federated" in stats:
         f = stats["federated"]
         print(f"  Federated:  pod {f['pod']}/{f['pods']}: {f['own_units']} "
@@ -613,6 +627,16 @@ def _stats_watch_lines(debug: dict, status: dict) -> list[str]:
             + (f"  fallbacks={coop['fallbacks']}"
                if "fallbacks" in coop else "")
             + (f"  [{tiers}]" if tiers else ""))
+        cx = coop.get("collective") or {}
+        if cx:
+            links = " ".join(
+                f"{lk}={b}" for lk, b in
+                sorted((cx.get("link_bytes") or {}).items()))
+            lines.append(
+                f"collective: phases={cx.get('phases', 0)}"
+                + (f"  wall={cx['wall_s']}s" if "wall_s" in cx else "")
+                + (f"  aborts={cx['aborts']}" if cx.get("aborts") else "")
+                + (f"  [{links}]" if links else ""))
     seeding = status.get("seeding") or {}
     if seeding.get("chunks_served") or seeding.get("active_leechers"):
         sline = (f"seed: {seeding.get('bytes_served', 0)}B in "

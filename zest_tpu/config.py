@@ -155,6 +155,26 @@ def _parse_coop_addrs(spec: str) -> dict[int, tuple[str, int]]:
     return out
 
 
+def parse_topology(spec: str) -> tuple[int, ...]:
+    """``"0,0,1,1"`` → ``(0, 0, 1, 1)`` — slice id per coop host index
+    (``ZEST_COOP_TOPOLOGY``; transfer.collective classifies each
+    exchange link ici/dcn from it). Strict like every other coop knob:
+    malformed or negative entries raise — a silently-dropped host would
+    misclass every one of its links and quietly route the big
+    cross-slice phases as if they were intra-slice."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part.isdigit():
+            raise ValueError(
+                f"bad ZEST_COOP_TOPOLOGY entry {part!r} "
+                "(want comma-separated slice ids, e.g. 0,0,1,1)")
+        out.append(int(part))
+    if not out:
+        raise ValueError("ZEST_COOP_TOPOLOGY is empty")
+    return tuple(out)
+
+
 def _opt_pos_float(env: dict[str, str], name: str) -> float | None:
     """Optional positive float knob: unset/empty/0 = unarmed (None); a
     malformed OR negative value raises (same typo discipline as
@@ -322,6 +342,15 @@ class Config:
     coop_addrs: dict[int, tuple[str, int]] = dataclasses.field(
         default_factory=dict)
     coop_inflight_bytes: int = DEFAULT_COOP_INFLIGHT_BYTES
+    # Collective-native exchange (transfer.collective, ISSUE 14):
+    # ``coop_collective`` is the rollback knob (ZEST_COOP_COLLECTIVE,
+    # strict 0/1) — 0 restores the PR-6 point-to-point exchange
+    # bit-for-bit; ``coop_topology`` is the slice id per coop host
+    # (ZEST_COOP_TOPOLOGY="0,0,1,1") from which exchange links are
+    # classed ici (intra-slice) vs dcn (cross-slice) — None = infer
+    # from the JAX runtime, else one flat slice.
+    coop_collective: bool = True
+    coop_topology: tuple[int, ...] | None = None
     # Pod fleet observability (telemetry.fleet; ISSUE 7): HTTP API
     # endpoints of the OTHER hosts' daemons, ``ZEST_POD_PEERS=
     # "1=hostB:9847,2=hostC:9847"`` (same grammar as coop addrs). The
@@ -488,6 +517,16 @@ class Config:
             coop_inflight_bytes=max(1, int(
                 env.get("ZEST_COOP_INFLIGHT")
                 or DEFAULT_COOP_INFLIGHT_BYTES)),
+            # Strict like ZEST_LAND_STREAM: ZEST_COOP_COLLECTIVE is
+            # the collective-exchange rollback knob — "false"/a typo
+            # must raise, never silently keep the collective on; the
+            # topology spec parses strictly for the same reason.
+            coop_collective=_strict_bool(
+                "ZEST_COOP_COLLECTIVE",
+                env.get("ZEST_COOP_COLLECTIVE", "1")),
+            coop_topology=(parse_topology(env["ZEST_COOP_TOPOLOGY"])
+                           if env.get("ZEST_COOP_TOPOLOGY", "").strip()
+                           else None),
             pod_peers=_parse_coop_addrs(env.get("ZEST_POD_PEERS", "")),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),

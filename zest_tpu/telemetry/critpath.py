@@ -52,6 +52,14 @@ _CATEGORY_PREFIXES = (
     ("swarm.", "fetch"),
     ("peer.", "fetch"),
     ("dcn.", "fetch"),
+    # Collective exchange (ISSUE 14): phase spans blame as "exchange"
+    # (redistribution work — their dcn.request_many children keep
+    # blaming wire waits as fetch/dcn), and barrier spans blame as
+    # "barrier" (a lagging partner's idle, which is neither fetch nor
+    # exchange work — the skew signal the straggler gauges quote).
+    ("coop.collective.barrier", "barrier"),
+    ("coop.collective.", "exchange"),
+    ("coop.exchange", "exchange"),
     ("coop.", "fetch"),
     ("federated.", "fetch"),
     ("pod.", "fetch"),

@@ -1,0 +1,510 @@
+"""Collective-native coop exchange: plan-derived all-to-all over
+ICI/DCN with compressed-in-flight payloads (ROADMAP item 3).
+
+The PR-6 exchange (transfer.coop) is point-to-point: each host pulls
+every foreign unit from its owner through per-window
+``DcnPool.request_many`` calls, serially re-negotiating per window what
+the deterministic ownership plan already determines, with a per-unit
+NOT_FOUND retry loop against owners that are still fetching. This
+module replaces that with a **collective**: every host derives the full
+N×N send/recv byte matrix purely from :class:`~zest_tpu.transfer.coop.
+CoopPlan` (the plan is fingerprint-identical on every host, so there is
+no negotiation round), and the redistribution executes as a schedule of
+synchronized phases — a recursive-doubling **hypercube** all-gather
+when the alive-host count is a power of two (log2 N phases), a
+pipelined **ring** otherwise (N-1 phases, one constant neighbor) — with
+ONE pre-sized request window per phase instead of per-unit
+request/reply round trips. Per-host connection count drops from
+O(N·units) round trips to O(log N) phases.
+
+Three properties carried through from the papers this leans on:
+
+- **Compressed through the collective** (EQuARX, PAPERS.md): phase
+  windows move xorb frame streams — BG4/LZ4 payloads still in their
+  planar compressed form — and the receiving host expands+verifies
+  with the fused Pallas pass (``ops.decode_pallas.FusedBg4Verifier``
+  via ``transfer.pod.make_unit_verifier``) before anything reaches the
+  cache. The interconnect never carries expanded bytes; an
+  EQuARX-style *lossy* tier is explicitly out of scope — verification
+  here is byte-exact.
+- **Topology awareness**: hosts are ranked slice-major (slice topology
+  from ``ZEST_COOP_TOPOLOGY`` — the sim override — or the JAX
+  runtime's ``slice_index``, transfer.pod.local_slice_groups), so the
+  many small early hypercube phases ride intra-slice (ICI-class)
+  links and only the few large top-bit phases cross slices on DCN.
+  Phase bytes are attributed per link class
+  (``zest_coop_collective_bytes_total{link=ici|dcn}``).
+- **Degradation, never a stall**: the schedule is pull-based over the
+  existing :class:`~zest_tpu.transfer.dcn.DcnChannel` transport, so a
+  lagging partner is a bounded barrier wait (NOT_FOUND → whole-window
+  retry with backoff, blamed to ``coop.collective.barrier`` spans),
+  and a dead/straggling partner ABORTS the collective: every
+  undelivered unit degrades to the PR-6 point-to-point exchange —
+  which itself degrades per-unit to the quarantine + re-shard + CDN
+  fallback ladder — and the pull always completes byte-identically.
+  ``ZEST_COOP_COLLECTIVE=0`` restores the PR-6 exchange bit-for-bit.
+
+The deterministic-schedule trick that removes the negotiation round:
+in a pull-based all-gather, host ``r`` can compute exactly which units
+its phase-``k`` partner holds (the partner's phase-``k`` subcube of
+owners in the hypercube; the ``(r-1-k) mod N``-th ownership block in
+the ring), because every host runs the same schedule over the same
+plan. A request window therefore never asks for anything the partner
+is not *scheduled* to have — NOT_FOUND means "partner behind", never
+"wrong host", which is what makes the whole-window barrier retry
+correct.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from zest_tpu import faults, telemetry
+from zest_tpu.cas import hashing
+from zest_tpu.config import parse_topology
+from zest_tpu.transfer.dcn import DcnResponse
+
+_M_PHASE_SECONDS = telemetry.histogram(
+    "zest_coop_collective_phase_seconds",
+    "Wall seconds per collective exchange phase",
+    buckets=(0.005, 0.02, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
+_M_COLLECTIVE_BYTES = telemetry.counter(
+    "zest_coop_collective_bytes_total",
+    "Collective exchange wire bytes by link class",
+    ("link",))
+_M_COLLECTIVE_PHASES = telemetry.gauge(
+    "zest_coop_collective_phases",
+    "Phase count of this host's last collective exchange")
+_M_COLLECTIVE_WALL = telemetry.gauge(
+    "zest_coop_collective_wall_seconds",
+    "This host's last collective exchange wall time")
+_M_COLLECTIVE_ABORTS = telemetry.counter(
+    "zest_coop_collective_aborts_total",
+    "Collective exchanges aborted to the point-to-point ladder")
+
+# Phase sub-window sizing: a phase window is pre-sized from the plan,
+# but its in-flight replies still stage under the round's ByteBudget —
+# sub-windows bound how many pipelined replies are outstanding at
+# once. Larger than the P2P exchange's 32 MiB/64-unit windows because
+# a phase is ONE partner at a predictable rate, not N racing owners.
+_PHASE_WINDOW_BYTES = 64 * 1024 * 1024
+_PHASE_WINDOW_UNITS = 512
+# Barrier pacing: a NOT_FOUND window means the partner has not reached
+# this phase yet (it is still fetching its share, or in an earlier
+# phase) — back off and re-request the WHOLE missing set as one window.
+_BARRIER_SLEEP_S = 0.05
+_BARRIER_SLEEP_CAP_S = 1.0
+
+LINK_ICI = "ici"
+LINK_DCN = "dcn"
+
+
+class CollectiveUnavailable(RuntimeError):
+    """The collective cannot run for this round (unaddressable partner,
+    degenerate topology): the caller falls back to the point-to-point
+    exchange — same bytes, more round trips, never a failure."""
+
+
+def slice_topology(n_hosts: int, cfg=None,
+                   env: dict | None = None) -> tuple[int, ...]:
+    """Slice id per host index, length ``n_hosts``.
+
+    Resolution order: an explicit ``env`` dict's ``ZEST_COOP_TOPOLOGY``
+    (callers that carry their own env — bare sims/tests; the process
+    environment is NOT re-read here: ``Config.load`` already parses
+    that knob once, strictly, into ``coop_topology``) >
+    ``Config.coop_topology`` > the JAX runtime's per-process
+    ``slice_index`` (transfer.pod.local_slice_groups — real
+    multi-slice jobs) > one flat slice (every link ICI-class; the
+    single-slice pod the north star quotes). A spec whose length
+    disagrees with the round is a config error and raises ValueError
+    (the coop round degrades it to the point-to-point exchange and
+    records why)."""
+    spec = (env or {}).get("ZEST_COOP_TOPOLOGY")
+    topo = None
+    if spec:
+        topo = parse_topology(spec)
+    elif cfg is not None and getattr(cfg, "coop_topology", None):
+        topo = tuple(cfg.coop_topology)
+    if topo is not None:
+        if len(topo) != n_hosts:
+            raise ValueError(
+                f"ZEST_COOP_TOPOLOGY names {len(topo)} hosts for an "
+                f"{n_hosts}-host round")
+        return topo
+    from zest_tpu.transfer.pod import local_slice_groups
+
+    topo = local_slice_groups(n_hosts)
+    return topo if topo is not None else (0,) * n_hosts
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One step of this host's schedule: request from ``partner`` every
+    plan unit owned by the hosts in ``owners`` (the set the partner is
+    scheduled to hold by now)."""
+
+    index: int
+    partner: int                 # host index (not rank)
+    owners: tuple[int, ...]      # host indices whose units to request
+    link: str                    # "ici" | "dcn"
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and n & (n - 1) == 0
+
+
+@dataclass(frozen=True)
+class CollectiveSchedule:
+    """This host's deterministic phase schedule over ``plan.alive``.
+
+    Three shapes, picked from the topology:
+
+    - **hierarchical** (S ≥ 2 equal power-of-two slices of power-of-two
+      size m ≥ 2): first a cross-slice all-gather among *counterpart
+      groups* (the hosts at the same intra-slice position — each
+      imports only its counterparts' OWN blocks, so the aggregate DCN
+      traffic is ONE copy of each slice's data instead of one per
+      receiving host), then an intra-slice all-gather spreads the
+      imported blocks over ICI-class links. Cross-slice bytes per host
+      drop to (S−1)/N of the total — vs (N−m)/N for the flat schedules
+      and the point-to-point exchange — which is the "prefer
+      intra-slice links" rule in byte form.
+    - **hypercube** (flat, power-of-two hosts): recursive doubling,
+      log2 N phases; ranks are slice-major so low-order-bit partners
+      land intra-slice when a topology exists but the hierarchical
+      conditions don't hold.
+    - **ring** (anything else): N−1 phases pulling from the constant
+      left neighbor.
+
+    Every host computes every other host's schedule from the same plan
+    + topology, which is what lets a request window name exactly the
+    units its partner holds."""
+
+    kind: str                    # "hierarchical" | "hypercube" | "ring"
+    host: int
+    alive: tuple[int, ...]       # rank order (slice-major)
+    phases: tuple[Phase, ...]
+
+    @staticmethod
+    def build(plan, host_index: int,
+              topology: tuple[int, ...]) -> "CollectiveSchedule":
+        if host_index not in plan.alive:
+            raise CollectiveUnavailable(
+                f"host {host_index} is not in the plan's alive set")
+        if max(plan.alive) >= len(topology):
+            raise ValueError(
+                f"topology names {len(topology)} hosts but the plan "
+                f"includes host {max(plan.alive)}")
+        order = tuple(sorted(plan.alive, key=lambda h: (topology[h], h)))
+        n = len(order)
+        if n < 2:
+            raise CollectiveUnavailable("nothing to exchange with")
+        rank = {h: i for i, h in enumerate(order)}
+        r = rank[host_index]
+
+        def link(a: int, b: int) -> str:
+            return LINK_ICI if topology[a] == topology[b] else LINK_DCN
+
+        # Slice groups in rank order (slice-major ⇒ contiguous).
+        slices: list[list[int]] = []
+        for h in order:
+            if slices and topology[slices[-1][0]] == topology[h]:
+                slices[-1].append(h)
+            else:
+                slices.append([h])
+        s_count = len(slices)
+        m = len(slices[0])
+        hier = (s_count >= 2 and m >= 2 and _is_pow2(s_count)
+                and _is_pow2(m)
+                and all(len(sl) == m for sl in slices))
+
+        phases: list[Phase] = []
+        if hier:
+            kind = "hierarchical"
+            gidx, pos = next(
+                (gi, sl.index(host_index))
+                for gi, sl in enumerate(slices) if host_index in sl)
+            members = slices[gidx]
+            group = [sl[pos] for sl in slices]  # my counterpart group
+            # Stage A — cross-slice all-gather of the counterparts'
+            # OWN blocks (recursive doubling over the group).
+            for k in range(s_count.bit_length() - 1):
+                pg = gidx ^ (1 << k)
+                owners = tuple(group[pg ^ q] for q in range(1 << k))
+                phases.append(Phase(len(phases), group[pg], owners,
+                                    link(host_index, group[pg])))
+            # Stage B — intra-slice all-gather where each member
+            # contributes its whole counterpart group (own block +
+            # everything stage A imported).
+            for k in range(m.bit_length() - 1):
+                pp = pos ^ (1 << k)
+                owners = tuple(
+                    sl[pp ^ q]
+                    for q in range(1 << k) for sl in slices)
+                phases.append(Phase(len(phases), members[pp], owners,
+                                    link(host_index, members[pp])))
+        elif _is_pow2(n):
+            kind = "hypercube"
+            for k in range(n.bit_length() - 1):
+                p = r ^ (1 << k)
+                owners = tuple(order[p ^ q] for q in range(1 << k))
+                phases.append(Phase(k, order[p], owners,
+                                    link(host_index, order[p])))
+        else:
+            kind = "ring"
+            left = order[(r - 1) % n]
+            for k in range(n - 1):
+                owner = order[(r - 1 - k) % n]
+                phases.append(Phase(k, left, (owner,),
+                                    link(host_index, left)))
+        return CollectiveSchedule(kind, host_index, order, tuple(phases))
+
+
+def units_by_owner(plan) -> dict[int, list]:
+    """``{owner_host: [(hash_hex, FetchInfo), ...]}`` over the plan —
+    the blocks the schedule's phases are expressed in."""
+    out: dict[int, list] = {h: [] for h in plan.alive}
+    for (hh, _start), fi in plan.units:
+        out[plan.owners[(hh, _start)]].append((hh, fi))
+    return out
+
+
+def transfer_matrix(plan, topology: tuple[int, ...]) -> list[list[int]]:
+    """The full N×N wire-byte matrix the schedule implies:
+    ``matrix[src][dst]`` = bytes host ``dst`` requests from host ``src``
+    across every phase of its schedule (indexed by host, zeros for
+    quarantined hosts). Derived purely from the plan + topology — the
+    no-negotiation proof the determinism tests pin: every byte a host
+    receives is requested exactly once, and per-owner received bytes
+    equal the plan's ownership row."""
+    n = plan.n_hosts
+    blocks = units_by_owner(plan)
+    block_bytes = {
+        h: sum(fi.url_range_end - fi.url_range_start for _hh, fi in us)
+        for h, us in blocks.items()
+    }
+    matrix = [[0] * n for _ in range(n)]
+    for dst in plan.alive:
+        sched = CollectiveSchedule.build(plan, dst, topology)
+        for ph in sched.phases:
+            matrix[ph.partner][dst] += sum(
+                block_bytes[o] for o in ph.owners)
+    return matrix
+
+
+def matrix_skew(matrix: list[list[int]]) -> float:
+    """max per-host sent bytes over mean sent bytes (1.0 = perfectly
+    balanced links)."""
+    sent = [sum(row) for row in matrix if sum(row)]
+    if not sent:
+        return 1.0
+    return max(sent) / (sum(sent) / len(sent))
+
+
+def run_collective(bridge, plan, host_index: int,
+                   peers: dict[int, tuple[str, int]], pool, budget,
+                   ex, verify, deadline: float,
+                   topology: tuple[int, ...],
+                   priorities: dict | None = None,
+                   entries_map: dict | None = None,
+                   health=None) -> tuple[dict, dict[int, list]]:
+    """Execute this host's phase schedule. Returns
+    ``(stats, leftover_by_owner)`` — ``leftover_by_owner`` is empty on
+    success; after an abort it maps TRUE owner host → undelivered
+    units, ready for the point-to-point exchange ladder.
+
+    Raises :class:`CollectiveUnavailable` (before any wire traffic)
+    when a scheduled partner has no address — the caller runs the full
+    P2P exchange instead.
+    """
+    from zest_tpu.transfer.coop import (
+        _admit, _already_cached, _fallback, _layer_order,
+    )
+
+    sched = CollectiveSchedule.build(plan, host_index, topology)
+    for ph in sched.phases:
+        if ph.partner not in peers:
+            raise CollectiveUnavailable(
+                f"phase {ph.index} partner host {ph.partner} has no "
+                "DCN address")
+    blocks = units_by_owner(plan)
+    mtx = transfer_matrix(plan, topology)
+
+    t0 = time.monotonic()
+    phase_walls: list[float] = []
+    link_bytes = {LINK_ICI: 0, LINK_DCN: 0}
+    windows = requests = retry_windows = 0
+    barrier_s = 0.0
+    window_cap = min(_PHASE_WINDOW_BYTES, budget.budget_bytes)
+
+    stats: dict = {
+        "schedule": sched.kind,
+        "phases": len(sched.phases),
+        "phase_wall_s": phase_walls,
+        "matrix_skew": round(matrix_skew(mtx), 4),
+        "link_bytes": link_bytes,
+        "windows": 0,
+        "requests": 0,
+        "retry_windows": 0,
+        # Per-unit request/reply round trips outside a phase window —
+        # structurally zero: the collective only ever issues whole
+        # (sub-)window batches. The smoke gate asserts it via the
+        # pool's wire-tag counters.
+        "unit_round_trips": 0,
+        "barrier_wait_s": 0.0,
+    }
+
+    def finish(aborted: str | None = None,
+               dead_host: int | None = None) -> dict:
+        stats["windows"] = windows
+        stats["requests"] = requests
+        stats["retry_windows"] = retry_windows
+        stats["barrier_wait_s"] = round(barrier_s, 3)
+        stats["elapsed_s"] = round(time.monotonic() - t0, 3)
+        if aborted:
+            stats["aborted"] = aborted
+            if dead_host is not None:
+                stats["dead_host"] = dead_host
+        _M_COLLECTIVE_PHASES.set(float(len(sched.phases)))
+        _M_COLLECTIVE_WALL.set(time.monotonic() - t0)
+        return stats
+
+    def leftovers(from_phase: int, pending: list) -> dict[int, list]:
+        """Undelivered foreign units by TRUE owner: the current phase's
+        remainder plus every later phase's blocks (minus anything a
+        whole-xorb sibling admit already covered)."""
+        out: dict[int, list] = {}
+        for hh, fi in pending:
+            if not _already_cached(bridge, hh, fi):
+                out.setdefault(plan.owners[(hh, fi.range.start)],
+                               []).append((hh, fi))
+        for ph in sched.phases[from_phase + 1:]:
+            for o in ph.owners:
+                for hh, fi in blocks[o]:
+                    if not _already_cached(bridge, hh, fi):
+                        out.setdefault(o, []).append((hh, fi))
+        return out
+
+    for ph in sched.phases:
+        host, port = peers[ph.partner]
+        wants = [(hh, fi) for o in ph.owners for hh, fi in blocks[o]
+                 if not _already_cached(bridge, hh, fi)]
+        wants = _layer_order(wants, priorities)
+        t_phase = time.monotonic()
+        sleep_s = _BARRIER_SLEEP_S
+        # Distinguishes a barrier RE-request (the missing set after a
+        # NOT_FOUND round — partner lag) from plain pagination (a phase
+        # larger than one sub-window): only the former is a retry.
+        retry_pass = False
+        with telemetry.span(f"coop.collective.phase{ph.index}",
+                            partner=ph.partner, link=ph.link,
+                            units=len(wants)):
+            pending = list(wants)
+            while pending:
+                window, wire_est = [], 0
+                while pending and len(window) < _PHASE_WINDOW_UNITS:
+                    nbytes = (pending[0][1].url_range_end
+                              - pending[0][1].url_range_start)
+                    if window and wire_est + nbytes > window_cap:
+                        break
+                    window.append(pending.pop(0))
+                    wire_est += nbytes
+                budget.acquire(wire_est)
+                try:
+                    if faults.fire("peer_timeout", key=f"{host}:{port}"):
+                        raise TimeoutError("injected peer_timeout")
+                    replies = pool.request_many(
+                        host, port,
+                        [(hashing.hex_to_hash(hh), fi.range.start,
+                          fi.range.end) for hh, fi in window],
+                        timeout=max(1.0, deadline - time.monotonic()),
+                        tag=pool.window_tag(),
+                    )
+                    windows += 1
+                    requests += len(window)
+                    if retry_pass:
+                        retry_windows += 1
+                        retry_pass = False
+                except (ConnectionError, TimeoutError, OSError) as exc:
+                    budget.release(wire_est)
+                    with ex.lock:
+                        ex.dead_hosts.add(ph.partner)
+                    _M_COLLECTIVE_ABORTS.inc()
+                    telemetry.record(
+                        "collective_abort", phase=ph.index,
+                        partner=ph.partner, link=ph.link,
+                        error=type(exc).__name__)
+                    if health is not None:
+                        try:
+                            health.record_failure((host, port),
+                                                  kind="io_timeout")
+                        except Exception:  # noqa: BLE001 - advisory
+                            pass
+                    return (finish(aborted=type(exc).__name__,
+                                   dead_host=ph.partner),
+                            leftovers(ph.index, window + pending))
+                missing = []
+                try:
+                    for (hh, fi), reply in zip(window, replies):
+                        admitted, wire, unpacked = _admit(
+                            bridge, entries_map, hh, fi, reply, verify)
+                        if admitted:
+                            bridge.stats.record("peer", wire)
+                            ex.book_exchange((hh, fi.range.start),
+                                             wire, unpacked,
+                                             link=ph.link)
+                            link_bytes[ph.link] += wire
+                            _M_COLLECTIVE_BYTES.inc(wire, link=ph.link)
+                        elif isinstance(reply, DcnResponse):
+                            # Structurally or content-bad bytes from a
+                            # live partner: never retried (the same
+                            # bytes would come back) — the unit heals
+                            # through the full waterfall, exactly the
+                            # P2P exchange's trust-boundary rule.
+                            with ex.lock:
+                                ex.verify_rejected += 1
+                            telemetry.record("verify_rejected",
+                                             unit=hh[:16],
+                                             owner=ph.partner,
+                                             tier="collective")
+                            _fallback(bridge, entries_map, [(hh, fi)],
+                                      ex, owner=ph.partner)
+                        else:
+                            missing.append((hh, fi))  # partner behind
+                finally:
+                    budget.release(wire_est)
+                if missing:
+                    if time.monotonic() + sleep_s > deadline:
+                        _M_COLLECTIVE_ABORTS.inc()
+                        telemetry.record(
+                            "collective_abort", phase=ph.index,
+                            partner=ph.partner, link=ph.link,
+                            error="deadline")
+                        return (finish(aborted="deadline",
+                                       dead_host=ph.partner),
+                                leftovers(ph.index, missing + pending))
+                    # Phase barrier: the partner has not finished the
+                    # prior phase (or its fetch share). Its own span so
+                    # the critical-path analyzer blames lag as
+                    # barrier idle, not exchange work.
+                    with telemetry.span("coop.collective.barrier",
+                                        phase=ph.index,
+                                        partner=ph.partner,
+                                        units=len(missing)):
+                        time.sleep(sleep_s)
+                    barrier_s += sleep_s
+                    sleep_s = min(sleep_s * 2, _BARRIER_SLEEP_CAP_S)
+                    retry_pass = True
+                    pending = missing + pending
+        wall = time.monotonic() - t_phase
+        phase_walls.append(round(wall, 4))
+        _M_PHASE_SECONDS.observe(wall)
+        if health is not None:
+            try:
+                health.record_success((host, port))
+            except Exception:  # noqa: BLE001 - health is advisory
+                pass
+    return finish(), {}

@@ -39,6 +39,15 @@ The in-pod spread (one host's devices) stays with the existing
 collective machinery: ``transfer.pod.pod_round`` over ICI after this
 round, and ``transfer.federated`` remains the cross-pod (separate-job)
 tier. This module is the *host-level* tier between them.
+
+Since ISSUE 14 the exchange phase itself is a ladder: the
+**collective-native** path (transfer.collective — a plan-derived
+hypercube/ring phase schedule, one pre-sized window per phase,
+topology-aware ici/dcn link classes) runs first; a dead or straggling
+partner aborts it to the point-to-point exchange below; and the
+point-to-point exchange keeps degrading per-unit to the CDN fallback.
+``ZEST_COOP_COLLECTIVE=0`` skips straight to the point-to-point
+exchange, restoring the PR-6 behavior (and stats schema) bit-for-bit.
 """
 
 from __future__ import annotations
@@ -244,10 +253,27 @@ def _unpacked_bytes(data: bytes) -> int:
 
 
 class _ExchangeStats:
-    """Thread-safe accumulator for the exchange phase."""
+    """Thread-safe per-unit attribution LEDGER for the exchange phase.
+
+    Tier attribution must exactly tile the delivered bytes: every unit
+    is booked under exactly one tier — the exchange wire (with its
+    ici/dcn link class, collective mode) or the fallback tier that
+    actually served it — and the tier totals are derived from the
+    ledger, never incremented twice. A unit that is RE-delivered later
+    in the round (the mid-round eviction race: an exchanged unit's
+    cache entry can be evicted under disk pressure before a fallback
+    pass re-lists it, so the refetch books fallback bytes for a unit
+    the exchange already counted) REPLACES its earlier booking — the
+    aborted delivery's bytes are subtracted, so
+    ``wire_bytes + fallback_bytes`` always equals the bytes that ended
+    the round attributed, one tier per unit. ``reattributed`` counts
+    the replacements (absent when zero, keeping the stats schema
+    byte-identical on rounds without the race)."""
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
+        # key (hash_hex, range_start) -> (kind, tier, bytes, unpacked)
+        self._booked: dict[tuple[str, int], tuple] = {}
         self.units = 0
         self.wire_bytes = 0
         self.unpacked_bytes = 0
@@ -258,9 +284,50 @@ class _ExchangeStats:
         # from a swarm peer or the cache): peer_served_ratio must not
         # book peer-served fallback bytes as CDN spend.
         self.fallback_tiers: dict[str, int] = {}
+        self.reattributed = 0
         self.verify_rejected = 0
         self.retries = 0
         self.dead_hosts: set[int] = set()
+
+    def book_exchange(self, key: tuple[str, int], wire: int,
+                      unpacked: int, link: str = "dcn") -> None:
+        """Attribute one exchange-delivered unit to the wire tier."""
+        with self.lock:
+            self._unbook(key)
+            self._booked[key] = ("x", link, wire, unpacked)
+            self.units += 1
+            self.wire_bytes += wire
+            self.unpacked_bytes += unpacked
+
+    def book_fallback(self, key: tuple[str, int], source: str,
+                      nbytes: int) -> None:
+        """Attribute one fallback-delivered unit to its serving tier."""
+        with self.lock:
+            self._unbook(key)
+            self._booked[key] = ("f", source, nbytes, 0)
+            self.fallback_units += 1
+            self.fallback_bytes += nbytes
+            self.fallback_tiers[source] = (
+                self.fallback_tiers.get(source, 0) + nbytes)
+
+    def _unbook(self, key: tuple[str, int]) -> None:
+        prev = self._booked.pop(key, None)
+        if prev is None:
+            return
+        kind, tier, nbytes, unpacked = prev
+        self.reattributed += 1
+        if kind == "x":
+            self.units -= 1
+            self.wire_bytes -= nbytes
+            self.unpacked_bytes -= unpacked
+        else:
+            self.fallback_units -= 1
+            self.fallback_bytes -= nbytes
+            left = self.fallback_tiers.get(tier, 0) - nbytes
+            if left > 0:
+                self.fallback_tiers[tier] = left
+            else:
+                self.fallback_tiers.pop(tier, None)
 
     def summary(self) -> dict:
         out = {
@@ -274,6 +341,8 @@ class _ExchangeStats:
         }
         if self.fallback_tiers:
             out["fallback_tiers"] = dict(sorted(self.fallback_tiers.items()))
+        if self.reattributed:
+            out["reattributed"] = self.reattributed
         if self.dead_hosts:
             out["dead_hosts"] = sorted(self.dead_hosts)
         return out
@@ -461,22 +530,57 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
         for h in plan.alive if h != host_index
     }
     clock_offsets: dict = {}
+    collective_stats: dict | None = None
+    use_collective = bool(getattr(bridge.cfg, "coop_collective", True))
     t_exchange = time.monotonic()
     try:
-        # Exchange workers are fresh threads: hand them this round's
-        # trace context explicitly (thread-locals do not propagate) so
-        # their spans land on this host's track in the merged trace.
-        ctx = telemetry.trace.current_context()
-        workers = [
-            threading.Thread(
-                target=_exchange_from,
-                args=(bridge, entries_map, pool, peers, h, units, budget,
-                      ex, verify, deadline, swarm_health, ctx),
-                name=f"zest-coop-x{h}", daemon=True,
-            )
-            for h, units in foreign.items() if units
-        ]
-        with telemetry.span("coop.exchange", owners=len(workers)):
+        with telemetry.span("coop.exchange",
+                            collective=use_collective) as _xsp:
+            # Collective tier FIRST (transfer.collective, ROADMAP item
+            # 3): the phase schedule redistributes everything in
+            # O(log N) pre-sized windows; whatever it could not deliver
+            # (abort on a dead/straggling partner) falls to the PR-6
+            # point-to-point exchange below, which itself degrades
+            # per-unit to the CDN fallback — the full ladder.
+            # ZEST_COOP_COLLECTIVE=0 skips straight to point-to-point,
+            # restoring the PR-6 exchange bit-for-bit.
+            if use_collective and any(foreign.values()):
+                from zest_tpu.transfer.collective import (
+                    CollectiveUnavailable, run_collective,
+                    slice_topology,
+                )
+
+                try:
+                    topo = slice_topology(n_hosts, cfg=bridge.cfg)
+                    collective_stats, foreign = run_collective(
+                        bridge, plan, host_index, peers, pool, budget,
+                        ex, verify, deadline, topo,
+                        priorities=priorities, entries_map=entries_map,
+                        health=swarm_health)
+                except (CollectiveUnavailable, ValueError) as exc:
+                    # ValueError = a topology spec that disagrees with
+                    # this round's host count — a config problem, but
+                    # the point-to-point exchange needs no topology,
+                    # so degrade (recorded) instead of failing the
+                    # whole cooperative round over link classing.
+                    telemetry.record("collective_unavailable",
+                                     error=str(exc))
+            # Exchange workers are fresh threads: hand them this
+            # round's trace context explicitly (thread-locals do not
+            # propagate) so their spans land on this host's track in
+            # the merged trace.
+            ctx = telemetry.trace.current_context()
+            workers = [
+                threading.Thread(
+                    target=_exchange_from,
+                    args=(bridge, entries_map, pool, peers, h, units,
+                          budget, ex, verify, deadline, swarm_health,
+                          ctx),
+                    name=f"zest-coop-x{h}", daemon=True,
+                )
+                for h, units in foreign.items() if units
+            ]
+            _xsp.set("owners", len(workers))
             for w in workers:
                 w.start()
             for w in workers:
@@ -518,6 +622,11 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
         "peer_served_ratio": round(ratio, 4),
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
+    if collective_stats is not None:
+        # Present only when the collective tier actually ran — with
+        # ZEST_COOP_COLLECTIVE=0 (or CollectiveUnavailable) the stats
+        # schema stays byte-identical to the point-to-point exchange.
+        stats["collective"] = collective_stats
     if clock_offsets:
         stats["clock_offsets"] = clock_offsets
     if log is not None:
@@ -528,15 +637,53 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
     return stats
 
 
-def _collect_clock_offsets(pool, peers, out: dict) -> None:
+# How long the round waits for the clock-offset hello dials before
+# moving on: a hung hello must never hold the round's tail.
+_CLK_HELLO_TIMEOUT_S = 2.0
+
+
+def _collect_clock_offsets(pool, peers, out: dict,
+                           timeout_s: float = _CLK_HELLO_TIMEOUT_S) -> None:
     """Per-peer hello clock-offset estimates keyed by HOST INDEX (the
     merge's normalization key), copied into the round stats and the
     active tracer's metadata. Best-effort: an offset-less round merges
-    on raw epoch anchors (documented fallback)."""
+    on raw epoch anchors (documented fallback).
+
+    Peers the exchange never dialed (a collective round only opens
+    channels to its log N partners; a P2P round skips owners with no
+    foreign units) get a hello dialed here so the merged trace can
+    normalize EVERY host's clock. The dial workers are named
+    (``zest-coop-clk-*``) and joined under one bounded deadline — a
+    hung hello is abandoned to its daemon thread (its channel, if it
+    ever completes, lands in the pool and is closed with it) instead
+    of leaking an anonymous unjoined thread per round."""
     try:
         by_addr = pool.clock_offsets()
     except Exception:  # noqa: BLE001 - observability must not fail a round
         return
+    missing = [(idx, addr) for idx, addr in sorted(peers.items())
+               if addr not in by_addr]
+    if missing:
+        def dial(addr):
+            try:
+                pool.channel(*addr)  # hello runs in channel setup
+            except Exception:  # noqa: BLE001 - offsets are best-effort
+                pass
+
+        workers = [
+            threading.Thread(target=dial, args=(addr,),
+                             name=f"zest-coop-clk-{idx}", daemon=True)
+            for idx, addr in missing
+        ]
+        for w in workers:
+            w.start()
+        join_deadline = time.monotonic() + timeout_s
+        for w in workers:
+            w.join(timeout=max(0.0, join_deadline - time.monotonic()))
+        try:
+            by_addr = pool.clock_offsets()
+        except Exception:  # noqa: BLE001
+            pass  # keep the pre-dial snapshot
     addr_to_idx = {addr: idx for idx, addr in peers.items()}
     for addr, row in by_addr.items():
         idx = row.get("host", addr_to_idx.get(addr))
@@ -605,11 +752,17 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
         try:
             if faults.fire("peer_timeout", key=f"{host}:{port}"):
                 raise TimeoutError("injected peer_timeout")
+            # Explicitly tagged like the collective's phase windows:
+            # the shaped-DCN hub charges RTT per WINDOW (tag boundary),
+            # and an untagged batch would be billed per request —
+            # penalizing the point-to-point leg for tagging, not for
+            # its actual round-trip structure.
             replies = pool.request_many(
                 host, port,
                 [(hashing.hex_to_hash(hh), fi.range.start, fi.range.end)
                  for hh, fi in window],
                 timeout=max(1.0, deadline - time.monotonic()),
+                tag=pool.window_tag(),
             )
         except (ConnectionError, TimeoutError, OSError) as exc:
             budget.release(wire_est)
@@ -643,10 +796,8 @@ def _exchange_from(bridge, entries_map, pool, peers, owner, units,
                     bridge, entries_map, hh, fi, reply, verify)
                 if admitted:
                     bridge.stats.record("peer", wire)
-                    with ex.lock:
-                        ex.units += 1
-                        ex.wire_bytes += wire
-                        ex.unpacked_bytes += unpacked
+                    ex.book_exchange((hh, fi.range.start), wire,
+                                     unpacked)
                 elif isinstance(reply, DcnResponse):
                     # Structurally or content-bad bytes from a live
                     # owner: do NOT retry (same bytes would come back);
@@ -713,11 +864,7 @@ def _fallback(bridge, entries_map, units, ex: _ExchangeStats,
         except Exception:  # noqa: BLE001 - landing waterfall retries per term
             continue
         _cache_unit(bridge, entries_map, hh, fi, fi.range.start, data)
-        with ex.lock:
-            ex.fallback_units += 1
-            ex.fallback_bytes += len(data)
-            ex.fallback_tiers[source] = (
-                ex.fallback_tiers.get(source, 0) + len(data))
+        ex.book_fallback((hh, fi.range.start), source, len(data))
         telemetry.record("cdn_fallback", unit=hh[:16], owner=owner,
                          tier=source, bytes=len(data))
         _M_COOP_FALLBACKS.inc()

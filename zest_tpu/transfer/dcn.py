@@ -398,13 +398,41 @@ class DcnServer:
     """
 
     def __init__(self, cfg: Config, cache: XorbCache | None = None,
-                 span_attrs: dict | None = None):
+                 span_attrs: dict | None = None, rate_bps: int = 0,
+                 window_rtt_s: float = 0.0,
+                 shape_slices: tuple[int, ...] | None = None,
+                 shape_host: int | None = None):
         self.cfg = cfg
         self.cache = cache or XorbCache(cfg)
         # Extra attrs stamped on every serve span (the in-process
         # multi-host simulations pass {"host": i}; production servers
         # inherit the process trace context instead).
         self.span_attrs = dict(span_attrs or {})
+        # Link shaping for the multihost simulations (the token-bucket
+        # hub the coop bench rides): ``rate_bps`` bounds served payload
+        # bytes through one shared shaping.TokenBucket, and
+        # ``window_rtt_s`` charges one WAN round trip per request
+        # WINDOW — the v2 wire tag marks window boundaries, so a
+        # pipelined request_many window pays the RTT once while
+        # untagged per-unit traffic pays it per request (exactly the
+        # asymmetry the collective-vs-point-to-point rows measure).
+        # With ``shape_slices`` (a ZEST_COOP_TOPOLOGY tuple) and
+        # ``shape_host`` (this server's coop host index), shaping
+        # applies ONLY to cross-slice connections — the physical
+        # asymmetry where intra-slice traffic rides ICI at full speed
+        # and only the DCN plane is scarce; the client's slice comes
+        # from the hello's peer host index (an anonymous client is
+        # conservatively treated as cross-slice). Both default off:
+        # production serving is unshaped here (the seeding tier has
+        # its own upload policy).
+        self._bucket = None
+        if rate_bps:
+            from zest_tpu.shaping import TokenBucket
+
+            self._bucket = TokenBucket(rate_bps)
+        self.window_rtt_s = float(window_rtt_s)
+        self.shape_slices = shape_slices
+        self.shape_host = shape_host
         self.port: int | None = None
         self.stats = DcnServerStats()
         self._stats_lock = threading.Lock()
@@ -480,6 +508,11 @@ class DcnServer:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(IDLE_TIMEOUT_S)
                 hello = _exchange_hello(conn)
+                shaped = self._conn_shaped(hello)
+                # Per-connection window tracking for the RTT shaper:
+                # a tag change (or an untagged request) starts a new
+                # window.
+                last_tag: list[int | None] = [None]
                 while not self._shutdown.is_set():
                     msg = _recv_message(conn)
                     if not isinstance(msg, DcnRequest):
@@ -487,14 +520,35 @@ class DcnServer:
                             msg.request_id, "server accepts only REQUEST"
                         )))
                         continue
-                    self._serve_request(conn, msg, hello)
+                    if shaped and self.window_rtt_s > 0:
+                        if msg.tag == 0 or msg.tag != last_tag[0]:
+                            time.sleep(self.window_rtt_s)
+                        last_tag[0] = msg.tag or None
+                    self._serve_request(conn, msg, hello,
+                                        shaped=shaped)
         except (ConnectionError, DcnProtocolError, OSError):
             return  # peer went away / spoke garbage: drop the connection
         finally:
             self._conns.discard(conn)
 
+    def _conn_shaped(self, hello: HelloInfo | None) -> bool:
+        """Whether this connection's serves go through the shaper:
+        always, unless a slice map narrows shaping to cross-slice
+        links and the hello proves the client shares our slice."""
+        if self._bucket is None and self.window_rtt_s <= 0:
+            return False
+        if self.shape_slices is None or self.shape_host is None:
+            return True
+        peer = getattr(hello, "peer_host", None)
+        if peer is None or not 0 <= peer < len(self.shape_slices) \
+                or not 0 <= self.shape_host < len(self.shape_slices):
+            return True  # anonymous client: conservatively cross-slice
+        return (self.shape_slices[peer]
+                != self.shape_slices[self.shape_host])
+
     def _serve_request(self, conn: socket.socket, req: DcnRequest,
-                       hello: HelloInfo | None = None) -> None:
+                       hello: HelloInfo | None = None,
+                       shaped: bool = False) -> None:
         # Server-side request span (ISSUE 7): stamped with the v2 tag
         # and the requester's host/trace identity from the hello block,
         # which is what the merged trace flow-links to the client-side
@@ -506,10 +560,10 @@ class DcnServer:
         if hello is not None and hello.peer_trace_id is not None:
             attrs.setdefault("trace_id", hello.peer_trace_id)
         with telemetry.span("dcn.serve", **attrs) as sp:
-            self._serve_request_inner(conn, req, sp)
+            self._serve_request_inner(conn, req, sp, shaped=shaped)
 
     def _serve_request_inner(self, conn: socket.socket, req: DcnRequest,
-                             sp) -> None:
+                             sp, shaped: bool = False) -> None:
         if not req.range_start < req.range_end:
             conn.sendall(encode_message(DcnError(
                 req.request_id,
@@ -542,6 +596,8 @@ class DcnServer:
         with self._stats_lock:
             self.stats.chunks_served += 1
             self.stats.bytes_served += len(blob)
+        if shaped and self._bucket is not None:
+            self._bucket.acquire(len(blob))
         _M_CHUNKS_SERVED.inc()
         _M_BYTES_SERVED.inc(len(blob))
         sp.add_bytes(len(blob))
@@ -707,12 +763,28 @@ class DcnPool:
         self._channels: dict[tuple[str, int], DcnChannel] = {}
         self._lock = threading.Lock()
         self._next_tag = 0
+        # Wire-tag accounting (ISSUE 14): how many request windows went
+        # out, how many individual REQUESTs they carried, and how many
+        # windows were UNTAGGED (no window tag on the wire — the
+        # per-unit round-trip shape the collective exchange must never
+        # produce; the coop smoke asserts untagged_windows == 0 on its
+        # collective leg).
+        self.counters = {"windows": 0, "requests": 0,
+                         "tagged_windows": 0, "untagged_windows": 0}
 
     def _alloc_tag(self) -> int:
         """Next nonzero u16 window tag (wraps; 0 stays 'untagged')."""
         with self._lock:
             self._next_tag = (self._next_tag % 0xFFFF) + 1
             return self._next_tag
+
+    def window_tag(self) -> int:
+        """Public window-tag allocator for callers that batch their own
+        windows (the collective exchange tags every phase sub-window so
+        the serve side can see window boundaries — shaping charges RTT
+        per window — and the wire-tag counters can prove no per-unit
+        round trips happened)."""
+        return self._alloc_tag()
 
     def clock_offsets(self) -> dict:
         """Per-peer hello measurements: ``{(host, port): {"offset_s",
@@ -767,6 +839,7 @@ class DcnPool:
     def request_many(
         self, host: str, port: int, wants: list[tuple[bytes, int, int]],
         timeout: float | None = None,
+        tag: int | None = None,
     ) -> list[DcnMessage]:
         """Pipelined batch through a pooled channel, transparently
         reconnecting and retrying ONCE when a previously pooled channel
@@ -774,18 +847,29 @@ class DcnPool:
         exactly here: the pool believed the channel was live, the first
         send/response proves otherwise). A *fresh* connection's failure
         propagates — that's a real peer problem, not staleness.
-        ``timeout`` caps each response wait for this call only."""
+        ``timeout`` caps each response wait for this call only.
+        ``tag`` stamps an explicit window tag on every REQUEST of this
+        batch (callers allocate via :meth:`window_tag`)."""
         # Forwarded only when set: injected channel doubles (tests,
-        # wrappers) predate the parameters. The window tag is allocated
-        # only while a trace is actually recording — it exists to
-        # flow-link this window span to the server's serve spans, and
-        # skipping it otherwise keeps the wire bytes (and the doubles'
-        # call shape) identical to the untraced path.
+        # wrappers) predate the parameters. Without an explicit ``tag``
+        # the window tag is allocated only while a trace is actually
+        # recording — it exists to flow-link this window span to the
+        # server's serve spans, and skipping it otherwise keeps the
+        # wire bytes (and the doubles' call shape) identical to the
+        # untraced path.
         kw = {} if timeout is None else {"timeout": timeout}
-        tag = 0
-        if telemetry.enabled() and telemetry.trace.active() is not None:
+        if tag is None and telemetry.enabled() \
+                and telemetry.trace.active() is not None:
             tag = self._alloc_tag()
+        if tag:
             kw["tag"] = tag
+        else:
+            tag = 0
+        with self._lock:
+            self.counters["windows"] += 1
+            self.counters["requests"] += len(wants)
+            self.counters["tagged_windows" if tag
+                          else "untagged_windows"] += 1
         attrs = {"peer": f"{host}:{port}", "requests": len(wants)}
         if tag:
             attrs["flow_tag"] = tag

@@ -98,6 +98,34 @@ def make_unit_verifier(key: bytes | None = None):
     return verify
 
 
+def local_slice_groups(n_hosts: int) -> tuple[int, ...] | None:
+    """Slice id per coop host index, from the JAX runtime — the
+    auto-inferred topology the collective exchange classes its links
+    with (``transfer.collective.slice_topology``; the explicit
+    ``ZEST_COOP_TOPOLOGY`` override wins for sims).
+
+    Multi-slice TPU jobs expose ``Device.slice_index``; each process's
+    devices share one slice, so process index → slice id is the whole
+    map. Returns None when the runtime has no slice notion (CPU sims,
+    single-controller), when jax is not importable here, or when the
+    process count disagrees with ``n_hosts`` (a coop round spanning a
+    different host set than the mesh — no honest inference exists)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        if not devs or getattr(devs[0], "slice_index", None) is None:
+            return None
+        by_proc: dict[int, int] = {}
+        for d in devs:
+            by_proc.setdefault(int(d.process_index), int(d.slice_index))
+        if sorted(by_proc) != list(range(n_hosts)):
+            return None
+        return tuple(by_proc[i] for i in range(n_hosts))
+    except Exception:  # noqa: BLE001 - topology inference is advisory
+        return None
+
+
 def fetch_file_header(bridge, rec):
     """Parse a safetensors header by fetching only the file's head terms.
 
