@@ -1,0 +1,163 @@
+"""Bench regression sentinel (ISSUE 15 satellite).
+
+The committed bench artifacts (``SWARM_r12.json``, ``TENANT_r13.json``,
+``MULTIHOST_r14.json``, ``DELTA_r10.json``) carry the numbers each PR
+was accepted on — but nothing re-checked them: a later PR regenerating
+an artifact with a worse number (a peer-served ratio under its gate, a
+speedup that quietly halved, a duplicate-fetch ratio creeping off zero)
+would ship silently. This script is the sentinel: it re-parses every
+committed artifact against (a) the artifact's own recorded ``gates``
+block (every recorded gate must still read true) and (b) an explicit
+tolerance table of floors/ceilings for the headline numbers — so a
+regenerated artifact below its gate fails CI loud.
+
+Tolerances are FLOORS, not equality: benches run on weather-grade CI
+hosts, so the table pins "never ship worse than the gate the PR was
+accepted on", not "reproduce the exact number".
+
+Usage: python scripts/bench_trend.py [--root DIR]
+Exit 0 = every artifact within tolerance; 1 = regression or a missing/
+malformed artifact (an artifact that vanished is a failure too — the
+sentinel must not pass vacuously).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def get(doc, path):
+    """Slash-path lookup (gate keys themselves may contain dots); None
+    when any hop is missing."""
+    cur = doc
+    for part in path.split("/"):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+# The tolerance table: (dotted path, op, bound, why).
+#   ge/le — the recorded headline must stay on the right side of the
+#           gate its PR was accepted on;
+#   eq   — exact invariants (zero corruption, zero unit round trips);
+#   truthy — recorded boolean gates that must still hold.
+CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
+    "SWARM_r12.json": [
+        ("gates/peer_served_ratio_ge_0.85", "truthy", None,
+         "recorded swarm gate flipped false"),
+        ("gates/corrupt_bytes_admitted_eq_0", "truthy", None,
+         "recorded corruption gate flipped false"),
+        ("gates/fairness_skew_le_2.0", "truthy", None,
+         "recorded fairness gate flipped false"),
+        ("gates/all_faults_fired", "truthy", None,
+         "chaos run went vacuous (a fault never fired)"),
+        ("shaped_chaos/peer_served_ratio", "ge", 0.85,
+         "swarm peer-served ratio under chaos fell below the "
+         "ISSUE-12 gate"),
+        ("shaped_chaos/upload_fairness/skew", "le", 2.0,
+         "per-seeder upload skew exceeds the fairness gate"),
+        ("shaped_chaos/corrupt_bytes_admitted", "eq", 0,
+         "corrupt bytes were admitted past the merkle boundary"),
+    ],
+    "TENANT_r13.json": [
+        ("gates/all_ok", "truthy", None,
+         "recorded tenant gate block flipped false"),
+        ("gates/duplicate_fetch_ratio", "le", 0.05,
+         "singleflight dedupe regressed: duplicate CDN fetches"),
+        ("gates/zero_corrupt", "truthy", None,
+         "tenant bench admitted corrupt bytes"),
+        ("gates/killed_isolated", "truthy", None,
+         "a killed tenant damaged its neighbors"),
+        ("gates/pinned_never_evicted", "truthy", None,
+         "disk pressure evicted a pinned cache entry"),
+        ("saturation/dedupe/dedupe_hits", "ge", 1,
+         "overlapping tenants shared zero in-flight fetches"),
+    ],
+    "MULTIHOST_r14.json": [
+        ("shaped/speedup", "ge", 3.0,
+         "coop speedup over the per-host baseline fell below the "
+         "accepted floor (recorded 5.5x)"),
+        ("shaped/coop/peer_served_ratio", "ge", 0.8,
+         "pod peer-served ratio fell below the north-star floor"),
+        ("shaped/coop/collective/unit_round_trips", "eq", 0,
+         "the collective re-grew per-unit round trips"),
+        ("shaped/coop/collective/aborts", "eq", 0,
+         "the shaped collective bench aborted to point-to-point"),
+        ("shaped/coop/fallbacks", "eq", 0,
+         "coop units fell back to CDN in the clean shaped run"),
+    ],
+    "DELTA_r10.json": [
+        ("delta_bytes_ratio", "le", 0.03,
+         "a 1%-changed delta pull fetched more than the 3% gate"),
+        ("swap_ratio", "le", 0.3,
+         "hot-swap wall exceeded 0.3x the cold pull gate"),
+        ("digest_identical", "truthy", None,
+         "the hot-swapped tree is no longer byte-identical to cold"),
+        ("tensors_reused", "ge", 1,
+         "the per-tensor short-circuit reused nothing"),
+    ],
+}
+
+
+def check(op: str, value, bound) -> bool:
+    if value is None:
+        return False
+    if op == "truthy":
+        return bool(value)
+    if op == "ge":
+        return value >= bound
+    if op == "le":
+        return value <= bound
+    if op == "eq":
+        return value == bound
+    raise ValueError(f"unknown op {op!r}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=None,
+                    help="repo root holding the artifacts "
+                         "(default: this script's parent's parent)")
+    args = ap.parse_args()
+    root = pathlib.Path(args.root) if args.root else \
+        pathlib.Path(__file__).resolve().parent.parent
+
+    failures: list[str] = []
+    checked = 0
+    for name, rules in sorted(CHECKS.items()):
+        path = root / name
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError) as exc:
+            failures.append(f"{name}: unreadable artifact ({exc})")
+            continue
+        if doc.get("partial"):
+            failures.append(
+                f"{name}: artifact is marked partial — a crashed bench "
+                "must be regenerated, not shipped as the record")
+            continue
+        for rule_path, op, bound, why in rules:
+            value = get(doc, rule_path)
+            checked += 1
+            if not check(op, value, bound):
+                bound_s = "" if op == "truthy" else f" (bound {bound})"
+                failures.append(
+                    f"{name}: {rule_path} = {value!r}{bound_s} — {why}")
+
+    if failures:
+        print("BENCH TREND FAILED — committed artifacts regressed "
+              "below their recorded gates:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"bench trend OK: {checked} gates across "
+          f"{len(CHECKS)} artifacts within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

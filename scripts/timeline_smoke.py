@@ -1,0 +1,230 @@
+"""CI gate for the live telemetry timelines (ISSUE 15).
+
+Three properties of the in-process time-series store, proven against a
+throttled loopback fixture pull:
+
+1. **Conservation** — the fetch-rate series (one per serving tier,
+   derived as counter deltas per sampler tick) must integrate back to
+   within 5% of the ``FetchStats`` byte total the pull itself reports:
+   the timeline is a *history of the counters*, not an estimate.
+2. **Visibility** — an injected mid-pull ``cdn_503`` burst must show up
+   as a visible rate dip in the series (the burst window's floor well
+   below the clean samples' median): a flapping CDN must be *watchable
+   while it happens*, which is the module's reason to exist.
+3. **Detection** — a ``seeder_stall`` run (every peer response sleeps
+   past the anomaly window) must fire the zero-progress stall detector:
+   flight-recorder event + ``zest_anomalies_total{kind=stall}`` +
+   session annotation, within 2× ``ZEST_ANOMALY_WINDOW_S``.
+
+Usage: python scripts/timeline_smoke.py [--size BYTES]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+# Sampler knobs BEFORE any zest import resolves them: 10 Hz ticks and
+# a 0.5 s anomaly window keep the smoke's wall clock in seconds.
+WINDOW_S = 0.5
+os.environ.setdefault("ZEST_TIMELINE_HZ", "10")
+os.environ.setdefault("ZEST_ANOMALY_WINDOW_S", str(WINDOW_S))
+
+
+def fail(msg: str, blob=None) -> int:
+    print(f"TIMELINE SMOKE FAILED: {msg}", file=sys.stderr)
+    if blob is not None:
+        print(json.dumps(blob, indent=2, default=str), file=sys.stderr)
+    return 1
+
+
+def fetch_series(tl_doc: dict) -> dict:
+    return {n: s for n, s in tl_doc["series"].items()
+            if n.startswith("fetch.")}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=float, default=0.064,
+                    help="checkpoint GB (default 0.064 = 64 MiB)")
+    args = ap.parse_args()
+
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu import faults, telemetry
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.telemetry import session as session_mod
+    from zest_tpu.telemetry import timeline
+    from zest_tpu.transfer.pull import pull_model
+
+    files = llama_checkpoint_files(args.size,
+                                   shard_bytes=8 * 1024 * 1024, scale=8)
+    repo = FixtureRepo("smoke/timeline", files, chunks_per_xorb=16)
+    total_payload = sum(len(v) for v in files.values())
+
+    def settle():
+        """Let the sampler take two more ticks so the final counter
+        delta lands in the series before we read it."""
+        time.sleep(2.5 / timeline.STORE.hz)
+
+    # ── Gate 1: rate series integrate to the FetchStats total ──
+    telemetry.reset_all()
+    with FixtureHub(repo, throttle_bps=24_000_000) as hub, \
+            tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+        res = pull_model(cfg, "smoke/timeline", no_p2p=True,
+                         log=lambda *a, **k: None)
+        settle()
+        doc = timeline.STORE.payload()
+        rates = fetch_series(doc)
+        if not rates:
+            return fail("no fetch.* rate series sampled",
+                        sorted(doc["series"]))
+        integrated = sum(timeline.integrate(s["samples"])
+                         for s in rates.values())
+        fetched = sum(res.stats["fetch"]["bytes"].values())
+        if fetched <= 0:
+            return fail("pull reports zero fetched bytes", res.stats)
+        err = abs(integrated - fetched) / fetched
+        if err > 0.05:
+            return fail(
+                f"rate series integrate to {integrated:.0f} B vs "
+                f"FetchStats {fetched} B ({err:.1%} off, gate 5%)",
+                {n: len(s["samples"]) for n, s in rates.items()})
+
+    # ── Gate 2: a mid-pull cdn_503 burst is a visible rate dip ──
+    telemetry.reset_all()
+    burst = {}
+    with FixtureHub(repo, throttle_bps=16_000_000) as hub, \
+            tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+
+        def chaos():
+            # Wait for real byte flow, then flap the CDN hard for a
+            # bounded window.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                sessions = session_mod.SESSIONS.active()
+                if sessions:
+                    f = sessions[0]._fetch
+                    if f is not None and f.bytes_from_cdn \
+                            > total_payload * 0.15:
+                        break
+                time.sleep(0.02)
+            burst["t0"] = time.time()
+            faults.install("cdn_503:0.9", seed=1337)
+            time.sleep(1.2)
+            faults.reset()
+            burst["t1"] = time.time()
+
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        pull_model(cfg, "smoke/timeline", no_p2p=True,
+                   log=lambda *a, **k: None)
+        t.join(timeout=30)
+        settle()
+        doc = timeline.STORE.payload()
+    if "t0" not in burst or "t1" not in burst:
+        return fail("chaos thread never saw the pull move bytes")
+    cdn = (doc["series"].get("fetch.cdn_bps") or {}).get("samples", [])
+    inside = [v for tm, v in cdn if burst["t0"] + 0.2 <= tm
+              <= burst["t1"]]
+    outside = [v for tm, v in cdn
+               if (tm < burst["t0"] or tm > burst["t1"] + 0.3) and v > 0]
+    if not inside or len(outside) < 3:
+        return fail(f"burst window has {len(inside)} samples, clean "
+                    f"window {len(outside)} — pull too fast to judge")
+    clean_median = statistics.median(outside)
+    dip_floor = min(inside)
+    if not dip_floor < 0.5 * clean_median:
+        return fail(
+            f"cdn_503 burst not visible: burst floor {dip_floor:.0f} "
+            f"B/s vs clean median {clean_median:.0f} B/s",
+            {"inside": inside, "outside_median": clean_median})
+
+    # ── Gate 3: seeder_stall fires the zero-progress stall detector ──
+    telemetry.reset_all()
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    with FixtureHub(repo) as hub, \
+            tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        seeder_cfg = Config(hf_home=rootp / "hf-seed",
+                            cache_dir=rootp / "zest-seed",
+                            hf_token="hf_test", endpoint=hub.url,
+                            listen_port=0)
+        pull_model(seeder_cfg, "smoke/timeline", no_p2p=True,
+                   log=lambda *a, **k: None)
+        telemetry.reset_all()  # the warm pull must not be the session
+        server = BtServer(seeder_cfg)
+        port = server.start()
+        faults.install("seeder_stall:1.0@2.0")
+        try:
+            leech = Config(hf_home=rootp / "hf-leech",
+                           cache_dir=rootp / "zest-leech",
+                           hf_token="hf_test", endpoint=hub.url,
+                           listen_port=0)
+            swarm = SwarmDownloader(leech)
+            swarm.add_direct_peer("127.0.0.1", port)
+            try:
+                pull_model(leech, "smoke/timeline", swarm=swarm,
+                           log=lambda *a, **k: None)
+            finally:
+                swarm.close()
+            if not faults.counters().get("seeder_stall"):
+                return fail("seeder_stall never fired — stall run is "
+                            "vacuous", faults.counters())
+        finally:
+            faults.reset()
+            server.shutdown()
+        anomalies = timeline.STORE.payload()["anomalies"]
+        stalls = [e for e in anomalies if e["kind"] == "stall"]
+        if not stalls:
+            return fail("stall detector never fired under seeder_stall",
+                        anomalies)
+        recent = session_mod.payload()["recent"]
+        if not recent or stalls[0].get("session") != recent[0]["id"]:
+            return fail("stall anomaly not attributed to the pull's "
+                        "session", {"anomaly": stalls[0],
+                                    "sessions": recent})
+        if stalls[0].get("stalled_s", 99) > 2 * WINDOW_S + 0.3:
+            return fail(
+                f"stall detected too late: {stalls[0]['stalled_s']}s "
+                f"vs 2x window {2 * WINDOW_S}s", stalls[0])
+        m = [m for m in telemetry.REGISTRY.metrics()
+             if m.name == "zest_anomalies_total"]
+        if not m or m[0].value(kind="stall") < 1:
+            return fail("zest_anomalies_total{kind=stall} not bumped")
+        recs = [e for e in telemetry.recorder.tail()
+                if e.get("kind") == "anomaly"
+                and e.get("anomaly") == "stall"]
+        if not recs:
+            return fail("no flight-recorder anomaly event")
+
+    print("timeline smoke OK: "
+          f"rates integrate to {integrated / fetched:.1%} of "
+          f"{fetched} fetched bytes; cdn_503 dip "
+          f"{dip_floor / clean_median:.0%} of clean median; "
+          f"stall fired at {stalls[0].get('stalled_s')}s "
+          f"(window {WINDOW_S}s) on session {stalls[0].get('session')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

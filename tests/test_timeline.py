@@ -1,0 +1,811 @@
+"""Live telemetry timelines (ISSUE 15): the bounded time-series store,
+counter-rate derivation, streaming anomaly detection with session
+attribution, the ``/v1/timeline`` surface, ``zest top``, and the
+tenancy-metrics satellites.
+
+The contract under test: bounded memory by construction (per-series
+ring × series cap), rate series that integrate exactly back to the
+counters they were derived from, anomalies that fire once per episode
+with the right kind and session, and ``ZEST_TIMELINE=0`` restoring the
+timeline-less process bit-for-bit (no sampler thread, empty store,
+byte-identical pull)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from zest_tpu import telemetry
+from zest_tpu.telemetry import critpath
+from zest_tpu.telemetry import session as session_mod
+from zest_tpu.telemetry import timeline
+from zest_tpu.transfer import tenancy
+from zest_tpu.transfer.pull import pull_model
+
+from fixtures import FixtureHub, FixtureRepo
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset_all()
+    tenancy.reset()
+    yield
+    telemetry.reset_all()
+    tenancy.reset()
+
+
+def _cfg(hub, root, **kw):
+    from zest_tpu.config import Config
+
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", endpoint=hub.url, **kw)
+
+
+class _FakeFetch:
+    """Scripted FetchStats double: the detector only reads the three
+    byte counters."""
+
+    def __init__(self):
+        self.bytes_from_cache = 0
+        self.bytes_from_peer = 0
+        self.bytes_from_cdn = 0
+
+
+# ── Store: ring bound, series cap, cursor paging ──
+
+
+class TestStore:
+    def test_series_ring_is_bounded(self):
+        store = timeline.TimelineStore(capacity=4)
+        for i in range(10):
+            store._append("s", float(i), "gauge", float(i))
+        doc = store.payload()
+        vals = [v for _t, v in doc["series"]["s"]["samples"]]
+        assert vals == [6.0, 7.0, 8.0, 9.0]  # oldest evicted
+
+    def test_series_count_is_capped_lru(self):
+        store = timeline.TimelineStore(capacity=4, max_series=3)
+        for i in range(5):
+            store._append(f"s{i}", 1.0, "gauge", float(i))
+        store._append("s2", 2.0, "gauge", 9.0)  # touch s2
+        store._append("brand.new", 1.0, "gauge", 10.0)
+        names = set(store.payload()["series"])
+        assert len(names) == 3
+        assert "s2" in names and "brand.new" in names
+        assert "s0" not in names and "s1" not in names
+
+    def test_cursor_paging(self):
+        store = timeline.TimelineStore(capacity=16)
+        store._append("a", 1.0, "gauge", 1.0)
+        store._append("b", 2.0, "gauge", 1.0)
+        doc = store.payload()
+        cursor = doc["cursor"]
+        assert {n for n in doc["series"]} == {"a", "b"}
+        # Nothing new past the cursor.
+        assert store.payload(since=cursor)["series"] == {}
+        store._append("a", 3.0, "gauge", 2.0)
+        page = store.payload(since=cursor)
+        assert list(page["series"]) == ["a"]
+        assert page["series"]["a"]["samples"] == [[2.0, 3.0]]
+        assert page["cursor"] == cursor + 1
+
+    def test_prefix_filter(self):
+        store = timeline.TimelineStore(capacity=4)
+        store._append("fetch.cdn_bps", 1.0, "rate", 1.0)
+        store._append("ring.stalls", 0.0, "gauge", 1.0)
+        assert list(store.payload(prefix="fetch.")["series"]) \
+            == ["fetch.cdn_bps"]
+
+
+# ── Rate derivation from registry counters ──
+
+
+class TestRates:
+    def test_rate_matches_hand_computed_counter_deltas(self):
+        store = timeline.TimelineStore(capacity=32)
+        c = telemetry.counter("zest_fetch_bytes_total", "", ("source",))
+        c.inc(1000, source="cdn")
+        store.tick(now=100.0, wall=100.0)  # baseline → 0.0 sample
+        c.inc(4000, source="cdn")
+        store.tick(now=102.0, wall=102.0)  # 4000 B / 2 s
+        c.inc(1000, source="cdn")
+        c.inc(600, source="peer")
+        store.tick(now=103.0, wall=103.0)
+        c.inc(300, source="peer")
+        store.tick(now=104.0, wall=104.0)
+        doc = store.payload()
+        cdn = doc["series"]["fetch.cdn_bps"]["samples"]
+        assert cdn == [[100.0, 0.0], [102.0, 2000.0], [103.0, 1000.0],
+                       [104.0, 0.0]]
+        # A labelset first seen mid-run credits its whole value over
+        # the preceding tick interval (zero-anchored so integration
+        # stays exact), then rates normally.
+        peer = doc["series"]["fetch.peer_bps"]["samples"]
+        assert peer == [[102.0, 0.0], [103.0, 600.0], [104.0, 300.0]]
+        assert timeline.integrate(peer) == pytest.approx(900.0)
+        assert cdn == sorted(cdn)  # monotonic timestamps
+
+    def test_integration_reproduces_counter_total(self):
+        store = timeline.TimelineStore(capacity=64)
+        c = telemetry.counter("zest_files_bytes_total", "", ("lane",))
+        t, total = 10.0, 0
+        c.inc(0, lane="copy")  # materialize the labelset at zero
+        store.tick(now=t, wall=t)
+        for i, (dt, nbytes) in enumerate(
+                [(1.0, 5000), (0.5, 0), (2.0, 12345), (1.0, 777)]):
+            t += dt
+            c.inc(nbytes, lane="copy")
+            total += nbytes
+            store.tick(now=t, wall=t)
+        samples = store.payload()["series"]["files.copy_bps"]["samples"]
+        assert timeline.integrate(samples) == pytest.approx(total)
+
+    def test_unlabeled_source_sums_to_one_series(self):
+        store = timeline.TimelineStore(capacity=8)
+        c = telemetry.counter("zest_seed_bytes_total", "",
+                              ("peer_state",))
+        c.inc(100, peer_state="reciprocal")
+        store.tick(now=1.0, wall=1.0)
+        c.inc(100, peer_state="reciprocal")
+        c.inc(300, peer_state="optimistic")
+        store.tick(now=2.0, wall=2.0)
+        samples = store.payload()["series"]["seed.bps"]["samples"]
+        assert samples[-1] == [2.0, 400.0]
+
+
+# ── Probes + cells ──
+
+
+class TestProbes:
+    def test_probe_sampled_each_tick_and_replace_semantics(self):
+        store = timeline.TimelineStore(capacity=8)
+        store._probes["g"] = lambda: 7
+        store.tick(now=1.0, wall=1.0)
+        store._probes["g"] = lambda: 9  # replacement wins
+        store.tick(now=2.0, wall=2.0)
+        assert store.payload()["series"]["g"]["samples"] \
+            == [[1.0, 7.0], [2.0, 9.0]]
+
+    def test_failing_or_none_probe_drops_sample(self):
+        store = timeline.TimelineStore(capacity=8)
+
+        def boom():
+            raise RuntimeError("probe died")
+
+        store._probes["bad"] = boom
+        store._probes["idle"] = lambda: None
+        store.tick(now=1.0, wall=1.0)
+        assert store.payload()["series"] == {}
+
+    def test_conditional_unregister_keeps_replacement(self):
+        old, new = (lambda: 1), (lambda: 2)
+        timeline.register_probe("ring.test", old)
+        timeline.register_probe("ring.test", new)
+        timeline.unregister_probe("ring.test", old)  # stale teardown
+        assert timeline.STORE._probes["ring.test"] is new
+        timeline.unregister_probe("ring.test", new)
+        assert "ring.test" not in timeline.STORE._probes
+
+    def test_host_ring_close_unregisters_its_probes(self):
+        """Regression: bound methods mint a fresh object per attribute
+        access, so close() must unregister with the SAME objects it
+        registered — and an old ring's late close must not drop a
+        newer ring's probes."""
+        from zest_tpu.models.loader import HostRing
+
+        ring = HostRing(1024, 4)
+        assert "ring.in_use_bytes" in timeline.STORE._probes
+        ring.close()
+        assert "ring.in_use_bytes" not in timeline.STORE._probes
+        assert "ring.stalls" not in timeline.STORE._probes
+        r1 = HostRing(1024, 4)
+        r2 = HostRing(2048, 4)
+        r1.close()  # replaced before closing: must be a no-op
+        assert timeline.STORE._probes["ring.in_use_bytes"] \
+            is r2._probe_in_use
+        r2.close()
+        assert "ring.in_use_bytes" not in timeline.STORE._probes
+
+    def test_posted_cells_recorded_until_cleared(self):
+        store = timeline.TimelineStore(capacity=8)
+        store._cells["collective.phase"] = 2.0
+        store.tick(now=1.0, wall=1.0)
+        store._cells.pop("collective.phase")
+        store.tick(now=2.0, wall=2.0)
+        samples = store.payload()["series"]["collective.phase"]["samples"]
+        assert samples == [[1.0, 2.0]]
+
+
+# ── Anomaly detection (synthetic ground truth) ──
+
+
+def _session_with_fetch(total=10_000, phase="fetch"):
+    sess = session_mod.begin("acme/anom", "main")
+    f = _FakeFetch()
+    sess._fetch = f
+    sess.set_total_bytes(total)
+    sess.phase = phase
+    return sess, f
+
+
+class TestAnomalies:
+    def test_stall_fires_within_two_windows_with_session_attribution(
+            self):
+        store = timeline.TimelineStore(capacity=64, window_s=2.0)
+        sess, f = _session_with_fetch()
+        t = 0.0
+        f.bytes_from_cdn = 2000
+        store.tick(now=t, wall=t)
+        # Progress for two ticks, then a dead stop.
+        for delta in (1000, 1000):
+            t += 1.0
+            f.bytes_from_cdn += delta
+            store.tick(now=t, wall=t)
+        stall_start = t
+        fired_at = None
+        for _ in range(8):
+            t += 1.0
+            store.tick(now=t, wall=t)
+            if store.payload()["anomalies"]:
+                fired_at = t
+                break
+        assert fired_at is not None, "stall never fired"
+        assert fired_at - stall_start <= 2 * store.window_s
+        (ev,) = store.payload()["anomalies"]
+        assert ev["kind"] == timeline.ANOMALY_STALL
+        assert ev["session"] == sess.id
+        # Metric + flight event + session annotation all fired.
+        assert telemetry.REGISTRY.metrics()
+        m = [m for m in telemetry.REGISTRY.metrics()
+             if m.name == "zest_anomalies_total"][0]
+        assert m.value(kind=timeline.ANOMALY_STALL) == 1
+        recs = [e for e in telemetry.recorder.tail()
+                if e["kind"] == "anomaly"]
+        assert recs and recs[0]["anomaly"] == timeline.ANOMALY_STALL
+        assert recs[0]["session"] == sess.id
+        assert timeline.ANOMALY_STALL in sess.snapshot()["anomalies"]
+        # One firing per episode: more stalled ticks add nothing.
+        for _ in range(4):
+            t += 1.0
+            store.tick(now=t, wall=t)
+        assert m.value(kind=timeline.ANOMALY_STALL) == 1
+        session_mod.finish(sess, "ok")
+
+    def test_stall_gated_on_byte_moving_phase(self):
+        store = timeline.TimelineStore(capacity=64, window_s=1.0)
+        sess, f = _session_with_fetch(phase="hbm_commit")
+        f.bytes_from_cdn = 5000
+        for i in range(6):
+            store.tick(now=float(i), wall=float(i))
+        assert store.payload()["anomalies"] == []
+        session_mod.finish(sess, "ok")
+
+    def test_stall_fires_during_direct_landing_with_open_fetch(self):
+        """Regression: the display phase during a direct landing is
+        hbm_commit (outranks fetch) while fetch workers still pull
+        bytes inside it — the stall rule judges the OPEN stage
+        multiset, so a mid-landing fetch stall still fires."""
+        store = timeline.TimelineStore(capacity=64, window_s=1.0)
+        sess, f = _session_with_fetch(phase="hbm_commit")
+        sess._open = {"hbm_commit": 1, "fetch": 1}
+        f.bytes_from_cdn = 5000
+        for i in range(6):
+            store.tick(now=float(i), wall=float(i))
+        kinds = [e["kind"] for e in store.payload()["anomalies"]]
+        assert kinds == [timeline.ANOMALY_STALL]
+        session_mod.finish(sess, "ok")
+
+    def test_throughput_collapse_vs_own_ewma(self):
+        store = timeline.TimelineStore(capacity=128, window_s=2.0)
+        sess, f = _session_with_fetch(total=100_000_000)
+        t = 0.0
+        store.tick(now=t, wall=t)
+        # 10 healthy seconds at ~2 MB/s build the EWMA baseline...
+        for _ in range(10):
+            t += 1.0
+            f.bytes_from_cdn += 2_000_000
+            store.tick(now=t, wall=t)
+        # ...then a trickle: nonzero (not a stall) but far below 25%.
+        for _ in range(6):
+            t += 1.0
+            f.bytes_from_cdn += 10_000
+            store.tick(now=t, wall=t)
+        kinds = [e["kind"] for e in store.payload()["anomalies"]]
+        assert kinds == [timeline.ANOMALY_COLLAPSE]
+        (ev,) = store.payload()["anomalies"]
+        assert ev["session"] == sess.id
+        assert ev["rate_bps"] < ev["ewma_bps"] * timeline.COLLAPSE_FRACTION
+        session_mod.finish(sess, "ok")
+
+    def test_queue_growth_without_admission(self):
+        store = timeline.TimelineStore(capacity=32, window_s=2.0)
+        det = store.detector
+        # Queue sits at 3 while admitted_total never moves → fires.
+        for i in range(5):
+            det.observe_queue(3, 10, float(i))
+        assert [e["kind"] for e in store.payload()["anomalies"]] \
+            == [timeline.ANOMALY_QUEUE]
+        # An admission re-arms the episode; a fresh hold re-fires.
+        det.observe_queue(3, 11, 6.0)
+        for i in range(7, 12):
+            det.observe_queue(3, 11, float(i))
+        kinds = [e["kind"] for e in store.payload()["anomalies"]]
+        assert kinds == [timeline.ANOMALY_QUEUE] * 2
+
+    def test_queue_draining_never_fires(self):
+        store = timeline.TimelineStore(capacity=32, window_s=1.0)
+        det = store.detector
+        for i, depth in enumerate([5, 4, 3, 2, 1, 0]):
+            det.observe_queue(depth, 10 + i, float(i))
+        assert store.payload()["anomalies"] == []
+
+    def test_collective_straggler_per_phase(self):
+        store = timeline.TimelineStore(capacity=32, window_s=1.0)
+        det = store.detector
+        cells = {"collective.phase": 0, "collective.barrier_s": 0.0,
+                 "collective.partner": 3}
+        det.observe_collective(cells, 0.0)
+        cells["collective.barrier_s"] = 1.5  # waited past the window
+        det.observe_collective(cells, 1.5)
+        (ev,) = store.payload()["anomalies"]
+        assert ev["kind"] == timeline.ANOMALY_STRAGGLER
+        assert ev["phase"] == 0 and ev["partner"] == 3
+        # Same phase: fired once. New phase: fresh baseline, no fire.
+        cells["collective.barrier_s"] = 3.0
+        det.observe_collective(cells, 3.0)
+        cells["collective.phase"] = 1
+        det.observe_collective(cells, 4.0)
+        assert len(store.payload()["anomalies"]) == 1
+
+
+# ── Knob-off identity ──
+
+
+FILES = {
+    "config.json": b'{"model_type": "test"}',
+    "model.safetensors": bytes(range(256)) * 2048,  # 512 KiB
+    "tokenizer.json": b'{"tok": 1}' * 20,
+}
+
+
+class TestKnobOff:
+    def test_off_means_no_thread_no_samples_no_probes(self):
+        timeline.set_enabled(False)
+        assert timeline.ensure_started() is False
+        assert timeline._sampler is None
+        timeline.register_probe("x", lambda: 1)
+        timeline.post("y", 2.0)
+        assert timeline.STORE._probes == {}
+        assert timeline.STORE._cells == {}
+        doc = timeline.payload()
+        assert doc == {"enabled": False, "series": {}, "anomalies": [],
+                       "cursor": 0}
+        assert timeline.status_block() == {"enabled": False}
+
+    def test_telemetry_off_implies_timeline_off(self):
+        telemetry.set_enabled(False)
+        timeline.set_enabled(True)
+        assert timeline.enabled() is False
+
+    def test_knob_off_pull_byte_identical_with_empty_store(
+            self, tmp_path, monkeypatch):
+        repo = FixtureRepo("acme/tl-model", FILES, chunks_per_xorb=3)
+        with FixtureHub(repo) as hub:
+            on = pull_model(_cfg(hub, tmp_path / "on"), "acme/tl-model",
+                            no_p2p=True, log=lambda *a, **k: None)
+            assert timeline._sampler is not None  # pull started it
+            telemetry.reset_all()
+            tenancy.reset()
+            monkeypatch.setenv(timeline.ENV_TIMELINE, "0")
+            off = pull_model(_cfg(hub, tmp_path / "off"),
+                             "acme/tl-model", no_p2p=True,
+                             log=lambda *a, **k: None)
+            # Hard-off: no sampler thread, empty store, and the pull's
+            # stats schema identical — the timeline adds no keys either
+            # way, which is exactly the point.
+            assert timeline._sampler is None
+            assert timeline.STORE.payload()["series"] == {}
+            assert sorted(on.stats) == sorted(off.stats)
+            for name in FILES:
+                assert (on.snapshot_dir / name).read_bytes() \
+                    == (off.snapshot_dir / name).read_bytes()
+
+
+# ── Chaos: a stalled seeder fires the stall anomaly on a real pull ──
+
+
+class TestChaosStall:
+    def test_seeder_stall_pull_fires_stall_with_session(
+            self, tmp_path, monkeypatch):
+        from zest_tpu import faults, storage
+        from zest_tpu.transfer.server import BtServer
+        from zest_tpu.transfer.swarm import SwarmDownloader
+
+        files = {"config.json": b'{"model_type": "stall"}',
+                 "model.safetensors": bytes(range(256)) * 6000}
+        repo = FixtureRepo("acme/stall-model", files, chunks_per_xorb=64)
+        window_s = 0.4
+        monkeypatch.setenv(timeline.ENV_WINDOW, str(window_s))
+        monkeypatch.setenv(timeline.ENV_HZ, "20")
+        timeline.reset()
+        with FixtureHub(repo) as hub:
+            seeder_cfg = _cfg(hub, tmp_path / "seeder")
+            pull_model(seeder_cfg, "acme/stall-model", no_p2p=True,
+                       log=lambda *a, **k: None)
+            telemetry.reset_all()  # drop the seeder warm pull's session
+            server = BtServer(seeder_cfg)
+            port = server.start()
+            # Every peer response sleeps well past 2× the window: the
+            # pull's fetch phase makes zero byte progress meanwhile.
+            faults.install("seeder_stall:1.0@2.0")
+            try:
+                leech = _cfg(hub, tmp_path / "leech")
+                swarm = SwarmDownloader(leech)
+                swarm.add_direct_peer("127.0.0.1", port)
+                try:
+                    res = pull_model(leech, "acme/stall-model",
+                                     swarm=swarm,
+                                     log=lambda *a, **k: None)
+                finally:
+                    swarm.close()
+                assert faults.counters().get("seeder_stall", 0) >= 1
+            finally:
+                faults.install(None)
+                server.shutdown()
+            # The pull completed (the stall elapsed / CDN healed it)...
+            for name, want in files.items():
+                assert (res.snapshot_dir / name).read_bytes() == want
+            # ...and the detector fired the stall DURING it, attributed
+            # to the pull's session (flight event + metric + session
+            # annotation — the acceptance triple).
+            anomalies = timeline.STORE.payload()["anomalies"]
+            stalls = [e for e in anomalies
+                      if e["kind"] == timeline.ANOMALY_STALL]
+            assert stalls, f"no stall anomaly; got {anomalies}"
+            (recent,) = session_mod.payload()["recent"][:1]
+            assert stalls[0]["session"] == recent["id"]
+            assert stalls[0].get("stalled_s", 0) <= 2 * window_s + 0.5
+            m = [m for m in telemetry.REGISTRY.metrics()
+                 if m.name == "zest_anomalies_total"][0]
+            assert m.value(kind=timeline.ANOMALY_STALL) >= 1
+            recs = [e for e in telemetry.recorder.tail()
+                    if e.get("kind") == "anomaly"
+                    and e.get("anomaly") == timeline.ANOMALY_STALL]
+            assert recs and recs[0]["session"] == recent["id"]
+            sess = session_mod.get(recent["id"])
+            assert timeline.ANOMALY_STALL \
+                in sess.snapshot().get("anomalies", {})
+
+
+# ── HTTP surface + pod merge ──
+
+
+@pytest.fixture
+def api(tmp_config, monkeypatch):
+    from zest_tpu.api.http_api import HttpApi
+
+    requests = pytest.importorskip("requests")
+    # Slow the live sampler to one tick per 50 s: the endpoint tests
+    # drive the store with injected clocks, which a concurrent
+    # wall-clock tick would interleave with.
+    monkeypatch.setenv(timeline.ENV_HZ, "0.02")
+    timeline.reset()
+    tmp_config.http_port = 0
+    a = HttpApi(tmp_config)
+    port = a.start()
+    yield a, requests, f"http://127.0.0.1:{port}"
+    a.close()
+
+
+class TestHttp:
+    def test_v1_timeline_cursor_paging(self, api):
+        _a, requests, base = api
+        c = telemetry.counter("zest_fetch_bytes_total", "", ("source",))
+        c.inc(1000, source="cdn")
+        timeline.STORE.tick(now=1.0, wall=1.0)
+        c.inc(2000, source="cdn")
+        timeline.STORE.tick(now=2.0, wall=2.0)
+        doc = requests.get(f"{base}/v1/timeline", timeout=5).json()
+        assert doc["enabled"] is True
+        assert doc["series"]["fetch.cdn_bps"]["kind"] == "rate"
+        assert len(doc["series"]["fetch.cdn_bps"]["samples"]) == 2
+        cursor = doc["cursor"]
+        page = requests.get(f"{base}/v1/timeline?since={cursor}",
+                            timeout=5).json()
+        assert page["series"] == {}
+        c.inc(500, source="cdn")
+        timeline.STORE.tick(now=3.0, wall=3.0)
+        page = requests.get(f"{base}/v1/timeline?since={cursor}",
+                            timeout=5).json()
+        assert list(page["series"]) == ["fetch.cdn_bps"]
+        assert len(page["series"]["fetch.cdn_bps"]["samples"]) == 1
+        # Series prefix filter.
+        filt = requests.get(f"{base}/v1/timeline?series=ring.",
+                            timeout=5).json()
+        assert filt["series"] == {}
+        # /v1/status carries the store block when on.
+        st = requests.get(f"{base}/v1/status", timeout=5).json()
+        assert st["timeline"]["enabled"] is True
+        assert st["timeline"]["cursor"] >= 3
+
+    def test_merge_timelines_normalizes_clocks(self):
+        doc0 = {
+            "series": {"fetch.cdn_bps": {
+                "kind": "rate", "samples": [[100.0, 5.0]]}},
+            "anomalies": [{"t": 100.5, "kind": "stall"}],
+            "clock_offsets": {"1": {"offset_s": 2.0, "rtt_s": 0.01}},
+        }
+        doc1 = {
+            "series": {"fetch.cdn_bps": {
+                "kind": "rate", "samples": [[102.0, 7.0]]}},
+            "anomalies": [],
+        }
+        merged = timeline.merge_timelines({"0": doc0, "1": doc1})
+        assert merged["reference"] == "0"
+        assert merged["series"]["h0.fetch.cdn_bps"]["samples"] \
+            == [[100.0, 5.0]]
+        # Host 1's clock runs 2 s ahead → samples shift back by 2.
+        assert merged["series"]["h1.fetch.cdn_bps"]["samples"] \
+            == [[100.0, 7.0]]
+        assert merged["clock_normalization"]["1"]["applied_offset_s"] \
+            == 2.0
+        assert merged["anomalies"][0]["host"] == "0"
+
+    def test_merge_without_offsets_is_honest_null(self):
+        merged = timeline.merge_timelines({
+            "0": {"series": {}, "anomalies": []},
+            "1": {"series": {"x": {"kind": "gauge",
+                                   "samples": [[5.0, 1.0]]}},
+                  "anomalies": []},
+        })
+        assert merged["clock_normalization"]["1"]["applied_offset_s"] \
+            is None
+        assert merged["series"]["h1.x"]["samples"] == [[5.0, 1.0]]
+
+
+# ── zest top ──
+
+
+class TestTop:
+    def _payloads(self):
+        status = {"version": "1.0"}
+        pulls = {
+            "active": [{"id": "p0001-ab", "repo": "a/b",
+                        "phase": "fetch", "status": "running",
+                        "progress": 0.5, "eta_s": 12.0,
+                        "anomalies": {"stall": {"t": 1.0}}}],
+            "recent": [],
+            "tenancy": {"active": 1, "queued": 2, "max_pulls": 4,
+                        "queue_cap": 16},
+        }
+        tl = {
+            "enabled": True,
+            "series": {
+                "session.p0001-ab.bytes": {
+                    "kind": "gauge",
+                    "samples": [[1.0, 0.0], [2.0, 4_000_000.0]]},
+                "fetch.cdn_bps": {"kind": "rate",
+                                  "samples": [[2.0, 2_500_000.0]]},
+                "fetch.peer_bps": {"kind": "rate",
+                                   "samples": [[2.0, 1_500_000.0]]},
+                "ring.in_use_bytes": {"kind": "gauge",
+                                      "samples": [[2.0, 1024.0]]},
+                "ring.stalls": {"kind": "gauge",
+                                "samples": [[2.0, 3.0]]},
+                "tenancy.queue_depth": {"kind": "gauge",
+                                        "samples": [[2.0, 2.0]]},
+                "tenancy.active_pulls": {"kind": "gauge",
+                                         "samples": [[2.0, 1.0]]},
+                "tenancy.inflight_fetches": {"kind": "gauge",
+                                             "samples": [[2.0, 5.0]]},
+            },
+            "anomalies": [{"t": 1.5, "kind": "stall",
+                           "session": "p0001-ab"}],
+        }
+        return status, pulls, tl
+
+    def test_top_lines_render_frame(self):
+        from zest_tpu.cli import _top_lines
+
+        lines = _top_lines(*self._payloads())
+        frame = "\n".join(lines)
+        assert "active 1" in lines[0] and "queued 2" in lines[0]
+        assert "p0001-ab" in frame and "a/b" in frame
+        assert "[############------------]" in frame  # 50% of 24
+        assert "50%" in frame and "eta 12.0s" in frame
+        assert "4.0 MB/s" in frame      # live session byte rate
+        assert "cdn=2.5 MB/s" in frame and "peer=1.5 MB/s" in frame
+        assert "stalls=3" in frame
+        assert "queue: depth=2  active=1  inflight_fetches=5" in frame
+        assert "anomaly: stall  session=p0001-ab" in frame
+        assert "!stall" in frame        # inline session annotation
+
+    def test_top_lines_idle_and_disabled(self):
+        from zest_tpu.cli import _top_lines
+
+        lines = _top_lines({"version": "1.0"}, {}, {"enabled": False})
+        frame = "\n".join(lines)
+        assert "(no active pulls)" in frame
+        assert "ZEST_TIMELINE=0" in frame
+
+    def test_cmd_top(self, api, monkeypatch, capsys):
+        from zest_tpu import cli
+
+        _a, _requests, base = api
+        monkeypatch.setenv("ZEST_HTTP_PORT", base.rsplit(":", 1)[1])
+        sess = session_mod.begin("a/b", tenant="t")
+        assert cli.main(["top", "--count", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "zest top" in out and sess.id in out
+        assert cli.main(["top", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["timeline"]["enabled"] is True
+        session_mod.finish(sess, "ok")
+
+
+# ── Tenancy metric satellites ──
+
+
+class TestTenancySatellites:
+    def test_singleflight_outcomes(self):
+        flights = tenancy.Singleflight()
+        mode, flight = flights.join("k")
+        assert mode == "lead"
+        results = []
+
+        def wait():
+            results.append(flights.wait(flight))
+
+        threads = [threading.Thread(target=wait) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        flights.resolve(flight)
+        for t in threads:
+            t.join(timeout=5)
+        assert results == ["done", "done"]
+        assert flights.summary()["outcomes"] \
+            == {"leader": 1, "waiter": 2, "handoff": 0}
+        m = [m for m in telemetry.REGISTRY.metrics()
+             if m.name == "zest_singleflight_total"][0]
+        assert m.value(outcome="leader") == 1
+        assert m.value(outcome="waiter") == 2
+
+    def test_singleflight_handoff_outcome(self):
+        flights = tenancy.Singleflight()
+        _mode, flight = flights.join("k")
+        results = []
+
+        def wait():
+            results.append(flights.wait(flight))
+
+        t = threading.Thread(target=wait)
+        t.start()
+        time.sleep(0.05)
+        flights.abdicate(flight)  # cancelled leader hands off
+        t.join(timeout=5)
+        assert results == ["lead"]
+        assert flights.summary()["outcomes"]["handoff"] == 1
+
+    def test_admission_wait_histogram_observed(self):
+        ctrl = tenancy.AdmissionController(max_pulls=1, max_queue=4)
+        ctrl.acquire("a")          # instant
+        done = threading.Event()
+
+        def queued():
+            ctrl.acquire("b")      # parks until the release below
+            done.set()
+
+        t = threading.Thread(target=queued)
+        t.start()
+        time.sleep(0.15)
+        ctrl.release()
+        assert done.wait(5)
+        h = [m for m in telemetry.REGISTRY.metrics()
+             if m.name == "zest_admission_wait_seconds"][0]
+        ((_labels, count),) = h.samples()
+        assert count == 2          # fast path + queued path
+        (_key, row) = h.rows()[0]
+        assert row[-1] >= 0.1      # the queued session's wait is in sum
+
+    def test_pinned_skip_flight_event(self, tmp_path):
+        pins = tenancy.PinBook()
+        cache = tmp_path / "xorbs"
+        sub = cache / "aa"
+        sub.mkdir(parents=True)
+        pinned_hash = "aa" + "1" * 62
+        loose_hash = "aa" + "2" * 62
+        (sub / pinned_hash).write_bytes(b"x" * 1000)
+        (sub / loose_hash).write_bytes(b"y" * 1000)
+        pins.pin("tree:a", [pinned_hash])
+        ev = tenancy.CacheEvictor(cache, high_bytes=500, low_bytes=100,
+                                  pins=pins)
+        freed = ev.maybe_evict(force=True)
+        assert freed == 1000
+        assert ev.pinned_survivals == 1
+        events = {e["kind"] for e in telemetry.recorder.tail()}
+        assert "cache_evict" in events
+        skip = [e for e in telemetry.recorder.tail()
+                if e["kind"] == "cache_evict_pinned_skip"]
+        assert skip and skip[0]["entries"] == 1
+        assert skip[0]["bytes"] == 1000
+
+    def test_status_tenancy_block_gains_outcomes(self, api, tmp_config):
+        _a, requests, base = api
+        tn = requests.get(f"{base}/v1/status", timeout=5) \
+            .json().get("tenancy")
+        if tn is None:
+            pytest.skip("tenancy off in this config")
+        assert tn["dedupe"]["outcomes"] \
+            == {"leader": 0, "waiter": 0, "handoff": 0}
+
+
+# ── Critical-path prefix-table extension (hand-built DAG) ──
+
+
+class TestCritpathExtension:
+    def _iv(self, name, t0, t1, **attrs):
+        return critpath._Iv(name, t0, t1, attrs)
+
+    def test_queued_and_collective_attribution(self):
+        """Hand-built DAG: 3 s parked in admission is a "queued" stage
+        (not `other`, not idle), collective phase spans are fetch work
+        split per link class, and barriers stay their own category."""
+        spans = [
+            self._iv("pull", 0.0, 10.0),
+            self._iv("tenancy.queued", 0.0, 3.0, tenant="t"),
+            self._iv("stage.fetch", 3.0, 4.0),
+            self._iv("coop.collective.phase0", 4.0, 6.0, link="ici"),
+            self._iv("coop.collective.phase1", 6.0, 9.0, link="dcn"),
+            self._iv("coop.collective.barrier", 8.0, 9.0, phase=1),
+            self._iv("hbm.commit", 9.0, 10.0),
+        ]
+        rep = critpath._analyze(spans)
+        assert rep["stages"]["queued"] == pytest.approx(3.0)
+        # Phases blame as fetch (minus the nested barrier's second).
+        assert rep["stages"]["fetch"] == pytest.approx(1.0 + 2.0 + 2.0)
+        assert rep["stages"]["barrier"] == pytest.approx(1.0)
+        assert "exchange" not in rep["stages"]
+        assert "other" not in rep["stages"]
+        # Per-link tier split: the collective's wire seconds land under
+        # ici/dcn next to the waterfall tiers.
+        assert rep["tiers"]["ici"] == pytest.approx(2.0)
+        assert rep["tiers"]["dcn"] == pytest.approx(2.0)
+        assert rep["path_s"] == pytest.approx(10.0)
+
+    def test_categorize_rules(self):
+        assert critpath.categorize("tenancy.queued") == "queued"
+        assert critpath.categorize("coop.collective.phase2") == "fetch"
+        assert critpath.categorize("coop.collective.barrier") \
+            == "barrier"
+        assert critpath.categorize("coop.exchange") == "exchange"
+        assert critpath._tier_of("coop.collective.phase2",
+                                 {"link": "ici"}) == "ici"
+        assert critpath._tier_of("coop.collective.phase2", {}) == "dcn"
+
+    def test_real_queued_pull_blames_queued_stage(self, tmp_path):
+        """A traced pull that parks in the admission queue carries a
+        `queued` stage in stats["critical_path"]."""
+        from zest_tpu.telemetry import trace as trace_mod
+
+        repo = FixtureRepo("acme/q-model", FILES, chunks_per_xorb=3)
+        with FixtureHub(repo) as hub:
+            cfg = _cfg(hub, tmp_path, tenant_max_pulls=1)
+            tracer = trace_mod.install(None)
+            st = tenancy.state(cfg)
+            st.controller.acquire("hog")   # hold the only slot
+            release = threading.Timer(
+                0.4, lambda: st.controller.release())
+            release.start()
+            try:
+                res = pull_model(cfg, "acme/q-model", no_p2p=True,
+                                 log=lambda *a, **k: None)
+            finally:
+                release.cancel()
+            assert len(tracer) > 0
+            cp = res.stats.get("critical_path")
+            assert cp is not None
+            assert cp["stages"].get("queued", 0) >= 0.3

@@ -131,6 +131,10 @@ class HttpApi:
         if not _WARMED.is_set():
             threading.Thread(target=self._warmup, daemon=True,
                              name="zest-serve-warmup").start()
+        # Live timelines (ISSUE 15): a serving daemon samples for its
+        # whole life, so `/v1/timeline` / `zest top` have history the
+        # moment the first pull starts (no-op when ZEST_TIMELINE=0).
+        telemetry.timeline.ensure_started()
         return self._httpd.server_address[1]
 
     @staticmethod
@@ -292,7 +296,57 @@ class HttpApi:
         tn = tenancy.summary(self.cfg)
         if tn is not None:
             payload["tenancy"] = tn
+        # Timeline store state (ISSUE 15): series/cursor/anomaly counts.
+        # Absent when knob-off, same schema rule as tenancy.
+        tl = telemetry.timeline.status_block()
+        if tl.get("enabled"):
+            payload["timeline"] = tl
         return payload
+
+    # ── Live timelines (ISSUE 15) ──
+
+    def timeline_payload(self, since: int = 0,
+                         prefix: str | None = None) -> dict:
+        """``GET /v1/timeline?since=<cursor>``: every series' samples
+        past the cursor plus the recent anomaly ring (see
+        telemetry.timeline.payload)."""
+        return telemetry.timeline.payload(since=since, prefix=prefix)
+
+    def pod_timeline_payload(self) -> dict:
+        """``GET /v1/timeline?scope=pod``: this host's timeline plus a
+        concurrent scrape of every configured pod peer's, merged onto
+        this host's clock via the hello offsets the last coop round
+        recorded (PR 7). A peer that fails the scrape is reported under
+        ``scrape_errors`` instead of failing the surface — same rule as
+        ``?scope=pod`` metrics."""
+        local_label = str(
+            self.cfg.coop_index if self.cfg.coop_index is not None
+            else self.cfg.mesh.process_id)
+        docs = {local_label: telemetry.timeline.payload()}
+        errors: dict = {}
+        peers = {str(k): v for k, v in self.pod_peers.items()
+                 if str(k) != local_label}
+        if peers:
+            def scrape(item):
+                label, (host, port) = item
+                url = f"http://{host}:{port}/v1/timeline"
+                try:
+                    with urllib.request.urlopen(url, timeout=2.0) as r:
+                        return label, json.loads(r.read().decode()), None
+                except Exception as exc:  # noqa: BLE001 - per-host report
+                    return label, None, str(exc)
+
+            with ThreadPoolExecutor(max_workers=min(8, len(peers))) as ex:
+                for label, doc, err in ex.map(scrape, peers.items()):
+                    if doc is not None:
+                        docs[label] = doc
+                    else:
+                        errors[label] = err
+        merged = telemetry.timeline.merge_timelines(
+            docs, reference=local_label)
+        if errors:
+            merged["scrape_errors"] = errors
+        return merged
 
     # ── Pull sessions (ISSUE 11) ──
 
@@ -907,6 +961,17 @@ class _Handler(BaseHTTPRequestHandler):
                 text = telemetry.render_prometheus()
             self._text(text.encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/v1/timeline":
+            try:
+                since = int(query.get("since", ["0"])[0])
+            except ValueError:
+                since = 0
+            if query.get("scope", [""])[0] == "pod":
+                self._json(self.api.pod_timeline_payload())
+            else:
+                prefix = query.get("series", [None])[0]
+                self._json(self.api.timeline_payload(since=since,
+                                                     prefix=prefix))
         elif path == "/v1/trace":
             self._json(self.api.trace_payload())
         elif path == "/v1/debug":
@@ -1046,6 +1111,9 @@ DASHBOARD_HTML = """<!doctype html>
 <table id="pulls"><thead><tr><th>id</th><th>repo</th><th>tenant</th>
 <th>phase</th><th>progress</th><th>elapsed</th></tr></thead>
 <tbody></tbody></table></div>
+<div class="card"><h2 style="font-size:1.05rem">Timelines</h2>
+<table id="spark"><tbody></tbody></table>
+<div id="anomalies" style="font-size:.85rem;color:#f28b82"></div></div>
 <div class="card"><h2 style="font-size:1.05rem">Cooperative pull</h2>
 <table id="coop"></table>
 <h3 style="font-size:.95rem;margin-bottom:.2rem">Flight recorder</h3>
@@ -1054,6 +1122,7 @@ DASHBOARD_HTML = """<!doctype html>
 <table id="models"><thead><tr><th>repo</th><th>revision</th><th>files</th>
 </tr></thead><tbody></tbody></table></div>
 <script>
+let tlCursor=0,tlSeries={};
 async function tick(){
  try{
   const s=await (await fetch('/v1/status')).json();
@@ -1129,6 +1198,59 @@ async function tick(){
   document.getElementById('coop').innerHTML=crows.map(([k,v])=>
    `<tr><td class="k">${k}</td><td><code>${v}</code></td></tr>`).join('')
    ||'<tr><td>no cooperative round yet</td></tr>';
+  // Timeline sparklines (ISSUE 15): one inline-SVG polyline per live
+  // series — rates (B/s) and structural gauges evolving over the ring
+  // window — plus the recent anomaly list. Polled INCREMENTALLY: the
+  // cursor from the last poll pages only new samples (a busy store is
+  // 256 series x 512 samples — re-serializing all of it every 2 s per
+  // open tab is exactly what ?since= exists to avoid); samples
+  // accumulate into a client-side ring capped at 150 per series.
+  // Series names come from the store (no free-form client input), but
+  // esc() anyway.
+  const page=await (await fetch('/v1/timeline?since='+tlCursor)).json();
+  if(page.enabled!==false){
+   if(page.cursor<tlCursor) tlSeries={};  // daemon/store restarted
+   tlCursor=page.cursor||0;
+   for(const [n,s] of Object.entries(page.series||{})){
+    const row=tlSeries[n]||(tlSeries[n]={kind:s.kind,samples:[]});
+    row.samples.push(...s.samples);
+    if(row.samples.length>150) row.samples.splice(0,row.samples.length-150);
+   }
+   // Prune series that stopped producing (finished sessions' byte
+   // series & co) so a long-lived tab stays bounded too.
+   const names=Object.keys(tlSeries);
+   if(names.length>30){
+    names.sort((a,b)=>(tlSeries[a].samples.at(-1)?.[0]||0)
+                     -(tlSeries[b].samples.at(-1)?.[0]||0));
+    for(const n of names.slice(0,names.length-30)) delete tlSeries[n];
+   }
+  }
+  const T={enabled:page.enabled,series:tlSeries,
+           anomalies:page.anomalies||[]};
+  const spark=(pts)=>{
+   if(pts.length<2) return '<code>·</code>';
+   const vs=pts.map(p=>p[1]),ts=pts.map(p=>p[0]);
+   const [v0,v1]=[Math.min(...vs),Math.max(...vs)];
+   const [t0,t1]=[Math.min(...ts),Math.max(...ts)];
+   const W=140,H=22,sx=t1>t0?W/(t1-t0):0,sy=v1>v0?(H-2)/(v1-v0):0;
+   const pl=pts.map(p=>((p[0]-t0)*sx).toFixed(1)+','
+     +(H-1-(p[1]-v0)*sy).toFixed(1)).join(' ');
+   return `<svg width="${W}" height="${H}"><polyline points="${pl}"
+     fill="none" stroke="#8ab4f8" stroke-width="1.2"/></svg>`;
+  };
+  const fmt=v=>v>=1e9?(v/1e9).toFixed(2)+'G':v>=1e6?(v/1e6).toFixed(1)+'M'
+    :v>=1e3?(v/1e3).toFixed(1)+'k':String(Math.round(v*100)/100);
+  const srows=Object.entries(T.series||{}).slice(0,14).map(([n,s])=>{
+   const pts=s.samples||[],last=pts.length?pts[pts.length-1][1]:0;
+   return `<tr><td class="k">${esc(n)}</td><td>${spark(pts)}</td>
+     <td><code>${fmt(last)}${s.kind==='rate'?'/s':''}</code></td></tr>`;
+  }).join('');
+  document.querySelector('#spark tbody').innerHTML=srows
+   ||`<tr><td>${T.enabled===false?'timelines off (ZEST_TIMELINE=0)'
+       :'no samples yet'}</td></tr>`;
+  document.getElementById('anomalies').textContent=
+   (T.anomalies||[]).slice(-4).map(a=>a.kind
+     +(a.session?' ['+a.session+']':'')).join('  ');
   const evs=(d.recorder||{}).events||[];
   document.querySelector('#recorder tbody').innerHTML=evs.map(e=>{
    const t=new Date(e.t*1000).toISOString().slice(11,23);

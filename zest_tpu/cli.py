@@ -742,6 +742,150 @@ def _ps_lines(payload: dict) -> list[str]:
     return lines
 
 
+def _fmt_rate(bps: float) -> str:
+    """Human B/s: 1.25 GB/s, 310 MB/s, 12 kB/s, 0 B/s."""
+    if bps >= 1e9:
+        return f"{bps / 1e9:.2f} GB/s"
+    if bps >= 1e6:
+        return f"{bps / 1e6:.1f} MB/s"
+    if bps >= 1e3:
+        return f"{bps / 1e3:.0f} kB/s"
+    return f"{bps:.0f} B/s"
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    frac = min(1.0, max(0.0, frac))
+    filled = int(round(frac * width))
+    return "[" + "#" * filled + "-" * (width - filled) + "]"
+
+
+def _series_last(tl: dict, name: str):
+    s = (tl.get("series") or {}).get(name)
+    if not s or not s.get("samples"):
+        return None
+    return s["samples"][-1][1]
+
+
+def _series_rate(tl: dict, name: str):
+    """Latest value-per-second of a gauge series (e.g. a session's
+    byte-progress series) from its last two samples."""
+    s = (tl.get("series") or {}).get(name)
+    pts = (s or {}).get("samples") or []
+    if len(pts) < 2:
+        return None
+    (t0, v0), (t1, v1) = pts[-2], pts[-1]
+    if t1 <= t0:
+        return None
+    return max(0.0, (v1 - v0) / (t1 - t0))
+
+
+def _top_lines(status: dict, pulls: dict, tl: dict,
+               width: int = 78) -> list[str]:
+    """One ``zest top`` frame (pure — testable): header, per-session
+    progress bars with live byte rates, tier-rate and queue/ring lines
+    from the timeline's latest samples, and the anomaly tail."""
+    active = pulls.get("active") or []
+    recent = pulls.get("recent") or []
+    tn = pulls.get("tenancy") or {}
+    anomalies = tl.get("anomalies") or []
+    lines = [
+        f"zest top — v{status.get('version', '?')}  "
+        f"active {len(active)}"
+        + (f"  queued {tn.get('queued', 0)}" if tn else "")
+        + f"  recent {len(recent)}"
+        + (f"  anomalies {len(anomalies)}" if anomalies else "")
+    ]
+    for s in active:
+        sid = s.get("id", "?")
+        frac = s.get("progress")
+        rate = _series_rate(tl, f"session.{sid}.bytes")
+        anns = ",".join(sorted((s.get("anomalies") or {})))
+        row = (f"  {sid}  {s.get('repo', '?')}  "
+               f"{s.get('phase', ''):<10} ")
+        row += _bar(frac if frac is not None else 0.0)
+        if frac is not None:
+            row += f" {frac:>4.0%}"
+        if rate is not None:
+            row += f"  {_fmt_rate(rate)}"
+        if s.get("eta_s") is not None:
+            row += f"  eta {s['eta_s']}s"
+        if anns:
+            row += f"  !{anns}"
+        lines.append(row[:width + 30])
+    if not active:
+        lines.append("  (no active pulls)")
+    # Tier rates: the latest per-tier fetch B/s samples, then the other
+    # wire lanes when they have history.
+    tiers = []
+    for tier in ("cdn", "peer", "cache", "dcn"):
+        v = _series_last(tl, f"fetch.{tier}_bps")
+        if v is not None:
+            tiers.append(f"{tier}={_fmt_rate(v)}")
+    for name, label in (("dcn.bps", "dcn_serve"), ("seed.bps", "seed"),
+                        ("collective.ici_bps", "coll_ici"),
+                        ("collective.dcn_bps", "coll_dcn")):
+        v = _series_last(tl, name)
+        if v:
+            tiers.append(f"{label}={_fmt_rate(v)}")
+    if tiers:
+        lines.append("rates: " + "  ".join(tiers))
+    ring = _series_last(tl, "ring.in_use_bytes")
+    if ring is not None:
+        stalls = _series_last(tl, "ring.stalls")
+        lines.append(f"ring:  {int(ring):,} B in flight"
+                     + (f"  stalls={int(stalls)}" if stalls else ""))
+    depth = _series_last(tl, "tenancy.queue_depth")
+    if depth is not None:
+        adm = int(_series_last(tl, "tenancy.active_pulls") or 0)
+        flights = int(_series_last(tl, "tenancy.inflight_fetches") or 0)
+        lines.append(f"queue: depth={int(depth)}  active={adm}"
+                     f"  inflight_fetches={flights}")
+    for ev in anomalies[-4:]:
+        row = f"anomaly: {ev.get('kind')}"
+        if ev.get("session"):
+            row += f"  session={ev['session']}"
+        for k in ("phase", "partner", "depth", "rate_bps"):
+            if k in ev:
+                row += f"  {k}={ev[k]}"
+        lines.append(row)
+    if tl.get("enabled") is False:
+        lines.append("timelines off (ZEST_TIMELINE=0) — rates and "
+                     "anomalies unavailable")
+    return lines
+
+
+def cmd_top(args) -> int:
+    """``zest top`` — the operator's live full-screen view over
+    ``/v1/pulls`` + ``/v1/timeline``: per-session progress bars with
+    live rates, tier throughput, queue/ring state, anomaly tail.
+    Redraws until Ctrl-C (or ``--count`` frames, for tests)."""
+    cfg = Config.load()
+    frames = 0
+    try:
+        while True:
+            status = _daemon_get(cfg, "/v1/status")
+            if status is None:
+                print("daemon not running", file=sys.stderr)
+                return 1
+            pulls = _daemon_get(cfg, "/v1/pulls") or {}
+            tl = _daemon_get(cfg, "/v1/timeline") or {}
+            if args.json:
+                print(json.dumps({"status": status, "pulls": pulls,
+                                  "timeline": tl}, indent=2))
+            else:
+                if sys.stdout.isatty():
+                    sys.stdout.write("\x1b[H\x1b[2J")
+                print("\n".join(_top_lines(status, pulls, tl)))
+            frames += 1
+            if args.count and frames >= args.count:
+                return 0
+            if args.json:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_ps(args) -> int:
     """``zest ps [--watch]`` — the daemon's live pull sessions
     (``GET /v1/pulls``): id, repo@rev, tenant, phase, progress/ETA,
@@ -1167,6 +1311,17 @@ def build_parser() -> argparse.ArgumentParser:
     ps_p.add_argument("--count", type=int, default=0,
                       help="with --watch: stop after N frames")
     ps_p.set_defaults(fn=cmd_ps)
+
+    top_p = sub.add_parser(
+        "top", help="live full-screen view: session progress bars, "
+                    "tier rates, queue, anomalies (/v1/timeline)")
+    top_p.add_argument("--json", action="store_true",
+                       help="one raw status+pulls+timeline document")
+    top_p.add_argument("--interval", type=float, default=1.0,
+                       help="redraw interval seconds (default 1.0)")
+    top_p.add_argument("--count", type=int, default=0,
+                       help="stop after N frames (0 = until Ctrl-C)")
+    top_p.set_defaults(fn=cmd_top)
 
     analyze_p = sub.add_parser(
         "analyze", help="critical-path attribution over a trace export")

@@ -17,6 +17,10 @@ import re
 from pathlib import Path
 
 from zest_tpu.telemetry.state import _OFF_VALUES as _TELEMETRY_OFF_VALUES
+from zest_tpu.telemetry.timeline import (
+    DEFAULT_HZ as DEFAULT_TIMELINE_HZ,
+    DEFAULT_WINDOW_S as DEFAULT_ANOMALY_WINDOW_S,
+)
 
 # ── Compiled defaults (reference: src/config.zig:6-19) ──
 DEFAULT_LISTEN_PORT = 6881          # BT/seed listener + DHT UDP port
@@ -381,6 +385,17 @@ class Config:
     tenant: str | None = None
     slo_tthbm_s: float | None = None
     slo_ttfl_s: float | None = None
+    # Live timelines (telemetry.timeline; ISSUE 15): like ZEST_TELEMETRY
+    # these are read by the sampler directly on its own paths — the
+    # fields here are the introspection mirror for /v1/status. The
+    # sampler records registry-counter rates + structural gauges at
+    # ZEST_TIMELINE_HZ; ZEST_TIMELINE=0 is hard-off (no sampler thread,
+    # empty store, byte-identical pull); ZEST_ANOMALY_WINDOW_S is how
+    # long a condition (zero progress, collapsed rate, stuck queue,
+    # barrier wait) must hold before the streaming detector fires.
+    timeline_enabled: bool = True
+    timeline_hz: float = DEFAULT_TIMELINE_HZ
+    anomaly_window_s: float = DEFAULT_ANOMALY_WINDOW_S
 
     # ── Construction ──
 
@@ -540,6 +555,19 @@ class Config:
             tenant=env.get("ZEST_TENANT") or None,
             slo_tthbm_s=_opt_pos_float(env, "ZEST_SLO_TTHBM_S"),
             slo_ttfl_s=_opt_pos_float(env, "ZEST_SLO_TTFL_S"),
+            # Same off-value convention as ZEST_TELEMETRY (the sampler
+            # resolves the env itself; this mirrors it). The hz/window
+            # knobs parse strictly HERE — a daemon started with a
+            # mistyped sampling rate must fail loud, not silently
+            # sample at the default.
+            timeline_enabled=env.get("ZEST_TIMELINE", "").strip().lower()
+            not in _TELEMETRY_OFF_VALUES,
+            timeline_hz=_strict_pos_float(
+                env, "ZEST_TIMELINE_HZ", DEFAULT_TIMELINE_HZ,
+                floor=0.01),
+            anomaly_window_s=_strict_pos_float(
+                env, "ZEST_ANOMALY_WINDOW_S", DEFAULT_ANOMALY_WINDOW_S,
+                floor=0.05),
         )
 
     # ── Path builders (reference: src/config.zig:95-133) ──

@@ -563,6 +563,29 @@ class HostRing:
         self.reuses = 0
         self.detached = 0
         self._waiting = False
+        # Live ring occupancy/stall gauges for the timeline sampler
+        # (ISSUE 15): one landing ring is live at a time in practice —
+        # register_probe's replace semantics make the newest ring the
+        # one sampled; close() unregisters so a finished landing stops
+        # reporting. Flag-gated no-ops when timelines are off. The
+        # bound methods are captured ONCE: attribute access mints a
+        # fresh bound-method object each time, so close()'s
+        # identity-checked unregister needs the same objects that were
+        # registered.
+        self._probe_in_use = self._probe_in_use_bytes
+        self._probe_stall_count = self._probe_stalls
+        telemetry.timeline.register_probe(
+            "ring.in_use_bytes", self._probe_in_use)
+        telemetry.timeline.register_probe(
+            "ring.stalls", self._probe_stall_count)
+
+    def _probe_in_use_bytes(self) -> int:
+        with self._cv:
+            return self._in_use_bytes
+
+    def _probe_stalls(self) -> int:
+        with self._cv:
+            return self.stalls
 
     def _trim_free_locked(self, incoming: int) -> None:
         while self._free and (self._in_use_bytes + self._free_bytes
@@ -666,6 +689,10 @@ class HostRing:
         """Wake any waiter with :class:`RingClosed` — the consumer's
         error path, so a failing commit can never leave the decode
         thread parked in ``acquire`` forever."""
+        telemetry.timeline.unregister_probe(
+            "ring.in_use_bytes", self._probe_in_use)
+        telemetry.timeline.unregister_probe(
+            "ring.stalls", self._probe_stall_count)
         with self._cv:
             self._closed = True
             self._free.clear()

@@ -62,6 +62,7 @@ from zest_tpu.telemetry import recorder as recorder  # noqa: PLC0414
 from zest_tpu.telemetry.recorder import record  # noqa: F401
 from zest_tpu.telemetry import session as session  # noqa: PLC0414
 from zest_tpu.telemetry import critpath as critpath  # noqa: PLC0414
+from zest_tpu.telemetry import timeline as timeline  # noqa: PLC0414
 
 __all__ = [
     "REGISTRY",
@@ -87,6 +88,7 @@ __all__ = [
     "span",
     "status_snapshot",
     "sum_allowlisted",
+    "timeline",
     "trace",
 ]
 
@@ -115,3 +117,4 @@ def reset_all() -> None:
     REGISTRY.reset()
     recorder.reset()
     session.reset()
+    timeline.reset()

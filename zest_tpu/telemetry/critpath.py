@@ -43,6 +43,12 @@ _SKIP_NAMES = frozenset({
 # in the name beats the prefix table (pod/coop verification spans are
 # nested under fetch-ish parents).
 _CATEGORY_PREFIXES = (
+    # Tenancy admission wait (ISSUE 15): time parked in the fair queue
+    # is its own stage — "queued" — not fetch work and not untraced
+    # idle. A pull that spent 40 s queued and 5 s fetching must blame
+    # the queue, or the analyzer would tell the operator to tune the
+    # CDN.
+    ("tenancy.queued", "queued"),
     ("stage.resolve", "metadata"),
     ("stage.cas_metadata", "metadata"),
     ("cas.reconstruction", "metadata"),
@@ -52,13 +58,15 @@ _CATEGORY_PREFIXES = (
     ("swarm.", "fetch"),
     ("peer.", "fetch"),
     ("dcn.", "fetch"),
-    # Collective exchange (ISSUE 14): phase spans blame as "exchange"
-    # (redistribution work — their dcn.request_many children keep
-    # blaming wire waits as fetch/dcn), and barrier spans blame as
-    # "barrier" (a lagging partner's idle, which is neither fetch nor
-    # exchange work — the skew signal the straggler gauges quote).
+    # Collective exchange (ISSUE 14/15): phase spans are byte movement
+    # — they blame as "fetch" with the wire link class as the tier
+    # (``link`` attr: dcn cross-slice, ici within a slice), so the
+    # per-tier fetch split stays one comparable ledger whether the
+    # bytes came over the waterfall or the collective. Barrier spans
+    # blame as "barrier" (a lagging partner's idle, which is neither
+    # fetch nor exchange work — the straggler signal).
     ("coop.collective.barrier", "barrier"),
-    ("coop.collective.", "exchange"),
+    ("coop.collective.", "fetch"),
     ("coop.exchange", "exchange"),
     ("coop.", "fetch"),
     ("federated.", "fetch"),
@@ -93,6 +101,10 @@ def _tier_of(name: str, attrs: dict) -> str | None:
     t = attrs.get("tier") or attrs.get("source")
     if t:
         return str(t)
+    if name.startswith("coop.collective."):
+        # Phase spans carry the link class (ici intra-slice, dcn
+        # cross-slice); an attr-less one is wire movement all the same.
+        return str(attrs.get("link") or "dcn")
     if name.startswith("cdn."):
         return "cdn"
     if name.startswith(("swarm.", "peer.")):

@@ -88,6 +88,7 @@ class PullSession:
         self.total_bytes: int | None = None  # pending payload, when known
         self.stats: dict | None = None       # terminal stats dict ref
         self.slo: dict = {}                  # slo -> breach info
+        self.anomalies: dict = {}            # anomaly kind -> info
         self.ended_at: float | None = None
         self._ended_t: float | None = None
         self._clock = None
@@ -144,6 +145,19 @@ class PullSession:
     def note_slo(self, slo: str, info: dict) -> None:
         with self._cv:
             self.slo[slo] = dict(info)
+            self.version += 1
+            self._cv.notify_all()
+
+    def note_anomaly(self, kind: str, info: dict | None = None) -> None:
+        """Streaming-anomaly annotation (ISSUE 15): the timeline
+        detector stamps the live session so ``/v1/pulls`` and ``zest
+        top`` show the anomaly next to the pull it belongs to. Keyed
+        by kind — a re-fired episode updates in place (bounded by the
+        handful of anomaly kinds, never per-tick growth)."""
+        with self._cv:
+            row = dict(info or {})
+            row["t"] = round(time.time(), 3)
+            self.anomalies[kind] = row
             self.version += 1
             self._cv.notify_all()
 
@@ -212,6 +226,7 @@ class PullSession:
         with self._cv:
             status, error, phase = self.status, self.error, self.phase
             version, slo = self.version, dict(self.slo)
+            anomalies = dict(self.anomalies)
             ended_t, ended_at = self._ended_t, self.ended_at
             stats = self.stats
         end = ended_t if ended_t is not None else time.monotonic()
@@ -256,6 +271,8 @@ class PullSession:
             doc["error"] = error
         if slo:
             doc["slo"] = slo
+        if anomalies:
+            doc["anomalies"] = anomalies
         if stats is not None:
             for k in ("time_to_hbm_s", "time_to_first_layer_s",
                       "time_to_swap_s", "peer_served_ratio"):

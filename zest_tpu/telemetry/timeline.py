@@ -1,0 +1,760 @@
+"""Live telemetry timelines: an in-process time-series store with
+streaming anomaly detection (ISSUE 15).
+
+Everything the observability stack built so far is either a *point in
+time* (gauges, ``/v1/status``, the session snapshot) or an *aggregate
+over all time* (registry counters, terminal stats dicts). Neither can
+answer the questions a gradually-failing shared pool actually raises —
+"was throughput collapsing before the error?", "has the admission
+queue been growing without a single admit?", "which collective phase
+kept stalling on its barrier?" — because nothing keeps a metric's
+*history*. This module is that history:
+
+- **The store** (:class:`TimelineStore`): a bounded set of named
+  series, each a fixed-capacity ring of ``(t, value)`` samples taken
+  at ``ZEST_TIMELINE_HZ`` (default 1 Hz) by one process-wide sampler
+  thread. Memory is bounded by construction: per-series ring capacity
+  × a hard series-count cap, oldest-touched series evicted first.
+- **Rates from existing counters**: the sampler derives per-tier
+  fetch B/s, per-lane file B/s, dcn / collective wire B/s, and seed
+  upload B/s from the registry counters the subsystems already bump —
+  zero new hot-path work; the instrumented code paths don't change.
+  Rate samples are exact by construction: each sample is
+  ``delta / dt`` over the tick interval, so integrating a rate series
+  (:func:`integrate`) reproduces the counter's total delta.
+- **Structural gauges**: subsystems register live *probes*
+  (``register_probe(name, fn)`` — called at tick time: tenancy queue
+  depth, admitted sessions, singleflight in-flight count, HostRing
+  occupancy/stalls) or *post* cells (``post(name, value)`` — for
+  transient state like the collective exchange's current phase index
+  and cumulative barrier wait). Per-session byte progress is sampled
+  straight off the session table.
+- **The anomaly detector** (:class:`AnomalyDetector`): streaming rules
+  evaluated every tick — sustained throughput collapse (session rate
+  < 25% of its own EWMA for ≥ ``ZEST_ANOMALY_WINDOW_S`` while bytes
+  remain), zero-progress stall, tenant-queue growth without a single
+  admission, and per-phase collective straggler attribution. Each
+  firing records a flight-recorder event (kind ``anomaly``), bumps
+  ``zest_anomalies_total{kind}``, and annotates the live session so
+  ``/v1/pulls`` / ``zest top`` show the anomaly next to the pull it
+  belongs to.
+
+Surfaces: ``GET /v1/timeline?since=<cursor>`` (cursor-paged JSON),
+``?scope=pod`` (the coordinator merges every peer's timeline onto its
+own clock via the PR-7 hello offsets — :func:`merge_timelines`),
+dashboard sparklines, and ``zest top``.
+
+Knob-off contract: ``ZEST_TIMELINE=0`` is hard-off — no sampler
+thread, an empty store, every ``register_probe``/``post`` call one
+flag check — and the pull is bit-for-bit the timeline-less pull
+(pinned by test). ``ZEST_TELEMETRY=0`` implies it.
+
+Import discipline: same as the rest of the package — nothing from
+``zest_tpu`` outside ``telemetry`` is imported, so every subsystem can
+register probes without cycles.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+from zest_tpu.telemetry import metrics, recorder, state
+from zest_tpu.telemetry import session as session_mod
+
+ENV_TIMELINE = "ZEST_TIMELINE"
+ENV_HZ = "ZEST_TIMELINE_HZ"
+ENV_WINDOW = "ZEST_ANOMALY_WINDOW_S"
+ENV_SAMPLES = "ZEST_TIMELINE_SAMPLES"
+
+DEFAULT_HZ = 1.0
+DEFAULT_WINDOW_S = 5.0
+DEFAULT_SAMPLES = 512      # ring capacity per series
+MAX_SERIES = 256           # hard cap on concurrent series
+_ANOMALY_RING = 64         # recent-anomalies ring on the store
+
+# Throughput-collapse rule constants: the session's rate must fall
+# below COLLAPSE_FRACTION of its own EWMA — and the EWMA itself must be
+# above a noise floor, or an idle trickle would "collapse" constantly.
+COLLAPSE_FRACTION = 0.25
+_COLLAPSE_FLOOR_BPS = 64 * 1024
+# EWMA time constant, in anomaly windows: long enough that one slow
+# tick doesn't drag the baseline down to meet the collapsed rate.
+_EWMA_WINDOWS = 3.0
+
+_M_ANOMALIES = metrics.counter(
+    "zest_anomalies_total",
+    "Streaming anomalies detected on live timelines, by kind",
+    ("kind",))
+_M_SAMPLES = metrics.counter(
+    "zest_timeline_samples_total",
+    "Samples appended to the in-process timeline store")
+
+# Counter → rate derivations: (series prefix, registry metric, label
+# key). One series per observed label value (``<prefix>.<label>_bps``),
+# or ``<prefix>.bps`` for unlabeled/summed metrics. All are byte
+# counters, so every derived series is in bytes/second.
+RATE_SOURCES = (
+    ("fetch", "zest_fetch_bytes_total", "source"),
+    ("files", "zest_files_bytes_total", "lane"),
+    ("coop", "zest_coop_bytes_total", "tier"),
+    ("collective", "zest_coop_collective_bytes_total", "link"),
+    ("dcn", "zest_dcn_bytes_served_total", None),
+    ("seed", "zest_seed_bytes_total", None),
+)
+
+ANOMALY_COLLAPSE = "throughput_collapse"
+ANOMALY_STALL = "stall"
+ANOMALY_QUEUE = "queue_stuck"
+ANOMALY_STRAGGLER = "collective_straggler"
+
+
+# ── On/off switch (lazy env resolution, same shape as state.enabled) ──
+
+_OFF_VALUES = frozenset({"0", "false", "off", "no"})
+
+_flag_lock = threading.Lock()
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    """The hot-path gate: ``ZEST_TELEMETRY`` off implies timeline off;
+    ``ZEST_TIMELINE=0`` turns just this layer off."""
+    if not state.enabled():
+        return False
+    global _enabled
+    on = _enabled
+    if on is not None:
+        return on
+    with _flag_lock:
+        if _enabled is None:
+            raw = os.environ.get(ENV_TIMELINE, "").strip().lower()
+            _enabled = raw not in _OFF_VALUES
+        return _enabled
+
+
+def set_enabled(on: bool | None) -> None:
+    """Test/CLI override; ``None`` returns to env resolution."""
+    global _enabled
+    with _flag_lock:
+        _enabled = on
+
+
+def _env_float(name: str, default: float, floor: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    if not math.isfinite(v) or v < floor:
+        return default
+    return v
+
+
+def _env_int(name: str, default: int, floor: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= floor else default
+
+
+# ── Series + store ──
+
+
+class Series:
+    """One named timeline: a fixed-capacity ring of
+    ``(seq, t, value)`` samples. ``seq`` is the store-global sample
+    counter — the paging cursor ``GET /v1/timeline?since=`` resumes
+    from."""
+
+    __slots__ = ("name", "kind", "ring", "last_touch")
+
+    def __init__(self, name: str, kind: str, capacity: int):
+        self.name = name
+        self.kind = kind            # "rate" | "gauge"
+        self.ring: deque = deque(maxlen=capacity)
+        self.last_touch = 0.0
+
+    def samples_since(self, since: int) -> list[list[float]]:
+        return [[t, v] for seq, t, v in self.ring if seq > since]
+
+
+class AnomalyDetector:
+    """Streaming anomaly rules over the sampler's per-tick views.
+
+    All state is per-episode: a rule arms when its condition first
+    holds, fires once the condition has held for ``window_s``, and
+    re-arms only after the condition clears — so a wedged pull
+    produces ONE stall anomaly, not one per tick."""
+
+    def __init__(self, store: "TimelineStore", window_s: float):
+        self.store = store
+        self.window_s = window_s
+        # sid → {last_bytes, last_t, ewma, collapse_since, stall_since,
+        #        fired: set[str]}
+        self._sessions: dict[str, dict] = {}
+        self._queue: dict = {}       # queue-growth episode state
+        self._collective: dict = {}  # per-phase barrier baseline
+
+    # — firing —
+
+    def _fire(self, kind: str, session=None, **fields) -> None:
+        sid = getattr(session, "id", None)
+        _M_ANOMALIES.inc(kind=kind)
+        ev = {"anomaly": kind, **fields}
+        if sid is not None:
+            ev["session"] = sid
+        recorder.record("anomaly", **ev)
+        if session is not None:
+            note = getattr(session, "note_anomaly", None)
+            if note is not None:
+                try:
+                    note(kind, fields)
+                except Exception:  # noqa: BLE001 - annotation is advisory
+                    pass
+        self.store._note_anomaly(kind, sid, fields)
+
+    # — per-session rules —
+
+    def observe_session(self, sess, now: float) -> None:
+        sid = sess.id
+        row = self._sessions.get(sid)
+        f = sess._fetch
+        done = None
+        if f is not None:
+            done = (f.bytes_from_cache + f.bytes_from_peer
+                    + f.bytes_from_cdn)
+        if row is None:
+            self._sessions[sid] = {
+                "last_bytes": done, "last_t": now, "ewma": 0.0,
+                "collapse_since": None, "stall_since": None,
+                "fired": set(),
+            }
+            return
+        dt = now - row["last_t"]
+        if dt <= 0 or done is None:
+            return
+        last = row["last_bytes"]
+        row["last_t"] = now
+        row["last_bytes"] = done
+        if last is None:
+            return
+        rate = max(0.0, (done - last) / dt)
+        total = sess.total_bytes
+        # Progress-bar semantics, like the session ETA: the tiers count
+        # blob bytes against the payload total — "bytes remain" is
+        # approximate, which is fine for an anomaly gate.
+        bytes_remain = total is not None and done < total
+        # "Byte-moving" is judged on the OPEN stage multiset, not the
+        # display phase: during a direct landing the display phase is
+        # hbm_commit (it outranks fetch in the session's rank table)
+        # while fetch workers are still pulling bytes inside it — a
+        # mid-landing fetch stall must still fire. When the fetch/files
+        # stages have genuinely closed, a slow commit is not a stall.
+        try:
+            open_stages = tuple(sess._open)
+        except RuntimeError:  # dict mutated under us — next tick reads
+            open_stages = ()
+        moving_phase = ("fetch" in open_stages or "files" in open_stages
+                        or sess.phase in ("fetch", "files"))
+
+        # Zero-progress stall: no byte movement for a whole window
+        # while the pull sits in a byte-moving phase with work left.
+        if rate == 0.0 and moving_phase and (bytes_remain or done == 0):
+            if row["stall_since"] is None:
+                row["stall_since"] = now
+            elif (now - row["stall_since"] >= self.window_s
+                    and ANOMALY_STALL not in row["fired"]):
+                row["fired"].add(ANOMALY_STALL)
+                self._fire(ANOMALY_STALL, session=sess,
+                           phase=sess.phase, bytes_done=done,
+                           stalled_s=round(now - row["stall_since"], 2))
+        else:
+            row["stall_since"] = None
+            if rate > 0.0:
+                row["fired"].discard(ANOMALY_STALL)
+
+        # Sustained throughput collapse vs the session's OWN history:
+        # the EWMA is the baseline, so a pull that was always slow
+        # doesn't alarm — only one that *fell off* its own rate.
+        ewma = row["ewma"]
+        collapsed = (ewma > _COLLAPSE_FLOOR_BPS
+                     and rate < COLLAPSE_FRACTION * ewma
+                     and bytes_remain)
+        if collapsed:
+            if row["collapse_since"] is None:
+                row["collapse_since"] = now
+            elif (now - row["collapse_since"] >= self.window_s
+                    and ANOMALY_COLLAPSE not in row["fired"]):
+                row["fired"].add(ANOMALY_COLLAPSE)
+                self._fire(ANOMALY_COLLAPSE, session=sess,
+                           rate_bps=int(rate), ewma_bps=int(ewma),
+                           bytes_done=done)
+        else:
+            row["collapse_since"] = None
+            if ewma > 0 and rate >= COLLAPSE_FRACTION * ewma:
+                row["fired"].discard(ANOMALY_COLLAPSE)
+        # Update the EWMA AFTER judging: the collapsed ticks must not
+        # drag the baseline down to meet the collapsed rate instantly.
+        tau = max(self.window_s * _EWMA_WINDOWS, 1e-6)
+        alpha = 1.0 - math.exp(-dt / tau)
+        row["ewma"] = ewma + alpha * (rate - ewma)
+
+    def drop_session(self, sid: str) -> None:
+        self._sessions.pop(sid, None)
+
+    # — queue rule —
+
+    def observe_queue(self, depth, admitted_total, now: float) -> None:
+        """Tenant queue growth without admission: the queue holds (or
+        grows) for a whole window while ``admitted_total`` doesn't
+        move — the signature of a wedged/undersized admission stage."""
+        if depth is None or admitted_total is None:
+            return
+        q = self._queue
+        stuck = (depth > 0 and bool(q)
+                 and admitted_total == q.get("admitted")
+                 and depth >= q.get("depth", 0))
+        if not stuck:
+            # Idle, drained below the episode's start depth, or an
+            # admission happened: start a fresh episode.
+            self._queue = {"since": now, "depth": depth,
+                           "admitted": admitted_total, "fired": False}
+            return
+        q["depth"] = depth
+        if now - q["since"] >= self.window_s and not q.get("fired"):
+            q["fired"] = True
+            self._fire(ANOMALY_QUEUE, depth=int(depth),
+                       waited_s=round(now - q["since"], 2))
+
+    # — collective rule —
+
+    def observe_collective(self, cells: dict, now: float) -> None:
+        """Per-phase straggler attribution: barrier wait accumulated
+        *within one phase* exceeding the window means this phase's
+        partner is the straggler — fired once per phase, carrying the
+        phase index and partner host."""
+        phase = cells.get("collective.phase")
+        barrier = cells.get("collective.barrier_s")
+        if phase is None or barrier is None:
+            self._collective = {}
+            return
+        c = self._collective
+        if c.get("phase") != phase:
+            self._collective = {"phase": phase, "barrier0": barrier,
+                                "fired": False}
+            return
+        waited = barrier - c.get("barrier0", 0.0)
+        if waited >= self.window_s and not c.get("fired"):
+            c["fired"] = True
+            fields = {"phase": int(phase),
+                      "barrier_wait_s": round(waited, 2)}
+            partner = cells.get("collective.partner")
+            if partner is not None:
+                fields["partner"] = int(partner)
+            self._fire(ANOMALY_STRAGGLER, **fields)
+
+
+class TimelineStore:
+    """The process timeline: bounded series rings, probe/cell
+    registries, the counter-rate state, and the anomaly ring."""
+
+    def __init__(self, capacity: int | None = None,
+                 max_series: int = MAX_SERIES,
+                 window_s: float | None = None):
+        if capacity is None:
+            capacity = _env_int(ENV_SAMPLES, DEFAULT_SAMPLES, 2)
+        if window_s is None:
+            window_s = _env_float(ENV_WINDOW, DEFAULT_WINDOW_S, 0.05)
+        self.capacity = capacity
+        self.max_series = max(1, max_series)
+        self.window_s = window_s
+        self.hz = _env_float(ENV_HZ, DEFAULT_HZ, 0.01)
+        self._lock = threading.Lock()
+        self._series: OrderedDict[str, Series] = OrderedDict()
+        self._seq = 0
+        self._probes: dict[str, object] = {}
+        self._cells: dict[str, float] = {}
+        # (metric, label value) → (counter value, t) rate baselines.
+        self._rate_state: dict[tuple[str, str], tuple[float, float]] = {}
+        # When the previous tick ran (monotonic): a labelset FIRST seen
+        # mid-run credits its whole counter value over this interval —
+        # the bytes moved since the last look, there was just no
+        # labelset row yet to watch them through.
+        self._last_tick_t: float | None = None
+        self._anomalies: deque = deque(maxlen=_ANOMALY_RING)
+        self._clock_offsets: dict = {}
+        self.detector = AnomalyDetector(self, window_s)
+        self.ticks = 0
+
+    # — write side —
+
+    def _append(self, name: str, value: float, kind: str,
+                t: float) -> None:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                while len(self._series) >= self.max_series:
+                    # Oldest-touched series evicts first (move-to-end
+                    # on every append keeps the OrderedDict in touch
+                    # order).
+                    self._series.popitem(last=False)
+                s = self._series[name] = Series(name, kind,
+                                                self.capacity)
+            self._seq += 1
+            # Microsecond timestamps: rate samples are integrated back
+            # to byte totals (×dt), so millisecond rounding would leak
+            # ~1% per tick into the smoke gate's 5% budget.
+            s.ring.append((self._seq, round(t, 6), value))
+            s.last_touch = t
+            self._series.move_to_end(name)
+        _M_SAMPLES.inc()
+
+    def _note_anomaly(self, kind: str, sid, fields: dict) -> None:
+        ev = {"t": round(time.time(), 3), "kind": kind}
+        if sid is not None:
+            ev["session"] = sid
+        ev.update({k: v for k, v in fields.items()
+                   if isinstance(v, (str, int, float, bool))})
+        with self._lock:
+            self._anomalies.append(ev)
+
+    def set_clock_offsets(self, offsets: dict) -> None:
+        """Record the pod clock offsets the last coop round measured
+        (host index → {offset_s, rtt_s}) — what ``?scope=pod`` hands
+        :func:`merge_timelines` for normalization."""
+        with self._lock:
+            self._clock_offsets.update(
+                {str(k): dict(v) for k, v in offsets.items()})
+
+    # — the sampling pass —
+
+    def tick(self, now: float | None = None, wall: float | None = None,
+             registry=None) -> None:
+        """One sampling pass. ``now`` is the monotonic rate clock,
+        ``wall`` the sample timestamp (tests inject both); production
+        calls leave them None."""
+        if now is None:
+            now = time.monotonic()
+        if wall is None:
+            wall = time.time()
+        if registry is None:
+            registry = metrics.REGISTRY
+        self.ticks += 1
+
+        # 1. Rates derived from the existing registry counters.
+        last_tick = self._last_tick_t
+        self._last_tick_t = now
+        by_name = {m.name: m for m in registry.metrics()}
+        for prefix, metric_name, label_key in RATE_SOURCES:
+            m = by_name.get(metric_name)
+            if m is None:
+                continue
+            sums: dict[str, float] = {}
+            for labels, value in m.samples():
+                key = labels.get(label_key, "") if label_key else ""
+                sums[key] = sums.get(key, 0.0) + value
+            for label_value, total in sums.items():
+                rk = (metric_name, label_value)
+                prev = self._rate_state.get(rk)
+                self._rate_state[rk] = (total, now)
+                name = (f"{prefix}.{label_value}_bps" if label_value
+                        else f"{prefix}.bps")
+                if prev is None:
+                    if last_tick is None or now <= last_tick:
+                        # The store's very first look: no prior instant
+                        # to rate against — a zero baseline anchors the
+                        # series for integration.
+                        self._append(name, 0.0, "rate", wall)
+                        continue
+                    # First seen mid-run: the whole counter value moved
+                    # since the previous tick (the labelset just didn't
+                    # exist to watch). A leading zero anchor at the
+                    # previous tick keeps integrate() exact.
+                    dt = now - last_tick
+                    self._append(name, 0.0, "rate", wall - dt)
+                    self._append(name, round(total / dt, 1), "rate",
+                                 wall)
+                    continue
+                pv, pt = prev
+                dt = now - pt
+                if dt <= 0:
+                    continue
+                self._append(name, round(max(0.0, total - pv) / dt, 1),
+                             "rate", wall)
+
+        # 2. Registered probes (live structural gauges).
+        with self._lock:
+            probes = list(self._probes.items())
+            cells = dict(self._cells)
+        probe_vals: dict[str, float] = {}
+        for name, fn in probes:
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 - a dying probe drops out
+                continue
+            if v is None:
+                continue
+            probe_vals[name] = float(v)
+            self._append(name, float(v), "gauge", wall)
+
+        # 3. Posted cells (transient subsystem state).
+        for name, v in cells.items():
+            self._append(name, float(v), "gauge", wall)
+
+        # 4. Per-session byte progress + the session anomaly rules.
+        active = session_mod.SESSIONS.active()
+        live_ids = set()
+        for sess in active:
+            live_ids.add(sess.id)
+            f = sess._fetch
+            if f is not None:
+                done = (f.bytes_from_cache + f.bytes_from_peer
+                        + f.bytes_from_cdn)
+                self._append(f"session.{sess.id}.bytes", float(done),
+                             "gauge", wall)
+            self.detector.observe_session(sess, now)
+        for sid in list(self.detector._sessions):
+            if sid not in live_ids:
+                self.detector.drop_session(sid)
+
+        # 5. Queue + collective anomaly rules (probe/cell views).
+        self.detector.observe_queue(
+            probe_vals.get("tenancy.queue_depth"),
+            probe_vals.get("tenancy.admitted_total"), now)
+        self.detector.observe_collective(cells, now)
+
+    # — read side —
+
+    def payload(self, since: int = 0, prefix: str | None = None) -> dict:
+        """The ``GET /v1/timeline`` document: every series' samples
+        with cursor > ``since`` (cursor-paged — pass the returned
+        ``cursor`` back as ``since`` to stream increments), the recent
+        anomaly ring, and the sampling config."""
+        with self._lock:
+            series = {
+                name: {"kind": s.kind,
+                       "samples": s.samples_since(since)}
+                for name, s in self._series.items()
+                if prefix is None or name.startswith(prefix)
+            }
+            doc = {
+                "enabled": True,
+                "hz": self.hz,
+                "window_s": self.window_s,
+                "cursor": self._seq,
+                "series": {n: d for n, d in series.items()
+                           if d["samples"]},
+                "anomalies": list(self._anomalies),
+            }
+            if self._clock_offsets:
+                doc["clock_offsets"] = dict(self._clock_offsets)
+        return doc
+
+
+# ── The sampler thread ──
+
+
+class _Sampler:
+    def __init__(self, store: TimelineStore):
+        self.store = store
+        self._stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="zest-timeline")
+
+    def _run(self) -> None:
+        interval = 1.0 / max(self.store.hz, 0.01)
+        # Immediate baseline tick: pins "the previous look" to the
+        # sampler's start, so bytes that move before the first interval
+        # elapses are credited to it instead of vanishing into a
+        # first-sight baseline.
+        try:
+            self.store.tick()
+        except Exception:  # noqa: BLE001 - sampling must never crash
+            pass
+        while not self._stop.wait(interval):
+            try:
+                self.store.tick()
+            except Exception:  # noqa: BLE001 - sampling must never crash
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ── Process-wide instance + module-level hooks ──
+
+STORE = TimelineStore()
+
+_sampler_lock = threading.Lock()
+_sampler: _Sampler | None = None
+
+
+def ensure_started() -> bool:
+    """Start the process sampler (idempotent). Called from pull entry
+    and the daemon's serve path; a no-op (False) when the layer is
+    knob-off."""
+    if not enabled():
+        return False
+    global _sampler
+    with _sampler_lock:
+        if _sampler is None:
+            _sampler = _Sampler(STORE)
+            _sampler.thread.start()
+    return True
+
+
+def register_probe(name: str, fn) -> None:
+    """Register a live gauge sampled every tick (``fn() -> float or
+    None``). Replace semantics: re-registering a name swaps the
+    callable — subsystems that rebuild (tenancy state, landing rings)
+    just re-register."""
+    if not enabled():
+        return
+    with STORE._lock:
+        STORE._probes[name] = fn
+
+
+def unregister_probe(name: str, fn=None) -> None:
+    """Remove a probe. With ``fn`` given, remove only if that callable
+    is still the registered one — an old owner's teardown must not
+    drop the probe its replacement just registered (the landing-ring
+    close-after-replace case)."""
+    with STORE._lock:
+        if fn is None or STORE._probes.get(name) is fn:
+            STORE._probes.pop(name, None)
+
+
+def post(name: str, value: float) -> None:
+    """Set a transient cell the sampler records each tick (the
+    collective exchange's phase index / barrier seconds)."""
+    if not enabled():
+        return
+    with STORE._lock:
+        STORE._cells[name] = float(value)
+
+
+def clear(prefix: str) -> None:
+    """Drop every posted cell under ``prefix`` (phase over)."""
+    with STORE._lock:
+        for name in [n for n in STORE._cells if n.startswith(prefix)]:
+            STORE._cells.pop(name, None)
+
+
+def set_clock_offsets(offsets: dict) -> None:
+    if not enabled() or not offsets:
+        return
+    STORE.set_clock_offsets(offsets)
+
+
+def payload(since: int = 0, prefix: str | None = None) -> dict:
+    """The ``/v1/timeline`` document (an explicit ``enabled: false``
+    stub when knob-off, so pollers see the state instead of a 404)."""
+    if not enabled():
+        return {"enabled": False, "series": {}, "anomalies": [],
+                "cursor": 0}
+    return STORE.payload(since=since, prefix=prefix)
+
+
+def status_block() -> dict:
+    """The ``timeline`` block for ``/v1/status``."""
+    if not enabled():
+        return {"enabled": False}
+    with STORE._lock:
+        return {"enabled": True, "hz": STORE.hz,
+                "series": len(STORE._series), "cursor": STORE._seq,
+                "anomalies": len(STORE._anomalies),
+                "ticks": STORE.ticks}
+
+
+def reset() -> None:
+    """Tests: stop the sampler, drop the store, unresolve the flag."""
+    global _sampler
+    with _sampler_lock:
+        if _sampler is not None:
+            _sampler.stop()
+            _sampler = None
+    global STORE
+    STORE = TimelineStore()
+    set_enabled(None)
+
+
+# ── Pure helpers (integration + pod merge) ──
+
+
+def integrate(samples: list[list[float]]) -> float:
+    """∫ rate·dt over a rate series' samples — left-Riemann over the
+    sample intervals, which is *exact* for series this store derived
+    (each sample IS delta/dt for the interval ending at its
+    timestamp). The smoke gate checks this against ``FetchStats``."""
+    total = 0.0
+    for (t0, _v0), (t1, v1) in zip(samples, samples[1:]):
+        total += v1 * (t1 - t0)
+    return total
+
+
+def merge_timelines(host_docs: dict, reference=None) -> dict:
+    """Merge per-host ``/v1/timeline`` docs into one pod-scope doc:
+    series renamed ``h<host>.<name>``, timestamps normalized onto the
+    reference host's clock via each doc's recorded hello clock offsets
+    (PR 7; a host without an offset estimate merges on raw wall
+    clocks — recorded as ``applied_offset_s: null``, same honesty rule
+    as ``fleet.merge_traces``). Anomalies merge into one time-ordered
+    list stamped with their host."""
+    if not host_docs:
+        raise ValueError("no timelines to merge")
+    keys = sorted(host_docs, key=str)
+    if reference is None:
+        reference = keys[0]
+    ref_offsets = (host_docs[reference].get("clock_offsets") or {})
+
+    merged_series: dict = {}
+    anomalies: list[dict] = []
+    norm_meta: dict = {}
+    for host in keys:
+        doc = host_docs[host]
+        offset = 0.0 if host == reference else None
+        est = ref_offsets.get(str(host))
+        if isinstance(est, dict) and "offset_s" in est:
+            offset = float(est["offset_s"])
+        else:
+            own = (doc.get("clock_offsets") or {}).get(str(reference))
+            if isinstance(own, dict) and "offset_s" in own:
+                offset = -float(own["offset_s"])
+        norm_meta[str(host)] = {
+            "applied_offset_s": (None if offset is None
+                                 else round(offset, 6))}
+        shift = -(offset or 0.0)
+        for name, s in (doc.get("series") or {}).items():
+            merged_series[f"h{host}.{name}"] = {
+                "kind": s.get("kind", "gauge"),
+                # µs rounding like the store's own samples: ms-rounded
+                # timestamps would leak ~1%/tick back into integrate()
+                # on a pod-merged rate series.
+                "samples": [[round(t + shift, 6), v]
+                            for t, v in s.get("samples", [])],
+            }
+        for ev in doc.get("anomalies") or []:
+            out = dict(ev)
+            out["host"] = host
+            if "t" in out:
+                out["t"] = round(out["t"] + shift, 6)
+            anomalies.append(out)
+    anomalies.sort(key=lambda e: e.get("t", 0))
+    return {
+        "scope": "pod",
+        "reference": reference,
+        "hosts": [str(k) for k in keys],
+        "clock_normalization": norm_meta,
+        "series": merged_series,
+        "anomalies": anomalies,
+    }

@@ -359,6 +359,7 @@ def run_collective(bridge, plan, host_index: int,
 
     def finish(aborted: str | None = None,
                dead_host: int | None = None) -> dict:
+        telemetry.timeline.clear("collective.")
         stats["windows"] = windows
         stats["requests"] = requests
         stats["retry_windows"] = retry_windows
@@ -394,6 +395,13 @@ def run_collective(bridge, plan, host_index: int,
                  if not _already_cached(bridge, hh, fi)]
         wants = _layer_order(wants, priorities)
         t_phase = time.monotonic()
+        # Live cells for the timeline sampler (ISSUE 15): the current
+        # phase index + partner and the cumulative barrier wait — what
+        # the per-phase straggler rule attributes from. Cleared by
+        # finish() so a finished exchange stops reporting a phase.
+        telemetry.timeline.post("collective.phase", ph.index)
+        telemetry.timeline.post("collective.partner", ph.partner)
+        telemetry.timeline.post("collective.barrier_s", barrier_s)
         sleep_s = _BARRIER_SLEEP_S
         # Distinguishes a barrier RE-request (the missing set after a
         # NOT_FOUND round — partner lag) from plain pagination (a phase
@@ -496,6 +504,8 @@ def run_collective(bridge, plan, host_index: int,
                                         units=len(missing)):
                         time.sleep(sleep_s)
                     barrier_s += sleep_s
+                    telemetry.timeline.post("collective.barrier_s",
+                                            barrier_s)
                     sleep_s = min(sleep_s * 2, _BARRIER_SLEEP_CAP_S)
                     retry_pass = True
                     pending = missing + pending

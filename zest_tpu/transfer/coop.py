@@ -586,6 +586,10 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
             for w in workers:
                 w.join()
         _collect_clock_offsets(pool, peers, clock_offsets)
+        # The timeline's pod merge (`/v1/timeline?scope=pod`) normalizes
+        # peer series onto this host's clock with the same offsets the
+        # trace merge uses (ISSUE 15).
+        telemetry.timeline.set_clock_offsets(clock_offsets)
     finally:
         if own_pool:
             pool.close()

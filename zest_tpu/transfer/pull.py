@@ -814,6 +814,10 @@ def pull_model(
         repo_id, revision, tenant=tenant_label, device=device)
     if sess is not None:
         sess.cancel_token = cancel
+    # Live timelines (ISSUE 15): make sure the process sampler is
+    # running for the life of this pull — one idempotent flag check;
+    # with ZEST_TIMELINE=0 nothing starts and the store stays empty.
+    telemetry.timeline.ensure_started()
     # The coop stage installs this pull's fleet trace context (host +
     # trace_id); restore the previous one at exit so a long-lived
     # daemon's NEXT pull never records under a stale identity (spans

@@ -84,6 +84,21 @@ _M_QUEUE_DEPTH = telemetry.gauge(
 _M_ADMITTED = telemetry.gauge(
     "zest_tenant_active_pulls",
     "Pull sessions currently holding an admission slot")
+# Tenancy metrics gaps (ISSUE 15 satellite): how singleflight resolved
+# each participant (leader fetched, waiter read the winner's entry,
+# handoff = a waiter inherited a cancelled leader's fetch), and how
+# long admission actually made sessions wait — the queue-health signal
+# the queue-depth gauge alone can't give (depth 3 for 10 ms and depth 3
+# for 10 min look identical on a gauge).
+_M_SINGLEFLIGHT = telemetry.counter(
+    "zest_singleflight_total",
+    "Singleflight participations by outcome",
+    ("outcome",))
+_M_ADMISSION_WAIT = telemetry.histogram(
+    "zest_admission_wait_seconds",
+    "Wall seconds a pull session waited for an admission slot",
+    buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             300.0))
 
 
 class PullCancelled(RuntimeError):
@@ -272,6 +287,14 @@ class Singleflight:
         self._flights: dict = {}
         self.led = 0
         self.hits = 0
+        # Outcome book (ISSUE 15 satellite): leader / waiter / handoff
+        # counts, mirrored into zest_singleflight_total{outcome}.
+        self.outcomes = {"leader": 0, "waiter": 0, "handoff": 0}
+
+    def _outcome(self, outcome: str) -> None:
+        # Callers hold self._cv.
+        self.outcomes[outcome] += 1
+        _M_SINGLEFLIGHT.inc(outcome=outcome)
 
     def join(self, key) -> tuple[str, _Flight]:
         with self._cv:
@@ -280,6 +303,7 @@ class Singleflight:
                 flight = self._flights[key] = _Flight(key)
                 self.led += 1
                 _M_FLIGHTS.inc()
+                self._outcome("leader")
                 return "lead", flight
             return "wait", flight
 
@@ -293,14 +317,18 @@ class Singleflight:
                         flight.promotions -= 1
                         self.led += 1
                         _M_FLIGHTS.inc()
+                        self._outcome("handoff")
                         return "lead"
                     if flight.state == "done":
+                        self._outcome("waiter")
                         return "done"
                     if flight.state == "failed":
+                        self._outcome("waiter")
                         return "failed"
                     if flight.state == "gone":
                         # Leader abdicated with no waiter counted yet
                         # (we raced the dissolve): fetch ourselves.
+                        self._outcome("handoff")
                         return "lead"
                     if cancel is not None and cancel.fired:
                         return "cancelled"
@@ -341,10 +369,15 @@ class Singleflight:
                 self._flights.pop(flight.key, None)
             self._cv.notify_all()
 
+    def in_flight(self) -> int:
+        with self._cv:
+            return len(self._flights)
+
     def summary(self) -> dict:
         with self._cv:
             return {"in_flight": len(self._flights),
-                    "led": self.led, "dedupe_hits": self.hits}
+                    "led": self.led, "dedupe_hits": self.hits,
+                    "outcomes": dict(self.outcomes)}
 
 
 # ── Pinning + eviction ──
@@ -498,11 +531,15 @@ class CacheEvictor:
                 target_bytes = min(self.low_bytes or usage // 2,
                                    usage // 2)
             freed = 0
+            pinned_skips = 0
+            pinned_skip_bytes = 0
             for mtime, size, path, hash_hex in sorted(entries):
                 if usage - freed <= target_bytes:
                     break
                 if self.pins.pinned(hash_hex):
                     self.pinned_survivals += 1
+                    pinned_skips += 1
+                    pinned_skip_bytes += size
                     continue
                 try:
                     os.unlink(path)
@@ -514,6 +551,13 @@ class CacheEvictor:
                 _M_EVICTIONS.inc(reason=reason)
                 telemetry.record("cache_evict", xorb=hash_hex,
                                  bytes=size, reason=reason)
+            if pinned_skips:
+                # One event per PASS, not per entry: a pressured cache
+                # full of pinned trees would otherwise flood the ring
+                # with thousands of identical skip events.
+                telemetry.record("cache_evict_pinned_skip",
+                                 reason=reason, entries=pinned_skips,
+                                 bytes=pinned_skip_bytes)
             if freed and usage - freed > target_bytes:
                 telemetry.record("cache_evict_short", reason=reason,
                                  remaining=usage - freed,
@@ -642,11 +686,13 @@ class AdmissionController:
         token fires while queued (the waiter leaves the queue — its
         spot frees immediately)."""
         waiter = _Waiter(tenant, weight, session)
+        t_enter = time.monotonic()
         with self._cv:
             if self._active < self.max_pulls and not self._queued:
                 self._active += 1
                 self.admitted_total += 1
                 _M_ADMITTED.set(self._active)
+                _M_ADMISSION_WAIT.observe(0.0)
                 return
             if self._queued >= self.max_queue:
                 self.rejected_total += 1
@@ -678,6 +724,7 @@ class AdmissionController:
                     self._active -= 1
                     self._dispatch_locked()
                 raise
+        _M_ADMISSION_WAIT.observe(time.monotonic() - t_enter)
         if session is not None:
             session.set_phase("starting")
 
@@ -738,6 +785,21 @@ class TenancyState:
             cfg.tenant_disk_low, self.pins)
         self.byte_budget = ByteBudget(cfg.tenant_inflight_bytes)
         storage.set_disk_full_hook(self.evictor.on_enospc)
+        # Live structural gauges for the timeline sampler (ISSUE 15):
+        # queue depth, admitted sessions, singleflight in-flight count
+        # — the history the anomaly detector's queue-growth rule reads.
+        # Replace semantics: a knob rebuild just re-registers the names
+        # over the old state's probes.
+        c = self.controller
+        telemetry.timeline.register_probe(
+            "tenancy.queue_depth", lambda: c.summary()["queued"])
+        telemetry.timeline.register_probe(
+            "tenancy.active_pulls", lambda: c.summary()["active"])
+        telemetry.timeline.register_probe(
+            "tenancy.admitted_total",
+            lambda: c.summary()["admitted_total"])
+        telemetry.timeline.register_probe(
+            "tenancy.inflight_fetches", self.flights.in_flight)
 
     def summary(self) -> dict:
         doc = self.controller.summary()
@@ -845,8 +907,12 @@ class admit:
         if not enabled(self.cfg):
             return self
         self._st = state(self.cfg)
-        self._st.controller.acquire(self.tenant, cancel=self.cancel,
-                                    session=self.session)
+        # The queue wait gets its own span so the critical-path
+        # analyzer blames parked time as a distinct "queued" stage
+        # (ISSUE 15 satellite) instead of untraced idle.
+        with telemetry.span("tenancy.queued", tenant=self.tenant):
+            self._st.controller.acquire(self.tenant, cancel=self.cancel,
+                                        session=self.session)
         self._t0 = time.monotonic()
         sid = getattr(self.session, "id", None) or f"{id(self):x}"
         self._owner = f"sess:{sid}"
@@ -887,3 +953,6 @@ def reset() -> None:
     with _lock:
         _state = None
     storage.set_disk_full_hook(None)
+    for name in ("tenancy.queue_depth", "tenancy.active_pulls",
+                 "tenancy.admitted_total", "tenancy.inflight_fetches"):
+        telemetry.timeline.unregister_probe(name)
