@@ -1,7 +1,8 @@
 """Bench regression sentinel (ISSUE 15 satellite).
 
 The committed bench artifacts (``SWARM_r12.json``, ``TENANT_r13.json``,
-``MULTIHOST_r14.json``, ``DELTA_r10.json``) carry the numbers each PR
+``MULTIHOST_r14.json``, ``DELTA_r10.json``, ``FLEET_r16.json``) carry
+the numbers each PR
 was accepted on — but nothing re-checked them: a later PR regenerating
 an artifact with a worse number (a peer-served ratio under its gate, a
 speedup that quietly halved, a duplicate-fetch ratio creeping off zero)
@@ -89,6 +90,26 @@ CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
          "the shaped collective bench aborted to point-to-point"),
         ("shaped/coop/fallbacks", "eq", 0,
          "coop units fell back to CDN in the clean shaped run"),
+    ],
+    "FLEET_r16.json": [
+        ("gates/all_ok", "truthy", None,
+         "recorded fleet gate block flipped false"),
+        ("gates/peer_served_ratio_min", "ge", 0.90,
+         "fleet peer-served ratio fell below the ISSUE-16 gate"),
+        ("gates/peer_served_flat_pm_0.03", "truthy", None,
+         "peer-served ratio no longer holds flat 256 -> 1024 hosts"),
+        ("gates/cdn_egress_per_host_decreasing", "truthy", None,
+         "CDN egress per host stopped decreasing with fleet size"),
+        ("gates/federated_speedup_min", "ge", 1.3,
+         "the federated 3-level schedule no longer beats the flat "
+         "schedule by 1.3x on p99 time-to-HBM"),
+        ("gates/gossip_converged_within_bound", "truthy", None,
+         "gossip who-has convergence exceeded 2*ceil(log2 N) sweeps"),
+        ("gates/digest_memory_bounded", "truthy", None,
+         "gossip digest grew past its configured entry bound at "
+         "1024 hosts"),
+        ("gates/cold_pod_zero_cdn_for_warm", "truthy", None,
+         "a cold pod sent CDN bytes for xorbs the fleet holds"),
     ],
     "DELTA_r10.json": [
         ("delta_bytes_ratio", "le", 0.03,

@@ -1,0 +1,79 @@
+"""Fleet-scale topology bench driver (ISSUE 16 tentpole d).
+
+Runs ``bench_scale.bench_fleet`` — the 256/512/1024-host simulation
+over the 3-level ICI < DCN < WAN < CDN link matrix, driving the real
+CoopPlan / CollectiveSchedule / GossipNode components through an
+analytic clock — and writes ``FLEET_r16.json`` at the repo root. The
+artifact's in-recorded ``gates`` block is what scripts/bench_trend.py
+re-checks on every CI run:
+
+- peer_served_ratio >= 0.90 and flat (+-0.03) from 256 to 1024 hosts;
+- CDN egress bytes per host strictly decreasing with fleet size;
+- the federated 3-stage schedule >= 1.3x the pod-blind flat schedule
+  on p99 time-to-HBM in the WAN-bottlenecked regime;
+- gossip who-has convergence within 2*ceil(log2 N) sweeps and digest
+  memory under its configured bound at 1024 hosts;
+- a cold pod's fetch fully served by warm pods (zero CDN bytes for
+  warm-held xorbs).
+
+Usage: python scripts/fleet_bench.py [--out FLEET_r16.json]
+       [--sizes 256,512,1024] [--pod-size 64] [--gb 8.0]
+Exit 0 when every gate holds; 1 otherwise (the artifact is still
+written so the failure is inspectable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(
+        pathlib.Path(__file__).resolve().parent.parent
+        / "FLEET_r16.json"))
+    ap.add_argument("--sizes", default="256,512,1024")
+    ap.add_argument("--pod-size", type=int, default=64)
+    ap.add_argument("--gb", type=float, default=8.0)
+    args = ap.parse_args()
+
+    from zest_tpu.bench_scale import bench_fleet
+
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    t0 = time.perf_counter()
+    out = bench_fleet(fleet_sizes=sizes, pod_size=args.pod_size,
+                      model_gb=args.gb, out_path=args.out)
+    out["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+    pathlib.Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+
+    print(f"{'hosts':>6} {'pods':>5} {'peer_ratio':>10} "
+          f"{'cdn/host MB':>11} {'flat p99 s':>10} {'fed p99 s':>9} "
+          f"{'speedup':>7} {'gossip sweeps':>13}")
+    for s in sizes:
+        f = out["fleets"][str(s)]
+        print(f"{f['hosts']:>6} {f['pods']:>5} "
+              f"{f['peer_served_ratio']:>10.4f} "
+              f"{f['cdn_egress_bytes_per_host'] / 1e6:>11.1f} "
+              f"{f['flat']['p99_time_to_hbm_s']:>10.2f} "
+              f"{f['federated']['p99_time_to_hbm_s']:>9.2f} "
+              f"{f['federated_speedup']:>7.2f} "
+              f"{f['gossip']['sweeps_to_converge']:>6}/"
+              f"{f['gossip']['sweep_bound']}")
+    gates = out["gates"]
+    bad = [k for k, v in gates.items() if isinstance(v, bool) and not v]
+    if bad:
+        print(f"FLEET BENCH GATES FAILED: {bad}", file=sys.stderr)
+        print(json.dumps(gates, indent=2), file=sys.stderr)
+        return 1
+    print(f"fleet bench OK in {out['bench_wall_s']}s -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,292 @@
+"""CI smoke for the fleet-scale topology tier (ISSUE 16).
+
+Three legs, mirroring the tentpole's three claims:
+
+1. **32-host loopback fleet** (4 pods x 8, real GossipNodes over a
+   LoopbackMesh): warm pods announce their xorbs into the epidemic
+   digest, the tracker is then DISABLED (bootstrap-seed-only — also
+   re-asserted at the swarm layer: an attached gossip node demotes
+   every non-first announce), and every host must resolve >= 0.85 of
+   the checkpoint bytes from the gossip who-has index alone — the
+   announce path whose cost is O(N log N), not every-host-to-tracker.
+2. **Cold-pod routing**: pod 3 never announces; after anti-entropy
+   spreads the index, each of its hosts must route EVERY warm-held
+   xorb to a warm pod over WAN (zero CDN bytes for warm-held keys,
+   link-cost table ICI < DCN < WAN < CDN), and once one cold member
+   holds a key, its pod-mates must prefer that pod-local copy over
+   any WAN holder.
+3. **Dead-gateway round** (4 real hosts, 2 pods x 2, loopback DCN +
+   fixture hub): pod 1's elected gateway is dead on the wire. The
+   federated collective must ABORT (not hang), degrade down the PR-13
+   ladder (point-to-point exchange, then per-unit CDN fallback for
+   the dead host's share), and still leave every surviving host fully
+   cached — while ``elect_gateways`` over a plan that quarantines the
+   dead host re-elects the next-lowest member with no round trips.
+
+Exit 0 on success; prints the offending block and fails otherwise.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+N_HOSTS = 32
+POD_SIZE = 8
+N_PODS = 4
+WARM_KEYS = 60
+UNKNOWN_KEYS = 4  # held by nobody: the honest CDN remainder
+REPO_ID = "smoke/fleet-llama"
+
+
+def fail(msg: str, blob=None) -> int:
+    print(f"FLEET SMOKE FAILED: {msg}", file=sys.stderr)
+    if blob is not None:
+        print(json.dumps(blob, indent=2, default=str), file=sys.stderr)
+    return 1
+
+
+def gossip_fleet_legs() -> int | None:
+    """Legs 1 + 2: the 32-host loopback gossip fleet."""
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.gossip import (GossipNode, LoopbackMesh,
+                                          link_cost)
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    pods = tuple(h // POD_SIZE for h in range(N_HOSTS))
+    topo = tuple(2 * (h // POD_SIZE) + (h % POD_SIZE >= POD_SIZE // 2)
+                 for h in range(N_HOSTS))
+    book = {h: ("127.0.0.1", 7000 + h) for h in range(N_HOSTS)}
+    mesh = LoopbackMesh()
+    nodes = [GossipNode(h, N_HOSTS, book, topology=topo, pods=pods)
+             for h in range(N_HOSTS)]
+    for node in nodes:
+        mesh.register(node)
+
+    # Bootstrap: tracker-visible announces, counted. Warm pods 0..2
+    # announce; pod 3 is cold. After this block the tracker is never
+    # consulted again — resolution below is digest-only.
+    class Tracker:
+        announces = 0
+
+        def announce(self, info_hash, port):
+            Tracker.announces += 1
+
+        def find_peers(self, info_hash):
+            return []
+
+    tracker = Tracker()
+    keys = [bytes([j]) * 32 for j in range(WARM_KEYS)]
+    for j, key in enumerate(keys):
+        holder = (j % 3) * POD_SIZE + (j % POD_SIZE)
+        tracker.announce(key, 6881)  # the bootstrap seed
+        nodes[holder].announce(key, 6881)
+    bootstrap_announces = Tracker.announces
+
+    # Anti-entropy to convergence (bound: 2 * ceil(log2 N) sweeps).
+    import math
+
+    bound = 2 * math.ceil(math.log2(N_HOSTS))
+    for sweep in range(bound):
+        for node in nodes:
+            node.tick(mesh)
+        if all(node.who_has(k) for node in nodes for k in keys):
+            break
+    else:
+        return fail(f"gossip did not converge in {bound} sweeps")
+
+    # Leg 1: tracker disabled; resolve everything from the digest.
+    if Tracker.announces != bootstrap_announces:
+        return fail("gossip rounds leaked tracker announces")
+    key_bytes = 1 << 20
+    peer = cdn = 0
+    for node in nodes:
+        for j in range(WARM_KEYS + UNKNOWN_KEYS):
+            key = bytes([j]) * 32 if j < WARM_KEYS else bytes(
+                [0xF0 + j - WARM_KEYS]) * 32
+            if node.who_has(key):
+                peer += key_bytes
+            else:
+                cdn += key_bytes
+    ratio = peer / (peer + cdn)
+    if ratio < 0.85:
+        return fail(f"fleet peer_served_ratio {ratio:.3f} < 0.85 with "
+                    "tracker disabled after bootstrap")
+
+    # Swarm-layer re-assertion: with a node attached, the tracker sees
+    # exactly ONE announce per swarm regardless of refreshes.
+    with tempfile.TemporaryDirectory() as root:
+        cfg = Config(hf_home=pathlib.Path(root) / "hf",
+                     cache_dir=pathlib.Path(root) / "zest")
+        t2 = Tracker()
+        before = Tracker.announces
+        sw = SwarmDownloader(cfg, peer_sources=[t2])
+        sw.attach_gossip(GossipNode(0, 2, {}))
+        for _ in range(5):
+            sw.announce_available(keys[0], keys[0].hex())
+        sw.close()
+        if Tracker.announces - before != 1:
+            return fail(
+                f"attached swarm sent {Tracker.announces - before} "
+                "tracker announces for one swarm (want 1: bootstrap)")
+
+    # Leg 2: cold pod 3 routes warm-held keys to warm pods over WAN.
+    cold = [nodes[3 * POD_SIZE + i] for i in range(POD_SIZE)]
+    cold_cdn = 0
+    for node in cold:
+        for key in keys:
+            holders = node.who_has(key)
+            if not holders:
+                cold_cdn += key_bytes
+                continue
+            link = link_cost(node.host_index, holders[0],
+                             topology=topo, pods=pods)
+            if link != 2:  # COST_WAN — nearest warm copy, not origin
+                return fail(
+                    f"cold host {node.host_index} routed key to "
+                    f"holder {holders[0]} at cost {link} (want WAN=2)")
+    if cold_cdn:
+        return fail(f"cold pod sent {cold_cdn} bytes to the CDN for "
+                    "warm-held xorbs (want 0)")
+    # Once a cold member holds a key, pod-mates prefer the pod-local
+    # copy (ICI/DCN) over every WAN holder.
+    cold[0].announce(keys[0], 6881)
+    for node in cold:
+        node.tick(mesh)
+    local = cold[1].who_has(keys[0])[0]
+    if link_cost(cold[1].host_index, local,
+                 topology=topo, pods=pods) >= 2:
+        return fail(f"pod-mate preferred remote holder {local} over "
+                    "the pod-local copy")
+    print(f"fleet gossip legs OK: ratio {ratio:.3f} with tracker "
+          f"disabled ({bootstrap_announces} bootstrap announces, "
+          f"sweeps <= {bound}), cold pod zero-CDN for warm keys")
+    return None
+
+
+def dead_gateway_leg() -> int | None:
+    """Leg 3: a 2-pod round whose pod-1 gateway is dead on the wire."""
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.collective import elect_gateways
+    from zest_tpu.transfer.coop import CoopPlan, coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+
+    n = 4
+    pods = (0, 0, 1, 1)
+    dead = 2  # pod 1's elected gateway (lowest index in the pod)
+    files = llama_checkpoint_files(0.016, shard_bytes=8 * 1024 * 1024,
+                                   scale=8, smooth=True)
+    repo = FixtureRepo(REPO_ID, files, chunks_per_xorb=16)
+    with FixtureHub(repo) as hub, tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        hosts, servers, addrs = [], [], {}
+        for i in range(n):
+            cfg = Config(hf_home=rootp / f"h{i}/hf",
+                         cache_dir=rootp / f"h{i}/zest",
+                         hf_token="hf_test", endpoint=hub.url,
+                         dcn_port=0, coop_pods=pods,
+                         coop_topology=pods)
+            bridge = XetBridge(cfg)
+            bridge.authenticate(REPO_ID)
+            if i == dead:
+                # In the addr map, dead on the wire: port 1 refuses.
+                addrs[i] = ("127.0.0.1", 1)
+            else:
+                server = DcnServer(bridge.cfg, bridge.cache)
+                addrs[i] = ("127.0.0.1", server.start())
+                servers.append(server)
+            hosts.append(bridge)
+
+        recs_by_host = {}
+        for i in (0, 1, 3):
+            recs_by_host[i] = [
+                hosts[i].get_reconstruction(e.xet_hash)
+                for e in HubClient(hosts[i].cfg).list_files(REPO_ID)
+                if e.is_xet]
+        results: dict[int, dict] = {}
+        errors: list[str] = []
+
+        def run(i):
+            try:
+                results[i] = coop_round(
+                    hosts[i], recs_by_host[i], i, n, addrs,
+                    deadline_s=20.0)
+            except Exception as exc:  # noqa: BLE001 - reported below
+                errors.append(f"host {i}: {exc!r}")
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in (0, 1, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        for s in servers:
+            s.shutdown()
+        total = sum(
+            fi.url_range_end - fi.url_range_start
+            for _k, fi in CoopPlan.build(recs_by_host[0], 1).units)
+        if errors:
+            return fail(f"surviving rounds crashed: {errors}")
+        if sorted(results) != [0, 1, 3]:
+            return fail(f"rounds missing: {sorted(results)}")
+        # Only hosts whose schedule DIALS the dead gateway abort: host
+        # 0 (stage B, gateway-to-gateway) and host 3 (stage A/C, pod
+        # mate). Host 1's partners are all pod-local and alive — its
+        # collective may finish cleanly, served by host 0's healed
+        # ladder through the NOT_FOUND barrier.
+        for i in (0, 3):
+            cx = results[i].get("collective")
+            if cx is not None and not cx.get("aborted"):
+                return fail(f"host {i} collective finished cleanly "
+                            "against a dead gateway", cx)
+        for i, r in results.items():
+            fetched = (sum(r["fetch"]["tiers"].values())
+                       + r["exchange"]["wire_bytes"]
+                       + sum(r["exchange"].get("fallback_tiers",
+                                               {}).values()))
+            if fetched < total:
+                return fail(f"host {i} ended short: {fetched} < "
+                            f"{total} bytes", r)
+        aborts = sum(1 for r in results.values()
+                     if (r.get("collective") or {}).get("aborted"))
+        fallbacks = sum(r["fallbacks"] for r in results.values())
+        if not fallbacks:
+            return fail("no CDN fallbacks — the dead gateway's share "
+                        "was never degraded down the ladder", results)
+
+        # Deterministic re-election: quarantining the dead gateway
+        # hands pod 1 to the next-lowest member, no round trips.
+        plan2 = CoopPlan.build(recs_by_host[0], n,
+                               quarantined=frozenset({dead}))
+        gw2 = elect_gateways(plan2, pods)
+        if gw2 != {0: 0, 1: 3}:
+            return fail(f"re-election elected {gw2}, want "
+                        "{0: 0, 1: 3}")
+        for b in hosts:
+            b.close()
+    print(f"dead-gateway leg OK: {aborts} collective aborts, "
+          f"{fallbacks} CDN-fallback units healed the round, pod 1 "
+          f"re-elects host 3")
+    return None
+
+
+def main() -> int:
+    for leg in (gossip_fleet_legs, dead_gateway_leg):
+        rc = leg()
+        if rc is not None:
+            return rc
+    print("fleet smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -576,3 +576,184 @@ def test_clock_offsets_dial_undialed_peers_named_and_bounded(tmp_path):
         pool2.close()
     finally:
         mute.close()
+
+
+# ── Federated 3-level schedule (ISSUE 16 tentpole b) ──
+
+
+def _fed_plan(hub, tmp_path, n):
+    b = _bridge(hub, tmp_path / "fedplan")
+    plan = CoopPlan.build(_recs(b), n)
+    b.close()
+    return plan
+
+
+def _pod_maps(n, pod_size):
+    pods = tuple(h // pod_size for h in range(n))
+    topo = tuple(2 * (h // pod_size) + (h % pod_size >= pod_size // 2)
+                 for h in range(n))
+    return topo, pods
+
+
+@pytest.mark.parametrize("n,pod_size", [(8, 4), (8, 2), (12, 4)])
+def test_federated_coverage_exactly_once(hub, tmp_path, n, pod_size):
+    """Every host's federated schedule requests exactly the foreign
+    unit set, each unit once — across pow2 pods (hypercube stage B)
+    and 3 pods (WAN ring over gateways)."""
+    from zest_tpu.transfer.collective import elect_gateways
+
+    plan = _fed_plan(hub, tmp_path, n)
+    topo, pods = _pod_maps(n, pod_size)
+    blocks = units_by_owner(plan)
+    for h in plan.alive:
+        sched = CollectiveSchedule.build(plan, h, topo, pods=pods)
+        assert sched.kind == "federated"
+        keys = []
+        for ph in sched.phases:
+            for o in ph.owners:
+                keys.extend((hh, fi.range.start)
+                            for hh, fi in blocks[o])
+        want = sorted(k for k, _fi in plan.units
+                      if plan.owners[k] != h)
+        assert sorted(keys) == want, f"host {h} coverage broken"
+    # Election is lowest alive index per pod.
+    gws = elect_gateways(plan, pods)
+    assert gws == {p: min(h for h in plan.alive if pods[h] == p)
+                   for p in set(pods)}
+
+
+def test_federated_wan_pairs_are_gateways_only(hub, tmp_path):
+    """Cross-pod wire pairs occur ONLY between elected gateways, and
+    aggregate WAN bytes equal one copy of each pod's data per
+    receiving pod — the (P-1)/P-per-gateway property the ISSUE-16
+    speedup gate rests on."""
+    from zest_tpu.transfer.collective import elect_gateways
+
+    n, pod_size = 8, 4
+    plan = _fed_plan(hub, tmp_path, n)
+    topo, pods = _pod_maps(n, pod_size)
+    gws = set(elect_gateways(plan, pods).values())
+    blocks = units_by_owner(plan)
+    bb = {h: sum(fi.url_range_end - fi.url_range_start
+                 for _hh, fi in us) for h, us in blocks.items()}
+    wan_bytes = 0
+    for h in plan.alive:
+        sched = CollectiveSchedule.build(plan, h, topo, pods=pods)
+        for ph in sched.phases:
+            if pods[h] != pods[ph.partner]:
+                assert ph.link == "wan"
+                assert h in gws and ph.partner in gws, \
+                    f"non-gateway WAN pair {h}<-{ph.partner}"
+                wan_bytes += sum(bb[o] for o in ph.owners)
+    n_pods = len(set(pods))
+    total = plan.total_bytes
+    assert wan_bytes == (n_pods - 1) * total
+
+
+def test_federated_gateway_reelection_on_quarantine(hub, tmp_path):
+    """A quarantined gateway is absent from plan.alive, so the
+    next-lowest pod member inherits deterministically — and the
+    schedule still covers every unit exactly once."""
+    from zest_tpu.transfer.collective import elect_gateways
+
+    n = 8
+    plan = _fed_plan(hub, tmp_path, n)
+    topo, pods = _pod_maps(n, 4)
+    b = _bridge(hub, tmp_path / "fedq")
+    plan_q = CoopPlan.build(_recs(b), n, quarantined=frozenset({4}))
+    b.close()
+    assert elect_gateways(plan, pods) == {0: 0, 1: 4}
+    assert elect_gateways(plan_q, pods) == {0: 0, 1: 5}
+    blocks = units_by_owner(plan_q)
+    for h in plan_q.alive:
+        sched = CollectiveSchedule.build(plan_q, h, topo, pods=pods)
+        keys = []
+        for ph in sched.phases:
+            assert ph.partner != 4, "schedule dials the quarantined host"
+            for o in ph.owners:
+                keys.extend((hh, fi.range.start)
+                            for hh, fi in blocks[o])
+        want = sorted(k for k, _fi in plan_q.units
+                      if plan_q.owners[k] != h)
+        assert sorted(keys) == want
+
+
+def test_pods_env_and_single_pod_degenerate(hub, tmp_path):
+    """ZEST_COOP_PODS resolution (env > cfg > None), strict length
+    check, and the single-pod degenerate: a pod map naming one pod
+    yields the pre-federation schedule bit-for-bit."""
+    from zest_tpu.transfer.collective import pod_topology
+
+    assert pod_topology(4) is None
+    assert pod_topology(4, env={"ZEST_COOP_PODS": "0,0,1,1"}) == \
+        (0, 0, 1, 1)
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 coop_pods=(0, 1))
+    assert pod_topology(2, cfg=cfg) == (0, 1)
+    with pytest.raises(ValueError):
+        pod_topology(3, env={"ZEST_COOP_PODS": "0,1"})
+
+    plan = _fed_plan(hub, tmp_path, 4)
+    topo = (0, 0, 1, 1)
+    for h in plan.alive:
+        base = CollectiveSchedule.build(plan, h, topo)
+        one_pod = CollectiveSchedule.build(plan, h, topo,
+                                           pods=(0, 0, 0, 0))
+        assert one_pod == base
+
+
+def test_federated_round_end_to_end(hub, tmp_path):
+    """4 live hosts, 2 pods x 2 over real loopback DCN: every round
+    takes the federated schedule, completes with zero fallbacks, and
+    the link ledger carries the pinned 'wan' key (schema: present iff
+    a pod map is configured)."""
+    from zest_tpu.transfer.bridge import XetBridge
+
+    n, pods = 4, (0, 0, 1, 1)
+    bridges, servers, addrs = [], [], {}
+    for i in range(n):
+        cfg = Config(hf_home=tmp_path / f"fed{i}/hf",
+                     cache_dir=tmp_path / f"fed{i}/zest",
+                     hf_token="hf_test", endpoint=hub.url, dcn_port=0,
+                     coop_pods=pods, coop_topology=pods)
+        b = XetBridge(cfg)
+        b.authenticate(REPO_ID)
+        s = DcnServer(b.cfg, b.cache)
+        addrs[i] = ("127.0.0.1", s.start())
+        bridges.append(b)
+        servers.append(s)
+    results: list = [None] * n
+    errors: list = []
+
+    def run(i):
+        try:
+            results[i] = coop_round(bridges[i], _recs(bridges[i]), i,
+                                    n, addrs, server=servers[i])
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for s in servers:
+        s.shutdown()
+    assert not errors, errors
+    for i, r in enumerate(results):
+        cx = r["collective"]
+        assert cx["schedule"] == "federated", cx
+        assert not cx.get("aborted"), cx
+        assert r["fallbacks"] == 0, r
+        assert "wan" in cx["link_bytes"], cx
+        _assert_fully_cached(bridges[i], tmp_path / f"fed{i}")
+    # Cross-pod bytes actually crossed: the two gateways (0 and 2)
+    # carry WAN traffic; non-gateways carry none.
+    assert any(r["collective"]["link_bytes"]["wan"] > 0
+               for r in results), results
+    for i in (1, 3):
+        assert results[i]["collective"]["link_bytes"]["wan"] == 0, \
+            results[i]["collective"]
+    for b in bridges:
+        b.close()

@@ -621,6 +621,68 @@ def test_v1_metrics_pod_scope_scrapes_peers(tmp_config):
         peer_httpd.server_close()
 
 
+def test_pod_scrape_fanout_bounded_at_64_peers(tmp_config, monkeypatch):
+    """ISSUE 16 satellite: the pod-scope scrape fan-out rides ONE
+    shared bounded pool — with 64 peers and 4 workers at most 4
+    scrapes are ever in flight, and every peer still gets scraped
+    (the pre-fix behavior built a fresh 8-worker executor per
+    request, an unbounded burst across concurrent requests)."""
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from zest_tpu.api.http_api import HttpApi
+
+    lk = threading.Lock()
+    in_flight, peak, served = [0], [0], [0]
+
+    class PeerHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            # Count the worker-held window ONLY: decrement before the
+            # response bytes go out — a worker can't start its next
+            # scrape until it has read this response, so peak is a true
+            # concurrent-worker reading, not racy by one against a
+            # handler still between write-return and decrement.
+            with lk:
+                in_flight[0] += 1
+                peak[0] = max(peak[0], in_flight[0])
+            _time.sleep(0.02)
+            with lk:
+                in_flight[0] -= 1
+                served[0] += 1
+            body = (b"# TYPE zest_x_total counter\n"
+                    b"zest_x_total 1\n")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), PeerHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+
+    monkeypatch.setattr(fleet, "_SCRAPE_POOL", None)
+    tmp_config.coop_index = 0
+    tmp_config.pod_scrape_workers = 4
+    a = HttpApi(tmp_config, pod_peers={
+        i: ("127.0.0.1", port) for i in range(1, 65)})
+    try:
+        text = a.pod_metrics_text()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        pool = fleet._SCRAPE_POOL
+        monkeypatch.setattr(fleet, "_SCRAPE_POOL", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+    assert served[0] == 64
+    assert peak[0] <= 4, f"scrape fan-out burst to {peak[0]} threads"
+    parsed = fleet.parse_prometheus(text)
+    assert parsed["zest_pod_hosts"]["samples"][()] == 65  # local + 64
+
+
 def test_cmd_debug_writes_report(api, tmp_path, monkeypatch):
     from zest_tpu import cli
 

@@ -1,0 +1,372 @@
+"""Fleet gossip (transfer.gossip; ISSUE 16): digest CRDT semantics,
+O(log N) anti-entropy convergence, bounded eviction, partition healing,
+the DCN piggyback wire path, and the ZEST_GOSSIP=0 wiring gate.
+
+The convergence sims are fully seeded (node RNGs are deterministic per
+host index) so every run replays identically — a flaky O(log N) bound
+would be worse than none.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from zest_tpu.config import Config
+from zest_tpu.transfer import gossip as gossip_mod
+from zest_tpu.transfer.gossip import (
+    COST_DCN,
+    COST_ICI,
+    COST_WAN,
+    KIND_SEEDER,
+    KIND_XORB,
+    MAX_DELTA_ENTRIES,
+    DcnGossipTransport,
+    GossipDigest,
+    GossipNode,
+    LoopbackMesh,
+    link_cost,
+    node_from_config,
+)
+
+
+def _xh(i: int) -> bytes:
+    return i.to_bytes(2, "big") * 16
+
+
+def _fleet(n: int, **kw) -> tuple[LoopbackMesh, list[GossipNode]]:
+    mesh = LoopbackMesh()
+    book = {i: (f"host{i}", 7000 + i) for i in range(n)}
+    nodes = [GossipNode(i, n, book, **kw) for i in range(n)]
+    for node in nodes:
+        mesh.register(node)
+    return mesh, nodes
+
+
+def _sweep(mesh: LoopbackMesh, nodes: list[GossipNode]) -> None:
+    for node in nodes:
+        node.tick(mesh)
+
+
+# ── Convergence (satellite: N ∈ {16, 64, 256}) ──
+
+
+@pytest.mark.parametrize("n", [16, 64, 256])
+def test_all_to_all_convergence_within_log_rounds(n):
+    """Every host announces its own xorb; the fleet must agree on all
+    N entries within O(log N) sweeps. The bound is 2·⌈log2 N⌉ —
+    generous for push-pull with fanout ⌈log2 N⌉, but still O(log N):
+    a linear-round regression (e.g. fanout accidentally 1-directional
+    or deltas dropped) blows through it immediately."""
+    mesh, nodes = _fleet(n)
+    for i, node in enumerate(nodes):
+        node.announce(_xh(i), 7000 + i)
+    bound = 2 * math.ceil(math.log2(n))
+    rounds = 0
+    while rounds < bound:
+        rounds += 1
+        _sweep(mesh, nodes)
+        if all(len(node.digest) == n for node in nodes):
+            break
+    assert all(len(node.digest) == n for node in nodes), (
+        f"not converged after {rounds} rounds at N={n}: "
+        f"{sorted(len(node.digest) for node in nodes)[:5]}...")
+    assert rounds <= bound
+    # Fanout really is O(log N).
+    assert nodes[0].fanout() == math.ceil(math.log2(n))
+
+
+def test_single_rumor_reaches_everyone(n=64):
+    mesh, nodes = _fleet(n)
+    nodes[17].announce(_xh(17), 7017)
+    for _ in range(math.ceil(math.log2(n))):
+        _sweep(mesh, nodes)
+    holders = [len(node.digest.holders(KIND_XORB, _xh(17).hex()))
+               for node in nodes]
+    assert all(h == 1 for h in holders)
+    # find_peers answers from the digest, excluding self.
+    assert nodes[0].find_peers(_xh(17)) == [("host17", 7017)]
+    assert nodes[17].find_peers(_xh(17)) == []
+
+
+def test_reannounce_bumps_sequence_and_wins():
+    """Merge keeps the max origin seq (CRDT): a re-announce with a new
+    port replaces the old payload everywhere, in any merge order."""
+    mesh, nodes = _fleet(4)
+    nodes[1].announce(_xh(1), 7001)
+    for _ in range(3):
+        _sweep(mesh, nodes)
+    nodes[1].announce(_xh(1), 9999)  # moved listen port
+    for _ in range(3):
+        _sweep(mesh, nodes)
+    for node in nodes:
+        holders = node.digest.holders(KIND_XORB, _xh(1).hex())
+        assert holders[1]["port"] == 9999
+
+
+# ── Bounded digest / eviction ──
+
+
+def test_eviction_keeps_bound_and_prefers_foreign():
+    d = GossipDigest(max_entries=8, own_origin=0)
+    for s in range(4):  # own entries (origin 0)
+        d.update(KIND_XORB, f"own{s}", 0, s + 1, {"port": 1})
+    for o in range(1, 101):  # 100 foreign origins
+        d.update(KIND_XORB, f"f{o}", o, 1, {"port": 1})
+    assert len(d) == 8
+    assert d.evicted == 96
+    # The origin-0 (own) entries all survived — only foreign evicted.
+    own = [ident for ident in d._entries if ident[2] == 0]
+    assert len(own) == 4
+
+
+def test_version_vector_survives_eviction():
+    """An evicted entry must NOT be re-merged at the same seq (the vv
+    remembers the origin reached it); a seq bump does re-enter."""
+    d = GossipDigest(max_entries=2)
+    d.update(KIND_XORB, "a", 1, 5, {"port": 1})
+    d.update(KIND_XORB, "b", 2, 5, {"port": 1})
+    d.update(KIND_XORB, "c", 3, 5, {"port": 1})  # evicts one
+    assert len(d) == 2 and d.evicted == 1
+    evicted_key = next(k for k in ("a", "b", "c") if not d.holders(
+        KIND_XORB, k))
+    origin = {"a": 1, "b": 2, "c": 3}[evicted_key]
+    assert not d.update(KIND_XORB, evicted_key, origin, 5, {"port": 1})
+    assert d.update(KIND_XORB, evicted_key, origin, 6, {"port": 2})
+
+
+def test_digest_memory_bound_at_1024_hosts():
+    """Acceptance: digest memory stays under the configured bound at
+    1024 hosts. 1024 origins × 64 announces each against a 4096-entry
+    bound — entries never exceed the bound and the byte estimate stays
+    under bound × a conservative per-entry ceiling."""
+    d = GossipDigest(max_entries=4096)
+    for origin in range(1024):
+        for s in range(64):
+            d.update(KIND_XORB, _xh(origin * 64 + s).hex(), origin,
+                     s + 1, {"port": 7000 + origin})
+    assert len(d) <= 4096
+    assert d.evicted == 1024 * 64 - 4096
+    per_entry_ceiling = 64 + len("xorb") + 64 + 32  # ident + payload
+    assert d.memory_bytes() <= 4096 * per_entry_ceiling
+    assert len(d.vv) == 1024  # vectors survive eviction
+
+
+def test_delta_is_capped():
+    d = GossipDigest()
+    for s in range(MAX_DELTA_ENTRIES + 100):
+        d.update(KIND_XORB, f"k{s}", 0, s + 1, {"port": 1})
+    rows = d.delta_since({})
+    assert len(rows) == MAX_DELTA_ENTRIES
+    # Oldest-seq first: repeated capped rounds drain monotonically.
+    seqs = [r[3] for r in rows]
+    assert seqs == sorted(seqs) and seqs[0] == 1
+
+
+# ── Partition then heal (satellite) ──
+
+
+class _PartitionedMesh(LoopbackMesh):
+    def __init__(self, split: int):
+        super().__init__()
+        self.split = split
+        self.healed = False
+
+    def exchange(self, peer, payload):
+        if not self.healed:
+            sender = payload.get("host", 0)
+            if (sender < self.split) != (peer < self.split):
+                return None  # WAN partition: exchange times out
+        return super().exchange(peer, payload)
+
+
+def test_partition_then_heal_reconverges():
+    n, split = 32, 16
+    mesh = _PartitionedMesh(split)
+    book = {i: (f"host{i}", 7000 + i) for i in range(n)}
+    nodes = [GossipNode(i, n, book) for i in range(n)]
+    for node in nodes:
+        mesh.register(node)
+    nodes[2].announce(_xh(2), 7002)    # left half
+    nodes[20].announce(_xh(20), 7020)  # right half
+    for _ in range(8):
+        _sweep(mesh, nodes)
+    # Each side converged on its own rumor, neither crossed the cut.
+    assert all(nodes[i].digest.holders(KIND_XORB, _xh(2).hex())
+               for i in range(split))
+    assert not any(nodes[i].digest.holders(KIND_XORB, _xh(2).hex())
+                   for i in range(split, n))
+    assert not any(nodes[i].digest.holders(KIND_XORB, _xh(20).hex())
+                   for i in range(split))
+    mesh.healed = True
+    for _ in range(2 * math.ceil(math.log2(n))):
+        _sweep(mesh, nodes)
+    for node in nodes:
+        assert node.digest.holders(KIND_XORB, _xh(2).hex())
+        assert node.digest.holders(KIND_XORB, _xh(20).hex())
+
+
+# ── Content-aware routing: link costs + nearest-first (tentpole c) ──
+
+
+def test_link_cost_table():
+    topo = (0, 0, 1, 1)
+    pods = (0, 0, 0, 1)
+    assert link_cost(0, 1, topo, pods) == COST_ICI
+    assert link_cost(0, 2, topo, pods) == COST_DCN
+    assert link_cost(2, 3, topo, pods) == COST_WAN  # pod beats slice
+    # Missing maps degrade conservatively.
+    assert link_cost(0, 1, None, None) == COST_DCN
+    assert link_cost(0, 1, topo, None) == COST_ICI
+
+
+def test_find_peers_orders_by_link_cost():
+    """A cold host's candidate list tries ICI, then DCN, then WAN —
+    the routing rule that sends a cold pod to the nearest warm pod."""
+    n = 8
+    topo = (0, 0, 1, 1, 0, 0, 1, 1)
+    pods = (0, 0, 0, 0, 1, 1, 1, 1)
+    mesh = LoopbackMesh()
+    book = {i: (f"host{i}", 7000 + i) for i in range(n)}
+    nodes = [GossipNode(i, n, book, topology=topo, pods=pods)
+             for i in range(n)]
+    for node in nodes:
+        mesh.register(node)
+    for holder in (6, 2, 1):  # WAN, DCN, ICI holders from host 0's view
+        nodes[holder].announce(_xh(42), 7000 + holder)
+    for _ in range(6):
+        _sweep(mesh, nodes)
+    assert nodes[0].who_has(_xh(42)) == [1, 2, 6]
+    assert nodes[0].find_peers(_xh(42)) == [
+        ("host1", 7001), ("host2", 7002), ("host6", 7006)]
+    # From inside the other pod the same holders sort WAN-last too.
+    assert nodes[7].who_has(_xh(42)) == [6, 1, 2]
+
+
+def test_seeder_state_spreads():
+    mesh, nodes = _fleet(4)
+    nodes[3].set_seeder_state("draining", until=123)
+    for _ in range(3):
+        _sweep(mesh, nodes)
+    st = nodes[0].digest.holders(KIND_SEEDER, "3")
+    assert st[3]["state"] == "draining" and st[3]["until"] == 123
+
+
+# ── DCN piggyback (tentpole a: no new listener, no new port) ──
+
+
+def test_gossip_over_real_dcn_wire(tmp_path):
+    from zest_tpu.transfer.dcn import DcnPool, DcnServer
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 dcn_port=0)
+    server_node = GossipNode(1, 2, {})
+    server_node.announce(_xh(9), 7555)
+    srv = DcnServer(cfg)
+    srv.attach_gossip(server_node)
+    port = srv.start()
+    pool = DcnPool()
+    try:
+        client = GossipNode(0, 2, {1: ("127.0.0.1", port)})
+        transport = DcnGossipTransport(pool, {1: ("127.0.0.1", port)})
+        fresh = client.tick(transport)
+        assert fresh == 1
+        assert client.find_peers(_xh(9)) == [("127.0.0.1", 7555)]
+        # Push half: the server learned the client's announcements too.
+        client.announce(_xh(10), 7010)
+        client.tick(transport)
+        assert server_node.digest.holders(KIND_XORB, _xh(10).hex())
+    finally:
+        pool.close()
+        srv.shutdown()
+
+
+def test_pre_gossip_server_is_unavailable_not_fatal(tmp_path):
+    """A server with no node attached answers GOSSIP with the legacy
+    ERROR — the transport treats the peer as gossip-unavailable while
+    chunk RPCs keep working (mixed-fleet rollout)."""
+    from zest_tpu.transfer.dcn import DcnPool, DcnServer, GossipUnavailable
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 dcn_port=0)
+    srv = DcnServer(cfg)  # no attach_gossip
+    port = srv.start()
+    pool = DcnPool()
+    try:
+        with pytest.raises(GossipUnavailable):
+            pool.gossip_exchange("127.0.0.1", port,
+                                 {"host": 0, "vv": {}, "delta": []})
+        node = GossipNode(0, 2, {1: ("127.0.0.1", port)})
+        transport = DcnGossipTransport(pool, {1: ("127.0.0.1", port)})
+        assert node.tick(transport) == 0  # best-effort, no raise
+    finally:
+        pool.close()
+        srv.shutdown()
+
+
+# ── Wiring gate (acceptance: ZEST_GOSSIP=0 bit-for-bit) ──
+
+
+def test_node_from_config_gossip_off(tmp_path):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    cfg.gossip_enabled = False
+    assert node_from_config(cfg, 0, 4, None) is None
+
+
+def test_node_from_config_carries_knobs(tmp_path):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    cfg.coop_topology = (0, 0, 1, 1)
+    cfg.coop_pods = (0, 0, 1, 1)
+    cfg.gossip_fanout = 3
+    cfg.gossip_max_entries = 128
+    node = node_from_config(cfg, 2, 4, {i: ("h", 1) for i in range(4)})
+    assert node is not None
+    assert node.fanout() == 3
+    assert node.digest.max_entries == 128
+    assert node.cost_to(3) == COST_ICI
+    assert node.cost_to(0) == COST_WAN
+
+
+def test_swarm_announce_is_bootstrap_only_with_gossip(tmp_path):
+    """With a node attached, the tracker sees ONE announce per swarm
+    (the bootstrap seed); refreshes ride the digest. Detached
+    (ZEST_GOSSIP=0) the tracker sees every announce — bit-for-bit the
+    old behavior — and the stats schema carries no gossip key."""
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    class RecordingSource:
+        def __init__(self):
+            self.announces = []
+
+        def find_peers(self, info_hash):
+            return []
+
+        def announce(self, info_hash, port):
+            self.announces.append(info_hash)
+
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest")
+    xorb = _xh(5)
+
+    tracker = RecordingSource()
+    plain = SwarmDownloader(cfg, peer_sources=[tracker])
+    for _ in range(3):
+        plain.announce_available(xorb, xorb.hex())
+    assert len(tracker.announces) == 3  # tracker-only: every announce
+    assert "gossip" not in plain.summary()
+    plain.close()
+
+    from zest_tpu.p2p.peer_id import compute_info_hash
+
+    tracker = RecordingSource()
+    node = GossipNode(0, 2, {})
+    sw = SwarmDownloader(cfg, peer_sources=[tracker])
+    sw.attach_gossip(node)
+    assert sw.peer_sources[0] is node  # primary discovery source
+    for _ in range(3):
+        sw.announce_available(xorb, xorb.hex())
+    assert len(tracker.announces) == 1  # bootstrap seed only
+    assert node.digest.holders(KIND_XORB,
+                               compute_info_hash(xorb).hex())
+    assert sw.summary()["gossip"]["entries"] == 1
+    sw.close()

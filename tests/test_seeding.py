@@ -377,9 +377,14 @@ def test_stalled_reader_struck_with_distinct_kind(hub, tmp_path):
             stream.recv_handshake()
             stream.send_raw(wire.encode_extended(
                 0, bep_xet.make_ext_handshake(LOCAL_UT_XET_ID, 7778)))
-            stream.send_raw(bep_xet.encode_framed(
-                LOCAL_UT_XET_ID,
-                bep_xet.ChunkRequest(1, xorb_hash, 0, n)))
+            # Pipeline enough requests that the aggregate response
+            # exceeds any autotuned send buffer (tcp_wmem caps at
+            # ~4 MB): one ~1.5 MB response alone can be absorbed
+            # whole by the kernel, and then the send never blocks.
+            for rid in range(1, 7):
+                stream.send_raw(bep_xet.encode_framed(
+                    LOCAL_UT_XET_ID,
+                    bep_xet.ChunkRequest(rid, xorb_hash, 0, n)))
             # ...and never read: the server's send must hit its
             # deadline and attribute the stall to US.
             deadline = time.monotonic() + 10

@@ -430,6 +430,17 @@ def test_quarantine_transition_triggers_reannounce(tmp_path, clock):
 
     clock.t += 10.1
     health.partition([("bad", 9)])             # probation observation
+    # Within the coalescing window the transition still fires, but the
+    # per-swarm dedup skips the tracker round trip — a quarantine
+    # storm re-registers each swarm once per window, not once per
+    # transition (ISSUE 16 satellite).
+    time.sleep(0.2)
+    assert len(source.announces) == base + 1
+    assert swarm.stats.reannounces == 1
+
+    clock.t += swarm_mod.REANNOUNCE_WINDOW_S + 0.1
+    for _ in range(2):
+        health.record_failure(("bad", 9))      # re-trip past the window
     assert _eventually(
         lambda: len(source.announces) >= base + 2
         and swarm.stats.reannounces == 2), (

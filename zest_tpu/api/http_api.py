@@ -42,7 +42,6 @@ from __future__ import annotations
 import json
 import threading
 import urllib.request
-from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -336,12 +335,16 @@ class HttpApi:
                 except Exception as exc:  # noqa: BLE001 - per-host report
                     return label, None, str(exc)
 
-            with ThreadPoolExecutor(max_workers=min(8, len(peers))) as ex:
-                for label, doc, err in ex.map(scrape, peers.items()):
-                    if doc is not None:
-                        docs[label] = doc
-                    else:
-                        errors[label] = err
+            # Shared bounded pool (telemetry.fleet.scrape_pool): the
+            # fan-out is capped process-wide, not per request — at
+            # hundreds of peers concurrent pod-scope requests queue
+            # instead of bursting a thread per peer each.
+            pool = fleet.scrape_pool(self.cfg.pod_scrape_workers)
+            for label, doc, err in pool.map(scrape, peers.items()):
+                if doc is not None:
+                    docs[label] = doc
+                else:
+                    errors[label] = err
         merged = telemetry.timeline.merge_timelines(
             docs, reference=local_label)
         if errors:
@@ -574,12 +577,12 @@ class HttpApi:
                 except Exception as exc:  # noqa: BLE001 - per-host report
                     return label, None, str(exc)
 
-            with ThreadPoolExecutor(max_workers=min(8, len(peers))) as ex:
-                for label, text, err in ex.map(scrape, peers.items()):
-                    if text is not None:
-                        texts[label] = text
-                    else:
-                        errors[label] = err
+            pool = fleet.scrape_pool(self.cfg.pod_scrape_workers)
+            for label, text, err in pool.map(scrape, peers.items()):
+                if text is not None:
+                    texts[label] = text
+                else:
+                    errors[label] = err
         return fleet.aggregate_prometheus(texts, errors)
 
     def pull_events(self, repo_id: str, revision: str, device: str | None,

@@ -50,7 +50,7 @@ import numpy as np
 
 __all__ = ["llama_checkpoint_files", "mutate_tensors", "bench_gb_pull",
            "bench_coop_pull", "bench_delta_pull", "bench_swarm",
-           "bench_tenants"]
+           "bench_tenants", "bench_fleet"]
 
 
 def mutate_tensors(tensors: dict, fraction: float, seed: int = 1) -> None:
@@ -1072,6 +1072,351 @@ def bench_gb_pull(gb: float = 2.0, runs: int = 3,
         "fixture_gen_s": round(t_gen, 1),
         "fixture_encode_s": round(t_encode, 1),
     }
+
+
+def bench_fleet(fleet_sizes: tuple[int, ...] = (256, 512, 1024),
+                pod_size: int = 64, model_gb: float = 8.0,
+                n_units: int = 4096,
+                ici_bps: float = 12.5e9, dcn_bps: float = 3.1e9,
+                pod_wan_bps: float = 625e6, cdn_bps: float = 1.25e9,
+                ici_rtt_s: float = 0.0001, dcn_rtt_s: float = 0.001,
+                wan_rtt_s: float = 0.05, cdn_rtt_s: float = 0.08,
+                hbm_bps: float = 50e9,
+                gossip_keys: int = 64,
+                out_path: str | None = None) -> dict:
+    """Fleet-scale topology sim (ISSUE 16 tentpole d): 256/512/1024
+    hosts in ``pod_size``-host pods over a 3-level link matrix
+    (ICI < DCN < WAN < CDN), driving the REAL components — CoopPlan
+    over synthetic units, CollectiveSchedule (flat hypercube vs the
+    federated 3-stage schedule), and live GossipNodes over a
+    LoopbackMesh — through an analytic timing model. Nothing at this
+    scale fits real sockets in a bench window; what's real here is
+    every *decision* (ownership, phase schedules, gateway election,
+    gossip spread, cost-ordered routing), and what's modeled is only
+    the clock.
+
+    Timing model (extends PR-13's DcnServer shaping to a matrix):
+    synchronous-round relaxation — a pull phase on host ``h`` from
+    partner ``p`` completes at ``max(t[h], t[p]) + rtt(link) +
+    bytes/bw(link)``, with link class derived from the PHYSICAL
+    (topology, pods) placement for flat AND federated alike (the flat
+    schedule doesn't know about pods; its bytes still cross them — that
+    is exactly the comparison). WAN capacity is a per-pod uplink shared
+    by the pod's hosts, applied as an aggregate congestion floor
+    (inbound WAN bytes / uplink rate) — the WAN-bottlenecked regime the
+    ≥1.3× federated gate is judged in. The CDN is one shared origin:
+    the 1/N plan-share fetch walls at ``model_bytes / cdn_bps``.
+
+    Per fleet size the artifact records: peer_served_ratio (exchange +
+    cold-pod bytes over everything incl. CDN), CDN egress per host (the
+    cost axis — total/N, decreasing by construction *because* the plan
+    fetches each unit from origin exactly once fleet-wide), p99
+    time-to-HBM for the flat and federated schedules and their ratio,
+    per-pod WAN bytes for both, gossip convergence (sweeps to full
+    who-has coverage vs the 2·ceil(log2 N) bound, digest memory vs its
+    configured cap), and the cold-pod join (a fresh ``pod_size``-host
+    pod routing every warm-held xorb to the nearest warm pod over WAN —
+    zero CDN bytes). Gates live in-artifact under ``gates`` so
+    scripts/bench_trend.py locks the result in."""
+    import math
+
+    from zest_tpu.cas.reconstruction import ChunkRange, FetchInfo
+    from zest_tpu.transfer.collective import (CollectiveSchedule,
+                                              elect_gateways)
+    from zest_tpu.transfer.coop import CoopPlan
+    from zest_tpu.transfer.gossip import (DEFAULT_MAX_ENTRIES,
+                                          GossipNode, LoopbackMesh)
+
+    model_bytes = int(model_gb * 1e9)
+    unit_bytes = model_bytes // n_units
+
+    def phys(a: int, b: int, topo, pods) -> tuple[str, float, float]:
+        """(link class, rtt, per-flow bps) from PHYSICAL placement."""
+        if pods[a] != pods[b]:
+            return "wan", wan_rtt_s, pod_wan_bps
+        if topo[a] != topo[b]:
+            return "dcn", dcn_rtt_s, dcn_bps
+        return "ici", ici_rtt_s, ici_bps
+
+    def walk(scheds: dict, t0: float, bb: dict, topo, pods,
+             gateways=None):
+        """Relaxation walk over every host's schedule. Flat hypercube
+        and federated stages A/B are mutual-pair lockstep (partner's
+        partner is self — both sides agree on the start); federated
+        stage C is a binomial tree processed in broadcast order (the
+        parent's time is final before any child reads it). Returns
+        (per-host completion, wan bytes into each pod, link byte
+        totals)."""
+        t = {h: t0 for h in scheds}
+        wan_in: dict[int, int] = {}
+        link_bytes = {"ici": 0, "dcn": 0, "wan": 0}
+
+        def pull(h: int, ph) -> None:
+            nbytes = sum(bb[o] for o in ph.owners)
+            link, rtt, bps = phys(h, ph.partner, topo, pods)
+            link_bytes[link] += nbytes
+            if link == "wan":
+                wan_in[pods[h]] = wan_in.get(pods[h], 0) + nbytes
+            start = max(t[h], t[ph.partner])
+            t[h] = start + rtt + nbytes / bps
+
+        kinds = {s.kind for s in scheds.values()}
+        if kinds == {"hypercube"}:
+            for k in range(len(next(iter(scheds.values())).phases)):
+                prev = dict(t)
+                for h, s in scheds.items():
+                    ph = s.phases[k]
+                    nbytes = sum(bb[o] for o in ph.owners)
+                    link, rtt, bps = phys(h, ph.partner, topo, pods)
+                    link_bytes[link] += nbytes
+                    if link == "wan":
+                        wan_in[pods[h]] = wan_in.get(pods[h], 0) + nbytes
+                    t[h] = (max(prev[h], prev[ph.partner])
+                            + rtt + nbytes / bps)
+        elif kinds == {"federated"}:
+            pod_ids = sorted({pods[h] for h in scheds})
+            members = {p: sorted(h for h in scheds if pods[h] == p)
+                       for p in pod_ids}
+            k_a = max(0, len(members[pod_ids[0]]).bit_length() - 1)
+            k_b = max(0, len(pod_ids).bit_length() - 1)
+            # Stage A: lockstep within each pod.
+            for k in range(k_a):
+                prev = dict(t)
+                for h, s in scheds.items():
+                    ph = s.phases[k]
+                    nbytes = sum(bb[o] for o in ph.owners)
+                    link, rtt, bps = phys(h, ph.partner, topo, pods)
+                    link_bytes[link] += nbytes
+                    t[h] = (max(prev[h], prev[ph.partner])
+                            + rtt + nbytes / bps)
+            # Stage B: lockstep over the gateways only.
+            for k in range(k_b):
+                prev = dict(t)
+                for gw in gateways.values():
+                    ph = scheds[gw].phases[k_a + k]
+                    nbytes = sum(bb[o] for o in ph.owners)
+                    link, rtt, bps = phys(gw, ph.partner, topo, pods)
+                    link_bytes[link] += nbytes
+                    if link == "wan":
+                        wan_in[pods[gw]] = (wan_in.get(pods[gw], 0)
+                                            + nbytes)
+                    t[gw] = (max(prev[gw], prev[ph.partner])
+                             + rtt + nbytes / bps)
+            # Stage C: binomial broadcast, parents before children —
+            # the gateway-first member order IS the broadcast order.
+            for p in pod_ids:
+                gw = gateways[p]
+                for h in [m for m in members[p] if m != gw]:
+                    pull(h, scheds[h].phases[k_a])
+        else:  # pragma: no cover - the sim only builds these two
+            raise ValueError(f"unexpected schedule kinds {kinds}")
+        return t, wan_in, link_bytes
+
+    out: dict = {
+        "bench": "fleet",
+        "pod_size": pod_size,
+        "model_bytes": model_bytes,
+        "units": n_units,
+        "links": {
+            "ici": {"bps": ici_bps, "rtt_s": ici_rtt_s},
+            "dcn": {"bps": dcn_bps, "rtt_s": dcn_rtt_s},
+            "wan": {"bps": pod_wan_bps, "rtt_s": wan_rtt_s,
+                    "shared": "per-pod uplink"},
+            "cdn": {"bps": cdn_bps, "rtt_s": cdn_rtt_s,
+                    "shared": "one origin"},
+        },
+        "fleets": {},
+    }
+    fleets = out["fleets"]
+
+    for n in fleet_sizes:
+        n_pods = n // pod_size
+        pods = tuple(h // pod_size for h in range(n))
+        # Two ICI slices per pod — the full 3-level matrix.
+        topo = tuple(2 * (h // pod_size)
+                     + (h % pod_size >= pod_size // 2)
+                     for h in range(n))
+        units = [(f"{i:08x}",
+                  FetchInfo(url=f"sim://u{i}", url_range_start=0,
+                            url_range_end=unit_bytes,
+                            range=ChunkRange(0, 1)))
+                 for i in range(n_units)]
+        plan = CoopPlan.build([], n, units=units)
+        bb = plan.bytes_per_host()
+        gateways = elect_gateways(plan, pods)
+
+        # ── Stage 1: the 1/N CDN fetch (shared origin). ──
+        fetch_wall = model_bytes / cdn_bps + cdn_rtt_s
+
+        # ── Stage 2: flat (pod-blind hypercube) vs federated. ──
+        flat = {h: CollectiveSchedule.build(plan, h, (0,) * n)
+                for h in plan.alive}
+        fed = {h: CollectiveSchedule.build(plan, h, topo, pods=pods)
+               for h in plan.alive}
+        results = {}
+        for tag, scheds in (("flat", flat), ("federated", fed)):
+            t, wan_in, link_bytes = walk(
+                scheds, fetch_wall, bb, topo, pods,
+                gateways=gateways if tag == "federated" else None)
+            floor = {p: fetch_wall + b / pod_wan_bps
+                     for p, b in wan_in.items()}
+            done = sorted(max(t[h], floor.get(pods[h], 0.0))
+                          + model_bytes / hbm_bps
+                          for h in plan.alive)
+            results[tag] = {
+                "schedule": next(iter(scheds.values())).kind,
+                "phases_max": max(len(s.phases)
+                                  for s in scheds.values()),
+                "p50_time_to_hbm_s": round(done[len(done) // 2], 3),
+                "p99_time_to_hbm_s": round(
+                    done[min(n - 1, int(0.99 * (n - 1)))], 3),
+                "wan_bytes_total": sum(wan_in.values()),
+                "wan_bytes_per_pod_max": max(wan_in.values(), default=0),
+                "link_bytes": link_bytes,
+            }
+        speedup = (results["flat"]["p99_time_to_hbm_s"]
+                   / results["federated"]["p99_time_to_hbm_s"])
+
+        # ── Stage 3: gossip spread + content-aware cold-pod routing
+        # (REAL GossipNodes; the clock here is sweeps, not seconds). ──
+        book = {h: ("sim", 7000 + h) for h in range(n)}
+        mesh = LoopbackMesh()
+        nodes = [GossipNode(h, n, book, topology=topo, pods=pods)
+                 for h in range(n)]
+        for node in nodes:
+            mesh.register(node)
+        # Warm holders: key j announced by ONE host, pods round-robin —
+        # the sparse index shape (most xorbs live in few places) whose
+        # fleet-wide spread the sweep count measures.
+        keys = [bytes.fromhex(f"{j:064x}") for j in range(gossip_keys)]
+        for j in range(gossip_keys):
+            holder = ((j % n_pods) * pod_size
+                      + (j // n_pods) % pod_size)
+            nodes[holder].announce(keys[j], 6881)
+        bound = 2 * math.ceil(math.log2(n))
+        sweeps = 0
+        while sweeps < bound:
+            sweeps += 1
+            for node in nodes:
+                node.tick(mesh)
+            if all(node.who_has(k)
+                   for node in nodes for k in keys):
+                break
+        converged = all(
+            node.who_has(k) for node in nodes for k in keys)
+        mem_max = max(node.digest.memory_bytes() for node in nodes)
+        entries_max = max(len(node.digest) for node in nodes)
+        gossip_block = {
+            "fanout": nodes[0].fanout(),
+            "sweeps_to_converge": sweeps,
+            "sweep_bound": bound,
+            "converged": converged,
+            "entries_max": entries_max,
+            "digest_memory_bytes_max": mem_max,
+            "digest_max_entries": DEFAULT_MAX_ENTRIES,
+            "bytes_out_total": sum(node.bytes_out for node in nodes),
+        }
+
+        # Cold pod join: pod_size fresh hosts (a brand-new pod) learn
+        # the index via anti-entropy, then route every warm-held xorb
+        # to the NEAREST warm holder — WAN beats CDN in the cost table,
+        # so origin sees zero bytes for anything the fleet holds.
+        n2 = n + pod_size
+        pods2 = pods + (n_pods,) * pod_size
+        topo2 = topo + tuple(
+            2 * n_pods + (i >= pod_size // 2) for i in range(pod_size))
+        book2 = dict(book)
+        book2.update({n + i: ("sim", 7000 + n + i)
+                      for i in range(pod_size)})
+        cold = [GossipNode(n + i, n2, book2, topology=topo2,
+                           pods=pods2) for i in range(pod_size)]
+        for node in cold:
+            mesh.register(node)
+        cold_sweeps = 0
+        while cold_sweeps < bound:
+            cold_sweeps += 1
+            for node in cold:
+                node.tick(mesh)
+            if all(node.who_has(k) for node in cold for k in keys):
+                break
+        key_bytes = model_bytes // gossip_keys
+        cold_cdn = cold_peer = 0
+        wan_routed = True
+        for node in cold:
+            for k in keys:
+                holders = node.who_has(k)
+                if holders:
+                    cold_peer += key_bytes
+                    _link, _rtt, _bps = phys(
+                        node.host_index, holders[0], topo2, pods2)
+                    wan_routed &= _link == "wan"
+                else:
+                    cold_cdn += key_bytes
+        cold_block = {
+            "hosts": pod_size,
+            "sweeps_to_index": cold_sweeps,
+            "warm_served_bytes": cold_peer,
+            "cdn_bytes_for_warm_held": cold_cdn,
+            "nearest_link": "wan" if wan_routed else "mixed",
+            "pull_s_est": round(
+                model_bytes / pod_wan_bps + wan_rtt_s, 3),
+        }
+
+        # ── Byte-flow ledger → peer_served_ratio + CDN egress. ──
+        peer_bytes = (sum(results["federated"]["link_bytes"].values())
+                      + cold_peer)
+        cdn_total = model_bytes + cold_cdn
+        ratio = peer_bytes / (peer_bytes + cdn_total)
+        fleets[str(n)] = {
+            "hosts": n,
+            "pods": n_pods,
+            "gateways": len(gateways),
+            "plan_skew": round(plan.skew(), 4),
+            "fetch_wall_s": round(fetch_wall, 3),
+            "peer_served_ratio": round(ratio, 4),
+            "peer_bytes": peer_bytes,
+            "cdn_egress_bytes": cdn_total,
+            "cdn_egress_bytes_per_host": cdn_total // n,
+            "flat": results["flat"],
+            "federated": results["federated"],
+            "federated_speedup": round(speedup, 2),
+            "gossip": gossip_block,
+            "cold_pod": cold_block,
+        }
+
+    sizes = [str(s) for s in fleet_sizes]
+    ratios = [fleets[s]["peer_served_ratio"] for s in sizes]
+    egress = [fleets[s]["cdn_egress_bytes_per_host"] for s in sizes]
+    out["gates"] = {
+        "peer_served_ratio_min": min(ratios),
+        "peer_served_ratio_ge_0.90": min(ratios) >= 0.90,
+        "peer_served_flat_pm_0.03": max(ratios) - min(ratios) <= 0.03,
+        "cdn_egress_per_host_decreasing": all(
+            a > b for a, b in zip(egress, egress[1:])),
+        "federated_speedup_min": min(
+            fleets[s]["federated_speedup"] for s in sizes),
+        "federated_speedup_ge_1.3": all(
+            fleets[s]["federated_speedup"] >= 1.3 for s in sizes),
+        "gossip_converged_within_bound": all(
+            fleets[s]["gossip"]["converged"]
+            and (fleets[s]["gossip"]["sweeps_to_converge"]
+                 <= fleets[s]["gossip"]["sweep_bound"])
+            for s in sizes),
+        "digest_memory_bounded": all(
+            fleets[s]["gossip"]["entries_max"]
+            <= fleets[s]["gossip"]["digest_max_entries"]
+            for s in sizes),
+        "cold_pod_zero_cdn_for_warm": all(
+            fleets[s]["cold_pod"]["cdn_bytes_for_warm_held"] == 0
+            for s in sizes),
+    }
+    out["gates"]["all_ok"] = all(
+        v for k, v in out["gates"].items()
+        if isinstance(v, bool))
+    if out_path:
+        pathlib.Path(out_path).write_text(json.dumps(out, indent=2)
+                                          + "\n")
+    return out
 
 
 def bench_tenants(gb: float = 0.064, k_tenants: int = 6,

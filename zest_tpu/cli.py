@@ -108,7 +108,31 @@ def _build_swarm(cfg: Config, tracker: str | None = None, dht: bool = True):
 
         sources.append(TrackerClient(tracker, peer_id_mod.generate(),
                                      listen_port=cfg.listen_port))
-    return SwarmDownloader(cfg, peer_sources=sources)
+    swarm = SwarmDownloader(cfg, peer_sources=sources)
+    _attach_fleet_gossip(cfg, swarm)
+    return swarm
+
+
+def _attach_fleet_gossip(cfg: Config, swarm, dcn_server=None):
+    """Fleet gossip wiring (transfer.gossip; ISSUE 16): with a coop
+    identity (host index + fleet size) and ZEST_GOSSIP on, the node
+    becomes the swarm's primary discovery source (tracker/DHT demote
+    to bootstrap announce) and, when a DcnServer is given, answers
+    anti-entropy exchanges on its listener. Returns the node or None
+    (no identity / ZEST_GOSSIP=0 — tracker-only, bit-for-bit)."""
+    if cfg.coop_index is None or not cfg.coop_hosts \
+            or cfg.coop_hosts < 2:
+        return None
+    from zest_tpu.transfer.gossip import node_from_config
+
+    node = node_from_config(cfg, cfg.coop_index, cfg.coop_hosts,
+                            cfg.coop_addrs or None)
+    if node is None:
+        return None
+    swarm.attach_gossip(node)
+    if dcn_server is not None:
+        dcn_server.attach_gossip(node)
+    return node
 
 
 # ── Commands ──
@@ -428,6 +452,33 @@ def cmd_serve(args) -> int:
     except OSError as exc:
         print(f"DCN listener disabled (port {cfg.dcn_port}: {exc})")
 
+    # Fleet gossip (ISSUE 16): the daemon both answers anti-entropy
+    # exchanges (piggybacked on the DCN listener) and runs the active
+    # tick loop against its coop peers' DCN endpoints.
+    gossip_stop = None
+    gossip_node = _attach_fleet_gossip(cfg, swarm, dcn_server)
+    if gossip_node is not None and cfg.coop_addrs:
+        import threading
+
+        from zest_tpu.transfer.dcn import DcnPool
+        from zest_tpu.transfer.gossip import DcnGossipTransport
+
+        transport = DcnGossipTransport(DcnPool(), cfg.coop_addrs)
+        gossip_stop = threading.Event()
+
+        def _gossip_loop():
+            while not gossip_stop.wait(cfg.gossip_interval_s):
+                try:
+                    gossip_node.tick(transport)
+                except Exception:  # noqa: BLE001 - gossip best-effort
+                    pass
+
+        threading.Thread(target=_gossip_loop, name="zest-gossip",
+                         daemon=True).start()
+        print(f"gossip: fanout {gossip_node.fanout()} over "
+              f"{len(cfg.coop_addrs)} peers, "
+              f"every {cfg.gossip_interval_s:g}s")
+
     _write_pid_file(cfg)
     api = HttpApi(cfg, bt_server=bt, registry=registry,
                   dcn_server=dcn_server, swarm=swarm)
@@ -454,6 +505,8 @@ def cmd_serve(args) -> int:
     try:
         api.shutdown_event.wait()
     finally:
+        if gossip_stop is not None:
+            gossip_stop.set()
         api.close()
         dcn_server.shutdown()
         bt.shutdown()

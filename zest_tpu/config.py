@@ -355,6 +355,20 @@ class Config:
     # from the JAX runtime, else one flat slice.
     coop_collective: bool = True
     coop_topology: tuple[int, ...] | None = None
+    # Fleet topology (ISSUE 16): ``coop_pods`` is the pod id per coop
+    # host (ZEST_COOP_PODS="0,0,1,1", same grammar as the slice map) —
+    # names the third link class (wan, cross-pod) and arms the
+    # federated gateway schedule; None = one pod, bit-for-bit the
+    # PR-13 shapes. ``gossip_enabled`` is the rollback knob
+    # (ZEST_GOSSIP, strict 0/1) — 0 restores tracker-only announce
+    # bit-for-bit; ``gossip_fanout`` peers per anti-entropy tick
+    # (0 = auto, ceil(log2 N)); ``gossip_max_entries`` bounds the
+    # digest; ``gossip_interval_s`` is the tick cadence.
+    coop_pods: tuple[int, ...] | None = None
+    gossip_enabled: bool = True
+    gossip_fanout: int = 0
+    gossip_max_entries: int = 65536
+    gossip_interval_s: float = 5.0
     # Pod fleet observability (telemetry.fleet; ISSUE 7): HTTP API
     # endpoints of the OTHER hosts' daemons, ``ZEST_POD_PEERS=
     # "1=hostB:9847,2=hostC:9847"`` (same grammar as coop addrs). The
@@ -362,6 +376,10 @@ class Config:
     # and ``zest trace --coop`` gathers their ``/v1/trace`` snapshots.
     pod_peers: dict[int, tuple[str, int]] = dataclasses.field(
         default_factory=dict)
+    # Pod-scope scrape fan-out bound (ISSUE 16 satellite): worker cap
+    # for /v1/metrics?scope=pod and /v1/timeline?scope=pod peer
+    # scrapes — one shared process-wide pool, not per-request bursts.
+    pod_scrape_workers: int = 8
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     endpoint: str = "https://huggingface.co"
     # Landing dtype for --device=tpu (None = checkpoint dtype; "bf16"
@@ -542,7 +560,19 @@ class Config:
             coop_topology=(parse_topology(env["ZEST_COOP_TOPOLOGY"])
                            if env.get("ZEST_COOP_TOPOLOGY", "").strip()
                            else None),
+            coop_pods=(parse_topology(env["ZEST_COOP_PODS"])
+                       if env.get("ZEST_COOP_PODS", "").strip()
+                       else None),
+            gossip_enabled=_strict_bool(
+                "ZEST_GOSSIP", env.get("ZEST_GOSSIP", "1")),
+            gossip_fanout=_strict_nonneg_int(env, "ZEST_GOSSIP_FANOUT"),
+            gossip_max_entries=_strict_nonneg_int(
+                env, "ZEST_GOSSIP_MAX", default=65536, floor=1),
+            gossip_interval_s=_strict_pos_float(
+                env, "ZEST_GOSSIP_INTERVAL_S", 5.0, floor=0.05),
             pod_peers=_parse_coop_addrs(env.get("ZEST_POD_PEERS", "")),
+            pod_scrape_workers=_strict_nonneg_int(
+                env, "ZEST_POD_SCRAPE_WORKERS", default=8, floor=1),
             mesh=MeshConfig.from_env(env),
             endpoint=env.get("HF_ENDPOINT", "https://huggingface.co"),
             land_dtype=env.get("ZEST_TPU_DTYPE") or None,

@@ -112,6 +112,12 @@ class HealthRegistry:
         self.quarantine_events = 0
         self._listeners: list = []
 
+    def now(self) -> float:
+        """This registry's clock (``time_fn``) — shared with the
+        swarm's re-announce dedup window so simulated-time tests drive
+        both from one fake clock."""
+        return self._time()
+
     def _peer_locked(self, addr: Addr) -> PeerHealth:
         peer = self._peers.get(addr)
         if peer is None:

@@ -44,8 +44,10 @@ from __future__ import annotations
 import hashlib
 import json
 import math
+import os
 import re
 import statistics
+import threading
 import urllib.request
 
 __all__ = [
@@ -246,6 +248,33 @@ def host_coverage_s(doc: dict, host, root_name: str | None = None):
     return total / 1e6, root.get("dur", 0.0) / 1e6
 
 
+_SCRAPE_POOL = None
+_SCRAPE_POOL_LOCK = threading.Lock()
+
+
+def scrape_pool(workers: int | None = None):
+    """The process-shared bounded executor behind every pod-scope
+    scrape fan-out (``/v1/metrics?scope=pod``, ``/v1/timeline?scope=
+    pod``, ``gather_traces``). One pool for the whole process — at
+    hundreds of peers, concurrent pod-scope requests queue on these
+    workers instead of bursting a fresh thread per peer per request
+    (ISSUE 16 satellite). Sized on first use: an explicit ``workers``
+    (Config.pod_scrape_workers) wins, else ZEST_POD_SCRAPE_WORKERS,
+    else 8; later calls reuse the existing pool regardless."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    global _SCRAPE_POOL
+    with _SCRAPE_POOL_LOCK:
+        if _SCRAPE_POOL is None:
+            if workers is None:
+                raw = os.environ.get("ZEST_POD_SCRAPE_WORKERS", "")
+                workers = int(raw) if raw.strip() else 8
+            _SCRAPE_POOL = ThreadPoolExecutor(
+                max_workers=max(1, int(workers)),
+                thread_name_prefix="zest-podscrape")
+        return _SCRAPE_POOL
+
+
 def gather_traces(api_addrs: dict, timeout_s: float = 5.0):
     """Snapshot every host's live tracer over ``GET /v1/trace``.
 
@@ -253,9 +282,8 @@ def gather_traces(api_addrs: dict, timeout_s: float = 5.0):
     ``(docs, errors)`` — hosts that fail to answer (daemon down, no
     tracer armed) land in ``errors`` instead of failing the gather;
     a merged trace of the hosts that DID answer is still the operator's
-    best artifact. Scrapes run concurrently: N dead peers must cost
-    one timeout, not N."""
-    from concurrent.futures import ThreadPoolExecutor
+    best artifact. Scrapes run concurrently on the shared bounded
+    :func:`scrape_pool`: N dead peers must cost one timeout, not N."""
 
     def scrape(item):
         key, (host, port) = item
@@ -274,12 +302,11 @@ def gather_traces(api_addrs: dict, timeout_s: float = 5.0):
     items = sorted(api_addrs.items(), key=lambda i: str(i))
     if not items:
         return docs, errors
-    with ThreadPoolExecutor(max_workers=min(8, len(items))) as ex:
-        for key, doc, err in ex.map(scrape, items):
-            if doc is not None:
-                docs[key] = doc
-            else:
-                errors[key] = err
+    for key, doc, err in scrape_pool().map(scrape, items):
+        if doc is not None:
+            docs[key] = doc
+        else:
+            errors[key] = err
     return docs, errors
 
 

@@ -99,6 +99,7 @@ _BARRIER_SLEEP_CAP_S = 1.0
 
 LINK_ICI = "ici"
 LINK_DCN = "dcn"
+LINK_WAN = "wan"
 
 
 class CollectiveUnavailable(RuntimeError):
@@ -140,6 +141,42 @@ def slice_topology(n_hosts: int, cfg=None,
     return topo if topo is not None else (0,) * n_hosts
 
 
+def pod_topology(n_hosts: int, cfg=None,
+                 env: dict | None = None) -> tuple[int, ...] | None:
+    """Pod id per host index, or ``None`` (no pod map — every host in
+    one pod; the flat/hierarchical schedules, bit-for-bit today's
+    behavior). Resolution mirrors :func:`slice_topology`: an explicit
+    ``env`` dict's ``ZEST_COOP_PODS`` > ``Config.coop_pods`` > None.
+    A spec whose length disagrees with the round raises ValueError."""
+    spec = (env or {}).get("ZEST_COOP_PODS")
+    pods = None
+    if spec:
+        pods = parse_topology(spec)
+    elif cfg is not None and getattr(cfg, "coop_pods", None):
+        pods = tuple(cfg.coop_pods)
+    if pods is None:
+        return None
+    if len(pods) != n_hosts:
+        raise ValueError(
+            f"ZEST_COOP_PODS names {len(pods)} hosts for an "
+            f"{n_hosts}-host round")
+    return pods
+
+
+def elect_gateways(plan, pods: tuple[int, ...]) -> dict[int, int]:
+    """Deterministic gateway election: pod id → its lowest *alive*
+    host index from the shared plan. Every host computes the same
+    mapping from the same fingerprinted plan, so the election needs no
+    round trips; a quarantined gateway is simply absent from
+    ``plan.alive`` and the next-lowest member inherits the role."""
+    out: dict[int, int] = {}
+    for h in sorted(plan.alive):
+        p = pods[h]
+        if p not in out:
+            out[p] = h
+    return dict(sorted(out.items()))
+
+
 @dataclass(frozen=True)
 class Phase:
     """One step of this host's schedule: request from ``partner`` every
@@ -149,7 +186,7 @@ class Phase:
     index: int
     partner: int                 # host index (not rank)
     owners: tuple[int, ...]      # host indices whose units to request
-    link: str                    # "ici" | "dcn"
+    link: str                    # "ici" | "dcn" | "wan"
 
 
 def _is_pow2(n: int) -> bool:
@@ -179,18 +216,42 @@ class CollectiveSchedule:
     - **ring** (anything else): N−1 phases pulling from the constant
       left neighbor.
 
+    With a pod map (``pods``, from ZEST_COOP_PODS) naming ≥ 2 alive
+    pods, the schedule becomes **federated** — the 3-level ICI < DCN <
+    WAN generalization of the hierarchical shape:
+
+    - **Stage A** — intra-pod all-gather of the pod's OWN blocks
+      (hypercube when the pod's alive-member count is a power of two,
+      ring otherwise; links classed by the slice topology as usual).
+    - **Stage B** — WAN, gateways only: each pod's deterministically
+      elected gateway (:func:`elect_gateways` — lowest alive host
+      index) all-gathers the per-pod aggregates with the other
+      gateways (recursive doubling over pods when the pod count is a
+      power of two, ring over gateways otherwise). Aggregate WAN
+      traffic is ONE copy of each pod's data per receiving pod —
+      (P−1)/P of the total per gateway — instead of one per receiving
+      host.
+    - **Stage C** — intra-pod binomial-tree broadcast of the imported
+      foreign blocks, gateway-first member order: the member at
+      broadcast position p pulls everything foreign from position
+      p − 2^⌊log2 p⌋ (its binomial parent). Pull + NOT_FOUND barrier
+      makes the ordering self-synchronizing — a parent that has not
+      finished its own pull yet is "behind", never "wrong".
+
     Every host computes every other host's schedule from the same plan
     + topology, which is what lets a request window name exactly the
     units its partner holds."""
 
-    kind: str                    # "hierarchical" | "hypercube" | "ring"
+    kind: str   # "hierarchical" | "hypercube" | "ring" | "federated"
     host: int
-    alive: tuple[int, ...]       # rank order (slice-major)
+    alive: tuple[int, ...]       # rank order (pod- then slice-major)
     phases: tuple[Phase, ...]
 
     @staticmethod
     def build(plan, host_index: int,
-              topology: tuple[int, ...]) -> "CollectiveSchedule":
+              topology: tuple[int, ...],
+              pods: tuple[int, ...] | None = None,
+              ) -> "CollectiveSchedule":
         if host_index not in plan.alive:
             raise CollectiveUnavailable(
                 f"host {host_index} is not in the plan's alive set")
@@ -198,15 +259,27 @@ class CollectiveSchedule:
             raise ValueError(
                 f"topology names {len(topology)} hosts but the plan "
                 f"includes host {max(plan.alive)}")
+        if pods is not None and max(plan.alive) >= len(pods):
+            raise ValueError(
+                f"pod map names {len(pods)} hosts but the plan "
+                f"includes host {max(plan.alive)}")
+
+        def link(a: int, b: int) -> str:
+            if pods is not None and pods[a] != pods[b]:
+                return LINK_WAN
+            return LINK_ICI if topology[a] == topology[b] else LINK_DCN
+
+        if pods is not None \
+                and len({pods[h] for h in plan.alive}) >= 2:
+            return CollectiveSchedule._build_federated(
+                plan, host_index, topology, pods, link)
+
         order = tuple(sorted(plan.alive, key=lambda h: (topology[h], h)))
         n = len(order)
         if n < 2:
             raise CollectiveUnavailable("nothing to exchange with")
         rank = {h: i for i, h in enumerate(order)}
         r = rank[host_index]
-
-        def link(a: int, b: int) -> str:
-            return LINK_ICI if topology[a] == topology[b] else LINK_DCN
 
         # Slice groups in rank order (slice-major ⇒ contiguous).
         slices: list[list[int]] = []
@@ -262,6 +335,81 @@ class CollectiveSchedule:
                                     link(host_index, left)))
         return CollectiveSchedule(kind, host_index, order, tuple(phases))
 
+    @staticmethod
+    def _build_federated(plan, host_index: int,
+                         topology: tuple[int, ...],
+                         pods: tuple[int, ...],
+                         link) -> "CollectiveSchedule":
+        pod_ids = sorted({pods[h] for h in plan.alive})
+        members_by_pod = {
+            p: sorted((h for h in plan.alive if pods[h] == p),
+                      key=lambda h: (topology[h], h))
+            for p in pod_ids
+        }
+        gateways = elect_gateways(plan, pods)
+        my_pod = pods[host_index]
+        members = members_by_pod[my_pod]
+        gw = gateways[my_pod]
+        phases: list[Phase] = []
+
+        # Stage A — intra-pod all-gather of this pod's OWN blocks.
+        m = len(members)
+        r = members.index(host_index)
+        if m >= 2:
+            if _is_pow2(m):
+                for k in range(m.bit_length() - 1):
+                    p = r ^ (1 << k)
+                    owners = tuple(members[p ^ q] for q in range(1 << k))
+                    phases.append(Phase(len(phases), members[p], owners,
+                                        link(host_index, members[p])))
+            else:
+                left = members[(r - 1) % m]
+                for k in range(m - 1):
+                    owner = members[(r - 1 - k) % m]
+                    phases.append(Phase(len(phases), left, (owner,),
+                                        link(host_index, left)))
+
+        if host_index == gw:
+            # Stage B — WAN, gateways only: all-gather the per-pod
+            # aggregates (a phase's owners are EVERY alive host of the
+            # pods in the partner gateway's subcube/ring block).
+            pcount = len(pod_ids)
+            pr = pod_ids.index(my_pod)
+            if _is_pow2(pcount):
+                for k in range(pcount.bit_length() - 1):
+                    pp = pr ^ (1 << k)
+                    owners = tuple(
+                        h for q in range(1 << k)
+                        for h in members_by_pod[pod_ids[pp ^ q]])
+                    partner = gateways[pod_ids[pp]]
+                    phases.append(Phase(len(phases), partner, owners,
+                                        link(host_index, partner)))
+            else:
+                left_gw = gateways[pod_ids[(pr - 1) % pcount]]
+                for k in range(pcount - 1):
+                    op = pod_ids[(pr - 1 - k) % pcount]
+                    owners = tuple(members_by_pod[op])
+                    phases.append(Phase(len(phases), left_gw, owners,
+                                        link(host_index, left_gw)))
+        else:
+            # Stage C — intra-pod binomial broadcast of the foreign
+            # blocks, gateway-first order: position p pulls from its
+            # binomial parent p − 2^⌊log2 p⌋. One phase per member;
+            # the NOT_FOUND barrier sequences the tree.
+            foreign = tuple(
+                h for p in pod_ids if p != my_pod
+                for h in members_by_pod[p])
+            bcast = [gw] + [h for h in members if h != gw]
+            bpos = bcast.index(host_index)
+            src = bcast[bpos - (1 << (bpos.bit_length() - 1))]
+            phases.append(Phase(len(phases), src, foreign,
+                                link(host_index, src)))
+
+        order = tuple(sorted(
+            plan.alive, key=lambda h: (pods[h], topology[h], h)))
+        return CollectiveSchedule("federated", host_index, order,
+                                  tuple(phases))
+
 
 def units_by_owner(plan) -> dict[int, list]:
     """``{owner_host: [(hash_hex, FetchInfo), ...]}`` over the plan —
@@ -272,7 +420,9 @@ def units_by_owner(plan) -> dict[int, list]:
     return out
 
 
-def transfer_matrix(plan, topology: tuple[int, ...]) -> list[list[int]]:
+def transfer_matrix(plan, topology: tuple[int, ...],
+                    pods: tuple[int, ...] | None = None,
+                    ) -> list[list[int]]:
     """The full N×N wire-byte matrix the schedule implies:
     ``matrix[src][dst]`` = bytes host ``dst`` requests from host ``src``
     across every phase of its schedule (indexed by host, zeros for
@@ -288,7 +438,7 @@ def transfer_matrix(plan, topology: tuple[int, ...]) -> list[list[int]]:
     }
     matrix = [[0] * n for _ in range(n)]
     for dst in plan.alive:
-        sched = CollectiveSchedule.build(plan, dst, topology)
+        sched = CollectiveSchedule.build(plan, dst, topology, pods)
         for ph in sched.phases:
             matrix[ph.partner][dst] += sum(
                 block_bytes[o] for o in ph.owners)
@@ -310,7 +460,9 @@ def run_collective(bridge, plan, host_index: int,
                    topology: tuple[int, ...],
                    priorities: dict | None = None,
                    entries_map: dict | None = None,
-                   health=None) -> tuple[dict, dict[int, list]]:
+                   health=None,
+                   pods: tuple[int, ...] | None = None,
+                   ) -> tuple[dict, dict[int, list]]:
     """Execute this host's phase schedule. Returns
     ``(stats, leftover_by_owner)`` — ``leftover_by_owner`` is empty on
     success; after an abort it maps TRUE owner host → undelivered
@@ -324,18 +476,22 @@ def run_collective(bridge, plan, host_index: int,
         _admit, _already_cached, _fallback, _layer_order,
     )
 
-    sched = CollectiveSchedule.build(plan, host_index, topology)
+    sched = CollectiveSchedule.build(plan, host_index, topology, pods)
     for ph in sched.phases:
         if ph.partner not in peers:
             raise CollectiveUnavailable(
                 f"phase {ph.index} partner host {ph.partner} has no "
                 "DCN address")
     blocks = units_by_owner(plan)
-    mtx = transfer_matrix(plan, topology)
+    mtx = transfer_matrix(plan, topology, pods)
 
     t0 = time.monotonic()
     phase_walls: list[float] = []
     link_bytes = {LINK_ICI: 0, LINK_DCN: 0}
+    if pods is not None:
+        # The wan key exists only under a pod map — without
+        # ZEST_COOP_PODS the stats schema is bit-for-bit PR-13's.
+        link_bytes[LINK_WAN] = 0
     windows = requests = retry_windows = 0
     barrier_s = 0.0
     window_cap = min(_PHASE_WINDOW_BYTES, budget.budget_bytes)

@@ -546,17 +546,18 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
             # restoring the PR-6 exchange bit-for-bit.
             if use_collective and any(foreign.values()):
                 from zest_tpu.transfer.collective import (
-                    CollectiveUnavailable, run_collective,
-                    slice_topology,
+                    CollectiveUnavailable, pod_topology,
+                    run_collective, slice_topology,
                 )
 
                 try:
                     topo = slice_topology(n_hosts, cfg=bridge.cfg)
+                    pods = pod_topology(n_hosts, cfg=bridge.cfg)
                     collective_stats, foreign = run_collective(
                         bridge, plan, host_index, peers, pool, budget,
                         ex, verify, deadline, topo,
                         priorities=priorities, entries_map=entries_map,
-                        health=swarm_health)
+                        health=swarm_health, pods=pods)
                 except (CollectiveUnavailable, ValueError) as exc:
                     # ValueError = a topology spec that disagrees with
                     # this round's host count — a config problem, but

@@ -94,6 +94,13 @@ MSG_REQUEST = 1
 MSG_RESPONSE = 2
 MSG_NOT_FOUND = 3
 MSG_ERROR = 4
+# Gossip piggyback (ISSUE 16): one anti-entropy push-pull round rides
+# the existing chunk-RPC channel as a JSON request/reply pair — no new
+# listener, no new port. A pre-gossip server answers GOSSIP with the
+# generic "server accepts only REQUEST" ERROR, which clients treat as
+# "gossip unavailable there", never a connection fault.
+MSG_GOSSIP = 5
+MSG_GOSSIP_REPLY = 6
 
 # A silent peer (half-open connection, port scanner that said hello)
 # releases its serving thread after this long; clients hold channels
@@ -105,6 +112,12 @@ _REQ_BODY = struct.Struct("<32sQQ")
 
 class DcnProtocolError(ConnectionError):
     pass
+
+
+class GossipUnavailable(ConnectionError):
+    """The peer's server answered GOSSIP with an ERROR — a pre-gossip
+    build (or one with no node attached). Callers skip the peer for
+    this round; chunk RPCs to it still work."""
 
 
 @dataclass(frozen=True)
@@ -138,7 +151,18 @@ class DcnError:
     message: str
 
 
-DcnMessage = DcnRequest | DcnResponse | DcnNotFound | DcnError
+@dataclass(frozen=True)
+class DcnGossip:
+    """One gossip push-pull payload (request or reply — symmetric):
+    ``payload`` is the transfer.gossip vv+delta dict, JSON on the wire
+    (gossip deltas are small bounded metadata, not chunk payloads)."""
+
+    request_id: int
+    payload: dict
+    reply: bool = False
+
+
+DcnMessage = DcnRequest | DcnResponse | DcnNotFound | DcnError | DcnGossip
 
 
 # ── Codec (fixed-buffer roundtrip-testable, no sockets) ──
@@ -179,6 +203,12 @@ def encode_message(msg: DcnMessage) -> bytes:
     elif isinstance(msg, DcnError):
         body = msg.message.encode()
         mtype = MSG_ERROR
+    elif isinstance(msg, DcnGossip):
+        import json as _json
+
+        body = _json.dumps(msg.payload,
+                           separators=(",", ":")).encode()
+        mtype = MSG_GOSSIP_REPLY if msg.reply else MSG_GOSSIP
     else:  # pragma: no cover - type system guards this
         raise DcnProtocolError(f"unencodable message {msg!r}")
     if len(body) > MAX_MESSAGE_SIZE:
@@ -206,6 +236,17 @@ def decode_message(header: bytes, body: bytes) -> DcnMessage:
         return DcnNotFound(req_id, body)
     if mtype == MSG_ERROR:
         return DcnError(req_id, body.decode(errors="replace"))
+    if mtype in (MSG_GOSSIP, MSG_GOSSIP_REPLY):
+        import json as _json
+
+        try:
+            payload = _json.loads(body.decode())
+        except (UnicodeDecodeError, ValueError) as exc:
+            raise DcnProtocolError(f"bad GOSSIP body: {exc}") from exc
+        if not isinstance(payload, dict):
+            raise DcnProtocolError("GOSSIP body is not an object")
+        return DcnGossip(req_id, payload,
+                         reply=mtype == MSG_GOSSIP_REPLY)
     raise DcnProtocolError(f"unknown message type {mtype}")
 
 
@@ -401,7 +442,9 @@ class DcnServer:
                  span_attrs: dict | None = None, rate_bps: int = 0,
                  window_rtt_s: float = 0.0,
                  shape_slices: tuple[int, ...] | None = None,
-                 shape_host: int | None = None):
+                 shape_host: int | None = None,
+                 shape_pods: tuple[int, ...] | None = None,
+                 wan_rtt_s: float = 0.0, wan_bps: int = 0):
         self.cfg = cfg
         self.cache = cache or XorbCache(cfg)
         # Extra attrs stamped on every serve span (the in-process
@@ -425,14 +468,29 @@ class DcnServer:
         # conservatively treated as cross-slice). Both default off:
         # production serving is unshaped here (the seeding tier has
         # its own upload policy).
+        # ``shape_pods`` (a ZEST_COOP_PODS tuple) adds a third link
+        # class: cross-pod connections are WAN and pay ``wan_rtt_s``
+        # per window through their own ``wan_bps`` bucket (scarcer
+        # than the DCN plane), which is what the fleet bench's
+        # 3-level ICI < DCN < WAN asymmetry rides on.
         self._bucket = None
-        if rate_bps:
+        self._wan_bucket = None
+        if rate_bps or wan_bps:
             from zest_tpu.shaping import TokenBucket
 
-            self._bucket = TokenBucket(rate_bps)
+            if rate_bps:
+                self._bucket = TokenBucket(rate_bps)
+            if wan_bps:
+                self._wan_bucket = TokenBucket(wan_bps)
         self.window_rtt_s = float(window_rtt_s)
+        self.wan_rtt_s = float(wan_rtt_s)
         self.shape_slices = shape_slices
         self.shape_host = shape_host
+        self.shape_pods = shape_pods
+        # Gossip responder (attach_gossip): anti-entropy exchanges
+        # piggyback on the same listener/connection the chunk RPCs
+        # use, so fleet metadata spread costs zero extra sockets.
+        self.gossip = None
         self.port: int | None = None
         self.stats = DcnServerStats()
         self._stats_lock = threading.Lock()
@@ -440,6 +498,13 @@ class DcnServer:
         self._sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._conns = ConnTracker()
+
+    def attach_gossip(self, node) -> None:
+        """Answer MSG_GOSSIP on this listener with ``node``'s
+        anti-entropy responder. Without an attached node the server
+        keeps its pre-gossip behavior (ERROR: "server accepts only
+        REQUEST"), which clients read as gossip-unavailable."""
+        self.gossip = node
 
     def start(self) -> int:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -508,47 +573,86 @@ class DcnServer:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(IDLE_TIMEOUT_S)
                 hello = _exchange_hello(conn)
-                shaped = self._conn_shaped(hello)
+                link = self._conn_link(hello)
+                rtt, bucket = self._link_shaping(link)
                 # Per-connection window tracking for the RTT shaper:
                 # a tag change (or an untagged request) starts a new
                 # window.
                 last_tag: list[int | None] = [None]
                 while not self._shutdown.is_set():
                     msg = _recv_message(conn)
+                    if isinstance(msg, DcnGossip) and not msg.reply:
+                        node = self.gossip
+                        if node is None:
+                            conn.sendall(encode_message(DcnError(
+                                msg.request_id,
+                                "server accepts only REQUEST",
+                            )))
+                            continue
+                        reply = node.handle_exchange(msg.payload)
+                        if rtt > 0:
+                            time.sleep(rtt)
+                            last_tag[0] = None
+                        conn.sendall(encode_message(
+                            DcnGossip(msg.request_id, reply, reply=True)
+                        ))
+                        continue
                     if not isinstance(msg, DcnRequest):
                         conn.sendall(encode_message(DcnError(
                             msg.request_id, "server accepts only REQUEST"
                         )))
                         continue
-                    if shaped and self.window_rtt_s > 0:
+                    if rtt > 0:
                         if msg.tag == 0 or msg.tag != last_tag[0]:
-                            time.sleep(self.window_rtt_s)
+                            time.sleep(rtt)
                         last_tag[0] = msg.tag or None
                     self._serve_request(conn, msg, hello,
-                                        shaped=shaped)
+                                        bucket=bucket)
         except (ConnectionError, DcnProtocolError, OSError):
             return  # peer went away / spoke garbage: drop the connection
         finally:
             self._conns.discard(conn)
 
-    def _conn_shaped(self, hello: HelloInfo | None) -> bool:
-        """Whether this connection's serves go through the shaper:
-        always, unless a slice map narrows shaping to cross-slice
-        links and the hello proves the client shares our slice."""
-        if self._bucket is None and self.window_rtt_s <= 0:
-            return False
+    def _conn_link(self, hello: HelloInfo | None) -> str:
+        """Classify this connection's link: ``"ici"`` (same slice,
+        unshaped), ``"dcn"`` (cross-slice), or ``"wan"`` (cross-pod,
+        when a pod map is configured). Without a slice map every
+        connection is the most expensive configured class; an
+        anonymous client is conservatively the farthest one."""
+        if self._bucket is None and self._wan_bucket is None \
+                and self.window_rtt_s <= 0 and self.wan_rtt_s <= 0:
+            return "ici"  # shaping entirely off
+        worst = "wan" if self.shape_pods is not None else "dcn"
         if self.shape_slices is None or self.shape_host is None:
-            return True
+            return worst
         peer = getattr(hello, "peer_host", None)
         if peer is None or not 0 <= peer < len(self.shape_slices) \
                 or not 0 <= self.shape_host < len(self.shape_slices):
-            return True  # anonymous client: conservatively cross-slice
-        return (self.shape_slices[peer]
-                != self.shape_slices[self.shape_host])
+            return worst  # anonymous client: conservatively far
+        pods = self.shape_pods
+        if pods is not None and peer < len(pods) \
+                and self.shape_host < len(pods) \
+                and pods[peer] != pods[self.shape_host]:
+            return "wan"
+        if self.shape_slices[peer] != self.shape_slices[self.shape_host]:
+            return "dcn"
+        return "ici"
+
+    def _link_shaping(self, link: str):
+        """``(window_rtt, bucket)`` for a link class. WAN falls back
+        to the DCN knobs when no WAN-specific ones were given, so a
+        pods map alone still shapes cross-pod links at least as hard
+        as cross-slice ones."""
+        if link == "wan":
+            return (self.wan_rtt_s or self.window_rtt_s,
+                    self._wan_bucket or self._bucket)
+        if link == "dcn":
+            return self.window_rtt_s, self._bucket
+        return 0.0, None
 
     def _serve_request(self, conn: socket.socket, req: DcnRequest,
                        hello: HelloInfo | None = None,
-                       shaped: bool = False) -> None:
+                       bucket=None) -> None:
         # Server-side request span (ISSUE 7): stamped with the v2 tag
         # and the requester's host/trace identity from the hello block,
         # which is what the merged trace flow-links to the client-side
@@ -560,10 +664,10 @@ class DcnServer:
         if hello is not None and hello.peer_trace_id is not None:
             attrs.setdefault("trace_id", hello.peer_trace_id)
         with telemetry.span("dcn.serve", **attrs) as sp:
-            self._serve_request_inner(conn, req, sp, shaped=shaped)
+            self._serve_request_inner(conn, req, sp, bucket=bucket)
 
     def _serve_request_inner(self, conn: socket.socket, req: DcnRequest,
-                             sp, shaped: bool = False) -> None:
+                             sp, bucket=None) -> None:
         if not req.range_start < req.range_end:
             conn.sendall(encode_message(DcnError(
                 req.request_id,
@@ -596,8 +700,8 @@ class DcnServer:
         with self._stats_lock:
             self.stats.chunks_served += 1
             self.stats.bytes_served += len(blob)
-        if shaped and self._bucket is not None:
-            self._bucket.acquire(len(blob))
+        if bucket is not None:
+            bucket.acquire(len(blob))
         _M_CHUNKS_SERVED.inc()
         _M_BYTES_SERVED.inc(len(blob))
         sp.add_bytes(len(blob))
@@ -716,6 +820,37 @@ class DcnChannel:
         return self.send_request(
             chunk_hash, range_start, range_end
         ).wait(self.timeout)
+
+    def gossip_exchange(self, payload: dict,
+                        timeout: float | None = None) -> dict:
+        """One anti-entropy round trip on this channel: send our
+        digest delta, return the peer's reply payload. A pre-gossip
+        server answers with ERROR ("server accepts only REQUEST"),
+        surfaced as :class:`DcnError` via ``GossipUnavailable``."""
+        if self.dead:
+            raise ConnectionError("DCN channel is dead")
+        with self._send_lock:
+            req_id = self._next_id
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            waiter = _Waiter(req_id)
+            with self._pending_lock:
+                self._pending[req_id] = waiter
+            try:
+                self._sock.sendall(encode_message(
+                    DcnGossip(req_id, payload)
+                ))
+            except OSError as exc:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                raise ConnectionError(f"DCN send failed: {exc}") from exc
+        msg = waiter.wait(self.timeout if timeout is None else timeout)
+        if isinstance(msg, DcnGossip):
+            return msg.payload
+        if isinstance(msg, DcnError):
+            raise GossipUnavailable(msg.message)
+        raise DcnProtocolError(
+            f"unexpected reply to GOSSIP: {type(msg).__name__}"
+        )
 
     def request_many(
         self, wants: list[tuple[bytes, int, int]],
@@ -887,6 +1022,28 @@ class DcnPool:
                 except (ConnectionError, TimeoutError, OSError):
                     self.drop(host, port)
                     raise
+
+    def gossip_exchange(self, host: str, port: int, payload: dict,
+                        timeout: float | None = None) -> dict:
+        """One anti-entropy round trip through a pooled channel, with
+        the same stale-channel reconnect-retry-once discipline as
+        :meth:`request_many`. ``GossipUnavailable`` propagates without
+        a retry — the peer is alive, it just doesn't speak gossip."""
+        ch, reused = self._lease(host, port)
+        try:
+            return ch.gossip_exchange(payload, timeout=timeout)
+        except GossipUnavailable:
+            raise
+        except (ConnectionError, TimeoutError, OSError):
+            self.drop(host, port)
+            if not reused:
+                raise
+            ch, _ = self._lease(host, port)
+            try:
+                return ch.gossip_exchange(payload, timeout=timeout)
+            except (ConnectionError, TimeoutError, OSError):
+                self.drop(host, port)
+                raise
 
     def drop(self, host: str, port: int) -> None:
         with self._lock:

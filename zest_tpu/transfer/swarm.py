@@ -46,6 +46,12 @@ DISCOVERY_TTL_S = 30.0
 # renegotiated quickly: caching the blank list for the full TTL would
 # silence the peer tier for 30 s after one transient DHT/tracker blip.
 NEGATIVE_DISCOVERY_TTL_S = 2.0
+# Re-announce dedup window (ISSUE 16 satellite): one health-transition
+# sweep re-registers a swarm at most once per window — a quarantine
+# storm at fleet scale (hundreds of transitions in seconds) must not
+# emit O(swarms × transitions) tracker round trips when each swarm's
+# registration is already fresh.
+REANNOUNCE_WINDOW_S = 30.0
 
 _M_SWARM = telemetry.counter(
     "zest_swarm_events_total", "Swarm events (attempts, failures, ...)",
@@ -140,7 +146,24 @@ class SwarmDownloader:
         self._announced: set[bytes] = set()
         self._reannounce_lock = threading.Lock()
         self._reannounce_pending = False
+        self._last_reannounce: dict[bytes, float] = {}
+        # Fleet gossip (transfer.gossip; ISSUE 16): when attached, the
+        # node is the FIRST discovery source (cost-ordered, zero round
+        # trips) and the tracker/DHT sources demote to bootstrap-only
+        # announce. None (ZEST_GOSSIP=0) = tracker-only, bit-for-bit.
+        self.gossip = None
         self.health.subscribe(self._on_health_transition)
+
+    def attach_gossip(self, node) -> None:
+        """Adopt ``node`` (transfer.gossip.GossipNode) as the primary
+        discovery source: its local digest answers ``find_peers``
+        nearest-warm-host first (ICI < DCN < WAN), and every announce
+        rumors through anti-entropy instead of a tracker round trip —
+        the non-gossip sources only see the FIRST announce per swarm
+        (the bootstrap seed)."""
+        self.gossip = node
+        self.peer_sources = [node] + [
+            s for s in self.peer_sources if s is not node]
 
     def add_direct_peer(self, host: str, port: int) -> None:
         """--peer flag path: tried before discovered peers (swarm.zig:279-314)."""
@@ -156,9 +179,14 @@ class SwarmDownloader:
         self.pool.close_all()
 
     def summary(self) -> dict:
-        """Session stats plus the health registry's live view."""
+        """Session stats plus the health registry's live view. The
+        ``gossip`` block exists only when a node is attached — with
+        ZEST_GOSSIP=0 the schema is bit-for-bit the tracker-only
+        build's."""
         out = self.stats.summary()
         out["health"] = self.health.summary()
+        if self.gossip is not None:
+            out["gossip"] = self.gossip.summary()
         return out
 
     # ── Discovery (reference: swarm.zig:320-355) ──
@@ -358,8 +386,17 @@ class SwarmDownloader:
 
     def announce_available(self, xorb_hash: bytes, hash_hex: str) -> None:
         info_hash = peer_id_mod.compute_info_hash(xorb_hash)
+        first = info_hash not in self._announced
         self._announced.add(info_hash)
         for source in self.peer_sources:
+            # With gossip attached the tracker/DHT tier is bootstrap
+            # only: it sees the FIRST announce per swarm (seeding the
+            # epidemic), and every refresh is a local digest update the
+            # anti-entropy rounds spread — announce traffic drops from
+            # every-host-to-tracker to O(N·log N) gossip payloads.
+            if self.gossip is not None and source is not self.gossip \
+                    and not first:
+                continue
             try:
                 source.announce(info_hash, self.cfg.listen_port)
             except Exception:
@@ -391,13 +428,25 @@ class SwarmDownloader:
 
     def _reannounce_sweep(self) -> None:
         try:
+            now = self.health.now()
+            swept = False
             for info_hash in list(self._announced):
+                # Per-swarm dedup: a swarm whose registration was
+                # refreshed within the window is skipped — back-to-back
+                # transitions (a quarantine storm) re-register each
+                # swarm once, not once per transition.
+                if now - self._last_reannounce.get(info_hash, -1e9) \
+                        < REANNOUNCE_WINDOW_S:
+                    continue
+                self._last_reannounce[info_hash] = now
+                swept = True
                 for source in self.peer_sources:
                     try:
                         source.announce(info_hash, self.cfg.listen_port)
                     except Exception:
                         continue
-            self.stats.bump("reannounces")
+            if swept:
+                self.stats.bump("reannounces")
         finally:
             with self._reannounce_lock:
                 self._reannounce_pending = False
