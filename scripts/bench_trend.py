@@ -1,7 +1,8 @@
 """Bench regression sentinel (ISSUE 15 satellite).
 
 The committed bench artifacts (``SWARM_r12.json``, ``TENANT_r13.json``,
-``MULTIHOST_r14.json``, ``DELTA_r10.json``, ``FLEET_r16.json``) carry
+``MULTIHOST_r14.json``, ``DELTA_r10.json``, ``FLEET_r16.json``,
+``MTTR_r17.json``) carry
 the numbers each PR
 was accepted on — but nothing re-checked them: a later PR regenerating
 an artifact with a worse number (a peer-served ratio under its gate, a
@@ -110,6 +111,21 @@ CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
          "1024 hosts"),
         ("gates/cold_pod_zero_cdn_for_warm", "truthy", None,
          "a cold pod sent CDN bytes for xorbs the fleet holds"),
+    ],
+    "MTTR_r17.json": [
+        ("gates/classes_at_half_ok", "truthy", None,
+         "fewer than 3 fault classes recover in <=0.5x the hands-off "
+         "MTTR — the self-healing policy stopped paying for itself"),
+        ("gates/corrupt_bytes_admitted", "eq", 0,
+         "a chaos arm admitted corrupt bytes past the merkle boundary"),
+        ("gates/all_faults_fired", "truthy", None,
+         "chaos run went vacuous (a fault never fired hands-off)"),
+        ("gates/remediations_have_series", "truthy", None,
+         "an executed action shipped without before/after series"),
+        ("gates/control_actions_executed", "eq", 0,
+         "the policy engine healed a HEALTHY swarm (over-healing)"),
+        ("gates/peer_ratio_ok", "truthy", None,
+         "policy-on control run tanked the peer-served ratio"),
     ],
     "DELTA_r10.json": [
         ("delta_bytes_ratio", "le", 0.03,

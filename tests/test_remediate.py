@@ -1,0 +1,859 @@
+"""Self-healing control plane (ISSUE 17): the remediation policy
+engine over the PR-14 anomaly stream, its bounded/rate-limited/
+reversible actions through the existing recovery paths, and the
+satellite fixes riding along.
+
+The contract under test: every decision is gated (action mask → token
+bucket → dry-run) and recorded (log entry + metric + flight event with
+before/after timeline snapshots) whatever the outcome; actions only
+ever drive *injected* targets (no telemetry → transfer imports);
+``ZEST_REMEDIATE=0`` restores the pure-observer process bit-for-bit
+(no subscription, no targets, identical pull stats schema); the tuner
+never leaves its rails and never oscillates within one observation
+window; and a demotion never creates a strike against a healthy peer.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from zest_tpu import telemetry
+from zest_tpu.telemetry import recorder
+from zest_tpu.telemetry import remediate
+from zest_tpu.telemetry import session as session_mod
+from zest_tpu.telemetry import timeline
+from zest_tpu.transfer import tenancy
+
+from fixtures import FixtureHub, FixtureRepo
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    # The engine reads ZEST_REMEDIATE_* from the live environment;
+    # scrub any ambient settings so every test starts from defaults.
+    for name in ("ZEST_REMEDIATE", "ZEST_REMEDIATE_ACTIONS",
+                 "ZEST_REMEDIATE_DRY", "ZEST_REMEDIATE_RATE_S",
+                 "ZEST_REMEDIATE_BURST", "ZEST_REMEDIATE_PATIENCE",
+                 "ZEST_REMEDIATE_BURN_MAX", "ZEST_REMEDIATE_OBSERVE_S",
+                 "ZEST_TIMELINE", "ZEST_TELEMETRY"):
+        monkeypatch.delenv(name, raising=False)
+    telemetry.reset_all()
+    tenancy.reset()
+    yield
+    telemetry.reset_all()
+    tenancy.reset()
+
+
+def _engine() -> remediate.RemediationEngine:
+    assert remediate.ensure_started()
+    return remediate.ENGINE
+
+
+def _counts(action: str) -> dict:
+    return remediate.payload()["counts"].get(action, {})
+
+
+# ── Enable gate + pure-observer contract ──
+
+
+class TestEnableGate:
+    def test_default_on(self):
+        assert remediate.enabled() is True
+
+    def test_env_off(self, monkeypatch):
+        monkeypatch.setenv("ZEST_REMEDIATE", "0")
+        assert remediate.enabled() is False
+        assert remediate.ensure_started() is False
+
+    def test_timeline_off_implies_off(self, monkeypatch):
+        monkeypatch.setenv("ZEST_TIMELINE", "0")
+        timeline.reset()
+        assert remediate.enabled() is False
+
+    def test_off_register_target_is_noop(self, monkeypatch):
+        monkeypatch.setenv("ZEST_REMEDIATE", "0")
+        assert remediate.register_target("hedge:x", lambda r: None) \
+            is False
+        assert remediate.ENGINE is None  # no engine even built
+
+    def test_off_payload_stub(self, monkeypatch):
+        monkeypatch.setenv("ZEST_REMEDIATE", "0")
+        doc = remediate.payload()
+        assert doc["enabled"] is False
+        assert doc["counts"] == {} and doc["recent"] == []
+
+    def test_parse_actions_lenient(self):
+        assert remediate.parse_actions(None) \
+            == frozenset(remediate.ACTIONS)
+        assert remediate.parse_actions("all") \
+            == frozenset(remediate.ACTIONS)
+        assert remediate.parse_actions("hedge, demote") \
+            == frozenset({"hedge", "demote"})
+        # Unknown names are dropped, never raised, on the engine side.
+        assert remediate.parse_actions("hedge,typo") \
+            == frozenset({"hedge"})
+
+
+# ── The decision spine: mask → bucket → dry-run → execute ──
+
+
+class TestDecisionSpine:
+    def test_stall_anomaly_arms_hedge_through_listener(self):
+        _engine()
+        sess = session_mod.begin("acme/m", "main")
+        calls: list[str] = []
+        remediate.register_target(
+            f"hedge:{sess.id}",
+            lambda reason: calls.append(reason) or {"armed": True})
+        timeline.STORE.detector._fire(
+            timeline.ANOMALY_STALL, session=sess, phase="fetch",
+            bytes_done=7)
+        assert calls == ["anomaly:stall"]
+        assert _counts("hedge") == {"success": 1}
+        session_mod.finish(sess, "ok")
+
+    def test_collapse_maps_to_hedge_too(self):
+        eng = _engine()
+        sess = session_mod.begin("acme/m", "main")
+        calls = []
+        remediate.register_target(f"hedge:{sess.id}",
+                                  lambda reason: calls.append(reason))
+        eng.on_anomaly(timeline.ANOMALY_COLLAPSE, sess, {})
+        assert calls == ["anomaly:throughput_collapse"]
+        session_mod.finish(sess, "ok")
+
+    def test_hedge_without_target_is_silent(self):
+        eng = _engine()
+        sess = session_mod.begin("acme/m", "main")
+        eng.on_anomaly(timeline.ANOMALY_STALL, sess, {})
+        # Not fetch-bound: no decision logged at all (not a no_target
+        # per stall of an unrelated phase).
+        assert remediate.payload()["recent"] == []
+        session_mod.finish(sess, "ok")
+
+    def test_token_bucket_rate_limit(self):
+        eng = _engine()
+        sess = session_mod.begin("acme/m", "main")
+        remediate.register_target(f"hedge:{sess.id}", lambda r: {})
+        for _ in range(eng.burst + 2):
+            eng.on_anomaly(timeline.ANOMALY_STALL, sess, {})
+        c = _counts("hedge")
+        assert c["success"] == eng.burst
+        assert c["rate_limited"] == 2
+        session_mod.finish(sess, "ok")
+
+    def test_token_bucket_refills(self):
+        b = remediate._TokenBucket(capacity=1, refill_s=10.0)
+        t0 = b.last_t
+        assert b.take(t0) is True
+        assert b.take(t0 + 1.0) is False
+        assert b.take(t0 + 10.5) is True  # one token back after refill_s
+
+    def test_action_mask_disables(self, monkeypatch):
+        monkeypatch.setenv("ZEST_REMEDIATE_ACTIONS", "strike,shed")
+        eng = _engine()
+        sess = session_mod.begin("acme/m", "main")
+        calls = []
+        remediate.register_target(f"hedge:{sess.id}",
+                                  lambda r: calls.append(r))
+        eng.on_anomaly(timeline.ANOMALY_STALL, sess, {})
+        assert calls == []
+        assert _counts("hedge") == {"disabled": 1}
+        session_mod.finish(sess, "ok")
+
+    def test_dry_run_records_but_does_not_execute(self, monkeypatch):
+        monkeypatch.setenv("ZEST_REMEDIATE_DRY", "1")
+        eng = _engine()
+        assert eng.dry_run is True
+        sess = session_mod.begin("acme/m", "main")
+        calls = []
+        remediate.register_target(f"hedge:{sess.id}",
+                                  lambda r: calls.append(r))
+        eng.on_anomaly(timeline.ANOMALY_STALL, sess, {})
+        assert calls == []
+        assert _counts("hedge") == {"dry_run": 1}
+        (entry,) = remediate.payload()["recent"]
+        assert entry["outcome"] == "dry_run" and entry["dry_run"]
+        session_mod.finish(sess, "ok")
+
+    def test_failing_target_records_failed(self):
+        eng = _engine()
+        sess = session_mod.begin("acme/m", "main")
+
+        def boom(reason):
+            raise RuntimeError("target exploded")
+
+        remediate.register_target(f"hedge:{sess.id}", boom)
+        eng.on_anomaly(timeline.ANOMALY_STALL, sess, {})  # must not raise
+        assert _counts("hedge") == {"failed": 1}
+        (entry,) = remediate.payload()["recent"]
+        assert "target exploded" in entry["detail"]["error"]
+        session_mod.finish(sess, "ok")
+
+    def test_decision_carries_before_after_snapshots(self):
+        _engine()
+        timeline.STORE._append("fetch.cdn_bps", 5.0, "rate", 1.0)
+        sess = session_mod.begin("acme/m", "main")
+        remediate.register_target(f"hedge:{sess.id}", lambda r: {})
+        timeline.STORE.detector._fire(timeline.ANOMALY_STALL,
+                                      session=sess, phase="fetch")
+        (entry,) = remediate.payload()["recent"]
+        assert entry["before"]["fetch.cdn_bps"] == [[1.0, 5.0]]
+        assert "after" in entry
+        evs = [e for e in recorder.tail() if e["kind"] == "remediation"]
+        assert evs and evs[0]["before"]["fetch.cdn_bps"] == [[1.0, 5.0]]
+        # The flight event is JSON-clean end to end.
+        json.dumps(evs[0])
+        session_mod.finish(sess, "ok")
+
+    def test_unregister_target_is_identity_checked(self):
+        eng = _engine()
+        a, b = (lambda r: "a"), (lambda r: "b")
+        remediate.register_target("hedge:x", a)
+        remediate.register_target("hedge:x", b)  # replace semantics
+        remediate.unregister_target("hedge:x", a)  # stale unregister
+        assert eng._targets.get("hedge:x") is b
+        remediate.unregister_target("hedge:x", b)
+        assert "hedge:x" not in eng._targets
+
+
+# ── Straggler strike / abort patience ──
+
+
+class TestStraggler:
+    def test_strike_then_abort_past_patience(self):
+        eng = _engine()
+        calls = []
+        remediate.register_target(
+            "collective", lambda cmd, p: calls.append((cmd, p)) or {})
+        for _ in range(3):
+            eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None,
+                           {"partner": 3, "barrier_wait_s": 2.0})
+        assert calls[0] == ("strike", 3)
+        assert calls[1] == ("abort", 3)   # patience default 2
+        assert calls[2] == ("abort", 3)
+
+    def test_collective_registration_resets_patience(self):
+        eng = _engine()
+        calls = []
+        remediate.register_target(
+            "collective", lambda cmd, p: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {"partner": 1})
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {"partner": 1})
+        assert calls == ["strike", "abort"]
+        # A new round registers a fresh target: patience starts over.
+        remediate.register_target(
+            "collective", lambda cmd, p: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {"partner": 1})
+        assert calls[-1] == "strike"
+
+    def test_straggler_without_partner_is_silent(self):
+        eng = _engine()
+        remediate.register_target("collective", lambda cmd, p: {})
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {})
+        assert remediate.payload()["recent"] == []
+
+    def test_collective_abort_flag_drains_to_ladder(self):
+        """The wired side: run_collective's injected target sets the
+        abort flag the barrier-retry loop checks (exercised end-to-end
+        by the MTTR bench; here the target contract)."""
+        from zest_tpu.p2p.health import HealthRegistry
+
+        health = HealthRegistry(strikes_to_quarantine=3)
+        _engine()
+        # Mimic run_collective's registration.
+        abort_req: dict = {}
+        peers = {2: ("127.0.0.1", 9999)}
+
+        def cmd_fn(cmd, partner):
+            if cmd == "strike":
+                health.record_failure(peers[partner], kind="straggler")
+                return {"cmd": "strike"}
+            abort_req["partner"] = partner
+            return {"cmd": "abort"}
+
+        remediate.register_target("collective", cmd_fn)
+        eng = remediate.ENGINE
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {"partner": 2})
+        assert health.detail()[0]["strike_kinds"] == {"straggler": 1}
+        assert not abort_req
+        eng.on_anomaly(timeline.ANOMALY_STRAGGLER, None, {"partner": 2})
+        assert abort_req == {"partner": 2}
+
+
+# ── Shed: queue_stuck + SLO burn, and the recovery leg ──
+
+
+class TestShed:
+    def test_skipped_without_burn(self):
+        eng = _engine()
+        calls = []
+        remediate.register_target("shed",
+                                  lambda cmd: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_QUEUE, None, {"depth": 9})
+        assert calls == []
+        (entry,) = remediate.payload()["recent"]
+        assert entry["detail"]["cmd"] == "none"
+
+    def test_fires_with_burn(self, monkeypatch):
+        eng = _engine()
+        monkeypatch.setattr(remediate, "_worst_burn", lambda: 0.5)
+        calls = []
+        remediate.register_target("shed",
+                                  lambda cmd: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_QUEUE, None, {"depth": 9})
+        assert calls == ["shed"]
+        assert remediate.payload()["shedding"] is True
+
+    def test_recovery_is_ungated(self, monkeypatch):
+        eng = _engine()
+        monkeypatch.setattr(remediate, "_worst_burn", lambda: 0.5)
+        calls = []
+        remediate.register_target("shed",
+                                  lambda cmd: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_QUEUE, None, {})
+        # Exhaust the shed bucket entirely — recovery must still run.
+        b = eng._bucket("shed")
+        while b.take(time.monotonic()):
+            pass
+        monkeypatch.setattr(remediate, "_worst_burn", lambda: 0.0)
+        eng._maybe_recover_shed()
+        assert calls == ["shed", "recover"]
+        assert remediate.payload()["shedding"] is False
+
+    def test_recovery_waits_for_half_burn(self, monkeypatch):
+        eng = _engine()
+        monkeypatch.setattr(remediate, "_worst_burn", lambda: 0.5)
+        calls = []
+        remediate.register_target("shed",
+                                  lambda cmd: calls.append(cmd) or {})
+        eng.on_anomaly(timeline.ANOMALY_QUEUE, None, {})
+        monkeypatch.setattr(remediate, "_worst_burn",
+                            lambda: eng.burn_max * 0.75)
+        eng._maybe_recover_shed()  # above burn_max/2: still shedding
+        assert calls == ["shed"]
+        assert remediate.payload()["shedding"] is True
+
+    def test_admission_controller_shed_evicts_lowest_deficit(self):
+        import threading
+
+        ctrl = tenancy.AdmissionController(max_pulls=1, max_queue=8)
+        ctrl.acquire("a")  # holds the only slot
+        errors: dict[str, BaseException] = {}
+
+        def queued(tenant):
+            try:
+                ctrl.acquire(tenant)
+            except BaseException as exc:  # noqa: BLE001
+                errors[tenant] = exc
+
+        t = threading.Thread(target=queued, args=("b",))
+        t.start()
+        deadline = time.monotonic() + 5
+        while ctrl.summary()["queued"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        out = ctrl.shed()
+        t.join(timeout=5)
+        assert out["tenant"] == "b" and out["shed"] == 1
+        assert isinstance(errors["b"], tenancy.AdmissionRejected)
+        assert errors["b"].retry_after_s >= 1.0
+        s = ctrl.summary()
+        assert s["shedding"] is True and s["shed_total"] == 1
+        assert s["queued"] == 0
+
+    def test_shedding_rejects_new_queuers_until_recover(self):
+        ctrl = tenancy.AdmissionController(max_pulls=1, max_queue=8)
+        ctrl.acquire("a")
+        ctrl.shed()
+        with pytest.raises(tenancy.AdmissionRejected):
+            ctrl.acquire("c")
+        rejected, retry = ctrl.probe_reject()
+        assert rejected and retry >= 1.0
+        ctrl.recover()
+        assert ctrl.summary()["shedding"] is False
+        ok, _ = ctrl.probe_reject()
+        assert ok is False  # back to "would queue, not rejected"
+
+    def test_admitted_sessions_survive_shed(self):
+        ctrl = tenancy.AdmissionController(max_pulls=2, max_queue=8)
+        ctrl.acquire("a")
+        ctrl.acquire("b")
+        ctrl.shed()
+        assert ctrl.summary()["active"] == 2  # never touched
+        ctrl.release()
+        ctrl.release()
+
+
+# ── Demote: the proactive seeder scan ──
+
+
+def _peer_row(addr="10.0.0.1:7000", strikes=0, kinds=None, served=0.0,
+              quarantined_for=0.0):
+    return {"peer": addr, "strikes": strikes,
+            "strike_kinds": kinds or {}, "successes": 0,
+            "failures": strikes, "corruptions": 0, "quarantines": 0,
+            "quarantined_for_s": quarantined_for,
+            "served_bytes_recent": served}
+
+
+class TestDemote:
+    def _wire(self, rows, budget=3):
+        eng = _engine()
+        demoted = []
+        remediate.register_target(
+            "peer_health",
+            lambda: {"rows": rows, "strike_budget": budget})
+        remediate.register_target(
+            "demote", lambda addr: demoted.append(addr) or
+            {"window_s": 15.0})
+        return eng, demoted
+
+    def test_near_budget_strikes_demote(self):
+        eng, demoted = self._wire([_peer_row(strikes=2)], budget=3)
+        eng._scan_seeders(now=100.0)
+        assert demoted == [("10.0.0.1", 7000)]
+        assert _counts("demote") == {"success": 1}
+
+    def test_bad_kind_strikes_demote(self):
+        rows = [_peer_row(strikes=2, kinds={"corrupt": 2})]
+        eng, demoted = self._wire(rows, budget=9)  # nowhere near budget
+        eng._scan_seeders(now=100.0)
+        assert demoted == [("10.0.0.1", 7000)]
+
+    def test_served_collapse_demotes_with_a_strike(self):
+        eng, demoted = self._wire(
+            [_peer_row(strikes=1, served=8 << 20)], budget=9)
+        eng._scan_seeders(now=100.0)       # records the 8 MiB peak
+        assert demoted == []
+        eng._peers["10.0.0.1:7000"]["demoted_t"] = None
+        row = _peer_row(strikes=1, served=100.0)  # collapsed vs peak
+        remediate.register_target(
+            "peer_health",
+            lambda: {"rows": [row], "strike_budget": 9})
+        eng._scan_seeders(now=200.0)
+        assert demoted == [("10.0.0.1", 7000)]
+
+    def test_healthy_peer_never_demoted(self):
+        eng, demoted = self._wire(
+            [_peer_row(strikes=0, served=8 << 20)], budget=3)
+        eng._scan_seeders(now=100.0)
+        assert demoted == []
+        assert remediate.payload()["recent"] == []
+
+    def test_quarantined_peer_skipped(self):
+        eng, demoted = self._wire(
+            [_peer_row(strikes=2, quarantined_for=9.0)], budget=3)
+        eng._scan_seeders(now=100.0)
+        assert demoted == []
+
+    def test_demote_cooldown_per_peer(self):
+        eng, demoted = self._wire([_peer_row(strikes=2)], budget=3)
+        eng._scan_seeders(now=100.0)
+        eng._scan_seeders(now=100.0 + eng.observe_s / 2)
+        assert len(demoted) == 1  # within the observe window
+        eng._scan_seeders(now=101.0 + eng.observe_s)
+        assert len(demoted) == 2
+
+    def test_health_demote_never_creates_a_strike(self):
+        """The failure-semantics rule (SCALING.md §15): demotion
+        quarantines WITHOUT touching strikes/strike_kinds/quarantines,
+        and the peer re-enters through the normal probation path."""
+        from zest_tpu.p2p.health import HealthRegistry
+
+        clock = [100.0]
+        h = HealthRegistry(strikes_to_quarantine=3,
+                           time_fn=lambda: clock[0])
+        events = []
+        h.subscribe(lambda ev, addr: events.append((ev, addr)))
+        addr = ("10.0.0.9", 7000)
+        h.record_failure(addr, kind="seed_stall")
+        before = h.detail()[0]
+        window = h.demote(addr)
+        assert window > 0
+        after = h.detail()[0]
+        assert after["strikes"] == before["strikes"] == 1
+        assert after["strike_kinds"] == {"seed_stall": 1}
+        assert after["quarantines"] == 0  # a demotion is NOT a breaker trip
+        assert h.is_quarantined(addr) is True
+        assert ("demoted", addr) in events
+        assert h.summary()["demotions"] == 1
+        # Re-entry through probation at expiry, record intact.
+        clock[0] += window + 1
+        assert h.is_quarantined(addr) is False
+
+
+# ── The ring-knob auto-tuner ──
+
+
+class TestTuner:
+    def _stall(self, v):
+        timeline.STORE._append("ring.stalls", float(v), "gauge",
+                               time.monotonic())
+
+    def test_up_nudge_on_stall_growth(self):
+        eng = _engine()
+        base = 64 << 20
+        remediate.set_knob_base("land_ring_bytes", base)
+        assert remediate.knob_override("land_ring_bytes") is None
+        self._stall(1)
+        eng._tune_ring(timeline.STORE, now=10.0)   # primes last sample
+        self._stall(3)
+        eng._tune_ring(timeline.STORE, now=20.0)
+        assert remediate.knob_override("land_ring_bytes") == base * 2
+        assert _counts("tune") == {"success": 1}
+
+    def test_rails_cap_at_8x_base(self):
+        eng = _engine()
+        base = 1 << 20
+        remediate.set_knob_base("land_ring_bytes", base)
+        now, v = 10.0, 0
+        for i in range(12):
+            v += 1
+            self._stall(v)
+            now += eng.observe_s + 1
+            eng._tune_ring(timeline.STORE, now=now)
+        assert remediate.knob_override("land_ring_bytes") == base * 8
+        assert eng._knobs["land_ring_bytes"]["max"] == base * 8
+
+    def test_oscillation_damping_one_direction_per_window(self):
+        """Satellite: an up-nudge must not be followed by a down-nudge
+        within the same observation window, however quiet the series
+        goes."""
+        eng = _engine()
+        base = 64 << 20
+        remediate.set_knob_base("land_ring_bytes", base)
+        self._stall(1)
+        eng._tune_ring(timeline.STORE, now=10.0)
+        self._stall(5)
+        eng._tune_ring(timeline.STORE, now=11.0)   # up ×2
+        assert remediate.knob_override("land_ring_bytes") == base * 2
+        self._stall(5)                              # quiet now
+        eng._tune_ring(timeline.STORE, now=11.5)
+        eng._tune_ring(timeline.STORE, now=11.0 + eng.observe_s - 0.5)
+        assert remediate.knob_override("land_ring_bytes") == base * 2
+
+    def test_down_nudge_after_quiet_window(self):
+        eng = _engine()
+        base = 64 << 20
+        remediate.set_knob_base("land_ring_bytes", base)
+        self._stall(1)
+        eng._tune_ring(timeline.STORE, now=10.0)
+        self._stall(5)
+        eng._tune_ring(timeline.STORE, now=11.0)   # up ×2
+        self._stall(5)                              # quiet
+        eng._tune_ring(timeline.STORE, now=12.0 + eng.observe_s)
+        assert remediate.knob_override("land_ring_bytes") is None  # back at base
+
+    def test_up_nudges_respect_their_own_window(self):
+        eng = _engine()
+        base = 64 << 20
+        remediate.set_knob_base("land_ring_bytes", base)
+        self._stall(1)
+        eng._tune_ring(timeline.STORE, now=10.0)
+        self._stall(2)
+        eng._tune_ring(timeline.STORE, now=11.0)   # ×2
+        self._stall(3)
+        eng._tune_ring(timeline.STORE, now=12.0)   # within window: no-op
+        assert remediate.knob_override("land_ring_bytes") == base * 2
+
+    def test_never_tunes_without_a_base(self):
+        eng = _engine()
+        self._stall(1)
+        eng._tune_ring(timeline.STORE, now=10.0)
+        self._stall(9)
+        eng._tune_ring(timeline.STORE, now=20.0)
+        assert remediate.payload()["knobs"] == {}
+        assert _counts("tune") == {}
+
+
+# ── Satellite 1: evidence-armed hedges share the deadline counters ──
+
+
+class TestHedgeAccounting:
+    def _bridge(self, tmp_path, monkeypatch):
+        from zest_tpu.config import Config
+        from zest_tpu.transfer import bridge as bridge_mod
+        from zest_tpu.transfer.bridge import XetBridge
+
+        monkeypatch.setattr(bridge_mod, "_HEDGE_EVIDENCE_WAIT_S", 0.05)
+        cfg = Config(hf_home=tmp_path / "hf",
+                     cache_dir=tmp_path / "zest")
+        br = XetBridge(cfg)
+        br.cas = object()  # authenticated enough for the hedge path
+        term = SimpleNamespace(xorb_hash=b"\x00" * 32,
+                               range=SimpleNamespace(start=0, end=4))
+        fi = SimpleNamespace(range=SimpleNamespace(start=0, end=4))
+        return br, term, fi
+
+    def test_evidence_hedge_win_bumps_shared_counters(self, tmp_path,
+                                                      monkeypatch):
+        br, term, fi = self._bridge(tmp_path, monkeypatch)
+        br.swarm = SimpleNamespace(
+            try_peer_download=lambda *a, **k: time.sleep(0.5))
+        sentinel = object()
+        monkeypatch.setattr(
+            br, "_cdn_fetch_for_term",
+            lambda *a, **k: sentinel, raising=False)
+        out = br.arm_hedge("anomaly:stall")
+        assert out == {"armed": True, "already": False,
+                       "reason": "anomaly:stall"}
+        assert br.arm_hedge()["already"] is True
+        try:
+            got = br._peer_tier(term, None, fi, "00" * 32)
+        finally:
+            br.close()
+        assert got is sentinel
+        assert br.stats.hedges == 1
+        assert br.stats.hedges_won == 1
+        assert br.stats.hedges_lost == 0
+        # The regression: these flow into stats.fetch.resilience.
+        res = br.stats.summary()["resilience"]
+        assert res["hedges"] == 1 and res["hedges_won"] == 1
+
+    def test_evidence_hedge_lost_waits_peer_out(self, tmp_path,
+                                                monkeypatch):
+        br, term, fi = self._bridge(tmp_path, monkeypatch)
+        blob = object()
+        br.swarm = SimpleNamespace(
+            try_peer_download=lambda *a, **k: time.sleep(0.2) or blob)
+
+        def cdn_fail(*a, **k):
+            raise OSError("cdn down")
+
+        monkeypatch.setattr(br, "_cdn_fetch_for_term", cdn_fail,
+                            raising=False)
+        br.arm_hedge()
+        try:
+            got = br._peer_tier(term, None, fi, "00" * 32)
+        finally:
+            br.close()
+        assert got is blob
+        assert br.stats.hedges == 1
+        assert br.stats.hedges_lost == 1
+        assert br.stats.hedges_won == 0
+
+    def test_unarmed_without_deadline_never_hedges(self, tmp_path,
+                                                   monkeypatch):
+        br, term, fi = self._bridge(tmp_path, monkeypatch)
+        blob = object()
+        br.swarm = SimpleNamespace(
+            try_peer_download=lambda *a, **k: blob)
+        try:
+            got = br._peer_tier(term, None, fi, "00" * 32)
+        finally:
+            br.close()
+        assert got is blob
+        assert br.stats.hedges == 0
+
+
+# ── Satellite 2: session eviction clears detector episode state ──
+
+
+class TestEpisodeEviction:
+    def test_finish_drops_detector_row(self):
+        timeline.ensure_started()
+        det = timeline.STORE.detector
+        sess = session_mod.begin("acme/m", "main")
+        det.observe_session(
+            SimpleNamespace(id=sess.id, phase="fetch", _fetch=None),
+            now=1.0)
+        assert sess.id in det._sessions
+        session_mod.finish(sess, "ok")
+        assert sess.id not in det._sessions
+
+    def test_one_stall_firing_per_distinct_session(self):
+        """Two sessions that each stall each get their own firing —
+        the first session's terminal eviction must not leave an
+        armed-off episode row suppressing the second's."""
+        _engine()
+        det = timeline.STORE.detector
+        fired = []
+        timeline.add_anomaly_listener(
+            lambda kind, sess, fields: fired.append(
+                (kind, getattr(sess, "id", None))))
+        for _ in range(2):
+            sess = session_mod.begin("acme/m", "main")
+            det._fire(timeline.ANOMALY_STALL, session=sess)
+            det._sessions.setdefault(
+                sess.id, {"fired": set()})["fired"] = {
+                    timeline.ANOMALY_STALL}
+            session_mod.finish(sess, "ok")
+            assert sess.id not in det._sessions
+        kinds = [k for k, _sid in fired if k == timeline.ANOMALY_STALL]
+        assert len(kinds) == 2
+        assert len({sid for _k, sid in fired}) == 2
+
+
+# ── Config mirror + strict action mask ──
+
+
+class TestConfig:
+    def _load(self, **env):
+        from zest_tpu.config import Config
+
+        base = {"HF_HOME": "/tmp/hf", "ZEST_CACHE_DIR": "/tmp/zc"}
+        base.update(env)
+        return Config.load(base)
+
+    def test_defaults(self):
+        cfg = self._load()
+        assert cfg.remediate_enabled is True
+        assert cfg.remediate_actions is None
+        assert cfg.remediate_dry_run is False
+        assert cfg.remediate_rate_s == 10.0
+        assert cfg.remediate_burst == 3
+
+    def test_mirrors_env(self):
+        cfg = self._load(ZEST_REMEDIATE="0",
+                         ZEST_REMEDIATE_ACTIONS="hedge,demote",
+                         ZEST_REMEDIATE_DRY="1",
+                         ZEST_REMEDIATE_RATE_S="2.5",
+                         ZEST_REMEDIATE_BURST="7")
+        assert cfg.remediate_enabled is False
+        assert cfg.remediate_actions == ("hedge", "demote")
+        assert cfg.remediate_dry_run is True
+        assert cfg.remediate_rate_s == 2.5
+        assert cfg.remediate_burst == 7
+
+    def test_unknown_action_raises(self):
+        with pytest.raises(ValueError, match="typo"):
+            self._load(ZEST_REMEDIATE_ACTIONS="hedge,typo")
+
+    def test_all_is_every_action(self):
+        assert self._load(
+            ZEST_REMEDIATE_ACTIONS="all").remediate_actions is None
+
+
+# ── Surfaces: /v1/remediations + zest heal ──
+
+
+@pytest.fixture
+def api(tmp_config, monkeypatch):
+    from zest_tpu.api.http_api import HttpApi
+
+    requests = pytest.importorskip("requests")
+    monkeypatch.setenv(timeline.ENV_HZ, "0.02")
+    timeline.reset()
+    tmp_config.http_port = 0
+    a = HttpApi(tmp_config)
+    port = a.start()
+    yield a, requests, f"http://127.0.0.1:{port}"
+    a.close()
+
+
+class TestSurfaces:
+    def test_http_remediations_payload(self, api):
+        _a, requests, base = api
+        _engine()
+        sess = session_mod.begin("acme/m", "main")
+        remediate.register_target(f"hedge:{sess.id}", lambda r: {})
+        remediate.ENGINE.on_anomaly(timeline.ANOMALY_STALL, sess, {})
+        doc = requests.get(f"{base}/v1/remediations", timeout=5).json()
+        assert doc["enabled"] is True
+        assert doc["counts"]["hedge"]["success"] == 1
+        assert doc["recent"][-1]["action"] == "hedge"
+        assert f"hedge:{sess.id}" in doc["targets"]
+        session_mod.finish(sess, "ok")
+
+    def test_http_dry_run_toggle(self, api):
+        _a, requests, base = api
+        _engine()
+        r = requests.post(f"{base}/v1/remediations",
+                          json={"dry_run": True}, timeout=5)
+        assert r.json() == {"dry_run": True}
+        assert remediate.ENGINE.dry_run is True
+        r = requests.post(f"{base}/v1/remediations",
+                          json={"dry_run": False}, timeout=5)
+        assert r.json() == {"dry_run": False}
+        bad = requests.post(f"{base}/v1/remediations",
+                            data=b"not json", timeout=5)
+        assert bad.status_code == 400
+
+    def test_heal_lines_render(self):
+        from zest_tpu.cli import _heal_lines
+
+        doc = {"enabled": True, "dry_run": False,
+               "actions": ["demote", "hedge"], "rate_s": 10.0,
+               "burst": 3, "shedding": True,
+               "knobs": {"land_ring_bytes": {
+                   "base": 64, "value": 128, "min": 64, "max": 512}},
+               "counts": {"hedge": {"success": 2, "rate_limited": 1}},
+               "recent": [{"t": 1700000000.0, "action": "hedge",
+                           "outcome": "success",
+                           "reason": "stall in phase fetch",
+                           "session": "p0001-aa"}]}
+        frame = "\n".join(_heal_lines(doc))
+        assert "LOAD SHEDDING ACTIVE" in frame
+        assert "knob land_ring_bytes: 128 (base 64" in frame
+        assert "success=2" in frame and "rate_limited=1" in frame
+        assert "session=p0001-aa" in frame
+
+    def test_heal_lines_disabled(self):
+        from zest_tpu.cli import _heal_lines
+
+        (line,) = _heal_lines({"enabled": False})
+        assert "pure observer" in line
+
+    def test_cmd_heal(self, api, monkeypatch, capsys):
+        from zest_tpu import cli
+
+        _a, _requests, base = api
+        monkeypatch.setenv("ZEST_HTTP_PORT", base.rsplit(":", 1)[1])
+        _engine()
+        assert cli.main(["heal"]) == 0
+        out = capsys.readouterr().out
+        assert "self-healing: live" in out
+        assert cli.main(["heal", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["enabled"] is True
+        assert cli.main(["heal", "--dry-run", "on"]) == 0
+        assert remediate.ENGINE.dry_run is True
+        assert cli.main(["heal", "--dry-run", "off"]) == 0
+        assert remediate.ENGINE.dry_run is False
+
+
+# ── Knob-off identity: ZEST_REMEDIATE=0 is a pure observer ──
+
+
+class TestKnobOffIdentity:
+    def test_pull_stats_schema_identical(self, tmp_path, monkeypatch):
+        from zest_tpu.config import Config
+        from zest_tpu.transfer.pull import pull_model
+
+        files = {"config.json": b'{"model_type": "heal"}',
+                 "model.safetensors": bytes(range(256)) * 400}
+        repo = FixtureRepo("acme/heal-model", files, chunks_per_xorb=3)
+
+        def cfg(hub, root):
+            return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                          hf_token="hf_test", endpoint=hub.url)
+
+        with FixtureHub(repo) as hub:
+            on = pull_model(cfg(hub, tmp_path / "on"),
+                            "acme/heal-model", no_p2p=True,
+                            log=lambda *a, **k: None)
+            assert remediate.ENGINE is not None  # pull started it
+            telemetry.reset_all()
+            tenancy.reset()
+            monkeypatch.setenv("ZEST_REMEDIATE", "0")
+            off = pull_model(cfg(hub, tmp_path / "off"),
+                             "acme/heal-model", no_p2p=True,
+                             log=lambda *a, **k: None)
+            assert remediate.ENGINE is None   # never built
+            assert sorted(on.stats) == sorted(off.stats)
+            for name in files:
+                assert (on.snapshot_dir / name).read_bytes() \
+                    == (off.snapshot_dir / name).read_bytes()
+
+    def test_reset_tears_everything_down(self):
+        _engine()
+        remediate.register_target("hedge:x", lambda r: {})
+        telemetry.reset_all()
+        assert remediate.ENGINE is None
+        assert timeline._anomaly_listeners == []
+        assert timeline._tick_listeners == []

@@ -1000,6 +1000,16 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"error": "unknown session"}, 404)
                 else:
                     self._json(detail)
+        elif path == "/v1/remediations":
+            # Self-healing control plane (ISSUE 17): the engine's
+            # enable/mask/rate state, live knob overrides, per-action
+            # outcome counts, and the recent decision log — what
+            # ``zest heal`` renders and what the MTTR bench asserts.
+            try:
+                limit = int(query.get("limit", ["50"])[0])
+            except ValueError:
+                limit = 50
+            self._json(telemetry.remediate.payload(limit=limit))
         elif path == "/v1/models":
             self._json(self.api.models_payload())
         elif path == "/":
@@ -1046,6 +1056,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._begin_sse()
             self._stream_sse(self.api.generate_events(req["repo_id"], req))
+        elif self.path == "/v1/remediations":
+            # ``zest heal --dry-run on|off``: flip decision-only mode on
+            # the live engine (decisions are logged and counted, no
+            # action executes). Body: {"dry_run": true|false}.
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                dry = bool(req["dry_run"])
+            except (json.JSONDecodeError, KeyError, TypeError,
+                    ValueError):
+                self._json({"error": "body must be JSON with dry_run"},
+                           400)
+                return
+            self._json({"dry_run": telemetry.remediate.set_dry_run(dry)})
         else:
             self._json({"error": "not found"}, 404)
 

@@ -678,6 +678,416 @@ def bench_swarm(gb: float = 0.064, m_pullers: int = 4, k_seeders: int = 4,
     return out
 
 
+def bench_mttr(gb: float = 0.02, runs: int = 2,
+               chunks_per_xorb: int = 8, scale: int = 8,
+               window_s: float = 0.6, hz: float = 20.0,
+               stall_s: float = 6.0,
+               corrupt_seed_bps: int = 1_000_000,
+               dcn_chunks_per_xorb: int = 32,
+               fault_seed: int = 1337,
+               dcn_fault_seed: int = 9) -> dict:
+    """Measured-MTTR chaos bench (ISSUE 17): detection-to-recovery with
+    the self-healing policy engine ON vs the same faults ridden out
+    hands-off (``ZEST_REMEDIATE=0`` — observer identical, actions off).
+
+    One fault class per scenario, each run twice (hands-off arm, then
+    policy arm) over ``runs`` cold pulls:
+
+    - **seeder_stall**: every seeder stalls ``stall_s`` per upload —
+      below the io-timeout floor, so the hands-off swarm never strikes
+      or reroutes; it just grinds one stall per request wave. The
+      policy arm's stall anomaly arms the mid-flight hedge, so every
+      wave after the first races the CDN with a sub-window peer head
+      start.
+    - **seeder_choke_flap**: spurious chokes. Honest non-win — a choke
+      is a fast refusal and the waterfall already falls through to the
+      CDN at full speed; reported, not gated.
+    - **cdn_503**: origin 5xx bursts on a peer-less pull. Honest
+      non-win (the retry/backoff path is the remedy in both arms).
+    - **upload_corrupt**: the ONLY seeder serves flipped bytes, with
+      ``ZEST_PEER_STRIKES=99`` so the hands-off registry never
+      quarantines — every term pays a shaped corrupt fetch + CDN heal.
+      The policy arm's seeder scan demotes the peer on corrupt-strike
+      evidence (never *creating* a strike) and the rest of the pull is
+      pure fast CDN.
+    - **dcn_reset**: 2-host cooperative round where the partner owns
+      half the plan but has an EMPTY cache (permanent NOT_FOUND), and
+      the injected reset kills the channel a few barrier rounds in.
+      Hands-off rides the backoff ladder until the reset aborts it;
+      ``ZEST_REMEDIATE_PATIENCE=1`` aborts on the first straggler
+      firing instead.
+    - **control**: healthy swarm, no faults — proves the policy arm
+      executes ZERO actions and holds the peer-served ratio when
+      nothing is wrong (over-healing is itself a failure mode).
+
+    MTTR = last-byte time minus detection time, where detection is the
+    first ``anomaly`` flight event (falling back to the first
+    ``fault_fired`` event for classes the detector has no signature
+    for, e.g. corrupt bytes — identical definition in both arms; the
+    detector runs in both, only actions differ). The ``gates`` block is
+    the acceptance surface: ≥3 classes at ≤0.5× hands-off MTTR, zero
+    corrupt bytes admitted, every fault actually fired in the hands-off
+    arm (the policy arm may legitimately short-circuit a fault site —
+    an aborted exchange never rolls the reset dice again), every
+    executed action carrying before/after series, and the control
+    scenario clean."""
+    import contextlib
+    import os
+    import tempfile as _tempfile
+
+    from zest_tpu import faults, telemetry
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config
+    from zest_tpu.p2p.health import PROVENANCE
+    from zest_tpu.telemetry import recorder
+    from zest_tpu.telemetry import remediate as remediate_mod
+    from zest_tpu.telemetry import timeline as timeline_mod
+    from zest_tpu.transfer import bridge as bridge_mod
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.coop import coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.pull import pull_model
+    from zest_tpu.transfer.server import BtServer
+    from zest_tpu.transfer.swarm import SwarmDownloader
+
+    fixtures = _import_fixtures()
+    # Keep the armed hedge's peer head start under the anomaly window:
+    # at the default 1 s wait every hedged wave opens with a
+    # window-length zero-rate gap that re-arms the stall episode AND
+    # dominates the policy arm's per-term cost (the quantity under
+    # measurement is detection-to-recovery, not the evidence pause).
+    saved_wait = bridge_mod._HEDGE_EVIDENCE_WAIT_S
+    bridge_mod._HEDGE_EVIDENCE_WAIT_S = min(saved_wait, window_s / 2.0)
+    files = llama_checkpoint_files(gb, scale=scale, smooth=True,
+                                   shard_bytes=8 * 1024 * 1024)
+    total = sum(len(b) for b in files.values())
+    quiet = {"log": lambda *a, **k: None}
+
+    @contextlib.contextmanager
+    def _env(overlay: dict[str, str]):
+        saved = {k: os.environ.get(k) for k in overlay}
+        os.environ.update(overlay)
+        try:
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _measure(fault_spec, seed, extra_env, policy_on, run_fn):
+        """One arm of one run: env → fresh telemetry → faults → pull.
+        Env lands BEFORE reset_all so the rebuilt store/engine read it;
+        the fault injector is (re)installed per run so the deterministic
+        trial sequence restarts identically in both arms."""
+        overlay = {
+            "ZEST_TIMELINE_HZ": str(hz),
+            "ZEST_ANOMALY_WINDOW_S": str(window_s),
+            "ZEST_REMEDIATE": "1" if policy_on else "0",
+            **(extra_env or {}),
+        }
+        with _env(overlay):
+            telemetry.reset_all()
+            faults.install(fault_spec, seed)
+            try:
+                t0 = time.time()
+                extra = run_fn()
+                t1 = time.time()
+                fired = dict(faults.counters())
+            finally:
+                faults.install(None)
+            events = recorder.tail()
+        anomaly_ts = [e["t"] for e in events
+                      if e.get("kind") == "anomaly"]
+        fault_ts = [e["t"] for e in events
+                    if e.get("kind") == "fault_fired"]
+        rems = [e for e in events if e.get("kind") == "remediation"]
+        detect = (min(anomaly_ts) if anomaly_ts
+                  else min(fault_ts) if fault_ts else t0)
+        return {
+            "wall_s": t1 - t0,
+            "mttr_s": max(0.0, t1 - detect),
+            "detect_lag_s": max(0.0, detect - t0),
+            "detected": bool(anomaly_ts),
+            "faults_fired": fired,
+            "remediations": [
+                {"action": e.get("action"),
+                 "outcome": e.get("outcome"),
+                 "has_series": isinstance(e.get("before"), dict)
+                 and isinstance(e.get("after"), dict)}
+                for e in rems],
+            **extra,
+        }
+
+    def swarm_case(name, spec, k_seeders, extra_env=None, seed_bps=None,
+                   seed=fault_seed, no_p2p=False, cfg_overrides=None):
+        """Warm K seeders once (unfaulted), then both arms × runs of a
+        cold single-puller pull against them + the loopback hub."""
+        repo_id = f"bench/mttr-{name}"
+        repo = fixtures.FixtureRepo(repo_id, dict(files),
+                                    chunks_per_xorb=chunks_per_xorb)
+        with _tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+            scfgs = []
+            for i in range(k_seeders):
+                cfg = Config(hf_home=rootp / f"seed{i}/hf",
+                             cache_dir=rootp / f"seed{i}/zest",
+                             hf_token="hf_test", endpoint="unused",
+                             listen_port=0)
+                if seed_bps:
+                    cfg.seed_rate_bps = seed_bps
+                scfgs.append(cfg)
+            with fixtures.FixtureHub(repo) as warm_hub:
+                for cfg in scfgs:
+                    cfg.endpoint = warm_hub.url
+                    pull_model(cfg, repo_id, no_p2p=True, **quiet)
+            servers = [BtServer(cfg) for cfg in scfgs]
+            ports = [s.start() for s in servers]
+            try:
+                with fixtures.FixtureHub(repo) as hub:
+                    def one_pull(tag):
+                        PROVENANCE.reset()
+                        cfg = Config(hf_home=rootp / f"{tag}/hf",
+                                     cache_dir=rootp / f"{tag}/zest",
+                                     hf_token="hf_test",
+                                     endpoint=hub.url)
+                        for k, v in (cfg_overrides or {}).items():
+                            setattr(cfg, k, v)
+                        swarm = None
+                        if not no_p2p:
+                            swarm = SwarmDownloader(cfg)
+                            for p in ports:
+                                swarm.add_direct_peer("127.0.0.1", p)
+                        try:
+                            res = pull_model(cfg, repo_id, swarm=swarm,
+                                             no_p2p=no_p2p, **quiet)
+                            bad = 0
+                            for fname, want in files.items():
+                                got = (res.snapshot_dir
+                                       / fname).read_bytes()
+                                if got != want:
+                                    bad += sum(
+                                        a != b for a, b in
+                                        zip(got, want)
+                                    ) + abs(len(got) - len(want))
+                            fb = res.stats["fetch"]["bytes"]
+                            return {
+                                "corrupt_bytes_admitted": bad,
+                                "peer_bytes": fb.get("peer", 0),
+                                "cdn_bytes": fb.get("cdn", 0),
+                            }
+                        finally:
+                            if swarm is not None:
+                                swarm.close()
+
+                    arms = {}
+                    for arm, on in (("hands_off", False),
+                                    ("policy_on", True)):
+                        arms[arm] = [
+                            _measure(spec, seed, extra_env, on,
+                                     lambda r=r, a=arm:
+                                     one_pull(f"{a}{r}"))
+                            for r in range(runs)]
+                    return arms
+            finally:
+                for s in servers:
+                    s.shutdown()
+
+    def coop_case(spec, seed, extra_env):
+        """2-host collective round: host 1 serves an EMPTY cache (every
+        exchange window a NOT_FOUND barrier retry) so host 0's round
+        lives or dies by the abort policy."""
+        repo_id = "bench/mttr-dcn_reset"
+        repo = fixtures.FixtureRepo(repo_id, dict(files),
+                                    chunks_per_xorb=dcn_chunks_per_xorb)
+        with fixtures.FixtureHub(repo) as hub, \
+                _tempfile.TemporaryDirectory() as root:
+            rootp = pathlib.Path(root)
+
+            def one_round(tag):
+                def mk(i):
+                    cfg = Config(hf_home=rootp / f"{tag}h{i}/hf",
+                                 cache_dir=rootp / f"{tag}h{i}/zest",
+                                 hf_token="hf_test", endpoint=hub.url,
+                                 dcn_port=0, coop_collective=True)
+                    b = XetBridge(cfg)
+                    b.authenticate(repo_id)
+                    return b
+                b0, b1 = mk(0), mk(1)
+                s1 = DcnServer(b1.cfg, b1.cache)
+                port1 = s1.start()
+                try:
+                    recs = [b0.get_reconstruction(e.xet_hash)
+                            for e in HubClient(b0.cfg)
+                            .list_files(repo_id) if e.is_xet]
+                    # A bare coop_round has no pull entry to start the
+                    # observer for it: start the sampler (BOTH arms —
+                    # detection is measured hands-off too) and, when
+                    # ZEST_REMEDIATE=1, the policy engine.
+                    timeline_mod.ensure_started()
+                    remediate_mod.ensure_started()
+                    coop_round(b0, recs, 0, 2,
+                               {1: ("127.0.0.1", port1)})
+                    bad = 0
+                    out_f = rootp / f"{tag}.check"
+                    for e in HubClient(b0.cfg).list_files(repo_id):
+                        if not e.is_xet:
+                            continue
+                        b0.reconstruct_to_file(e.xet_hash, out_f)
+                        got = out_f.read_bytes()
+                        want = files[e.path]
+                        if got != want:
+                            bad += sum(a != b for a, b in
+                                       zip(got, want)) \
+                                + abs(len(got) - len(want))
+                    st = b0.stats
+                    return {
+                        "corrupt_bytes_admitted": bad,
+                        "peer_bytes": getattr(st, "bytes_from_peer",
+                                              0),
+                        "cdn_bytes": getattr(st, "bytes_from_cdn", 0),
+                    }
+                finally:
+                    s1.shutdown()
+                    b0.close()
+                    b1.close()
+
+            arms = {}
+            for arm, on in (("hands_off", False), ("policy_on", True)):
+                arms[arm] = [
+                    _measure(spec, seed, extra_env, on,
+                             lambda r=r, a=arm: one_round(f"{a}{r}"))
+                    for r in range(runs)]
+            return arms
+
+    def _agg(rs):
+        ms = sorted(r["mttr_s"] for r in rs)
+        peer = sum(r.get("peer_bytes", 0) for r in rs)
+        cdn = sum(r.get("cdn_bytes", 0) for r in rs)
+        fired: dict[str, int] = {}
+        for r in rs:
+            for k, v in r["faults_fired"].items():
+                fired[k] = fired.get(k, 0) + v
+        actions: dict[str, int] = {}
+        series_ok = True
+        for r in rs:
+            for e in r["remediations"]:
+                k = f'{e["action"]}:{e["outcome"]}'
+                actions[k] = actions.get(k, 0) + 1
+                if not e["has_series"]:
+                    series_ok = False
+        return {
+            "runs": len(rs),
+            "mttr_s": {"p50": round(ms[len(ms) // 2], 3),
+                       "p99": round(ms[-1], 3)},
+            "detect_lag_s": round(
+                sorted(r["detect_lag_s"]
+                       for r in rs)[len(rs) // 2], 3),
+            "detected_runs": sum(1 for r in rs if r["detected"]),
+            "wall_s": round(
+                sorted(r["wall_s"] for r in rs)[len(rs) // 2], 3),
+            "peer_served_ratio": (round(peer / (peer + cdn), 4)
+                                  if peer + cdn else None),
+            "corrupt_bytes_admitted": sum(
+                r["corrupt_bytes_admitted"] for r in rs),
+            "faults_fired": fired,
+            "actions": dict(sorted(actions.items())),
+            "remediations_have_series": series_ok,
+        }
+
+    cases = [
+        ("seeder_stall", {"kind": "swarm",
+                          "spec": f"seeder_stall:1.0@{stall_s}",
+                          # Narrow pipe (same rationale as the corrupt
+                          # case): the unhedged FIRST wave — workers
+                          # already inside the peer tier when the
+                          # detector arms the hedge — is one stall per
+                          # concurrent slot, so a wide pipe front-loads
+                          # stalls the policy can never race.
+                          "cfg_overrides":
+                              {"max_concurrent_downloads": 4},
+                          "k": 2}),
+        ("seeder_choke_flap", {"kind": "swarm",
+                               "spec": "seeder_choke_flap:0.6",
+                               "k": 2}),
+        ("cdn_503", {"kind": "swarm", "spec": "cdn_503:0.3", "k": 0,
+                     "no_p2p": True}),
+        ("upload_corrupt", {"kind": "swarm",
+                            "spec": "upload_corrupt:1.0", "k": 1,
+                            "seed_bps": corrupt_seed_bps,
+                            # Narrow pipe: the corrupt-fetch tax is per
+                            # connection; wide concurrency would hide
+                            # the shaped seeder behind the loopback CDN.
+                            "cfg_overrides":
+                                {"max_concurrent_downloads": 4},
+                            "env": {"ZEST_PEER_STRIKES": "99"}}),
+        ("dcn_reset", {"kind": "coop", "spec": "dcn_reset:0.05",
+                       "seed": dcn_fault_seed,
+                       "env": {"ZEST_REMEDIATE_PATIENCE": "1"}}),
+        ("control", {"kind": "swarm", "spec": None, "k": 2}),
+    ]
+    out: dict = {
+        "model_bytes": total,
+        "runs": runs,
+        "window_s": window_s,
+        "hz": hz,
+        "cases": {},
+    }
+    try:
+        for name, c in cases:
+            if c["kind"] == "coop":
+                arms = coop_case(c["spec"], c.get("seed", fault_seed),
+                                 c.get("env"))
+            else:
+                arms = swarm_case(name, c["spec"], c["k"],
+                                  extra_env=c.get("env"),
+                                  seed_bps=c.get("seed_bps"),
+                                  seed=c.get("seed", fault_seed),
+                                  no_p2p=c.get("no_p2p", False),
+                                  cfg_overrides=c.get("cfg_overrides"))
+            ho, po = _agg(arms["hands_off"]), _agg(arms["policy_on"])
+            ratio = (round(po["mttr_s"]["p50"] / ho["mttr_s"]["p50"], 3)
+                     if ho["mttr_s"]["p50"] > 0 else None)
+            out["cases"][name] = {
+                "fault_spec": c["spec"],
+                "hands_off": ho,
+                "policy_on": po,
+                "mttr_ratio": ratio,
+                "win": bool(name != "control" and ratio is not None
+                            and ratio <= 0.5),
+            }
+    finally:
+        bridge_mod._HEDGE_EVIDENCE_WAIT_S = saved_wait
+        # Rebuild the default store/engine once the env games are over.
+        telemetry.reset_all()
+
+    fault_cases = [n for n, _ in cases if n != "control"]
+    wins = [n for n in fault_cases if out["cases"][n]["win"]]
+    corrupt = sum(out["cases"][n][arm]["corrupt_bytes_admitted"]
+                  for n, _ in cases
+                  for arm in ("hands_off", "policy_on"))
+    ctl = out["cases"]["control"]
+    ctl_exec = sum(v for k, v in ctl["policy_on"]["actions"].items()
+                   if k.endswith(":success") or k.endswith(":failed"))
+    out["gates"] = {
+        "classes_at_half": wins,
+        "classes_at_half_ok": len(wins) >= 3,
+        "corrupt_bytes_admitted": corrupt,
+        "all_faults_fired": all(
+            out["cases"][n]["hands_off"]["faults_fired"].get(n, 0) > 0
+            for n in fault_cases),
+        "remediations_have_series": all(
+            out["cases"][n]["policy_on"]["remediations_have_series"]
+            for n in fault_cases),
+        "control_actions_executed": ctl_exec,
+        "peer_ratio_ok": (
+            (ctl["policy_on"]["peer_served_ratio"] or 0.0)
+            >= (ctl["hands_off"]["peer_served_ratio"] or 0.0) - 0.05),
+    }
+    return out
+
+
 def bench_delta_pull(gb: float = 2.0, runs: int = 3,
                      chunks_per_xorb: int = 512, scale: int = 2,
                      mutate_fraction: float = 0.01,

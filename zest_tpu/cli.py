@@ -965,6 +965,97 @@ def cmd_ps(args) -> int:
         return 0
 
 
+def _heal_lines(doc: dict) -> list[str]:
+    """Render ``/v1/remediations`` as the operator view: engine mode,
+    live knob overrides, per-action outcome counts, recent decisions."""
+    lines: list[str] = []
+    if not doc.get("enabled"):
+        return ["self-healing off (ZEST_REMEDIATE=0 or timelines off) "
+                "— the process is a pure observer"]
+    mode = "DRY-RUN (decisions only)" if doc.get("dry_run") else "live"
+    lines.append(
+        f"self-healing: {mode}  "
+        f"actions={','.join(doc.get('actions') or []) or '-'}  "
+        f"rate={doc.get('rate_s')}s/token burst={doc.get('burst')}")
+    if doc.get("shedding"):
+        lines.append("LOAD SHEDDING ACTIVE — new queued pulls answer "
+                     "429 until the SLO burn recovers")
+    for name, k in sorted((doc.get("knobs") or {}).items()):
+        if k.get("value") != k.get("base"):
+            lines.append(
+                f"knob {name}: {k.get('value')} "
+                f"(base {k.get('base')}, rails "
+                f"[{k.get('min')}, {k.get('max')}])")
+    counts = doc.get("counts") or {}
+    if counts:
+        lines.append("decisions:")
+        for action, outcomes in sorted(counts.items()):
+            pairs = "  ".join(f"{o}={n}"
+                              for o, n in sorted(outcomes.items()))
+            lines.append(f"  {action:<8} {pairs}")
+    else:
+        lines.append("decisions: none yet")
+    recent = doc.get("recent") or []
+    if recent:
+        lines.append("recent:")
+    for e in recent[-10:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("t", 0)))
+        row = (f"  {ts}  {e.get('action', '?'):<8} "
+               f"{e.get('outcome', '?'):<12} {e.get('reason', '')}")
+        if e.get("session"):
+            row += f"  session={e['session']}"
+        lines.append(row)
+    return lines
+
+
+def cmd_heal(args) -> int:
+    """``zest heal [--watch|--json|--dry-run on|off]`` — the daemon's
+    self-healing control plane (``/v1/remediations``): what the policy
+    engine decided, on which anomaly, with which outcome, plus live
+    knob overrides and shed state."""
+    cfg = Config.load()
+    if args.dry_run is not None:
+        try:
+            import requests
+        except ImportError:
+            print("daemon not running", file=sys.stderr)
+            return 1
+        want = args.dry_run == "on"
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{cfg.effective_http_port()}"
+                "/v1/remediations",
+                json={"dry_run": want}, timeout=2.0)
+            ok = r.ok
+        except requests.RequestException:
+            ok = False
+        if not ok:
+            print("daemon not running", file=sys.stderr)
+            return 1
+        print(f"dry-run {'on' if want else 'off'}")
+        return 0
+    frames = 0
+    try:
+        while True:
+            payload = _daemon_get(cfg, f"/v1/remediations?limit="
+                                       f"{args.limit}")
+            if payload is None:
+                print("daemon not running", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(payload, indent=2))
+            else:
+                if args.watch and sys.stdout.isatty():
+                    sys.stdout.write("\x1b[H\x1b[2J")
+                print("\n".join(_heal_lines(payload)))
+            frames += 1
+            if not args.watch or (args.count and frames >= args.count):
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_analyze(args) -> int:
     """``zest analyze <trace.json>`` — automated critical-path
     attribution over a completed trace export (solo or a
@@ -1364,6 +1455,24 @@ def build_parser() -> argparse.ArgumentParser:
     ps_p.add_argument("--count", type=int, default=0,
                       help="with --watch: stop after N frames")
     ps_p.set_defaults(fn=cmd_ps)
+
+    heal_p = sub.add_parser(
+        "heal", help="self-healing control plane: the remediation "
+                     "engine's decisions, knob overrides, shed state")
+    heal_p.add_argument("--json", action="store_true",
+                        help="raw /v1/remediations document")
+    heal_p.add_argument("--watch", action="store_true",
+                        help="live redraw (Ctrl-C exits)")
+    heal_p.add_argument("--interval", type=float, default=1.0,
+                        help="redraw interval seconds (default 1.0)")
+    heal_p.add_argument("--count", type=int, default=0,
+                        help="with --watch: stop after N frames")
+    heal_p.add_argument("--limit", type=int, default=50,
+                        help="recent decisions to fetch (default 50)")
+    heal_p.add_argument("--dry-run", choices=["on", "off"], default=None,
+                        help="flip decision-only mode on the live "
+                             "engine (no action executes)")
+    heal_p.set_defaults(fn=cmd_heal)
 
     top_p = sub.add_parser(
         "top", help="live full-screen view: session progress bars, "

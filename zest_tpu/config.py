@@ -179,6 +179,27 @@ def parse_topology(spec: str) -> tuple[int, ...]:
     return tuple(out)
 
 
+def _parse_remediate_actions(spec: str) -> tuple[str, ...] | None:
+    """The ``ZEST_REMEDIATE_ACTIONS`` enable mask, strictly: empty or
+    ``all`` means every action (None); otherwise each comma-separated
+    name must be a known action — a typo here silently disables a
+    remediation the operator thinks is armed, exactly the failure the
+    strict knobs exist for. (The engine's own ``parse_actions`` stays
+    lenient: a typo must not crash a pull mid-flight.)"""
+    spec = (spec or "").strip().lower()
+    if not spec or spec == "all":
+        return None
+    from zest_tpu.telemetry.remediate import ACTIONS
+
+    names = tuple(p.strip() for p in spec.split(",") if p.strip())
+    bad = sorted(set(names) - set(ACTIONS))
+    if bad:
+        raise ValueError(
+            f"ZEST_REMEDIATE_ACTIONS names unknown action(s) {bad}; "
+            f"valid: {', '.join(ACTIONS)}")
+    return names
+
+
 def _opt_pos_float(env: dict[str, str], name: str) -> float | None:
     """Optional positive float knob: unset/empty/0 = unarmed (None); a
     malformed OR negative value raises (same typo discipline as
@@ -414,6 +435,18 @@ class Config:
     timeline_enabled: bool = True
     timeline_hz: float = DEFAULT_TIMELINE_HZ
     anomaly_window_s: float = DEFAULT_ANOMALY_WINDOW_S
+    # Self-healing control plane (telemetry.remediate; ISSUE 17): the
+    # engine reads the env directly like the sampler — these fields are
+    # the introspection mirror. ``remediate_actions`` is the enable
+    # mask (None = every action); it parses STRICTLY here (an unknown
+    # action name silently disabling a remediation is exactly the typo
+    # class the strict knobs exist for), while the engine itself stays
+    # lenient (a typo must not crash a pull).
+    remediate_enabled: bool = True
+    remediate_actions: tuple[str, ...] | None = None
+    remediate_dry_run: bool = False
+    remediate_rate_s: float = 10.0
+    remediate_burst: int = 3
 
     # ── Construction ──
 
@@ -598,6 +631,19 @@ class Config:
             anomaly_window_s=_strict_pos_float(
                 env, "ZEST_ANOMALY_WINDOW_S", DEFAULT_ANOMALY_WINDOW_S,
                 floor=0.05),
+            # Same off-value convention as ZEST_TIMELINE; the action
+            # mask is the one strict parse (see the field comment).
+            remediate_enabled=env.get("ZEST_REMEDIATE", "").strip().lower()
+            not in _TELEMETRY_OFF_VALUES,
+            remediate_actions=_parse_remediate_actions(
+                env.get("ZEST_REMEDIATE_ACTIONS", "")),
+            remediate_dry_run=env.get(
+                "ZEST_REMEDIATE_DRY", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            remediate_rate_s=_strict_pos_float(
+                env, "ZEST_REMEDIATE_RATE_S", 10.0, floor=0.01),
+            remediate_burst=_strict_nonneg_int(
+                env, "ZEST_REMEDIATE_BURST", default=3, floor=1),
         )
 
     # ── Path builders (reference: src/config.zig:95-133) ──

@@ -1231,6 +1231,16 @@ def stage_cached_to_hbm(
     if ring_bytes is None:
         ring_bytes = getattr(cfg, "land_ring_bytes",
                              DEFAULT_LAND_RING_BYTES)
+        # Auto-tuner override (ISSUE 17): the remediation engine may
+        # hold a railed override for this knob — nudged up (×2, capped
+        # at 8× the configured base) when the ring-stall series grows,
+        # decayed back toward the base after a quiet observation
+        # window. An explicit ring_bytes argument always wins; with
+        # ZEST_REMEDIATE=0 the override is always None.
+        telemetry.remediate.set_knob_base("land_ring_bytes", ring_bytes)
+        _override = telemetry.remediate.knob_override("land_ring_bytes")
+        if _override:
+            ring_bytes = _override
     if ring_slots is None:
         ring_slots = getattr(cfg, "land_ring_slots",
                              DEFAULT_LAND_RING_SLOTS)

@@ -110,6 +110,7 @@ class HealthRegistry:
         self._peers: dict[Addr, PeerHealth] = {}
         self._lock = threading.Lock()
         self.quarantine_events = 0
+        self.demotions = 0
         self._listeners: list = []
 
     def now(self) -> float:
@@ -234,6 +235,35 @@ class HealthRegistry:
         self._notify(events)
         return tripped
 
+    def demote(self, addr: Addr, window_s: float | None = None) -> float:
+        """Proactive remediation demotion (ISSUE 17): pull the peer out
+        of candidate ordering for one base quarantine window so the
+        swarm re-announces and traffic shifts — WITHOUT a strike.
+
+        The failure-semantics rule this encodes: a remediation may
+        never *create* a strike against a healthy peer. Strikes (and
+        the doubling-window backoff depth they feed) stay reserved for
+        observed failures recorded by the subsystems that witnessed
+        them; a demotion leaves ``strikes``/``strike_kinds``/
+        ``quarantines`` untouched, so the peer re-enters through the
+        existing probation path with exactly the record its real
+        behavior earned. Returns the window applied."""
+        window = (self.quarantine_base_s if window_s is None
+                  else max(0.0, window_s))
+        with self._lock:
+            p = self._peer_locked(addr)
+            p.quarantined_until = max(p.quarantined_until,
+                                      self._time() + window)
+            p.in_quarantine = True
+            self.demotions += 1
+        telemetry.record("peer_demoted", peer=f"{addr[0]}:{addr[1]}",
+                         window_s=round(window, 2))
+        # Same transition surface as the breaker: the swarm's
+        # re-announce listener treats any membership-changing event
+        # alike, and probation fires on expiry as usual.
+        self._notify([("demoted", addr)])
+        return window
+
     # ── Queries ──
 
     def is_quarantined(self, addr: Addr) -> bool:
@@ -319,6 +349,7 @@ class HealthRegistry:
                     if now < p.quarantined_until
                 ),
                 "quarantine_events": self.quarantine_events,
+                "demotions": self.demotions,
                 "corrupt_strikes": sum(
                     p.corruptions for p in self._peers.values()
                 ),

@@ -63,6 +63,7 @@ from zest_tpu.telemetry.recorder import record  # noqa: F401
 from zest_tpu.telemetry import session as session  # noqa: PLC0414
 from zest_tpu.telemetry import critpath as critpath  # noqa: PLC0414
 from zest_tpu.telemetry import timeline as timeline  # noqa: PLC0414
+from zest_tpu.telemetry import remediate as remediate  # noqa: PLC0414
 
 __all__ = [
     "REGISTRY",
@@ -81,6 +82,7 @@ __all__ = [
     "histogram",
     "record",
     "recorder",
+    "remediate",
     "render_prometheus",
     "reset_all",
     "session",
@@ -118,3 +120,4 @@ def reset_all() -> None:
     recorder.reset()
     session.reset()
     timeline.reset()
+    remediate.reset()

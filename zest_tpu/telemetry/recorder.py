@@ -99,7 +99,21 @@ class FlightRecorder:
                 continue
             if k in ("t", "kind"):  # field names the envelope owns
                 k = f"{k}_"
-            ev[k] = v if isinstance(v, (str, int, float, bool)) else str(v)
+            if isinstance(v, (str, int, float, bool)):
+                ev[k] = v
+            elif isinstance(v, (dict, list, tuple)):
+                # Structured payloads (the remediation events' before/
+                # after timeline snapshots, ISSUE 17) stay machine-
+                # readable when JSON-clean; anything dirtier falls back
+                # to the scalar coercion below.
+                try:
+                    json.dumps(v)
+                except (TypeError, ValueError):
+                    ev[k] = str(v)
+                else:
+                    ev[k] = list(v) if isinstance(v, tuple) else v
+            else:
+                ev[k] = str(v)
         with self._lock:
             self._ring.append(ev)
             self.recorded += 1

@@ -1,0 +1,786 @@
+"""Anomaly → action: the self-healing control plane (ISSUE 17).
+
+PR 14 made the system *see* its failures — the timeline's streaming
+anomaly detector fires ``stall`` / ``throughput_collapse`` /
+``queue_stuck`` / ``collective_straggler`` — but every remediation was
+still a human reading ``zest top``. This module closes the loop: a
+policy engine subscribed to the anomaly stream
+(:func:`timeline.add_anomaly_listener`) plus the sampler tick
+(:func:`timeline.add_tick_listener`) that maps each firing to a
+**bounded, rate-limited, reversible** action through recovery paths
+that already exist:
+
+========================  ========  ==============================
+anomaly / evidence        action    recovery path it drives
+========================  ========  ==============================
+stall / collapse on a     hedge     ``XetBridge.arm_hedge`` — the
+fetch-bound session                 existing hedge pool races the
+                                    next waterfall tier mid-flight
+                                    (no deadline required anymore)
+collective_straggler      strike    ``health.record_failure`` on
+                                    the blamed partner → the
+                                    quarantine re-shard path; past
+                                    a patience budget, a mid-round
+                                    abort down the PR-13 ladder
+collapsing seeder         demote    ``health.demote`` + swarm
+(served-bytes EWMA +                re-announce — proactive, BEFORE
+strike kinds)                       the strike budget exhausts
+queue_stuck + SLO burn    shed      ``AdmissionController.shed`` —
+projecting a breach                 lowest-deficit queued tenants
+                                    get 429/Retry-After; re-admit
+                                    when burn recovers
+ring-stall growth         tune      ``ZEST_LAND_RING_BYTES``-class
+                                    knob nudges within hard rails
+========================  ========  ==============================
+
+Safety rails, all pinned by test:
+
+- **Per-action token buckets** (``ZEST_REMEDIATE_BURST`` capacity,
+  one token per ``ZEST_REMEDIATE_RATE_S``): a flapping detector can
+  never drive an action storm.
+- **Enable mask** ``ZEST_REMEDIATE_ACTIONS`` (comma list; default
+  all): a masked action records the decision as ``disabled`` and
+  touches nothing.
+- **Dry-run** (``ZEST_REMEDIATE_DRY=1`` or ``zest heal --dry-run``):
+  every decision recorded, no action executed.
+- **Oscillation damping**: a knob nudged one way must not nudge back
+  within ``ZEST_REMEDIATE_OBSERVE_S`` of the last nudge.
+- **Never strike the healthy**: a remediation may drive an action
+  against a peer only on anomaly/strike evidence already attributed
+  to it; the proactive path (``demote``) explicitly does NOT add a
+  strike — see ``HealthRegistry.demote``.
+- **Reversible**: hedges race (never cancel the primary), demotion
+  expires into the existing probation path, shed tenants re-admit on
+  burn recovery, and knob nudges never leave [configured base,
+  hard cap].
+
+Every decision — executed or not — is a flight-recorder event (kind
+``remediation``) carrying before/after timeline snapshots, a
+``zest_remediations_total{action,outcome}`` sample, and a row on
+``GET /v1/remediations`` / ``zest heal``. ``ZEST_REMEDIATE=0``
+(default **on**) restores pure-observer behavior bit-for-bit: no
+listener state, no registered targets, no events, no metric.
+
+Import discipline: telemetry imports nothing from the rest of
+``zest_tpu``, so action *targets* (the bridge's hedge armer, the
+admission shedder, the swarm's demoter, the collective's abort hook)
+are injected by their owners via :func:`register_target` — the same
+direction as ``timeline.register_probe``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from zest_tpu.telemetry import metrics, recorder
+from zest_tpu.telemetry import session as session_mod
+from zest_tpu.telemetry import timeline
+
+ENV_REMEDIATE = "ZEST_REMEDIATE"
+ENV_ACTIONS = "ZEST_REMEDIATE_ACTIONS"
+ENV_DRY = "ZEST_REMEDIATE_DRY"
+ENV_RATE_S = "ZEST_REMEDIATE_RATE_S"
+ENV_BURST = "ZEST_REMEDIATE_BURST"
+ENV_PATIENCE = "ZEST_REMEDIATE_PATIENCE"
+ENV_BURN_MAX = "ZEST_REMEDIATE_BURN_MAX"
+ENV_OBSERVE_S = "ZEST_REMEDIATE_OBSERVE_S"
+
+ACTIONS = ("hedge", "strike", "demote", "shed", "tune")
+
+DEFAULT_RATE_S = 10.0     # seconds per replenished token, per action
+DEFAULT_BURST = 3         # token-bucket capacity, per action
+DEFAULT_PATIENCE = 2      # straggler firings before a mid-round abort
+DEFAULT_BURN_MAX = 0.1    # SLO burn ratio that projects a breach
+DEFAULT_OBSERVE_S = 30.0  # oscillation-damping / demote-cooldown window
+_LOG_CAP = 256            # decision ring behind /v1/remediations
+_SNAP_SAMPLES = 8         # samples per series in a before/after snapshot
+
+# Hard rails for the ring auto-tuner: never below the configured base
+# (a test's 1 MiB ring must stay 1 MiB-scale), never above base×8 or
+# the absolute cap, whichever is smaller.
+RING_KNOB = "land_ring_bytes"
+RING_GROWTH_CAP = 8
+RING_ABS_CAP_BYTES = 4 * 1024 * 1024 * 1024
+
+# Strike kinds that count as "this seeder is going bad" evidence for
+# the proactive demote rule (all recorded by OTHER subsystems on real
+# failures — the engine itself never invents one).
+_DEMOTE_EVIDENCE_KINDS = ("corrupt", "seed_stall", "stalled_reader",
+                          "io_timeout", "error")
+_DEMOTE_EVIDENCE_STRIKES = 2
+# Served-bytes EWMA collapse: recent < this fraction of the peer's own
+# peak (and the peak above a noise floor) reads as a collapsing seeder.
+_DEMOTE_COLLAPSE_FRACTION = 0.25
+_DEMOTE_COLLAPSE_FLOOR = 1 * 1024 * 1024
+
+_KIND_TO_ACTION = {
+    timeline.ANOMALY_STALL: "hedge",
+    timeline.ANOMALY_COLLAPSE: "hedge",
+    timeline.ANOMALY_STRAGGLER: "strike",
+    timeline.ANOMALY_QUEUE: "shed",
+}
+
+_OFF_VALUES = frozenset({"0", "false", "off", "no"})
+_ON_VALUES = frozenset({"1", "true", "on", "yes"})
+
+_M_REMEDIATIONS = metrics.counter(
+    "zest_remediations_total",
+    "Self-healing control-plane decisions, by action and outcome",
+    ("action", "outcome"))
+
+
+# ── On/off switch (lazy env resolution, same shape as timeline's) ──
+
+_flag_lock = threading.Lock()
+_forced: bool | None = None
+
+
+def enabled() -> bool:
+    """Default ON; ``ZEST_REMEDIATE=0`` is the pure-observer rollback.
+    Timeline off implies remediate off — there is no anomaly stream to
+    subscribe to."""
+    if not timeline.enabled():
+        return False
+    forced = _forced
+    if forced is not None:
+        return forced
+    raw = os.environ.get(ENV_REMEDIATE, "").strip().lower()
+    return raw not in _OFF_VALUES
+
+
+def set_enabled(on: bool | None) -> None:
+    """Test/CLI override; ``None`` returns to env resolution."""
+    global _forced
+    with _flag_lock:
+        _forced = on
+
+
+def parse_actions(raw: str | None) -> frozenset[str]:
+    """The ``ZEST_REMEDIATE_ACTIONS`` mask: comma-separated action
+    names; empty or ``all`` means every action. Unknown names are
+    ignored here (the engine must not crash a pull on a typo) —
+    ``Config.load`` is the strict front door that rejects them."""
+    raw = (raw or "").strip().lower()
+    if not raw or raw == "all":
+        return frozenset(ACTIONS)
+    return frozenset(p.strip() for p in raw.split(",")
+                     if p.strip() in ACTIONS)
+
+
+def _enabled_actions() -> frozenset[str]:
+    return parse_actions(os.environ.get(ENV_ACTIONS))
+
+
+class _TokenBucket:
+    """Per-action rate limit: ``capacity`` tokens, one replenished
+    every ``refill_s`` — a flapping detector drains the bucket and the
+    engine goes quiet instead of storming the recovery paths."""
+
+    __slots__ = ("capacity", "refill_s", "tokens", "last_t")
+
+    def __init__(self, capacity: int, refill_s: float):
+        self.capacity = max(1, capacity)
+        self.refill_s = max(refill_s, 1e-9)
+        self.tokens = float(self.capacity)
+        self.last_t = time.monotonic()
+
+    def take(self, now: float) -> bool:
+        self.tokens = min(float(self.capacity),
+                          self.tokens + (now - self.last_t) / self.refill_s)
+        self.last_t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class RemediationEngine:
+    """The policy engine: anomaly/tick subscriber, injected-target
+    registry, decision log, and the safety rails."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._log: deque = deque(maxlen=_LOG_CAP)
+        self._targets: dict[str, object] = {}
+        self._buckets: dict[str, _TokenBucket] = {}
+        self.counts: dict[tuple[str, str], int] = {}
+        # Straggler patience: firings observed since the current
+        # collective target registered (one exchange = one budget).
+        self._straggler_fired = 0
+        # Per-peer demote state: served-bytes peak + last demote time.
+        self._peers: dict[str, dict] = {}
+        self._shedding = False
+        # Knob state: base (configured), value (current), rails, and
+        # the last nudge (t, dir) the damping rule checks.
+        self._knobs: dict[str, dict] = {}
+        self._ring_last: float | None = None
+        # Decisions whose "after" snapshot settles on the next tick
+        # (the /v1/remediations view; the flight event carries the
+        # immediate post-action snapshot).
+        self._pending_after: list[tuple[dict, tuple[str, ...]]] = []
+
+        self.rate_s = _env_float(ENV_RATE_S, DEFAULT_RATE_S, 0.01)
+        self.burst = _env_int(ENV_BURST, DEFAULT_BURST, 1)
+        self.patience = _env_int(ENV_PATIENCE, DEFAULT_PATIENCE, 1)
+        self.burn_max = _env_float(ENV_BURN_MAX, DEFAULT_BURN_MAX, 1e-6)
+        self.observe_s = _env_float(ENV_OBSERVE_S, DEFAULT_OBSERVE_S,
+                                    0.01)
+        raw = os.environ.get(ENV_DRY, "").strip().lower()
+        self.dry_run = raw in _ON_VALUES
+
+    # ── Injected targets ──
+
+    def register_target(self, name: str, fn) -> None:
+        """Replace semantics, like ``timeline.register_probe``: the
+        latest owner of a name wins (benches rebuild swarms)."""
+        with self._lock:
+            self._targets[name] = fn
+            if name == "collective":
+                # A fresh exchange gets a fresh patience budget.
+                self._straggler_fired = 0
+
+    def unregister_target(self, name: str, fn=None) -> None:
+        """With ``fn`` given, remove only if that callable is still the
+        registered one — an old owner's teardown must not drop its
+        replacement's registration."""
+        with self._lock:
+            if fn is None or self._targets.get(name) is fn:
+                self._targets.pop(name, None)
+
+    # ── Snapshots ──
+
+    def _snapshot(self, names: tuple[str, ...]) -> dict:
+        """Tail samples of the named timeline series — the evidence a
+        decision was taken on (``before``) or left behind (``after``).
+        Pre-serialized structure (lists), so the flight recorder's
+        scalar coercion keeps it machine-readable as JSON."""
+        store = timeline.STORE
+        out: dict = {}
+        with store._lock:
+            for name in names:
+                s = store._series.get(name)
+                if s is None:
+                    continue
+                tail = list(s.ring)[-_SNAP_SAMPLES:]
+                out[name] = [[t, v] for _seq, t, v in tail]
+        return out
+
+    # ── The decision spine ──
+
+    def _decide(self, action: str, *, kind: str | None = None,
+                sid: str | None = None, reason: str = "",
+                series: tuple[str, ...] = (), execute=None,
+                detail: dict | None = None, gated: bool = True) -> dict:
+        """One policy decision end-to-end: mask → token bucket →
+        dry-run → execute, with the decision recorded whatever the
+        outcome. ``gated=False`` skips mask+bucket — used only for
+        *reversal* legs (shed recovery), which must never be the thing
+        the rate limit blocks."""
+        now = time.monotonic()
+        detail = dict(detail or {})
+        before = self._snapshot(series)
+        outcome = "success"
+        with self._lock:
+            if gated and action not in _enabled_actions():
+                outcome = "disabled"
+            elif gated and not self._bucket(action).take(now):
+                outcome = "rate_limited"
+            elif execute is None:
+                outcome = "no_target"
+            elif self.dry_run:
+                outcome = "dry_run"
+        if outcome == "success":
+            try:
+                result = execute()
+                if isinstance(result, dict):
+                    detail.update(result)
+            except Exception as exc:  # noqa: BLE001 - the control plane
+                outcome = "failed"    # must never take the pull down
+                detail["error"] = str(exc)
+        after = self._snapshot(series)
+        entry = {
+            "t": round(time.time(), 3),
+            "action": action,
+            "outcome": outcome,
+            "anomaly": kind,
+            "session": sid,
+            "reason": reason,
+            "dry_run": self.dry_run,
+            "detail": detail,
+            "before": before,
+            "after": after,
+        }
+        with self._lock:
+            self._log.append(entry)
+            key = (action, outcome)
+            self.counts[key] = self.counts.get(key, 0) + 1
+            if series:
+                self._pending_after.append((entry, series))
+        _M_REMEDIATIONS.inc(action=action, outcome=outcome)
+        recorder.record(
+            "remediation", action=action, outcome=outcome,
+            anomaly=kind, session=sid, reason=reason,
+            detail=detail, before=before, after=after)
+        return entry
+
+    def _bucket(self, action: str) -> _TokenBucket:
+        b = self._buckets.get(action)
+        if b is None:
+            b = self._buckets[action] = _TokenBucket(self.burst,
+                                                     self.rate_s)
+        return b
+
+    # ── Anomaly-driven actions ──
+
+    def on_anomaly(self, kind: str, session, fields: dict) -> None:
+        action = _KIND_TO_ACTION.get(kind)
+        if action == "hedge":
+            self._act_hedge(kind, session, fields)
+        elif action == "strike":
+            self._act_straggler(kind, fields)
+        elif action == "shed":
+            self._act_shed(kind, fields)
+
+    def _act_hedge(self, kind: str, session, fields: dict) -> None:
+        """(a) stall / throughput_collapse on a fetch-bound session →
+        arm the bridge's mid-flight hedge to the next waterfall tier.
+        Evidence replaces the deadline the hedge path used to
+        require."""
+        sid = getattr(session, "id", None)
+        if sid is None:
+            return
+        phase = (fields or {}).get("phase") or getattr(session, "phase",
+                                                       "")
+        with self._lock:
+            fn = self._targets.get(f"hedge:{sid}")
+        if fn is None:
+            # No bridge registered for this session — not fetch-bound
+            # (or remediation was off when the pull started). Nothing
+            # to drive; stay silent rather than log a no_target per
+            # stall of an unrelated phase.
+            return
+        self._decide(
+            "hedge", kind=kind, sid=sid,
+            reason=f"{kind} in phase {phase or '?'}",
+            series=(f"session.{sid}.bytes", "fetch.cdn_bps",
+                    "fetch.peer_bps"),
+            execute=lambda: fn(f"anomaly:{kind}"),
+            detail={"phase": phase})
+
+    def _act_straggler(self, kind: str, fields: dict) -> None:
+        """(b) collective_straggler → strike the blamed partner so the
+        existing quarantine re-shard path re-plans its ownership on the
+        next phase; past the patience budget, request a mid-round abort
+        down the PR-13 ladder."""
+        partner = (fields or {}).get("partner")
+        with self._lock:
+            fn = self._targets.get("collective")
+            self._straggler_fired += 1
+            fired = self._straggler_fired
+        if fn is None or partner is None:
+            return
+        cmd = "abort" if fired >= self.patience else "strike"
+        self._decide(
+            "strike", kind=kind,
+            reason=(f"barrier straggler partner={partner} "
+                    f"(firing {fired}/{self.patience})"),
+            series=("collective.barrier_s", "collective.phase"),
+            execute=lambda: fn(cmd, int(partner)),
+            detail={"cmd": cmd, "partner": int(partner),
+                    "barrier_wait_s": (fields or {}).get(
+                        "barrier_wait_s")})
+
+    def _act_shed(self, kind: str, fields: dict) -> None:
+        """(d) queue_stuck + SLO burn projecting a breach → shed the
+        lowest-deficit queued tenants with 429/Retry-After."""
+        with self._lock:
+            fn = self._targets.get("shed")
+        if fn is None:
+            return
+        burn = _worst_burn()
+        if burn < self.burn_max:
+            self._decide(
+                "shed", kind=kind,
+                reason=(f"queue stuck but burn {burn:.3f} < "
+                        f"{self.burn_max:.3f} — no breach projected"),
+                series=("tenancy.queue_depth",),
+                execute=lambda: {"skipped": True},
+                detail={"burn": round(burn, 4), "cmd": "none"})
+            return
+        def _shed():
+            out = fn("shed")
+            with self._lock:
+                self._shedding = True
+            return out
+        self._decide(
+            "shed", kind=kind,
+            reason=(f"queue stuck with SLO burn {burn:.3f} ≥ "
+                    f"{self.burn_max:.3f}"),
+            series=("tenancy.queue_depth", "tenancy.active_pulls"),
+            execute=_shed,
+            detail={"burn": round(burn, 4), "cmd": "shed",
+                    "depth": (fields or {}).get("depth")})
+
+    # ── Tick-driven actions ──
+
+    def on_tick(self, store, now: float) -> None:
+        self._settle_after()
+        self._scan_seeders(now)
+        self._maybe_recover_shed()
+        self._tune_ring(store, now)
+
+    def _settle_after(self) -> None:
+        """Fill each recent decision's settled after-snapshot one tick
+        later — the /v1/remediations view shows the series AFTER the
+        action had a sampling interval to take effect."""
+        with self._lock:
+            pending, self._pending_after = self._pending_after, []
+        for entry, series in pending:
+            entry["after"] = self._snapshot(series)
+
+    def _scan_seeders(self, now: float) -> None:
+        """(c) collapsing seeder → proactive demote/re-announce BEFORE
+        the strike budget exhausts. Evidence only: near-budget strikes,
+        repeated bad-kind strikes, or a served-bytes EWMA that fell off
+        its own peak — and the demotion itself never adds a strike."""
+        with self._lock:
+            monitor = self._targets.get("peer_health")
+            demote = self._targets.get("demote")
+        if monitor is None or demote is None:
+            return
+        try:
+            view = monitor() or {}
+        except Exception:  # noqa: BLE001 - a dying monitor drops out
+            return
+        budget = int(view.get("strike_budget", 3))
+        for row in view.get("rows", ()):
+            addr = row.get("peer")
+            if not addr or row.get("quarantined_for_s"):
+                continue
+            served = float(row.get("served_bytes_recent") or 0.0)
+            st = self._peers.setdefault(addr, {"peak": 0.0,
+                                               "demoted_t": None})
+            st["peak"] = max(st["peak"], served)
+            if (st["demoted_t"] is not None
+                    and now - st["demoted_t"] < self.observe_s):
+                continue
+            strikes = int(row.get("strikes") or 0)
+            kinds = row.get("strike_kinds") or {}
+            bad = sum(int(kinds.get(k, 0))
+                      for k in _DEMOTE_EVIDENCE_KINDS)
+            collapsing = (st["peak"] > _DEMOTE_COLLAPSE_FLOOR
+                          and served < (_DEMOTE_COLLAPSE_FRACTION
+                                        * st["peak"]))
+            if strikes >= max(1, budget - 1):
+                reason = (f"strikes {strikes} one short of "
+                          f"budget {budget}")
+            elif bad >= _DEMOTE_EVIDENCE_STRIKES:
+                reason = f"{bad} bad-kind strikes ({dict(kinds)})"
+            elif collapsing and strikes >= 1:
+                reason = (f"served-bytes collapse "
+                          f"{int(served)} < 25% of peak "
+                          f"{int(st['peak'])} with a strike")
+            else:
+                continue
+            st["demoted_t"] = now
+            host, _, port = addr.rpartition(":")
+            self._decide(
+                "demote", reason=reason,
+                series=("seed.bps", "fetch.peer_bps"),
+                execute=lambda h=host, p=port: demote((h, int(p))),
+                detail={"peer": addr, "strikes": strikes,
+                        "served_recent": int(served)})
+
+    def _maybe_recover_shed(self) -> None:
+        """The reversal leg of (d): when burn falls back under half the
+        trigger, lift shedding so parked tenants re-admit. Ungated —
+        recovery must never be what the rate limit blocks."""
+        with self._lock:
+            if not self._shedding:
+                return
+            fn = self._targets.get("shed")
+        if fn is None:
+            with self._lock:
+                self._shedding = False
+            return
+        burn = _worst_burn()
+        if burn >= self.burn_max / 2.0:
+            return
+        def _recover():
+            out = fn("recover")
+            with self._lock:
+                self._shedding = False
+            return out
+        self._decide(
+            "shed",
+            reason=(f"burn recovered to {burn:.3f} < "
+                    f"{self.burn_max / 2.0:.3f} — re-admitting"),
+            series=("tenancy.queue_depth",),
+            execute=_recover,
+            detail={"burn": round(burn, 4), "cmd": "recover"},
+            gated=False)
+
+    # ── The knob auto-tuner ──
+
+    def set_knob_base(self, knob: str, base: int) -> None:
+        """Pin a knob's configured base + hard rails. Called by the
+        pull path with the value Config resolved — the tuner may only
+        move within [base, min(base×8, absolute cap)]."""
+        if knob != RING_KNOB:
+            return
+        with self._lock:
+            k = self._knobs.get(knob)
+            if k is not None and k["base"] == base:
+                return
+            self._knobs[knob] = {
+                "base": int(base),
+                "value": int(base),
+                "min": int(base),
+                "max": max(int(base),
+                           min(int(base) * RING_GROWTH_CAP,
+                               RING_ABS_CAP_BYTES)),
+                "last_t": None,
+                "last_dir": 0,
+            }
+
+    def knob_override(self, knob: str) -> int | None:
+        """The tuner's current override (None = configured base)."""
+        with self._lock:
+            k = self._knobs.get(knob)
+            if k is None or k["value"] == k["base"]:
+                return None
+            return int(k["value"])
+
+    def _tune_ring(self, store, now: float) -> None:
+        """(e) nudge ``ZEST_LAND_RING_BYTES`` from the observed
+        ``ring.stalls`` series: stall growth while a ring is live →
+        double within rails; a full quiet observation window → halve
+        back toward base. One direction per observation window (the
+        damping rail)."""
+        with store._lock:
+            s = store._series.get("ring.stalls")
+            stalls = s.ring[-1][2] if s is not None and s.ring else None
+        with self._lock:
+            k = self._knobs.get(RING_KNOB)
+            if k is None:
+                self._ring_last = stalls
+                return
+            last = self._ring_last
+            self._ring_last = stalls
+            grew = (stalls is not None and last is not None
+                    and stalls > last)
+            in_window = (k["last_t"] is not None
+                         and now - k["last_t"] < self.observe_s)
+            cur = k["value"]
+            if grew and cur < k["max"]:
+                # Damping: an up-nudge within the window of a DOWN
+                # nudge would oscillate; same-direction repeats are
+                # also one-per-window (each doubling deserves its own
+                # observation).
+                if in_window:
+                    return
+                new, direction = min(k["max"], cur * 2), 1
+            elif (not grew and stalls is not None and cur > k["min"]
+                    and not in_window and k["last_t"] is not None):
+                new, direction = max(k["min"], cur // 2), -1
+            else:
+                return
+            if new == cur:
+                return
+        self._decide(
+            "tune",
+            reason=("ring stalls growing" if direction > 0
+                    else f"quiet for {self.observe_s:.0f}s — easing "
+                         "back toward base"),
+            series=("ring.stalls", "ring.in_use_bytes"),
+            execute=lambda: self._apply_knob(RING_KNOB, new, direction,
+                                            now),
+            detail={"knob": RING_KNOB, "from": cur, "to": new,
+                    "dir": "up" if direction > 0 else "down"})
+
+    def _apply_knob(self, knob: str, new: int, direction: int,
+                    now: float) -> dict:
+        with self._lock:
+            k = self._knobs[knob]
+            k["value"] = int(new)
+            k["last_t"] = now
+            k["last_dir"] = direction
+        return {"applied": int(new)}
+
+    # ── Read side ──
+
+    def payload(self, limit: int = 50) -> dict:
+        with self._lock:
+            recent = [dict(e) for e in list(self._log)[-limit:]]
+            counts: dict[str, dict[str, int]] = {}
+            for (action, outcome), n in sorted(self.counts.items()):
+                counts.setdefault(action, {})[outcome] = n
+            knobs = {name: {kk: vv for kk, vv in k.items()
+                            if kk != "last_t"}
+                     for name, k in self._knobs.items()}
+            return {
+                "enabled": True,
+                "dry_run": self.dry_run,
+                "actions": sorted(_enabled_actions()),
+                "rate_s": self.rate_s,
+                "burst": self.burst,
+                "patience": self.patience,
+                "burn_max": self.burn_max,
+                "observe_s": self.observe_s,
+                "shedding": self._shedding,
+                "knobs": knobs,
+                "counts": counts,
+                "targets": sorted(self._targets),
+                "recent": recent,
+            }
+
+    def status_block(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "dry_run": self.dry_run,
+                "decisions": sum(self.counts.values()),
+                "shedding": self._shedding,
+            }
+
+
+def _worst_burn() -> float:
+    """The worst SLO burn ratio across armed SLOs (PR-10 burn math:
+    breaches/pulls per SLO from the session table) — the breach
+    projection behind (d)."""
+    try:
+        burns = session_mod.SESSIONS.slo_burn()
+    except Exception:  # noqa: BLE001 - advisory
+        return 0.0
+    worst = 0.0
+    for row in burns.values():
+        b = row.get("burn")
+        if isinstance(b, (int, float)):
+            worst = max(worst, float(b))
+    return worst
+
+
+def _env_float(name: str, default: float, floor: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v >= floor else default
+
+
+def _env_int(name: str, default: int, floor: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= floor else default
+
+
+# ── Process-wide instance + module-level hooks ──
+
+ENGINE: RemediationEngine | None = None
+_engine_lock = threading.Lock()
+_subscribed = False
+
+
+def ensure_started() -> bool:
+    """Build the engine and subscribe it to the anomaly/tick stream
+    (idempotent). Called from the pull entry next to
+    ``timeline.ensure_started``; a no-op (False) when knob-off — the
+    pure-observer contract starts here."""
+    if not enabled():
+        return False
+    global ENGINE, _subscribed
+    with _engine_lock:
+        if ENGINE is None:
+            ENGINE = RemediationEngine()
+        if not _subscribed:
+            timeline.add_anomaly_listener(_on_anomaly)
+            timeline.add_tick_listener(_on_tick)
+            _subscribed = True
+    return True
+
+
+def _on_anomaly(kind: str, session, fields: dict) -> None:
+    eng = ENGINE
+    if eng is not None and enabled():
+        eng.on_anomaly(kind, session, fields)
+
+
+def _on_tick(store, now: float) -> None:
+    eng = ENGINE
+    if eng is not None and enabled():
+        eng.on_tick(store, now)
+
+
+def register_target(name: str, fn) -> bool:
+    """Inject an action target (``hedge:<sid>``, ``collective``,
+    ``shed``, ``demote``, ``peer_health``). No-op (False) when the
+    engine is off — with ``ZEST_REMEDIATE=0`` no owner leaves a trace
+    here."""
+    if not ensure_started():
+        return False
+    ENGINE.register_target(name, fn)
+    return True
+
+
+def unregister_target(name: str, fn=None) -> None:
+    eng = ENGINE
+    if eng is not None:
+        eng.unregister_target(name, fn)
+
+
+def set_knob_base(knob: str, base: int) -> None:
+    if ensure_started():
+        ENGINE.set_knob_base(knob, base)
+
+
+def knob_override(knob: str) -> int | None:
+    eng = ENGINE
+    if eng is None or not enabled():
+        return None
+    return eng.knob_override(knob)
+
+
+def set_dry_run(on: bool) -> bool:
+    """The ``zest heal --dry-run`` toggle (POST /v1/remediations).
+    Returns the dry-run state now in effect."""
+    if not ensure_started():
+        return False
+    ENGINE.dry_run = bool(on)
+    return ENGINE.dry_run
+
+
+def payload(limit: int = 50) -> dict:
+    """The ``GET /v1/remediations`` document (an explicit
+    ``enabled: false`` stub when knob-off, mirroring timeline)."""
+    eng = ENGINE
+    if not enabled() or eng is None:
+        return {"enabled": enabled(), "counts": {}, "recent": []}
+    return eng.payload(limit=limit)
+
+
+def status_block() -> dict:
+    """The ``remediate`` block for ``/v1/status``."""
+    eng = ENGINE
+    if not enabled() or eng is None:
+        return {"enabled": enabled()}
+    return eng.status_block()
+
+
+def reset() -> None:
+    """Tests: drop the engine, unsubscribe, unresolve the flag."""
+    global ENGINE, _subscribed
+    with _engine_lock:
+        ENGINE = None
+        _subscribed = False
+    set_enabled(None)

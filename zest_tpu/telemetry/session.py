@@ -366,6 +366,18 @@ class SessionTable:
             sess.finish(status, error=error, stats=stats)
             self._active.pop(sess.id, None)
             self._recent.append(sess)
+        # Eviction listeners run OUTSIDE the lock (they reach into
+        # other modules — lock order is table → session only). The
+        # timeline's anomaly detector rides this to clear per-session
+        # episode state at finish time instead of the next sampler
+        # tick (ISSUE 17 satellite: a session terminating mid-episode
+        # during a sampler gap must not leave the detector armed-off
+        # for a reused id slot).
+        for cb in list(_evict_listeners):
+            try:
+                cb(sess.id)
+            except Exception:  # noqa: BLE001 - observers must not
+                pass           # break the terminal transition
 
     def note_slo(self, slo: str, breached: bool) -> None:
         with self._lock:
@@ -450,6 +462,18 @@ class SessionTable:
 SESSIONS = SessionTable()
 
 _tls = threading.local()
+
+# Module-wired like the recorder's session resolver below: survives
+# SessionTable swaps AND reset() — the timeline registers once at
+# import and must keep hearing evictions from every future table.
+_evict_listeners: list = []
+
+
+def add_evict_listener(cb) -> None:
+    """``cb(sid)`` after a session's terminal transition (the id left
+    the active table). Idempotent."""
+    if cb not in _evict_listeners:
+        _evict_listeners.append(cb)
 
 
 def begin(repo: str, revision: str = "main", tenant: str | None = None,

@@ -222,6 +222,13 @@ class AnomalyDetector:
                 except Exception:  # noqa: BLE001 - annotation is advisory
                     pass
         self.store._note_anomaly(kind, sid, fields)
+        # The anomaly STREAM (ISSUE 17): subscribers — the remediation
+        # engine — see every firing with the live session attached.
+        for cb in list(_anomaly_listeners):
+            try:
+                cb(kind, session, dict(fields))
+            except Exception:  # noqa: BLE001 - a subscriber must never
+                pass           # take the detector down
 
     # — per-session rules —
 
@@ -533,6 +540,15 @@ class TimelineStore:
             probe_vals.get("tenancy.admitted_total"), now)
         self.detector.observe_collective(cells, now)
 
+        # 6. Tick subscribers (ISSUE 17): the remediation engine's
+        # periodic rules (seeder scan, shed recovery, knob tuner) ride
+        # the sampler cadence instead of owning a thread.
+        for cb in list(_tick_listeners):
+            try:
+                cb(self, now)
+            except Exception:  # noqa: BLE001 - sampling must never crash
+                pass
+
     # — read side —
 
     def payload(self, since: int = 0, prefix: str | None = None) -> dict:
@@ -597,6 +613,55 @@ STORE = TimelineStore()
 
 _sampler_lock = threading.Lock()
 _sampler: _Sampler | None = None
+
+# Anomaly/tick subscribers (ISSUE 17). Module-level, not store-level:
+# subscribers outlive a test's store swap the same way probes don't —
+# they re-attach to whatever STORE currently is via the forwarding
+# call sites above.
+_anomaly_listeners: list = []
+_tick_listeners: list = []
+
+
+def add_anomaly_listener(cb) -> None:
+    """``cb(kind, session, fields)`` on every detector firing.
+    Idempotent: re-adding the same callable is a no-op."""
+    if cb not in _anomaly_listeners:
+        _anomaly_listeners.append(cb)
+
+
+def add_tick_listener(cb) -> None:
+    """``cb(store, now)`` after every sampling pass. Idempotent."""
+    if cb not in _tick_listeners:
+        _tick_listeners.append(cb)
+
+
+def remove_anomaly_listener(cb) -> None:
+    try:
+        _anomaly_listeners.remove(cb)
+    except ValueError:
+        pass
+
+
+def remove_tick_listener(cb) -> None:
+    try:
+        _tick_listeners.remove(cb)
+    except ValueError:
+        pass
+
+
+def _session_evicted(sid: str) -> None:
+    """Session-table eviction → detector episode teardown (ISSUE 17
+    satellite): a session that terminates mid-episode between ticks
+    used to leave its armed-off episode row behind, suppressing the
+    first firing of a new session reusing the id slot. Finish-time
+    eviction clears it regardless of sampler timing."""
+    try:
+        STORE.detector.drop_session(sid)
+    except Exception:  # noqa: BLE001 - teardown is advisory
+        pass
+
+
+session_mod.add_evict_listener(_session_evicted)
 
 
 def ensure_started() -> bool:
@@ -677,7 +742,8 @@ def status_block() -> dict:
 
 
 def reset() -> None:
-    """Tests: stop the sampler, drop the store, unresolve the flag."""
+    """Tests: stop the sampler, drop the store + subscribers,
+    unresolve the flag."""
     global _sampler
     with _sampler_lock:
         if _sampler is not None:
@@ -685,6 +751,8 @@ def reset() -> None:
             _sampler = None
     global STORE
     STORE = TimelineStore()
+    del _anomaly_listeners[:]
+    del _tick_listeners[:]
     set_enabled(None)
 
 

@@ -51,6 +51,11 @@ _M_EVENTS = telemetry.counter(
 _HEDGE_PEER_FRACTION = 0.3
 _HEDGE_PEER_WAIT_CAP_S = 10.0
 _HEDGE_PEER_WAIT_FLOOR_S = 0.05
+# Head start for EVIDENCE-armed hedges (ISSUE 17): no deadline to take
+# a fraction of, so the peer tier gets a fixed window before the CDN
+# racer starts. Generous next to the deadline path's floor — the
+# anomaly evidence says the peer is slow, not that a budget is burning.
+_HEDGE_EVIDENCE_WAIT_S = 1.0
 
 # Serializes partial cache writes PER XORB (64-way striped by hash):
 # entries keyed ``{hash}.{start}`` can collide across different-width
@@ -226,9 +231,17 @@ class XetBridge:
         # and fetches from several file workers at once, and an unlocked
         # dict would let _known_entries iterate mid-insert.
         self._recons_lock = threading.Lock()
-        # Lazy: only a deadline-armed pull ever hedges.
+        # Lazy: only a hedging pull (deadline- or evidence-armed) ever
+        # builds the pool.
         self._hedge_pool: ThreadPoolExecutor | None = None
         self._hedge_lock = threading.Lock()
+        # Evidence-armed hedging (ISSUE 17): the remediation engine
+        # arms this mid-flight on stall/collapse anomalies — the same
+        # hedge race the deadline path runs, without requiring
+        # ZEST_PULL_DEADLINE_S. Reads are racy-by-design (a fetch
+        # already past the check hedges on its next term).
+        self._hedge_armed = False
+        self._hedge_reason: str | None = None
         # A DCN listener the cooperative round started for this pull
         # (transfer.coop): it must outlive the round — peer hosts still
         # mid-exchange read from it — so it lives until close().
@@ -244,6 +257,19 @@ class XetBridge:
         self.flights = None
         self.cancel = None
         self.on_reconstruction = None
+
+    def arm_hedge(self, reason: str = "policy") -> dict:
+        """Arm mid-flight hedging on evidence instead of a deadline
+        (ISSUE 17): every subsequent peer-tier fetch gives the peer a
+        fixed ``_HEDGE_EVIDENCE_WAIT_S`` head start, then races the
+        CDN — through the SAME ``FetchStats`` hedge counters as the
+        deadline path (the satellite accounting fix). Idempotent and
+        reversible by construction: the primary fetch is never
+        cancelled, only raced."""
+        already = self._hedge_armed
+        self._hedge_armed = True
+        self._hedge_reason = reason
+        return {"armed": True, "already": already, "reason": reason}
 
     def adopt_coop_server(self, server) -> None:
         """Own a coop-round DCN listener until :meth:`close` (see
@@ -591,26 +617,36 @@ class XetBridge:
 
     def _peer_tier(self, term: recon.Term, rec: recon.Reconstruction,
                    fi: recon.FetchInfo, hash_hex: str):
-        """The swarm attempt, hedged when a deadline is armed.
+        """The swarm attempt, hedged when armed — by a deadline OR by
+        anomaly evidence (:meth:`arm_hedge`).
 
-        Returns the swarm's result (or None) in the common case. With a
-        deadline, the peer fetch runs in a side thread with a head start
-        of ``_HEDGE_PEER_FRACTION`` of the remaining budget (capped);
-        if it hasn't delivered by then, a CDN fetch races it from this
-        thread and the winner's :class:`XorbFetchResult` is returned —
-        no single slow peer can spend more of the budget than its
-        fraction."""
+        Returns the swarm's result (or None) in the common case. When
+        hedging, the peer fetch runs in a side thread with a head start
+        — ``_HEDGE_PEER_FRACTION`` of the remaining budget (capped) on
+        the deadline path, a fixed ``_HEDGE_EVIDENCE_WAIT_S`` on the
+        evidence path — then a CDN fetch races it from this thread and
+        the winner's :class:`XorbFetchResult` is returned. Both arming
+        modes share ONE code path past the head-start choice, so the
+        ``hedges``/``hedges_won``/``hedges_lost`` counters stay
+        mutually consistent however the hedge was armed (the satellite
+        accounting fix: the old shape bumped them deadline-only)."""
         deadline = self.deadline
-        if deadline is None or self.cas is None:
+        if (deadline is None and not self._hedge_armed) \
+                or self.cas is None:
             return self.swarm.try_peer_download(
                 term.xorb_hash, hash_hex, fi.range.start, fi.range.end,
                 deadline=deadline,
             )
-        remaining = deadline.remaining()
-        if remaining <= 0:
-            return None  # budget gone: tier 3 fails fast with its own check
-        wait_s = min(max(remaining * _HEDGE_PEER_FRACTION,
-                         _HEDGE_PEER_WAIT_FLOOR_S), _HEDGE_PEER_WAIT_CAP_S)
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                return None  # budget gone: tier 3 fails fast with its own
+                #              check
+            wait_s = min(max(remaining * _HEDGE_PEER_FRACTION,
+                             _HEDGE_PEER_WAIT_FLOOR_S),
+                         _HEDGE_PEER_WAIT_CAP_S)
+        else:
+            wait_s = _HEDGE_EVIDENCE_WAIT_S
         fut = self._ensure_hedge_pool().submit(
             self.swarm.try_peer_download,
             term.xorb_hash, hash_hex, fi.range.start, fi.range.end, deadline,
@@ -623,16 +659,21 @@ class XetBridge:
             return fut.result(timeout=wait_s)
         except FutureTimeoutError:
             pass
-        # Peer still in flight with the deadline at risk: hedge to CDN.
+        # Peer still in flight with the head start spent: hedge to CDN.
         self.stats.bump("hedges")
         try:
             result = self._cdn_fetch_for_term(term, rec, fi, hash_hex)
         except Exception:
             # The CDN racer failed; the in-flight peer fetch is the last
-            # hope — wait it out, bounded by the deadline.
+            # hope — wait it out, bounded by the deadline when one is
+            # armed (the evidence path has no budget to cap by: wait
+            # the adaptive peer timeouts out, like the unhedged path
+            # would have).
             self.stats.bump("hedges_lost")
             try:
-                return fut.result(timeout=max(deadline.remaining(), 0.001))
+                timeout = (max(deadline.remaining(), 0.001)
+                           if deadline is not None else None)
+                return fut.result(timeout=timeout)
             except FutureTimeoutError:
                 return None
         self.stats.bump("hedges_won")

@@ -545,132 +545,178 @@ def run_collective(bridge, plan, host_index: int,
                         out.setdefault(o, []).append((hh, fi))
         return out
 
-    for ph in sched.phases:
-        host, port = peers[ph.partner]
-        wants = [(hh, fi) for o in ph.owners for hh, fi in blocks[o]
-                 if not _already_cached(bridge, hh, fi)]
-        wants = _layer_order(wants, priorities)
-        t_phase = time.monotonic()
-        # Live cells for the timeline sampler (ISSUE 15): the current
-        # phase index + partner and the cumulative barrier wait — what
-        # the per-phase straggler rule attributes from. Cleared by
-        # finish() so a finished exchange stops reporting a phase.
-        telemetry.timeline.post("collective.phase", ph.index)
-        telemetry.timeline.post("collective.partner", ph.partner)
-        telemetry.timeline.post("collective.barrier_s", barrier_s)
-        sleep_s = _BARRIER_SLEEP_S
-        # Distinguishes a barrier RE-request (the missing set after a
-        # NOT_FOUND round — partner lag) from plain pagination (a phase
-        # larger than one sub-window): only the former is a retry.
-        retry_pass = False
-        with telemetry.span(f"coop.collective.phase{ph.index}",
-                            partner=ph.partner, link=ph.link,
-                            units=len(wants)):
-            pending = list(wants)
-            while pending:
-                window, wire_est = [], 0
-                while pending and len(window) < _PHASE_WINDOW_UNITS:
-                    nbytes = (pending[0][1].url_range_end
-                              - pending[0][1].url_range_start)
-                    if window and wire_est + nbytes > window_cap:
-                        break
-                    window.append(pending.pop(0))
-                    wire_est += nbytes
-                budget.acquire(wire_est)
+    # Remediation action target (ISSUE 17): the policy engine's
+    # collective_straggler handler. "strike" feeds the blamed partner
+    # into peer health — quarantine re-shard then re-plans ownership
+    # around it on the next round; past the patience budget "abort"
+    # requests a mid-round abort, which the barrier-retry loop honors
+    # by returning the leftovers down the point-to-point ladder
+    # instead of waiting the deadline out.
+    abort_req: dict = {}
+
+    def _remediate_cmd(cmd: str, partner: int) -> dict:
+        if cmd == "strike":
+            if health is not None and partner in peers:
                 try:
-                    if faults.fire("peer_timeout", key=f"{host}:{port}"):
-                        raise TimeoutError("injected peer_timeout")
-                    replies = pool.request_many(
-                        host, port,
-                        [(hashing.hex_to_hash(hh), fi.range.start,
-                          fi.range.end) for hh, fi in window],
-                        timeout=max(1.0, deadline - time.monotonic()),
-                        tag=pool.window_tag(),
-                    )
-                    windows += 1
-                    requests += len(window)
-                    if retry_pass:
-                        retry_windows += 1
-                        retry_pass = False
-                except (ConnectionError, TimeoutError, OSError) as exc:
-                    budget.release(wire_est)
-                    with ex.lock:
-                        ex.dead_hosts.add(ph.partner)
-                    _M_COLLECTIVE_ABORTS.inc()
-                    telemetry.record(
-                        "collective_abort", phase=ph.index,
-                        partner=ph.partner, link=ph.link,
-                        error=type(exc).__name__)
-                    if health is not None:
-                        try:
-                            health.record_failure((host, port),
-                                                  kind="io_timeout")
-                        except Exception:  # noqa: BLE001 - advisory
-                            pass
-                    return (finish(aborted=type(exc).__name__,
-                                   dead_host=ph.partner),
-                            leftovers(ph.index, window + pending))
-                missing = []
-                try:
-                    for (hh, fi), reply in zip(window, replies):
-                        admitted, wire, unpacked = _admit(
-                            bridge, entries_map, hh, fi, reply, verify)
-                        if admitted:
-                            bridge.stats.record("peer", wire)
-                            ex.book_exchange((hh, fi.range.start),
-                                             wire, unpacked,
-                                             link=ph.link)
-                            link_bytes[ph.link] += wire
-                            _M_COLLECTIVE_BYTES.inc(wire, link=ph.link)
-                        elif isinstance(reply, DcnResponse):
-                            # Structurally or content-bad bytes from a
-                            # live partner: never retried (the same
-                            # bytes would come back) — the unit heals
-                            # through the full waterfall, exactly the
-                            # P2P exchange's trust-boundary rule.
-                            with ex.lock:
-                                ex.verify_rejected += 1
-                            telemetry.record("verify_rejected",
-                                             unit=hh[:16],
-                                             owner=ph.partner,
-                                             tier="collective")
-                            _fallback(bridge, entries_map, [(hh, fi)],
-                                      ex, owner=ph.partner)
-                        else:
-                            missing.append((hh, fi))  # partner behind
-                finally:
-                    budget.release(wire_est)
-                if missing:
-                    if time.monotonic() + sleep_s > deadline:
+                    health.record_failure(peers[partner],
+                                          kind="straggler")
+                except Exception:  # noqa: BLE001 - health is advisory
+                    pass
+            return {"cmd": "strike", "partner": partner}
+        if cmd == "abort":
+            abort_req["partner"] = partner
+            return {"cmd": "abort", "partner": partner}
+        return {"cmd": cmd, "partner": partner, "ignored": True}
+
+    telemetry.remediate.register_target("collective", _remediate_cmd)
+
+    try:
+        for ph in sched.phases:
+            host, port = peers[ph.partner]
+            wants = [(hh, fi) for o in ph.owners for hh, fi in blocks[o]
+                     if not _already_cached(bridge, hh, fi)]
+            wants = _layer_order(wants, priorities)
+            t_phase = time.monotonic()
+            # Live cells for the timeline sampler (ISSUE 15): the current
+            # phase index + partner and the cumulative barrier wait — what
+            # the per-phase straggler rule attributes from. Cleared by
+            # finish() so a finished exchange stops reporting a phase.
+            telemetry.timeline.post("collective.phase", ph.index)
+            telemetry.timeline.post("collective.partner", ph.partner)
+            telemetry.timeline.post("collective.barrier_s", barrier_s)
+            sleep_s = _BARRIER_SLEEP_S
+            # Distinguishes a barrier RE-request (the missing set after a
+            # NOT_FOUND round — partner lag) from plain pagination (a phase
+            # larger than one sub-window): only the former is a retry.
+            retry_pass = False
+            with telemetry.span(f"coop.collective.phase{ph.index}",
+                                partner=ph.partner, link=ph.link,
+                                units=len(wants)):
+                pending = list(wants)
+                while pending:
+                    window, wire_est = [], 0
+                    while pending and len(window) < _PHASE_WINDOW_UNITS:
+                        nbytes = (pending[0][1].url_range_end
+                                  - pending[0][1].url_range_start)
+                        if window and wire_est + nbytes > window_cap:
+                            break
+                        window.append(pending.pop(0))
+                        wire_est += nbytes
+                    budget.acquire(wire_est)
+                    try:
+                        if faults.fire("peer_timeout", key=f"{host}:{port}"):
+                            raise TimeoutError("injected peer_timeout")
+                        replies = pool.request_many(
+                            host, port,
+                            [(hashing.hex_to_hash(hh), fi.range.start,
+                              fi.range.end) for hh, fi in window],
+                            timeout=max(1.0, deadline - time.monotonic()),
+                            tag=pool.window_tag(),
+                        )
+                        windows += 1
+                        requests += len(window)
+                        if retry_pass:
+                            retry_windows += 1
+                            retry_pass = False
+                    except (ConnectionError, TimeoutError, OSError) as exc:
+                        budget.release(wire_est)
+                        with ex.lock:
+                            ex.dead_hosts.add(ph.partner)
                         _M_COLLECTIVE_ABORTS.inc()
                         telemetry.record(
                             "collective_abort", phase=ph.index,
                             partner=ph.partner, link=ph.link,
-                            error="deadline")
-                        return (finish(aborted="deadline",
+                            error=type(exc).__name__)
+                        if health is not None:
+                            try:
+                                health.record_failure((host, port),
+                                                      kind="io_timeout")
+                            except Exception:  # noqa: BLE001 - advisory
+                                pass
+                        return (finish(aborted=type(exc).__name__,
                                        dead_host=ph.partner),
-                                leftovers(ph.index, missing + pending))
-                    # Phase barrier: the partner has not finished the
-                    # prior phase (or its fetch share). Its own span so
-                    # the critical-path analyzer blames lag as
-                    # barrier idle, not exchange work.
-                    with telemetry.span("coop.collective.barrier",
-                                        phase=ph.index,
-                                        partner=ph.partner,
-                                        units=len(missing)):
-                        time.sleep(sleep_s)
-                    barrier_s += sleep_s
-                    telemetry.timeline.post("collective.barrier_s",
-                                            barrier_s)
-                    sleep_s = min(sleep_s * 2, _BARRIER_SLEEP_CAP_S)
-                    retry_pass = True
-                    pending = missing + pending
-        wall = time.monotonic() - t_phase
-        phase_walls.append(round(wall, 4))
-        _M_PHASE_SECONDS.observe(wall)
-        if health is not None:
-            try:
-                health.record_success((host, port))
-            except Exception:  # noqa: BLE001 - health is advisory
-                pass
-    return finish(), {}
+                                leftovers(ph.index, window + pending))
+                    missing = []
+                    try:
+                        for (hh, fi), reply in zip(window, replies):
+                            admitted, wire, unpacked = _admit(
+                                bridge, entries_map, hh, fi, reply, verify)
+                            if admitted:
+                                bridge.stats.record("peer", wire)
+                                ex.book_exchange((hh, fi.range.start),
+                                                 wire, unpacked,
+                                                 link=ph.link)
+                                link_bytes[ph.link] += wire
+                                _M_COLLECTIVE_BYTES.inc(wire, link=ph.link)
+                            elif isinstance(reply, DcnResponse):
+                                # Structurally or content-bad bytes from a
+                                # live partner: never retried (the same
+                                # bytes would come back) — the unit heals
+                                # through the full waterfall, exactly the
+                                # P2P exchange's trust-boundary rule.
+                                with ex.lock:
+                                    ex.verify_rejected += 1
+                                telemetry.record("verify_rejected",
+                                                 unit=hh[:16],
+                                                 owner=ph.partner,
+                                                 tier="collective")
+                                _fallback(bridge, entries_map, [(hh, fi)],
+                                          ex, owner=ph.partner)
+                            else:
+                                missing.append((hh, fi))  # partner behind
+                    finally:
+                        budget.release(wire_est)
+                    if missing:
+                        if abort_req:
+                            # The remediation engine's patience ran out
+                            # on this straggler: abort NOW instead of
+                            # burning barrier backoff up to the
+                            # deadline — the leftovers go down the
+                            # point-to-point ladder, which re-plans
+                            # ownership around the quarantined partner.
+                            with ex.lock:
+                                ex.dead_hosts.add(ph.partner)
+                            _M_COLLECTIVE_ABORTS.inc()
+                            telemetry.record(
+                                "collective_abort", phase=ph.index,
+                                partner=ph.partner, link=ph.link,
+                                error="remediation")
+                            return (finish(aborted="remediation",
+                                           dead_host=ph.partner),
+                                    leftovers(ph.index, missing + pending))
+                        if time.monotonic() + sleep_s > deadline:
+                            _M_COLLECTIVE_ABORTS.inc()
+                            telemetry.record(
+                                "collective_abort", phase=ph.index,
+                                partner=ph.partner, link=ph.link,
+                                error="deadline")
+                            return (finish(aborted="deadline",
+                                           dead_host=ph.partner),
+                                    leftovers(ph.index, missing + pending))
+                        # Phase barrier: the partner has not finished the
+                        # prior phase (or its fetch share). Its own span so
+                        # the critical-path analyzer blames lag as
+                        # barrier idle, not exchange work.
+                        with telemetry.span("coop.collective.barrier",
+                                            phase=ph.index,
+                                            partner=ph.partner,
+                                            units=len(missing)):
+                            time.sleep(sleep_s)
+                        barrier_s += sleep_s
+                        telemetry.timeline.post("collective.barrier_s",
+                                                barrier_s)
+                        sleep_s = min(sleep_s * 2, _BARRIER_SLEEP_CAP_S)
+                        retry_pass = True
+                        pending = missing + pending
+            wall = time.monotonic() - t_phase
+            phase_walls.append(round(wall, 4))
+            _M_PHASE_SECONDS.observe(wall)
+            if health is not None:
+                try:
+                    health.record_success((host, port))
+                except Exception:  # noqa: BLE001 - health is advisory
+                    pass
+        return finish(), {}
+    finally:
+        telemetry.remediate.unregister_target("collective",
+                                              _remediate_cmd)

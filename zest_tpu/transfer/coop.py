@@ -566,6 +566,23 @@ def _coop_round(bridge, recs, host_index, n_hosts, host_addrs,
                     # whole cooperative round over link classing.
                     telemetry.record("collective_unavailable",
                                      error=str(exc))
+                if (collective_stats
+                        and collective_stats.get("aborted") == "remediation"
+                        and collective_stats.get("dead_host") is not None):
+                    # The remediation engine condemned this partner
+                    # mid-round (ISSUE 17): handing its leftovers a
+                    # fresh point-to-point channel would override that
+                    # decision and ride NOT_FOUND retries to the shared
+                    # deadline. They degrade straight down the landing
+                    # waterfall instead (another peer / swarm / CDN).
+                    bad = collective_stats["dead_host"]
+                    condemned = foreign.pop(bad, None)
+                    if condemned:
+                        telemetry.record("exchange_condemned",
+                                         owner=bad,
+                                         units=len(condemned))
+                        _fallback(bridge, entries_map, condemned, ex,
+                                  owner=bad)
             # Exchange workers are fresh threads: hand them this
             # round's trace context explicitly (thread-locals do not
             # propagate) so their spans land on this host's track in

@@ -818,6 +818,11 @@ def pull_model(
     # running for the life of this pull — one idempotent flag check;
     # with ZEST_TIMELINE=0 nothing starts and the store stays empty.
     telemetry.timeline.ensure_started()
+    # Self-healing control plane (ISSUE 17): subscribe the remediation
+    # engine to the anomaly stream + sampler tick for the life of the
+    # process. Idempotent; with ZEST_REMEDIATE=0 (or timeline off) the
+    # engine never subscribes and the process is a pure observer.
+    telemetry.remediate.ensure_started()
     # The coop stage installs this pull's fleet trace context (host +
     # trace_id); restore the previous one at exit so a long-lived
     # daemon's NEXT pull never records under a stale identity (spans
@@ -1055,6 +1060,17 @@ def _pull_model(
 
     deadline = Deadline.after(getattr(cfg, "pull_deadline_s", None))
     bridge.deadline = deadline
+    # Remediation action target (ISSUE 17): a stall/throughput-collapse
+    # anomaly on THIS session arms a mid-flight hedge on its bridge —
+    # the evidence-armed path in XetBridge._peer_tier races the peer
+    # tier against the CDN with a fixed head start, no
+    # ZEST_PULL_DEADLINE_S required. Unregistered when the bridge
+    # closes; no-op (and no trace) with ZEST_REMEDIATE=0.
+    _hedge_target = None
+    _hedge_fn = bridge.arm_hedge  # bound once: unregister is identity-checked
+    if session is not None:
+        _hedge_target = f"hedge:{session.id}"
+        telemetry.remediate.register_target(_hedge_target, _hedge_fn)
     width = max(1, getattr(cfg, "pull_pipeline_width", 1))
     # ONE term-fetch pool shared by every concurrent file reassembly:
     # total in-flight fetch streams stay at the configured budget no
@@ -1405,8 +1421,15 @@ def _pull_model(
         # inside the pre-pass or landing) must not leak the pools or
         # leave queued downloads running unsupervised.
         file_pipeline.abort()
+        if _hedge_target is not None:
+            telemetry.remediate.unregister_target(_hedge_target,
+                                                  _hedge_fn)
         bridge.close()
         raise
+    if _hedge_target is not None:
+        # The session's fetch work is over: a late-firing anomaly must
+        # not arm a hedge on a closed bridge.
+        telemetry.remediate.unregister_target(_hedge_target, _hedge_fn)
     bridge.close()  # release hedge threads (no-op unless a deadline hedged)
 
     storage.write_ref(cfg, repo_id, revision, commit_sha)

@@ -79,6 +79,7 @@ class SwarmStats:
     peer_choked: int = 0           # upload-policy denials (no strike)
     peer_refusals: int = 0         # quarantined-source refusals (no strike)
     peers_quarantined: int = 0     # circuit-breaker trips
+    peers_demoted: int = 0         # proactive remediation demotions
     corrupt_from_peer: int = 0     # corruption attributions from the bridge
     chunks_from_peers: int = 0
     bytes_from_peers: int = 0
@@ -103,6 +104,7 @@ class SwarmStats:
             "peer_choked": self.peer_choked,
             "peer_refusals": self.peer_refusals,
             "peers_quarantined": self.peers_quarantined,
+            "peers_demoted": self.peers_demoted,
             "corrupt_from_peer": self.corrupt_from_peer,
             "chunks_from_peers": self.chunks_from_peers,
             "bytes_from_peers": self.bytes_from_peers,
@@ -153,6 +155,22 @@ class SwarmDownloader:
         # announce. None (ZEST_GOSSIP=0) = tracker-only, bit-for-bit.
         self.gossip = None
         self.health.subscribe(self._on_health_transition)
+        # Self-healing targets (ISSUE 17): the remediation engine's
+        # seeder scan reads the health book through ``peer_health`` and
+        # demotes collapsing seeders through ``demote`` — injected here
+        # because telemetry must not import transfer. Replace semantics
+        # (latest swarm wins), identity-checked unregister in close();
+        # with ZEST_REMEDIATE=0 both calls are one flag check.
+        self._remediate_monitor = lambda: {
+            "rows": self.health.detail(),
+            "strike_budget": self.health.strikes_to_quarantine,
+        }
+        telemetry.remediate.register_target("peer_health",
+                                            self._remediate_monitor)
+        # Bound once: unregister_target is identity-checked, and each
+        # ``self._demote_peer`` access makes a fresh bound method.
+        self._demote_fn = self._demote_peer
+        telemetry.remediate.register_target("demote", self._demote_fn)
 
     def attach_gossip(self, node) -> None:
         """Adopt ``node`` (transfer.gossip.GossipNode) as the primary
@@ -171,11 +189,24 @@ class SwarmDownloader:
         if addr not in self.direct_peers:
             self.direct_peers.append(addr)
 
+    def _demote_peer(self, addr: tuple[str, int]) -> dict:
+        """The engine's proactive demote (ISSUE 17): a strike-FREE
+        re-rank window through :meth:`HealthRegistry.demote` — the
+        "demoted" transition event drives the same re-announce sweep a
+        breaker trip does, so the tracker's view shifts traffic off the
+        collapsing seeder before its strike budget exhausts."""
+        window = self.health.demote(addr)
+        self.stats.bump("peers_demoted")
+        return {"window_s": round(window, 2)}
+
     def close(self) -> None:
         # Detach from the (possibly shared, longer-lived) health
         # registry first: a closed swarm must not keep re-announcing on
         # its transitions or be pinned in memory by the listener ref.
         self.health.unsubscribe(self._on_health_transition)
+        telemetry.remediate.unregister_target("peer_health",
+                                              self._remediate_monitor)
+        telemetry.remediate.unregister_target("demote", self._demote_fn)
         self.pool.close_all()
 
     def summary(self) -> dict:

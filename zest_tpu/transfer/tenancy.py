@@ -576,13 +576,17 @@ class CacheEvictor:
 
 
 class _Waiter:
-    __slots__ = ("tenant", "weight", "admitted", "session")
+    __slots__ = ("tenant", "weight", "admitted", "session", "shed")
 
     def __init__(self, tenant: str, weight: float, session=None):
         self.tenant = tenant
         self.weight = weight
         self.admitted = False
         self.session = session
+        # Set to a retry-after estimate when a load-shed evicts this
+        # waiter from the queue: its acquire() raises AdmissionRejected
+        # instead of parking on (ISSUE 17).
+        self.shed: float | None = None
 
 
 class AdmissionController:
@@ -612,6 +616,13 @@ class AdmissionController:
         self._queued = 0
         self.admitted_total = 0
         self.rejected_total = 0
+        self.shed_total = 0
+        # Load-shed mode (ISSUE 17): while True, sessions that would
+        # queue are rejected with 429 + Retry-After instead of parked
+        # — the remediation engine flips this on when the queue is
+        # stuck AND the SLO burn rate projects a breach, and back off
+        # when the burn recovers. Admitted sessions are never touched.
+        self._shedding = False
         # Recent admission walls, for the 429 retry-after estimate.
         self._recent_walls: deque = deque(maxlen=16)
 
@@ -664,6 +675,53 @@ class AdmissionController:
                 del self._queues[waiter.tenant]
         _M_QUEUE_DEPTH.set(self._queued)
 
+    # — load shedding (ISSUE 17) —
+
+    def shed(self) -> dict:
+        """Enter shed mode and evict the lowest-deficit tenant's queued
+        waiters (the tenant with the LEAST accumulated fairness credit
+        — it queued most recently / least underserved, so shedding it
+        costs the least accrued fairness debt). Evicted waiters raise
+        :class:`AdmissionRejected` (→ 429 + Retry-After); admitted
+        sessions are never touched. While shedding, new sessions that
+        would queue are rejected immediately. Reversible via
+        :meth:`recover`."""
+        with self._cv:
+            self._shedding = True
+            victim = None
+            if self._queues:
+                victim = min(self._queues,
+                             key=lambda t: self._deficit.get(t, 0.0))
+            n = 0
+            retry = self._retry_after_locked()
+            if victim is not None:
+                q = self._queues.pop(victim, None) or ()
+                for waiter in q:
+                    waiter.shed = retry
+                    n += 1
+                self._queued -= n
+                self.shed_total += n
+                self.rejected_total += n
+                for _ in range(n):
+                    _M_REJECTS.inc()
+                _M_QUEUE_DEPTH.set(self._queued)
+            self._cv.notify_all()
+            return {"tenant": victim, "shed": n,
+                    "retry_after_s": retry,
+                    "queued_left": self._queued}
+
+    def recover(self) -> dict:
+        """Leave shed mode: new sessions queue normally again."""
+        with self._cv:
+            was = self._shedding
+            self._shedding = False
+            return {"was_shedding": was, "queued": self._queued}
+
+    @property
+    def shedding(self) -> bool:
+        with self._cv:
+            return self._shedding
+
     def retry_after_s(self) -> float:
         """Advice for a rejected client: roughly one mean recent pull
         wall per queued-sessions-per-slot, clamped to [1, 60]."""
@@ -694,6 +752,12 @@ class AdmissionController:
                 _M_ADMITTED.set(self._active)
                 _M_ADMISSION_WAIT.observe(0.0)
                 return
+            if self._shedding:
+                self.rejected_total += 1
+                _M_REJECTS.inc()
+                raise AdmissionRejected(
+                    "load shedding active (SLO burn); retry later",
+                    self._retry_after_locked())
             if self._queued >= self.max_queue:
                 self.rejected_total += 1
                 _M_REJECTS.inc()
@@ -710,6 +774,12 @@ class AdmissionController:
             self._dispatch_locked()
             try:
                 while not waiter.admitted:
+                    if waiter.shed is not None:
+                        # A load-shed evicted us from the queue; the
+                        # shed pass already did the removal/accounting.
+                        raise AdmissionRejected(
+                            "shed while queued (SLO burn); retry later",
+                            waiter.shed)
                     if cancel is not None and cancel.fired:
                         self._remove_locked(waiter)
                         raise PullCancelled(
@@ -737,7 +807,8 @@ class AdmissionController:
         Returns (rejected, retry_after_s)."""
         with self._cv:
             would_queue = self._active >= self.max_pulls or self._queued > 0
-            if would_queue and self._queued >= self.max_queue:
+            if would_queue and (self._shedding
+                                or self._queued >= self.max_queue):
                 self.rejected_total += 1
                 _M_REJECTS.inc()
                 return True, self._retry_after_locked()
@@ -759,6 +830,8 @@ class AdmissionController:
                 "queue_cap": self.max_queue,
                 "admitted_total": self.admitted_total,
                 "rejected_total": self.rejected_total,
+                "shed_total": self.shed_total,
+                "shedding": self._shedding,
             }
 
 
@@ -800,6 +873,16 @@ class TenancyState:
             lambda: c.summary()["admitted_total"])
         telemetry.timeline.register_probe(
             "tenancy.inflight_fetches", self.flights.in_flight)
+        # Remediation action target (ISSUE 17): the policy engine sheds
+        # the lowest-deficit tenant's queued sessions when queue_stuck
+        # coincides with an SLO burn projecting a breach, and recovers
+        # when the burn subsides. Replace semantics, like the probes.
+        telemetry.remediate.register_target("shed", self._shed_cmd)
+
+    def _shed_cmd(self, cmd: str) -> dict:
+        if cmd == "recover":
+            return self.controller.recover()
+        return self.controller.shed()
 
     def summary(self) -> dict:
         doc = self.controller.summary()
@@ -956,3 +1039,4 @@ def reset() -> None:
     for name in ("tenancy.queue_depth", "tenancy.active_pulls",
                  "tenancy.admitted_total", "tenancy.inflight_fetches"):
         telemetry.timeline.unregister_probe(name)
+    telemetry.remediate.unregister_target("shed")
