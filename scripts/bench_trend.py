@@ -2,7 +2,7 @@
 
 The committed bench artifacts (``SWARM_r12.json``, ``TENANT_r13.json``,
 ``MULTIHOST_r14.json``, ``DELTA_r10.json``, ``FLEET_r16.json``,
-``MTTR_r17.json``) carry
+``MTTR_r17.json``, ``SERVE_r18.json``) carry
 the numbers each PR
 was accepted on — but nothing re-checked them: a later PR regenerating
 an artifact with a worse number (a peer-served ratio under its gate, a
@@ -126,6 +126,21 @@ CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
          "the policy engine healed a HEALTHY swarm (over-healing)"),
         ("gates/peer_ratio_ok", "truthy", None,
          "policy-on control run tanked the peer-served ratio"),
+    ],
+    "SERVE_r18.json": [
+        ("gates/all_ok", "truthy", None,
+         "recorded serving-pool gate block flipped false"),
+        ("gates/ttft_cold_ratio", "le", 0.5,
+         "pool cold TTFT no longer <= 0.5x the full-cold-pull-then-"
+         "generate wall"),
+        ("gates/digest_identical", "truthy", None,
+         "an evict -> re-land round trip stopped being byte-identical"),
+        ("gates/pinned_never_evicted", "truthy", None,
+         "admission pressure evicted a pinned (decoding) model"),
+        ("gates/expert_residency", "le", 0.5,
+         "lazy MoE paging stopped bounding expert residency under 50%"),
+        ("moe_experts/verified", "ge", 1,
+         "expert page-ins shipped without digest verification"),
     ],
     "DELTA_r10.json": [
         ("delta_bytes_ratio", "le", 0.03,

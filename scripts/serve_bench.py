@@ -1,0 +1,64 @@
+"""Serving-pool artifact driver: HBM as a managed multi-model cache
+(ISSUE 18).
+
+Writes ``SERVE_r18.json``: the scale-to-zero serving bench. Baseline
+arm is a classic cold serve — full throttled-network pull plus family
+generator first token; pool arm re-lands the evicted model from its
+local snapshot with the decode gated on per-layer commits. The
+``gates`` block is the acceptance surface:
+
+- ``ttft_ok`` — pool cold TTFT <= 0.5x the full-cold-pull-then-
+  generate wall;
+- ``digest_identical`` — the re-landed tree's ``params_digest`` is
+  byte-identical to the original landing;
+- ``pinned_never_evicted`` — a pinned (actively decoding) tree
+  survives admission pressure with a one-byte-slack budget;
+- ``experts_ok`` — the MoE serve's expert residency stays under 50%
+  with every page-in digest-verified.
+
+Usage: python scripts/serve_bench.py [--out SERVE_r18.json]
+       [--runs 3] [--mb 20] [--throttle-mbps 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SERVE_r18.json")
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--mb", type=float, default=20.0)
+    ap.add_argument("--throttle-mbps", type=float, default=200.0)
+    ap.add_argument("--budget-s", type=float, default=None)
+    args = ap.parse_args()
+
+    from zest_tpu.bench_scale import bench_serve_pool
+
+    out: dict = {
+        # Honesty note: one box, loopback hub — the baseline's network
+        # share is synthetic (token-bucket throttle). The pull_s field
+        # makes that share visible; the local re-land beating a real
+        # WAN pull would only widen the ratio.
+        "note": "single-box loopback; baseline network is a "
+                "token-bucket throttle — pull_s shows its share",
+    }
+    out.update(bench_serve_pool(gb=args.mb / 1024.0, runs=args.runs,
+                                throttle_mbps=args.throttle_mbps,
+                                budget_s=args.budget_s))
+    print(json.dumps(out, indent=1))
+    ok = out["gates"]["all_ok"]
+    pathlib.Path(args.out).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {args.out} (gates {'OK' if ok else 'FAILED'}: "
+          f"{json.dumps(out['gates'])})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

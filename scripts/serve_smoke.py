@@ -1,0 +1,141 @@
+"""CI smoke for the HBM serving pool (ISSUE 18).
+
+Two models against the loopback fixture hub — a ~64 MiB llama
+(scale=8: nine layers, the largest decode-consistent scale the
+synthetic generator emits — deeper scales shrink kv_dim past
+num_kv_heads * head_dim) and a small second tenant — driven through
+the scale-to-zero serving cycle:
+
+- the classic cold serve (full pull + family generator first token) is
+  the baseline wall; the pool's re-land of the SAME model after an
+  eviction must produce its first token in < 0.5x that wall, with the
+  decode provably starting before the landing finished;
+- while model A is pinned (an active decode holds it), model B's
+  admission under a one-byte-slack budget must NOT evict A — the pool
+  runs over budget instead of breaking a live decode;
+- after a real eviction, the re-landed tree's ``params_digest`` is
+  byte-identical to the original landing, and the re-served tokens
+  match the pre-eviction tokens exactly.
+
+Exit 0 on success; any broken invariant prints the pool summary and
+fails the step.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+
+def main() -> int:
+    import numpy as np
+
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.models import hbm_pool
+    from zest_tpu.models.generate import load_generator
+    from zest_tpu.transfer.pull import pull_model
+
+    files_a = llama_checkpoint_files(0.064, shard_bytes=8 * 1024 * 1024,
+                                     scale=8)
+    files_b = llama_checkpoint_files(0.008, seed=1, scale=8)
+    repo_a = FixtureRepo("smoke/serve-a", files_a, chunks_per_xorb=32)
+    repo_b = FixtureRepo("smoke/serve-b", files_b, chunks_per_xorb=32)
+
+    prompt = [1, 2, 3]
+    steps = 4
+    quiet = {"log": lambda *a, **k: None}
+    with FixtureHub(repo_a, repo_b) as hub, \
+            tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        cfg = Config(hf_home=rootp / "hf", cache_dir=rootp / "zest",
+                     hf_token="hf_test", endpoint=hub.url)
+
+        # Baseline: the classic cold serve, request -> first token.
+        t0 = time.perf_counter()
+        res_a = pull_model(cfg, "smoke/serve-a", no_p2p=True, **quiet)
+        snap_a = res_a.snapshot_dir
+        first: dict = {}
+        _mt, family = load_generator(snap_a)
+        family(prompt, steps,
+               on_token=lambda _p, _t: first.setdefault(
+                   "t", time.perf_counter()))
+        full_cold = first["t"] - t0
+
+        pool = hbm_pool.HbmPool(cfg)
+
+        def fail(msg: str) -> int:
+            print(f"SERVE SMOKE FAILED: {msg}", file=sys.stderr)
+            print(json.dumps(pool.summary(), indent=2, default=str),
+                  file=sys.stderr)
+            return 1
+
+        try:
+            out_first, _info = pool.generate_for(
+                snap_a, "smoke/serve-a", prompt, steps)
+            d0 = pool.digest(snap_a)
+            if not d0:
+                return fail("no digest for the resident tree")
+
+            # Pinned A + one-byte-slack budget: B's admission must
+            # leave A resident (over budget beats broken decodes).
+            res_b = pull_model(cfg, "smoke/serve-b", no_p2p=True,
+                               **quiet)
+            entry_a, hot = pool.acquire(snap_a, "smoke/serve-a")
+            if not hot:
+                return fail("model A went cold while still resident")
+            pool.budget = entry_a.reserved + 1
+            pool.generate_for(res_b.snapshot_dir, "smoke/serve-b",
+                              prompt, 2)
+            if entry_a.state != "resident":
+                return fail("admission pressure evicted a PINNED "
+                            f"model (state={entry_a.state!r})")
+            if pool.pinned_survivals < 1:
+                return fail("the pinned-survival path never engaged")
+            pool.release(entry_a)
+
+            # Scale to zero, then the measured re-land serve.
+            pool.budget = cfg.hbm_pool_bytes
+            if not pool.evict(snap_a, "scale_to_zero"):
+                return fail("could not evict the unpinned model A")
+            if pool.digest(snap_a) is not None:
+                return fail("evicted model still reports a digest")
+            out_again, info = pool.generate_for(
+                snap_a, "smoke/serve-a", prompt, steps)
+            ttft = info["ttft_s"]
+            if info["temp"] != "cold":
+                return fail(f"re-land served {info['temp']}, not cold")
+            if not info["decode_start_before_land_end"]:
+                return fail("the gated decode waited for the full "
+                            "land — first-layer-commit start did not "
+                            "engage")
+            if not ttft < 0.5 * full_cold:
+                return fail(f"pool cold TTFT ({ttft:.3f}s) is not "
+                            f"< 0.5 x the full cold serve wall "
+                            f"({full_cold:.3f}s)")
+            d1 = pool.digest(snap_a)
+            if d1 != d0:
+                return fail(f"re-landed digest {d1} != original {d0}")
+            if not np.array_equal(np.asarray(out_again),
+                                  np.asarray(out_first)):
+                return fail("re-served tokens differ from the "
+                            "pre-eviction serve")
+            print(f"serve smoke OK: pool cold TTFT {ttft:.3f}s vs "
+                  f"full cold serve {full_cold:.3f}s "
+                  f"({ttft / full_cold:.0%}), gate stall "
+                  f"{info['gate_stall_s']:.3f}s, digest {d0[:16]} "
+                  "identical across evict -> re-land, pinned "
+                  "survived pressure")
+            return 0
+        finally:
+            pool.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -623,3 +623,65 @@ def llama_checkpoint_files(
         "config.json": _json.dumps(cfg).encode(),
         "model.safetensors": _safetensors_blob(t),
     }
+
+
+def mixtral_checkpoint_files(
+    hidden_size: int = 64,
+    n_layer: int = 2,
+    vocab_size: int = 256,
+    n_ctx: int = 64,
+    n_experts: int = 8,
+    top_k: int = 2,
+    seed: int = 0,
+) -> dict[str, bytes]:
+    """A small but *valid* HF Mixtral checkpoint (HF tensor names +
+    config) — the MoE counterpart of :func:`llama_checkpoint_files`.
+    Expert tensors dominate the byte count (the real Mixtral shape of
+    the problem), which is what the HBM pool's lazy expert paging
+    (ISSUE 18) needs a fixture for: a dense core worth a small fraction
+    of the checkpoint plus ``n_experts`` per-layer SwiGLU expert
+    groups."""
+    import json as _json
+
+    import numpy as np
+
+    E, L, V, X = hidden_size, n_layer, vocab_size, n_experts
+    n_head, n_kv = 4, 2
+    head_dim = E // n_head
+    inter = 2 * E
+    cfg = dict(model_type="mixtral",
+               architectures=["MixtralForCausalLM"],
+               vocab_size=V, hidden_size=E, intermediate_size=inter,
+               num_hidden_layers=L, num_attention_heads=n_head,
+               num_key_value_heads=n_kv, max_position_embeddings=n_ctx,
+               num_local_experts=X, num_experts_per_tok=top_k,
+               rms_norm_eps=1e-5, rope_theta=10000.0,
+               torch_dtype="float32")
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        return rng.normal(0, 0.02, shape).astype(np.float32)
+
+    t = {
+        "model.embed_tokens.weight": w(V, E),
+        "model.norm.weight": np.ones(E, np.float32),
+        "lm_head.weight": w(V, E),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.ones(E, np.float32)
+        t[p + "post_attention_layernorm.weight"] = np.ones(E, np.float32)
+        t[p + "self_attn.q_proj.weight"] = w(n_head * head_dim, E)
+        t[p + "self_attn.k_proj.weight"] = w(n_kv * head_dim, E)
+        t[p + "self_attn.v_proj.weight"] = w(n_kv * head_dim, E)
+        t[p + "self_attn.o_proj.weight"] = w(E, n_head * head_dim)
+        t[p + "block_sparse_moe.gate.weight"] = w(X, E)
+        for x in range(X):
+            ep = f"{p}block_sparse_moe.experts.{x}."
+            t[ep + "w1.weight"] = w(inter, E)
+            t[ep + "w2.weight"] = w(E, inter)
+            t[ep + "w3.weight"] = w(inter, E)
+    return {
+        "config.json": _json.dumps(cfg).encode(),
+        "model.safetensors": _safetensors_blob(t),
+    }

@@ -1,0 +1,720 @@
+"""HBM serving pool (ISSUE 18): multi-model residency, scale-to-zero
+re-landing, first-layer-commit decode start, and lazy MoE expert
+paging.
+
+The contract under test: the pool admits/evicts against the
+``ZEST_HBM_POOL_BYTES`` watermark and NEVER evicts a pinned tree; an
+evict → re-land cycle reproduces the exact bytes a cold pull landed
+(``loader.params_digest`` identity); a cold generate starts decoding
+at first-layer commit, before the land finishes; the gated decoders
+are bit-identical to the family paths (greedy AND sampled); a Mixtral
+entry serves with expert residency bounded by the pager budget, every
+page-in digest-verified; an aborted landing strands zero HBM bytes
+(satellite 1, pool and loader side); and ``ZEST_HBM_POOL=0`` restores
+the single-model serving path bit-for-bit, payload schemas included.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fixtures import (
+    FixtureHub,
+    FixtureRepo,
+    llama_checkpoint_files,
+    mixtral_checkpoint_files,
+)
+from zest_tpu import telemetry
+from zest_tpu.config import Config
+from zest_tpu.models import hbm_pool
+from zest_tpu.telemetry import remediate, timeline
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    for name in ("ZEST_HBM_POOL", "ZEST_HBM_POOL_BYTES",
+                 "ZEST_SLO_TTFT_S", "ZEST_TIMELINE", "ZEST_TELEMETRY",
+                 "ZEST_REMEDIATE", "ZEST_TENANCY"):
+        monkeypatch.delenv(name, raising=False)
+    hbm_pool.reset()
+    telemetry.reset_all()
+    yield
+    hbm_pool.reset()
+    telemetry.reset_all()
+
+
+def _snap(root, files, name="snap"):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    for fname, data in files.items():
+        if not isinstance(data, bytes):
+            data = data.encode()
+        (d / fname).write_bytes(data)
+    return d
+
+
+def _cfg(root, **kw) -> Config:
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", **kw)
+
+
+@pytest.fixture
+def make_pool(tmp_path):
+    pools: list[hbm_pool.HbmPool] = []
+
+    def make(**kw) -> hbm_pool.HbmPool:
+        p = hbm_pool.HbmPool(_cfg(tmp_path, **kw))
+        pools.append(p)
+        return p
+
+    yield make
+    for p in pools:
+        p.close()
+
+
+def _wait_state(entry, want, timeout=30.0):
+    t0 = time.monotonic()
+    while entry.state != want:
+        assert time.monotonic() - t0 < timeout, \
+            f"entry stuck in {entry.state!r}, wanted {want!r}"
+        time.sleep(0.01)
+
+
+def _samples(name: str) -> list:
+    for m in telemetry.REGISTRY.metrics():
+        if m.name == name:
+            return m.samples()
+    return []
+
+
+# ── Config knobs (strict env parsing) ──
+
+
+class TestKnobs:
+    def test_defaults(self):
+        cfg = Config.load({})
+        assert cfg.hbm_pool_enabled is True
+        assert cfg.hbm_pool_bytes == 2 << 30
+        assert cfg.slo_ttft_s is None
+
+    def test_pool_off(self):
+        assert Config.load({"ZEST_HBM_POOL": "0"}).hbm_pool_enabled \
+            is False
+
+    @pytest.mark.parametrize("bad", ["false", "yes", "2", ""])
+    def test_pool_knob_strict(self, bad):
+        with pytest.raises(ValueError):
+            Config.load({"ZEST_HBM_POOL": bad})
+
+    def test_pool_bytes(self):
+        cfg = Config.load({"ZEST_HBM_POOL_BYTES": "1048576"})
+        assert cfg.hbm_pool_bytes == 1048576
+        assert Config.load(
+            {"ZEST_HBM_POOL_BYTES": "0"}).hbm_pool_bytes == 0
+
+    @pytest.mark.parametrize("bad", ["2GB", "-1", "1.5"])
+    def test_pool_bytes_strict(self, bad):
+        with pytest.raises(ValueError):
+            Config.load({"ZEST_HBM_POOL_BYTES": bad})
+
+    def test_slo_ttft(self):
+        assert Config.load({"ZEST_SLO_TTFT_S": "1.5"}).slo_ttft_s == 1.5
+        assert Config.load({"ZEST_SLO_TTFT_S": "0"}).slo_ttft_s is None
+        assert Config.load({"ZEST_SLO_TTFT_S": ""}).slo_ttft_s is None
+
+    @pytest.mark.parametrize("bad", ["-1", "soon"])
+    def test_slo_ttft_strict(self, bad):
+        with pytest.raises(ValueError):
+            Config.load({"ZEST_SLO_TTFT_S": bad})
+
+
+# ── Admission / eviction / pinning ──
+
+
+class TestAdmission:
+    def test_acquire_miss_then_hit(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        pool = make_pool()
+        entry, hot = pool.acquire(snap, "acme/a")
+        assert hot is False and entry.pins == 2  # caller + land thread
+        _wait_state(entry, "resident")
+        pool.release(entry)
+        entry2, hot2 = pool.acquire(snap, "acme/a")
+        assert entry2 is entry and hot2 is True
+        pool.release(entry2)
+        assert pool.hits == 1 and pool.misses == 1
+        assert entry.bytes == entry.reserved > 0
+        assert pool.used_bytes() == entry.bytes
+
+    def test_unsupported_family_rejected(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, {
+            "config.json": json.dumps({"model_type": "gpt2"})})
+        pool = make_pool()
+        with pytest.raises(ValueError, match="not pool-served"):
+            pool.acquire(snap, "acme/gpt2")
+        assert pool.supports("gpt2") is False
+        assert pool.supports("llama") is True
+
+    def test_missing_checkpoint_unpins(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, {
+            "config.json": json.dumps({"model_type": "llama"})})
+        pool = make_pool()
+        with pytest.raises(FileNotFoundError):
+            pool.acquire(snap, "acme/empty")
+        # The failed admission must not leak its pin.
+        assert pool._entries[str(snap.resolve())].pins == 0
+
+    def test_pressure_evicts_lru_not_pinned(self, make_pool, tmp_path):
+        files = llama_checkpoint_files()
+        snap_a = _snap(tmp_path, files, "a")
+        snap_b = _snap(tmp_path, llama_checkpoint_files(seed=1), "b")
+        snap_c = _snap(tmp_path, llama_checkpoint_files(seed=2), "c")
+        pool = make_pool()
+        ea, _ = pool.acquire(snap_a, "acme/a")
+        _wait_state(ea, "resident")
+        pool.release(ea)
+        # Budget: room for ~two trees, not three.
+        pool.budget = int(ea.reserved * 2.5)
+
+        eb, _ = pool.acquire(snap_b, "acme/b")
+        _wait_state(eb, "resident")
+        # B stays pinned while C admits: A (LRU, unpinned) must be the
+        # victim; B must survive.
+        ec, _ = pool.acquire(snap_c, "acme/c")
+        _wait_state(ec, "resident")
+        assert ea.state == "evicted" and ea.bytes == 0
+        assert eb.state == "resident"
+        assert pool.evictions == 1
+        evs = {lbl.get("reason"): v
+               for lbl, v in _samples("zest_hbm_pool_evictions_total")}
+        assert evs.get("pressure") == 1
+        pool.release(eb)
+        pool.release(ec)
+
+    def test_all_pinned_survives_over_budget(self, make_pool, tmp_path):
+        snap_a = _snap(tmp_path, llama_checkpoint_files(), "a")
+        snap_b = _snap(tmp_path, llama_checkpoint_files(seed=1), "b")
+        pool = make_pool()
+        ea, _ = pool.acquire(snap_a, "acme/a")
+        _wait_state(ea, "resident")
+        pool.budget = ea.reserved + 1  # no room for a second tree
+        eb, _ = pool.acquire(snap_b, "acme/b")  # A still pinned
+        _wait_state(eb, "resident")
+        # Zero pinned-model evictions under pressure — the pool runs
+        # over budget rather than break an active decode.
+        assert ea.state == "resident"
+        assert pool.evictions == 0
+        assert pool.pinned_survivals >= 1
+        assert pool.used_bytes() > pool.budget
+        pool.release(ea)
+        pool.release(eb)
+
+    def test_manual_evict_refuses_pinned(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        pool = make_pool()
+        entry, _ = pool.acquire(snap, "acme/a")
+        _wait_state(entry, "resident")
+        assert pool.evict(snap) is False          # pinned
+        assert entry.state == "resident"
+        pool.release(entry)
+        assert pool.evict(snap) is True
+        assert entry.state == "evicted"
+
+    def test_shed_coldest_picks_lru(self, make_pool, tmp_path):
+        snap_a = _snap(tmp_path, llama_checkpoint_files(), "a")
+        snap_b = _snap(tmp_path, llama_checkpoint_files(seed=1), "b")
+        pool = make_pool()
+        for snap, repo in ((snap_a, "acme/a"), (snap_b, "acme/b")):
+            e, _ = pool.acquire(snap, repo)
+            _wait_state(e, "resident")
+            pool.release(e)
+        # Touch B so A is coldest.
+        eb, _ = pool.acquire(snap_b, "acme/b")
+        pool.release(eb)
+        assert pool.shed_coldest() == "acme/a"
+        assert pool.shed_coldest() == "acme/b"
+        assert pool.shed_coldest() is None
+
+
+# ── Scale-to-zero re-landing ──
+
+
+class TestReLand:
+    def test_evict_reland_digest_identity(self, make_pool, tmp_path):
+        from zest_tpu.models.generate import snapshot_tensors
+        from zest_tpu.models.loader import params_digest
+
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        pool = make_pool()
+        out1, info1 = pool.generate_for(snap, "acme/a", [1, 2, 3], 4)
+        assert info1["temp"] == "cold"
+        d_cold = pool.digest(snap)
+        assert d_cold is not None
+        # The on-disk truth: digest over the snapshot's host tensors.
+        d_disk = params_digest(snapshot_tensors(snap))
+        assert d_cold == d_disk
+
+        assert pool.evict(snap) is True
+        assert pool.digest(snap) is None          # evicted: no tree
+        out2, info2 = pool.generate_for(snap, "acme/a", [1, 2, 3], 4)
+        assert info2["temp"] == "cold"
+        # Byte-identical tree after the round trip, identical tokens.
+        assert pool.digest(snap) == d_disk
+        np.testing.assert_array_equal(np.asarray(out1),
+                                      np.asarray(out2))
+
+    def test_decode_starts_before_land_end(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, llama_checkpoint_files(n_layer=4))
+        pool = make_pool()
+        pool.group_bytes = 4096      # flush per layer boundary
+        pool.land_delay_s = 0.05     # stretch the landing tail
+        out, info = pool.generate_for(snap, "acme/a", [1, 2, 3], 2)
+        entry = pool._entries[str(snap.resolve())]
+        assert info["temp"] == "cold"
+        assert info["decode_start_before_land_end"] is True
+        assert entry.t_decode_start < entry.t_land_end
+        # The decode really waited on gates rather than a full tree.
+        assert entry.t_first_layer < entry.t_land_end
+        assert info["ttft_s"] > 0
+
+    def test_concurrent_hot_and_cold(self, make_pool, tmp_path):
+        snap_a = _snap(tmp_path, llama_checkpoint_files(), "a")
+        snap_b = _snap(tmp_path, llama_checkpoint_files(seed=1), "b")
+        pool = make_pool()
+        warm, _ = pool.generate_for(snap_a, "acme/a", [1, 2, 3], 4)
+        pool.land_delay_s = 0.02
+        results: dict = {}
+
+        def hot():
+            results["hot"] = pool.generate_for(
+                snap_a, "acme/a", [1, 2, 3], 4)
+
+        t = threading.Thread(target=hot)
+        t.start()
+        results["cold"] = pool.generate_for(
+            snap_b, "acme/b", [1, 2, 3], 4)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        out_hot, info_hot = results["hot"]
+        out_cold, info_cold = results["cold"]
+        assert info_hot["temp"] == "hot"
+        assert info_cold["temp"] == "cold"
+        # The hot decode is undisturbed by the concurrent landing.
+        np.testing.assert_array_equal(np.asarray(out_hot),
+                                      np.asarray(warm))
+        assert not np.array_equal(np.asarray(out_cold),
+                                  np.asarray(out_hot))
+
+    def test_land_abort_strands_no_bytes(self, make_pool, tmp_path,
+                                         monkeypatch):
+        """Satellite 1, pool side: a landing that dies mid-flight
+        releases every array it already committed, reports state
+        'error' at the gates, and a later acquire retries cleanly."""
+        import zest_tpu.models.loader as loader_mod
+
+        snap = _snap(tmp_path, llama_checkpoint_files(n_layer=4))
+        pool = make_pool()
+        pool.group_bytes = 4096
+        real = loader_mod.commit_tensors
+        calls = {"n": 0}
+
+        def flaky(batch, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise RuntimeError("injected mid-land fault")
+            return real(batch, *a, **kw)
+
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(loader_mod, "commit_tensors", flaky)
+            entry, _ = pool.acquire(snap, "acme/a")
+            _wait_state(entry, "error")
+            with pytest.raises(RuntimeError, match="landing .* failed"):
+                entry.wait_for(entry.first_layer)
+            pool.release(entry)
+        assert calls["n"] > 1            # fault really fired mid-land
+        assert entry.params == {} and entry.bytes == 0
+        assert entry.committed == set()
+        # Recovery: the next acquire re-lands from scratch.
+        entry2, hot = pool.acquire(snap, "acme/a")
+        assert entry2 is entry and hot is False
+        _wait_state(entry, "resident")
+        assert entry.bytes == entry.reserved
+        pool.release(entry)
+
+
+# ── Decode parity with the family paths ──
+
+
+class TestParity:
+    def test_llama_matches_family(self, make_pool, tmp_path):
+        from zest_tpu.models.generate import load_generator
+
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        _mt, family = load_generator(snap)
+        pool = make_pool()
+        for kwargs in (
+            dict(),
+            dict(temperature=0.8, top_k=20, seed=3),
+        ):
+            want = family([1, 2, 3], 6, **kwargs)
+            got, _info = pool.generate_for(snap, "acme/a", [1, 2, 3], 6,
+                                           **kwargs)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want)), kwargs
+
+    def test_mixtral_matches_family(self, make_pool, tmp_path):
+        from zest_tpu.models.generate import load_generator
+
+        snap = _snap(tmp_path, mixtral_checkpoint_files())
+        _mt, family = load_generator(snap)
+        want = family([1, 2, 3], 5)
+        pool = make_pool()
+        got, info = pool.generate_for(snap, "acme/moe", [1, 2, 3], 5)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+        # Paged experts, same logits: the dense core landed, experts
+        # paged on demand, and residency stayed under the 50% bound.
+        ex = info["experts"]
+        assert 0 < ex["residency"] < 0.5
+        assert ex["page_ins"] > 0 and ex["verified"] > 0
+
+
+# ── Lazy MoE expert paging ──
+
+
+def _fake_expert_store(n_layer=2, n_expert=4, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    store = {}
+    for layer in range(n_layer):
+        for e in range(n_expert):
+            pre = (f"model.layers.{layer}.block_sparse_moe."
+                   f"experts.{e}.")
+            for leaf in ("w1", "w3", "w2"):
+                store[pre + leaf + ".weight"] = rng.normal(
+                    size=(dim, dim)).astype(np.float32)
+    return store
+
+
+class TestExpertPager:
+    GROUP = 3 * 8 * 8 * 4  # three dim×dim f32 tensors
+
+    def _pager(self, store, groups: float):
+        pager = hbm_pool.ExpertPager(lambda n: store[n],
+                                     int(self.GROUP * groups))
+        pager.total_expert_bytes = self.GROUP * 8
+        return pager
+
+    def test_lru_bound_and_eviction(self):
+        store = _fake_expert_store()
+        pager = self._pager(store, 2)
+        for e in range(3):
+            pager.get(0, e)
+        assert pager.bytes <= pager.budget_bytes
+        assert pager.evictions == 1 and pager.page_ins == 3
+        # (0,0) was evicted — a re-get is a page-in, not a hit.
+        pager.get(0, 0)
+        assert pager.page_ins == 4 and pager.hits == 0
+
+    def test_hit_refreshes_lru(self):
+        store = _fake_expert_store()
+        pager = self._pager(store, 2)
+        pager.get(0, 0)
+        pager.get(0, 1)
+        pager.get(0, 0)                 # refresh: 1 is now LRU
+        assert pager.hits == 1
+        pager.get(0, 2)                 # evicts (0,1), not (0,0)
+        pager.get(0, 0)
+        assert pager.hits == 2
+
+    def test_single_over_budget_group_serves(self):
+        store = _fake_expert_store()
+        pager = self._pager(store, 0.5)  # budget < one group
+        grp = pager.get(0, 0)
+        assert set(grp) == {"w1", "w3", "w2"}
+        assert pager.bytes == self.GROUP  # admitted despite overshoot
+        assert pager.stats()["residency"] == pytest.approx(1 / 8)
+
+    def test_corrupt_page_in_refused(self):
+        store = _fake_expert_store()
+        pager = self._pager(store, 2)
+        grp = pager.get(0, 0)
+        np.testing.assert_array_equal(
+            np.asarray(grp["w1"]),
+            store["model.layers.0.block_sparse_moe.experts.0"
+                  ".w1.weight"])
+        # Flip bytes on "disk", then force a re-read (evict the group).
+        store["model.layers.0.block_sparse_moe.experts.0"
+              ".w1.weight"][0, 0] += 1.0
+        pager.clear()
+        with pytest.raises(RuntimeError, match="changed on disk"):
+            pager.get(0, 0)
+        corrupt = {lbl.get("outcome"): v for lbl, v in _samples(
+            "zest_hbm_pool_expert_pages_total")}
+        assert corrupt.get("corrupt") == 1
+
+    def test_routed_miss_pages_in_through_pool(self, make_pool,
+                                               tmp_path):
+        snap = _snap(tmp_path, mixtral_checkpoint_files())
+        pool = make_pool()
+        _out, info = pool.generate_for(snap, "acme/moe", [1, 2, 3], 4)
+        entry = pool._entries[str(snap.resolve())]
+        pager = entry.pager
+        assert pager is not None
+        assert pager.bytes <= pager.budget_bytes
+        assert pager.stats()["residency"] < 0.5
+        # Expert bytes count against the pool, dense core excluded
+        # from expected.
+        assert entry.hbm_bytes == entry.bytes + pager.bytes
+        assert not any(hbm_pool._is_expert_name(n)
+                       for n in entry.expected)
+        outcomes = {lbl.get("outcome"): v for lbl, v in _samples(
+            "zest_hbm_pool_expert_pages_total")}
+        assert outcomes.get("miss", 0) == pager.page_ins > 0
+
+
+# ── Knob-off: bit-for-bit single-model behavior ──
+
+
+class TestKnobOff:
+    def test_pool_none_when_disabled(self, tmp_path):
+        cfg = _cfg(tmp_path, hbm_pool_enabled=False)
+        assert hbm_pool.pool(cfg) is None
+
+    def test_http_payload_schema_identity(self, tmp_path):
+        from zest_tpu.api.http_api import HttpApi
+
+        api_on = HttpApi(_cfg(tmp_path))
+        api_off = HttpApi(_cfg(tmp_path, hbm_pool_enabled=False))
+        try:
+            on, off = api_on.status_payload(), api_off.status_payload()
+            assert "hbm_pool" in on and "hbm_pool" not in off
+            assert set(on) - {"hbm_pool"} == set(off)
+            mon, moff = (api_on.models_payload(),
+                         api_off.models_payload())
+            assert "resident" in mon and set(moff) == {"models"}
+        finally:
+            api_on.close()
+            api_off.close()
+
+    def test_generate_path_bit_identical(self, tmp_path):
+        from zest_tpu.api.http_api import HttpApi
+
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        api_on = HttpApi(_cfg(tmp_path))
+        api_off = HttpApi(_cfg(tmp_path, hbm_pool_enabled=False))
+        try:
+            mt_on, gen_on, info = api_on._decode_path(snap, "acme/a")
+            mt_off, gen_off, none = api_off._decode_path(snap, "acme/a")
+            assert mt_on == mt_off == "llama"
+            assert info is not None and none is None
+            out_on = gen_on([1, 2, 3], 6)
+            out_off = gen_off([1, 2, 3], 6)
+            np.testing.assert_array_equal(np.asarray(out_on),
+                                          np.asarray(out_off))
+            assert info["temp"] == "cold"
+        finally:
+            api_on.close()
+            api_off.close()
+
+    def test_streamed_done_carries_pool_info(self, tmp_path):
+        from zest_tpu.api.http_api import HttpApi
+
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        api = HttpApi(_cfg(tmp_path))
+        try:
+            mt, gen, info = api._decode_path(snap, "acme/a")
+            kwargs = dict(temperature=0.0, top_k=None, top_p=None,
+                          seed=0, stop_at_eos=True)
+            evs = list(api._streamed_decode(gen, mt, [1, 2, 3], 4,
+                                            None, kwargs,
+                                            pool_info=info))
+            assert [e["event"] for e in evs] == ["token"] * 4 + ["done"]
+            assert evs[-1]["pool"]["temp"] == "cold"
+        finally:
+            api.close()
+
+
+# ── Observability: metrics, SLO, CLI ──
+
+
+class TestObservability:
+    def test_metrics_and_timeline(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        pool = make_pool()
+        pool.generate_for(snap, "acme/a", [1, 2, 3], 3)
+        states = {lbl.get("state"): v
+                  for lbl, v in _samples("zest_hbm_pool_bytes")}
+        assert set(states) == {"pinned", "resident"}
+        assert states["pinned"] == 0       # decode finished, unpinned
+        assert states["resident"] > 0
+        ttft = _samples("zest_ttft_seconds")
+        assert any(lbl.get("temp") == "cold" for lbl, _v in ttft)
+        # Timeline probes registered by the pool (replace semantics).
+        assert timeline.STORE is not None
+        row = pool.summary()
+        assert row["models"][0]["state"] == "resident"
+        assert row["enabled"] is True
+
+    def test_ttft_slo_breach(self, make_pool, tmp_path):
+        snap = _snap(tmp_path, llama_checkpoint_files())
+        pool = make_pool(slo_ttft_s=1e-6)   # impossible budget
+        pool.generate_for(snap, "acme/a", [1, 2, 3], 2)
+        breaches = {lbl.get("slo"): v
+                    for lbl, v in _samples("zest_slo_breaches_total")}
+        assert breaches.get("ttft") == 1
+        burn = telemetry.session.SESSIONS.slo_burn()
+        assert burn["ttft"]["breaches"] == 1
+        assert burn["ttft"]["burn"] == 1.0
+
+    def test_cli_models_resident(self, make_pool, tmp_path,
+                                 monkeypatch, capsys):
+        from types import SimpleNamespace
+
+        from zest_tpu import cli
+
+        rows = [{"repo": "acme/a", "state": "resident",
+                 "bytes": 1048576, "pins": 0, "lands": 1,
+                 "gate_stall_s": 0.0,
+                 "experts": {"residency": 0.375}}]
+        monkeypatch.setattr(
+            cli, "_daemon_get",
+            lambda cfg, path, timeout=2.0: {"models": [],
+                                            "resident": rows})
+        rc = cli.cmd_models(SimpleNamespace(json=False, resident=True))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "acme/a" in out and "resident" in out
+        assert "experts 38%" in out
+
+        rc = cli.cmd_models(SimpleNamespace(json=True, resident=True))
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == {"resident": rows}
+
+    def test_cli_models_resident_no_daemon(self, monkeypatch, capsys):
+        from types import SimpleNamespace
+
+        from zest_tpu import cli
+
+        monkeypatch.setattr(cli, "_daemon_get",
+                            lambda cfg, path, timeout=2.0: None)
+        rc = cli.cmd_models(SimpleNamespace(json=False, resident=True))
+        assert rc == 1
+        assert "no HBM pool state" in capsys.readouterr().err
+
+
+# ── Remediation rules (pool thrash → shed, gate stall → rush) ──
+
+
+class TestRemediation:
+    def _engine(self):
+        assert remediate.ensure_started()
+        return remediate.ENGINE
+
+    def test_stall_growth_arms_rush(self):
+        eng = self._engine()
+        fired = []
+        remediate.register_target("pool_land",
+                                  lambda cmd: fired.append(cmd) or True)
+        timeline.post("hbm_pool.gate_stall_s", 0.5)
+        timeline.post("hbm_pool.landing", 1.0)
+        timeline.STORE.tick()
+        eng._pool_rules(timeline.STORE, time.monotonic())
+        assert fired == []                   # first tick: baseline only
+        timeline.post("hbm_pool.gate_stall_s", 2.0)
+        timeline.STORE.tick()
+        eng._pool_rules(timeline.STORE, time.monotonic())
+        assert fired == ["rush"]
+        counts = remediate.payload()["counts"].get("hedge", {})
+        assert counts.get("success", 0) == 1
+
+    def test_eviction_growth_sheds(self):
+        eng = self._engine()
+        fired = []
+        remediate.register_target("pool_shed",
+                                  lambda cmd: fired.append(cmd) or True)
+        timeline.post("hbm_pool.evictions", 1.0)
+        timeline.STORE.tick()
+        eng._pool_rules(timeline.STORE, time.monotonic())
+        timeline.post("hbm_pool.evictions", 3.0)
+        timeline.STORE.tick()
+        eng._pool_rules(timeline.STORE, time.monotonic())
+        assert fired == ["shed_coldest"]
+        counts = remediate.payload()["counts"].get("shed", {})
+        assert counts.get("success", 0) == 1
+
+    def test_steady_state_no_action(self):
+        eng = self._engine()
+        fired = []
+        remediate.register_target("pool_land",
+                                  lambda cmd: fired.append(cmd) or True)
+        remediate.register_target("pool_shed",
+                                  lambda cmd: fired.append(cmd) or True)
+        for _ in range(3):
+            timeline.post("hbm_pool.gate_stall_s", 1.0)
+            timeline.post("hbm_pool.evictions", 2.0)
+            timeline.post("hbm_pool.landing", 0.0)
+            timeline.STORE.tick()
+            eng._pool_rules(timeline.STORE, time.monotonic())
+        assert fired == []
+
+    def test_pool_rush_target(self, make_pool):
+        pool = make_pool()
+        assert pool._land_cmd("rush") is True
+        assert pool._rush.is_set()
+        assert pool._land_cmd("unknown") is False
+        assert pool._shed_cmd("shed_coldest") is False  # empty pool
+
+
+# ── Satellite 1, loader side: aborted streaming landing cleanup ──
+
+
+class TestLoaderAbortCleanup:
+    def test_aborted_streaming_land_releases_arrays(self, tmp_path):
+        from zest_tpu.models.loader import stage_cached_to_hbm
+        from zest_tpu.transfer.bridge import XetBridge
+        from zest_tpu.transfer.pod import fetch_file_header, pod_round
+
+        files = llama_checkpoint_files(n_layer=4)
+        repo = FixtureRepo("acme/tiny-llama", files, chunks_per_xorb=2)
+        with FixtureHub(repo) as hub:
+            cfg = Config(hf_home=tmp_path / "hf",
+                         cache_dir=tmp_path / "zest",
+                         hf_token="hf_test", endpoint=hub.url)
+            bridge = XetBridge(cfg)
+            bridge.authenticate("acme/tiny-llama")
+            frepo = hub.repos["acme/tiny-llama"]
+            rec = frepo.reconstructions[
+                frepo.files["model.safetensors"].xet_hash]
+            pod_round(bridge, [rec])
+            header = fetch_file_header(bridge, rec)
+
+            def gate(_i, name, _cancel):
+                if name.startswith("model.layers.2."):
+                    raise RuntimeError("injected abort at layer 2")
+
+            base = sum(int(a.nbytes) for a in jax.live_arrays())
+            with pytest.raises(RuntimeError, match="injected abort"):
+                stage_cached_to_hbm(bridge, [(rec, header)],
+                                    stream=True, tensor_gate=gate)
+            # The committed prefix (embeddings + early layers) was
+            # deleted by the abort path — no stranded partial tree.
+            after = sum(int(a.nbytes) for a in jax.live_arrays())
+            assert after - base < 64 * 1024, \
+                f"stranded {after - base} HBM bytes after abort"
+
+            # The cache is intact: a clean landing still round-trips.
+            params, stats = stage_cached_to_hbm(bridge, [(rec, header)],
+                                                stream=True)
+            assert stats["streamed"] is True
+            emb = np.frombuffer(
+                np.asarray(params["model.embed_tokens.weight"])
+                .tobytes(), np.float32)
+            assert emb.size == 256 * 64
+            for arr in params.values():
+                arr.delete()

@@ -300,6 +300,14 @@ class HttpApi:
         tl = telemetry.timeline.status_block()
         if tl.get("enabled"):
             payload["timeline"] = tl
+        # HBM serving pool (ISSUE 18): occupancy vs watermark, hit/miss,
+        # evictions, per-model rows. Absent with ZEST_HBM_POOL=0 —
+        # same knob-off schema rule as tenancy/timeline.
+        from zest_tpu.models import hbm_pool
+
+        hp = hbm_pool.pool(self.cfg)
+        if hp is not None:
+            payload["hbm_pool"] = hp.summary()
         return payload
 
     # ── Live timelines (ISSUE 15) ──
@@ -414,10 +422,25 @@ class HttpApi:
                **final}
 
     def models_payload(self) -> dict:
-        """Pulled models in the HF hub cache (http_api.zig:152-210)."""
+        """Pulled models in the HF hub cache (http_api.zig:152-210),
+        plus — with the pool on (ISSUE 18) — which of them are resident
+        or landing in HBM right now (``resident`` rows; each disk row
+        whose repo matches also gains a ``pool_state``). Knob-off keeps
+        the original single-key schema."""
+        from zest_tpu.models import hbm_pool
         from zest_tpu.storage import list_models
 
-        return {"models": list_models(self.cfg)}
+        doc: dict = {"models": list_models(self.cfg)}
+        hp = hbm_pool.pool(self.cfg)
+        if hp is not None:
+            rows = hp.resident()
+            doc["resident"] = rows
+            states = {r["repo"]: r["state"] for r in rows}
+            for m in doc["models"]:
+                state = states.get(m.get("repo_id"))
+                if state is not None:
+                    m["pool_state"] = state
+        return doc
 
     def trace_payload(self) -> dict:
         """Live tracer snapshot as Chrome trace JSON (``GET /v1/trace``)
@@ -734,7 +757,8 @@ class HttpApi:
                        "message": "need ids, or prompt + a tokenizer "
                                   "in the snapshot"}
                 return
-            model_type, generate = self._generator_for(snapshot_dir)
+            model_type, generate, pool_info = self._decode_path(
+                snapshot_dir, repo_id)
             top_k = req.get("top_k")
             top_p = req.get("top_p")
             kwargs = dict(
@@ -747,15 +771,55 @@ class HttpApi:
             steps = int(req.get("steps", 20))
             if req.get("stream"):
                 yield from self._streamed_decode(
-                    generate, model_type, prompt, steps, tok, kwargs
+                    generate, model_type, prompt, steps, tok, kwargs,
+                    pool_info=pool_info,
                 )
                 return
             out = generate(prompt, steps, **kwargs)
-            yield self._done_event(model_type, out, tok)
+            ev = self._done_event(model_type, out, tok)
+            if pool_info:
+                ev["pool"] = dict(pool_info)
+            yield ev
         except Exception as exc:  # noqa: BLE001 - reported to client
             yield {"event": "error", "message": str(exc)}
         finally:
             self._unpin_snapshot(memo_key)
+
+    def _decode_path(self, snapshot_dir, repo_id: str):
+        """Route one generate to the HBM pool or the classic path.
+
+        Returns ``(model_type, generate, pool_info)``. With the pool on
+        (ISSUE 18) and a pool-served family, ``generate`` is a thin
+        wrapper over ``HbmPool.generate_for`` — the pool pins the tree,
+        re-lands it from the local snapshot if it was evicted
+        (scale-to-zero), and starts decoding at first-layer commit; the
+        TTFT/temperature facts it returns accumulate into ``pool_info``
+        (a dict the caller folds into the ``done`` event as ``pool``).
+        gpt2/unknown families — and ``ZEST_HBM_POOL=0`` entirely — take
+        the pre-pool single-model path, ``pool_info=None``, and the
+        event schema is byte-identical to before the pool existed."""
+        from zest_tpu.models import hbm_pool
+
+        pool = hbm_pool.pool(self.cfg)
+        if pool is not None:
+            model_type, eos_ids = hbm_pool.snapshot_meta(snapshot_dir)
+            if pool.supports(model_type):
+                pool_info: dict = {}
+
+                def generate(prompt, steps, on_token=None, **kw):
+                    out, info = pool.generate_for(
+                        snapshot_dir, repo_id, prompt, steps,
+                        on_token=on_token, **kw)
+                    pool_info.update(info)
+                    return out
+
+                # _streamed_decode reads eos_ids off the callable to
+                # stop token events at the first generated EOS — same
+                # contract the family generate functions carry.
+                generate.eos_ids = eos_ids
+                return model_type, generate, pool_info
+        model_type, generate = self._generator_for(snapshot_dir)
+        return model_type, generate, None
 
     _PULL_TTL_S = 30.0
 
@@ -848,7 +912,7 @@ class HttpApi:
         return payload
 
     def _streamed_decode(self, generate, model_type: str, prompt, steps,
-                         tok, kwargs: dict):
+                         tok, kwargs: dict, pool_info: dict | None = None):
         """Run the decode in a worker; relay its io_callback token queue
         as SSE events. Prompt prefill positions are filtered here (the
         callback reports every written position), and token events stop
@@ -920,7 +984,12 @@ class HttpApi:
                     ended = bool(eos_ids) and tid in eos_ids
         finally:
             cancelled.set()
-        yield self._done_event(model_type, out, tok)
+        ev = self._done_event(model_type, out, tok)
+        if pool_info:
+            # Filled in by the pool wrapper during generate(); the
+            # worker finished before 'done' was queued, so it's final.
+            ev["pool"] = dict(pool_info)
+        yield ev
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -1148,6 +1217,12 @@ DASHBOARD_HTML = """<!doctype html>
 <div class="card"><h2 style="font-size:1.05rem">Cached models</h2>
 <table id="models"><thead><tr><th>repo</th><th>revision</th><th>files</th>
 </tr></thead><tbody></tbody></table></div>
+<div class="card" id="poolcard" style="display:none">
+<h2 style="font-size:1.05rem">HBM pool</h2>
+<div id="poolsum" style="font-size:.85rem;margin-bottom:.4rem"></div>
+<table id="pool"><thead><tr><th>repo</th><th>state</th><th>bytes</th>
+<th>pins</th><th>lands</th><th>gate stall</th><th>experts</th></tr></thead>
+<tbody></tbody></table></div>
 <script>
 let tlCursor=0,tlSeries={};
 async function tick(){
@@ -1178,8 +1253,30 @@ async function tick(){
    ||'<tr><td colspan="6">no pulls yet</td></tr>';
   const m=await (await fetch('/v1/models')).json();
   document.querySelector('#models tbody').innerHTML=m.models.map(x=>
-   `<tr><td>${x.repo_id}</td><td><code>${(x.revision||'').slice(0,12)}</code>
+   `<tr><td>${x.repo_id}${x.pool_state?' <span class="k">['
+    +esc(x.pool_state)+']</span>':''}</td>
+    <td><code>${(x.revision||'').slice(0,12)}</code>
     </td><td>${x.files}</td></tr>`).join('');
+  // HBM pool panel (ISSUE 18): occupancy vs watermark, hit/miss/
+  // eviction counters, and per-model rows (state, bytes, pins, land
+  // count, gate-stall seconds, MoE expert residency).
+  const HP=s.hbm_pool;
+  document.getElementById('poolcard').style.display=HP?'':'none';
+  if(HP){
+   const MB=v=>(v/1048576).toFixed(1)+' MiB';
+   document.getElementById('poolsum').textContent=
+    'used '+MB(HP.used_bytes)+' ('+MB(HP.pinned_bytes)+' pinned) / '
+    +(HP.budget_bytes?MB(HP.budget_bytes):'unbounded')
+    +' · hits '+HP.hits+' · misses '+HP.misses
+    +' · evictions '+HP.evictions+(HP.rush?' · RUSH':'');
+   document.querySelector('#pool tbody').innerHTML=
+    (HP.models||[]).map(r=>
+     `<tr><td>${esc(r.repo)}</td><td class="k">${esc(r.state)}</td>
+      <td>${MB(r.bytes)}</td><td>${r.pins}</td><td>${r.lands}</td>
+      <td>${r.gate_stall_s}s</td><td>${r.experts?
+       (r.experts.residency*100).toFixed(0)+'% resident':''}</td></tr>`
+    ).join('')||'<tr><td colspan="7">empty</td></tr>';
+  }
   // Coop panel (ISSUE 7): live peer-served ratio, per-tier bytes,
   // quarantined peers, and the flight-recorder tail from /v1/debug.
   const d=await (await fetch('/v1/debug?tail=8')).json();

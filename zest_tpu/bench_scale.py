@@ -50,7 +50,7 @@ import numpy as np
 
 __all__ = ["llama_checkpoint_files", "mutate_tensors", "bench_gb_pull",
            "bench_coop_pull", "bench_delta_pull", "bench_swarm",
-           "bench_tenants", "bench_fleet"]
+           "bench_tenants", "bench_fleet", "bench_serve_pool"]
 
 
 def mutate_tensors(tensors: dict, fraction: float, seed: int = 1) -> None:
@@ -2167,3 +2167,180 @@ def bench_tenants(gb: float = 0.064, k_tenants: int = 6,
         pathlib.Path(out_path).write_text(json.dumps(out, indent=2)
                                           + "\n")
     return out
+
+
+def bench_serve_pool(gb: float = 0.02, runs: int = 3, scale: int = 8,
+                     throttle_mbps: float = 200.0,
+                     chunks_per_xorb: int = 64, steps: int = 8,
+                     budget_s: float | None = None) -> dict:
+    """HBM serving-pool bench (ISSUE 18 acceptance).
+
+    The scale-to-zero story, measured: model A was served once, got
+    evicted under pressure, and a request arrives for it again. The
+    baseline arm is what serving A costs with no pool — a full cold
+    pull over a throttled loopback network plus the family generator's
+    first token (``full_cold_serve_s``, timed from request to first
+    token). The pool arm re-lands A from its local snapshot with the
+    decode parked on per-layer gates, so the first token overlaps the
+    landing tail (``ttft_cold_s`` — the pool's own request-to-first-
+    token clock). The ``ttft_cold_ratio`` gate is <= 0.5.
+
+    Each run also proves the safety half of the contract in-band:
+    while A is *pinned* (an active decode), B's admission under a
+    one-byte-slack budget must NOT evict A (``pinned_never_evicted``),
+    and the re-landed tree's ``params_digest`` must be byte-identical
+    to the original landing (``digest_identical``). One MoE serve per
+    bench records the lazy expert pager's residency — the dense core
+    lands, experts page on demand, bounded under 50%.
+
+    Honesty notes: baseline and pool arms share one process, so jit
+    traces built by earlier runs are warm for later ones on the pool
+    side (its builders cache by config) while the family path
+    re-traces per snapshot — exactly the asymmetry a long-lived server
+    has, since a re-served model's compiled fns are resident while a
+    never-served model pays its build. ``pull_s`` is reported so the
+    network share of the baseline is visible."""
+    fixtures = _import_fixtures()
+    FixtureHub, FixtureRepo = fixtures.FixtureHub, fixtures.FixtureRepo
+
+    from zest_tpu.config import Config
+    from zest_tpu.models import hbm_pool
+    from zest_tpu.models.generate import load_generator
+    from zest_tpu.transfer.pull import pull_model
+
+    t_bench0 = time.perf_counter()
+    files_a = llama_checkpoint_files(gb, seed=0, scale=scale,
+                                     shard_bytes=8 << 20)
+    files_b = llama_checkpoint_files(gb, seed=1, scale=scale,
+                                     shard_bytes=8 << 20)
+    total = sum(len(b) for b in files_a.values())
+    repo_a = FixtureRepo("bench/serve-a", files_a,
+                         chunks_per_xorb=chunks_per_xorb)
+    repo_b = FixtureRepo("bench/serve-b", files_b,
+                         chunks_per_xorb=chunks_per_xorb)
+    repo_moe = FixtureRepo("bench/serve-moe",
+                           fixtures.mixtral_checkpoint_files(),
+                           chunks_per_xorb=chunks_per_xorb)
+    gc.collect()
+
+    quiet = {"log": lambda *a, **k: None}
+    prompt = [1, 2, 3]
+    full_cold: list[float] = []
+    pull_s: list[float] = []
+    ttft_cold: list[float] = []
+    ttft_hot: list[float] = []
+    stalls: list[float] = []
+    overlap: list[bool] = []
+    digest_ok: bool | None = None
+    pinned_ok: bool | None = None
+    moe: dict | None = None
+    with FixtureHub(repo_a, repo_b, repo_moe,
+                    throttle_bps=int(throttle_mbps * 1e6 / 8)) as hub:
+        for run_i in range(runs):
+            if run_i and budget_s is not None \
+                    and time.perf_counter() - t_bench0 > budget_s:
+                break  # keep what's measured (bench_gb_pull's rule)
+            _settle_page_cache(False)
+            with tempfile.TemporaryDirectory() as root:
+                rootp = pathlib.Path(root)
+                cfg = Config(hf_home=rootp / "hf",
+                             cache_dir=rootp / "zest",
+                             hf_token="hf_test", endpoint=hub.url)
+
+                # Baseline arm: classic cold serve, request → token 1.
+                t0 = time.perf_counter()
+                res_a = pull_model(cfg, "bench/serve-a", no_p2p=True,
+                                   **quiet)
+                pull_s.append(time.perf_counter() - t0)
+                snap_a = res_a.snapshot_dir
+                first: dict = {}
+                _mt, family = load_generator(snap_a)
+                family(prompt, steps,
+                       on_token=lambda _p, _t: first.setdefault(
+                           "t", time.perf_counter()))
+                full_cold.append(first["t"] - t0)
+
+                pool = hbm_pool.HbmPool(cfg)
+                try:
+                    # Establish residency (untimed), then prove the
+                    # pinned tree survives B's admission pressure.
+                    pool.generate_for(snap_a, "bench/serve-a",
+                                      prompt, steps)
+                    d0 = pool.digest(snap_a)
+                    res_b = pull_model(cfg, "bench/serve-b",
+                                       no_p2p=True, **quiet)
+                    entry_a, _hot = pool.acquire(snap_a,
+                                                 "bench/serve-a")
+                    pool.budget = entry_a.reserved + 1
+                    pool.generate_for(res_b.snapshot_dir,
+                                      "bench/serve-b", prompt, 2)
+                    ok = (entry_a.state == "resident"
+                          and pool.pinned_survivals > 0)
+                    pinned_ok = ok if pinned_ok is None \
+                        else (pinned_ok and ok)
+                    pool.release(entry_a)
+
+                    # Scale A to zero; the measured re-land serve.
+                    pool.budget = cfg.hbm_pool_bytes
+                    pool.evict(snap_a, "scale_to_zero")
+                    _o, info_c = pool.generate_for(
+                        snap_a, "bench/serve-a", prompt, steps)
+                    ttft_cold.append(info_c["ttft_s"])
+                    stalls.append(info_c["gate_stall_s"])
+                    overlap.append(
+                        info_c["decode_start_before_land_end"])
+                    ok = bool(d0) and pool.digest(snap_a) == d0
+                    digest_ok = ok if digest_ok is None \
+                        else (digest_ok and ok)
+                    _o, info_h = pool.generate_for(
+                        snap_a, "bench/serve-a", prompt, steps)
+                    ttft_hot.append(info_h["ttft_s"])
+                    if moe is None:
+                        res_m = pull_model(cfg, "bench/serve-moe",
+                                           no_p2p=True, **quiet)
+                        _o, info_m = pool.generate_for(
+                            res_m.snapshot_dir, "bench/serve-moe",
+                            prompt, 4)
+                        moe = info_m["experts"]
+                finally:
+                    pool.close()
+                del res_a
+                gc.collect()
+
+    med_full = statistics.median(full_cold)
+    med_cold = statistics.median(ttft_cold)
+    ratio = (med_cold / med_full) if med_full else None
+    expert_res = (moe or {}).get("residency")
+    gates = {
+        "ttft_cold_ratio_max": 0.5,
+        "ttft_cold_ratio": round(ratio, 4) if ratio is not None
+        else None,
+        "ttft_ok": bool(ratio is not None and ratio <= 0.5),
+        "digest_identical": bool(digest_ok),
+        "pinned_never_evicted": bool(pinned_ok),
+        "expert_residency_max": 0.5,
+        "expert_residency": expert_res,
+        "experts_ok": bool(expert_res is not None
+                           and expert_res < 0.5
+                           and (moe or {}).get("verified", 0) > 0),
+    }
+    gates["all_ok"] = (gates["ttft_ok"] and gates["digest_identical"]
+                       and gates["pinned_never_evicted"]
+                       and gates["experts_ok"])
+    return {
+        "bench": "serve_pool",
+        "checkpoint_gb": round(total / 1e9, 3),
+        "throttle_mbps": throttle_mbps,
+        "runs": len(ttft_cold),
+        "steps": steps,
+        "full_cold_serve_s": round(med_full, 3),
+        "full_cold_serve_runs_s": [round(t, 3) for t in full_cold],
+        "pull_s": round(statistics.median(pull_s), 3),
+        "ttft_cold_s": round(med_cold, 3),
+        "ttft_cold_runs_s": [round(t, 3) for t in ttft_cold],
+        "ttft_hot_s": round(statistics.median(ttft_hot), 4),
+        "gate_stall_s": round(statistics.median(stalls), 3),
+        "decode_start_before_land_end": all(overlap),
+        "moe_experts": moe,
+        "gates": gates,
+    }

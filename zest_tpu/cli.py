@@ -1267,6 +1267,32 @@ def cmd_models(args) -> int:
 
     cfg = Config.load()
     payload = _daemon_get(cfg, "/v1/models")
+    if args.resident:
+        # HBM-pool residency (ISSUE 18): which trees the serving daemon
+        # holds in HBM right now. Pool state lives in the daemon
+        # process — without one (or with ZEST_HBM_POOL=0, when the
+        # payload has no 'resident' key) there is nothing to list.
+        resident = (payload.get("resident")
+                    if isinstance(payload, dict) else None)
+        if not isinstance(resident, list):
+            print("no HBM pool state (daemon not running, or "
+                  "ZEST_HBM_POOL=0)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps({"resident": resident}))
+            return 0
+        if not resident:
+            print("HBM pool empty")
+        for r in resident:
+            line = (f"{r.get('repo')}  {r.get('state')}  "
+                    f"{r.get('bytes', 0) / 1e6:.1f} MB  "
+                    f"pins {r.get('pins', 0)}  lands {r.get('lands', 0)}")
+            ex = r.get("experts")
+            if isinstance(ex, dict):
+                line += (f"  experts {ex.get('residency', 0) * 100:.0f}%"
+                         " resident")
+            print(line)
+        return 0
     models = payload.get("models") if payload is not None else None
     if not isinstance(models, list) or any(
             not isinstance(m, dict) or not m.get("repo_id")
@@ -1292,7 +1318,10 @@ def cmd_models(args) -> int:
         print("no models pulled")
     for m in models:
         rev = (m.get("revision") or "?")[:12]
-        print(f"{m.get('repo_id')}  rev {rev}  {m.get('files', 0)} files")
+        pool = (f"  [hbm:{m['pool_state']}]"
+                if m.get("pool_state") else "")
+        print(f"{m.get('repo_id')}  rev {rev}  "
+              f"{m.get('files', 0)} files{pool}")
     print(f"xorb cache: {len(xorbs)} xorbs, {xorb_bytes / 1e6:.1f} MB")
     return 0
 
@@ -1533,6 +1562,9 @@ def build_parser() -> argparse.ArgumentParser:
     models_p = sub.add_parser(
         "models", help="list pulled models and xorb cache totals")
     models_p.add_argument("--json", action="store_true")
+    models_p.add_argument(
+        "--resident", action="store_true",
+        help="only models resident/landing in the serving HBM pool")
     models_p.set_defaults(fn=cmd_models)
 
     bench = sub.add_parser("bench", help="run the synthetic benchmark suite")

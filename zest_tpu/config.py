@@ -121,6 +121,19 @@ DEFAULT_TENANCY = True
 DEFAULT_TENANT_MAX_PULLS = 4
 DEFAULT_TENANT_QUEUE = 16
 DEFAULT_TENANT_INFLIGHT_BYTES = 4 << 30
+# HBM serving pool (models.hbm_pool, ISSUE 18): with 1 (default) the
+# daemon's /v1/generate serves from a process-wide managed pool of
+# resident model trees — byte accounting against ZEST_HBM_POOL_BYTES,
+# LRU eviction of cold unpinned trees back to the xorb/snapshot cache,
+# and scale-to-zero re-landing where decode starts at first-layer
+# commit instead of full land. 0 restores the single-model
+# generator-LRU behavior bit-for-bit (stats schema included).
+# ZEST_HBM_POOL_BYTES is the pool watermark (0 = unbounded);
+# ZEST_SLO_TTFT_S is the time-to-first-token SLO budget (unset/0 =
+# unarmed — a breach bumps zest_slo_breaches_total{slo="ttft"} like
+# the PR-11 tthbm/ttfl budgets).
+DEFAULT_HBM_POOL = True
+DEFAULT_HBM_POOL_BYTES = DEFAULT_HBM_STAGING_BYTES
 # Delta pulls (transfer.delta, ISSUE 10): with 1 (default) every pull
 # persists a revision manifest and a pull of revision B over a cached
 # revision A plans a chunk-level delta — unchanged bytes serve from the
@@ -343,6 +356,9 @@ class Config:
     tenant_inflight_bytes: int = DEFAULT_TENANT_INFLIGHT_BYTES
     tenant_disk_high: int = 0
     tenant_disk_low: int = 0
+    # HBM serving pool (see DEFAULT_HBM_POOL above).
+    hbm_pool_enabled: bool = DEFAULT_HBM_POOL
+    hbm_pool_bytes: int = DEFAULT_HBM_POOL_BYTES
     # Delta pulls (see DEFAULT_DELTA above).
     delta_pull: bool = DEFAULT_DELTA
     # Background materialization lane (see DEFAULT_FILES_* above).
@@ -424,6 +440,7 @@ class Config:
     tenant: str | None = None
     slo_tthbm_s: float | None = None
     slo_ttfl_s: float | None = None
+    slo_ttft_s: float | None = None
     # Live timelines (telemetry.timeline; ISSUE 15): like ZEST_TELEMETRY
     # these are read by the sampler directly on its own paths — the
     # fields here are the introspection mirror for /v1/status. The
@@ -558,6 +575,16 @@ class Config:
                 DEFAULT_TENANT_INFLIGHT_BYTES, floor=1),
             tenant_disk_high=disk_high,
             tenant_disk_low=disk_low,
+            # Strict like ZEST_LAND_STREAM: ZEST_HBM_POOL is the
+            # serving-pool rollback knob — "false"/a typo must raise,
+            # never silently keep the pool on; the byte watermark
+            # follows the seed-rate sign-slip discipline.
+            hbm_pool_enabled=_strict_bool(
+                "ZEST_HBM_POOL",
+                env.get("ZEST_HBM_POOL",
+                        "1" if DEFAULT_HBM_POOL else "0")),
+            hbm_pool_bytes=_strict_nonneg_int(
+                env, "ZEST_HBM_POOL_BYTES", DEFAULT_HBM_POOL_BYTES),
             # Strict like ZEST_LAND_STREAM: ZEST_DELTA is the delta
             # rollback knob — "false"/a typo must raise, never silently
             # keep deltas on.
@@ -618,6 +645,7 @@ class Config:
             tenant=env.get("ZEST_TENANT") or None,
             slo_tthbm_s=_opt_pos_float(env, "ZEST_SLO_TTHBM_S"),
             slo_ttfl_s=_opt_pos_float(env, "ZEST_SLO_TTFL_S"),
+            slo_ttft_s=_opt_pos_float(env, "ZEST_SLO_TTFT_S"),
             # Same off-value convention as ZEST_TELEMETRY (the sampler
             # resolves the env itself; this mirrors it). The hz/window
             # knobs parse strictly HERE — a daemon started with a

@@ -1,0 +1,1249 @@
+"""HBM as a managed multi-model serving cache (ISSUE 18).
+
+The loader lands layer-by-layer (PR 8), swaps revisions in place
+(PR 9), and the daemon decodes via ``/v1/generate`` — but HBM was a
+one-model scratch buffer: a request against a cold model paid a full
+pull wall before its first token. This module lifts the PR-12
+PinBook/CacheEvictor pattern from the disk tier to HBM:
+
+* A process-wide :class:`HbmPool` holds multiple resident model trees
+  as **flat HF name → jax.Array dicts** (exactly what the streaming
+  landing commits, so ``loader.params_digest`` is directly comparable
+  between a cold pull and a pool re-land), byte-accounted against the
+  ``ZEST_HBM_POOL_BYTES`` watermark.
+* **Pinning** protects the actively-decoding model; LRU eviction drops
+  cold trees back to the xorb/snapshot cache (arrays deleted, bytes
+  stay on disk) — never a pinned one.
+* **Scale-to-zero re-landing**: a generate against an evicted model
+  re-lands from the local snapshot in layer-priority order
+  (``registry.order_names``), and decode starts at *first-layer
+  commit* — the gated decoders below run each forward layer as soon as
+  its tensors are resident, overlapping prefill with the landing tail
+  behind per-layer gates instead of waiting for the whole checkpoint.
+* **Lazy MoE expert paging** (the creative stretch): a Mixtral entry
+  lands only its dense core; expert tensors are pulled on demand per
+  routed token through :class:`ExpertPager`, a small expert LRU inside
+  the pool's budget, each page-in BLAKE3-verified against the digest
+  pinned at first read — the same byte-identity boundary any peer/CDN
+  byte crosses (the snapshot itself is the product of merkle-verified
+  chunks; the pager guards the disk → HBM re-read).
+
+Observability is wired from day one: ``zest_hbm_pool_bytes{state}``,
+``zest_hbm_pool_evictions_total{reason}``, ``zest_ttft_seconds{temp}``,
+timeline series (occupancy, gate stalls, evictions) and remediation
+targets (``pool_land`` rush for stalled gates, ``pool_shed`` for
+thrash) so PRs 10/14/17 cover the new hot path.
+
+``ZEST_HBM_POOL=0`` removes the pool entirely (:func:`pool` returns
+None) — the daemon then serves exactly the pre-pool single-model path.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zest_tpu import telemetry
+from zest_tpu.telemetry import remediate, timeline
+
+# Families the gated decoders below cover. gpt2 (and unknown types)
+# fall back to the classic single-model path in api.http_api — the
+# pool never claims a model it cannot gate-decode.
+POOL_FAMILIES = ("llama", "mistral", "qwen2", "mixtral")
+
+# Re-land commit group: tensors accumulate to ~this many bytes before
+# one batched commit_tensors (cut only at layer-priority boundaries so
+# a gate never opens on half a layer). The remediation "rush" flips to
+# per-layer flushes.
+DEFAULT_GROUP_BYTES = 64 << 20
+
+# Expert LRU budget as a fraction of the checkpoint's full expert
+# bytes — 0.375 keeps worst-case residency safely under the 50%
+# acceptance bound while still absorbing router locality.
+EXPERT_BUDGET_FRACTION = 0.375
+
+_M_POOL_BYTES = telemetry.gauge(
+    "zest_hbm_pool_bytes",
+    "HBM bytes held by the serving pool, by pin state", ("state",))
+_M_POOL_EVICTIONS = telemetry.counter(
+    "zest_hbm_pool_evictions_total",
+    "Model trees evicted from the HBM pool", ("reason",))
+_M_TTFT = telemetry.histogram(
+    "zest_ttft_seconds",
+    "Time from /v1/generate arrival to first generated token",
+    ("temp",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
+_M_EXPERT_PAGES = telemetry.counter(
+    "zest_hbm_pool_expert_pages_total",
+    "Expert-tensor page events in the MoE pager", ("outcome",))
+# Same (name, labels) as transfer.pull's counter — the registry
+# returns the shared instance, so serving TTFT breaches land in the
+# same series as pull-side SLO breaches.
+_M_SLO_BREACHES = telemetry.counter(
+    "zest_slo_breaches_total",
+    "Pulls that breached an armed SLO budget (ZEST_SLO_TTHBM_S / "
+    "ZEST_SLO_TTFL_S)", ("slo",))
+
+
+# ── Checkpoint topology helpers ──
+
+
+def _snapshot_cfg(snapshot_dir: str | Path) -> dict:
+    return json.loads((Path(snapshot_dir) / "config.json").read_text())
+
+
+def snapshot_meta(snapshot_dir: str | Path) -> tuple[str | None, tuple]:
+    """(model_type, eos_ids) from a snapshot's config.json — what the
+    serving layer needs to route a request (pool vs classic path)
+    before touching the pool. ``(None, ())`` when the snapshot has no
+    readable config."""
+    try:
+        cfg_json = _snapshot_cfg(snapshot_dir)
+    except (OSError, json.JSONDecodeError):
+        return None, ()
+    from zest_tpu.models.generate import _eos_token_ids
+
+    return cfg_json.get("model_type"), _eos_token_ids(cfg_json)
+
+
+def _is_expert_name(name: str) -> bool:
+    from zest_tpu.models.moe import expert_of_tensor
+
+    return expert_of_tensor(name) is not None
+
+
+def _llama_layer_names(i: int, present: frozenset[str]) -> list[str]:
+    pre = f"model.layers.{i}."
+    names = [
+        pre + "input_layernorm.weight",
+        pre + "self_attn.q_proj.weight",
+        pre + "self_attn.k_proj.weight",
+        pre + "self_attn.v_proj.weight",
+        pre + "self_attn.o_proj.weight",
+        pre + "post_attention_layernorm.weight",
+        pre + "mlp.gate_proj.weight",
+        pre + "mlp.up_proj.weight",
+        pre + "mlp.down_proj.weight",
+    ]
+    # Optional bias leaves (Qwen2 q/k/v, attention_bias o): gate on
+    # what the checkpoint actually ships, or the gate would wait on a
+    # tensor that never lands.
+    for opt in ("self_attn.q_proj.bias", "self_attn.k_proj.bias",
+                "self_attn.v_proj.bias", "self_attn.o_proj.bias"):
+        if pre + opt in present:
+            names.append(pre + opt)
+    missing = [n for n in names if n not in present]
+    if missing:
+        raise ValueError(f"checkpoint missing {missing[:3]}")
+    return names
+
+
+def _moe_layer_names(i: int, present: frozenset[str]) -> list[str]:
+    pre = f"model.layers.{i}."
+    names = [
+        pre + "input_layernorm.weight",
+        pre + "self_attn.q_proj.weight",
+        pre + "self_attn.k_proj.weight",
+        pre + "self_attn.v_proj.weight",
+        pre + "self_attn.o_proj.weight",
+        pre + "post_attention_layernorm.weight",
+        pre + "block_sparse_moe.gate.weight",
+    ]
+    missing = [n for n in names if n not in present]
+    if missing:
+        raise ValueError(f"checkpoint missing {missing[:3]}")
+    return names
+
+
+# ── Gated flat decoders ──
+#
+# The family modules decode over STACKED trees (params_from_hf piles
+# per-layer tensors into [L, ...] leaves) — useless mid-landing, when
+# layer 7 exists but layer 8 is still on the wire. These decoders run
+# the identical math directly over the flat HF-orientation dict the
+# landing commits, one jitted step shared by every layer (identical
+# shapes → one compile), with a Python layer loop that waits on the
+# entry's committed-tensor frontier. HF stores Linear weights
+# [out, in]; the family modules materialize the transpose at load —
+# here the transpose folds into the jitted matmul (``x @ W.T``), which
+# XLA canonicalizes to the same dot, so logits (and greedy tokens)
+# match the family path bit-for-bit on the same checkpoint.
+
+
+@functools.lru_cache(maxsize=16)
+def _llama_layer_step(cfg):
+    from zest_tpu.models.llama import _rms_norm, _rope
+
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+
+    def step(lp, x, ck, cv, pos):
+        B, S, _ = x.shape
+        h = _rms_norm(x, lp["ln1"], cfg.rms_eps)
+
+        def proj(w, b):
+            y = h @ lp[w].T
+            if b in lp:
+                y = y + lp[b]
+            return y.reshape(B, S, -1, D)
+
+        q = _rope(proj("q_w", "q_b"), cfg, pos)
+        k = _rope(proj("k_w", "k_b"), cfg, pos)
+        v = proj("v_w", "v_b")
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        kk, vv = ck, cv
+        if KV != H:
+            kk = jnp.repeat(kk, H // KV, axis=2)
+            vv = jnp.repeat(vv, H // KV, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
+        valid = (jnp.arange(ck.shape[1])[None, :]
+                 <= pos + jnp.arange(S)[:, None])
+        scores = jnp.where(valid[None, None, :, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
+        out = out.reshape(B, S, H * D) @ lp["o_w"].T
+        if "o_b" in lp:
+            out = out + lp["o_b"]
+        x = x + out
+        h = _rms_norm(x, lp["ln2"], cfg.rms_eps)
+        mlp = (jax.nn.silu(h @ lp["gate_w"].T)
+               * (h @ lp["up_w"].T)) @ lp["down_w"].T
+        return x + mlp, ck, cv
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=16)
+def _llama_head(cfg):
+    from zest_tpu.models.llama import _rms_norm
+
+    def head(x_last, norm_g, head_w):
+        # HF lm_head and wte are both [vocab, E], so tied and untied
+        # checkpoints share this one projection (x @ W.T).
+        return _rms_norm(x_last, norm_g, cfg.rms_eps) @ head_w.T
+
+    return jax.jit(head)
+
+
+@functools.lru_cache(maxsize=16)
+def _moe_attn_step(cfg):
+    from zest_tpu.models.moe import _rms_norm, _rope
+
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+
+    def step(lp, x, ck, cv, pos):
+        B, S, _ = x.shape
+        h = _rms_norm(x, lp["ln1"], cfg.rms_eps)
+        q = (h @ lp["q_w"].T).reshape(B, S, H, D)
+        k = (h @ lp["k_w"].T).reshape(B, S, KV, D)
+        v = (h @ lp["v_w"].T).reshape(B, S, KV, D)
+        q = _rope(q, cfg.rope_theta, pos)
+        k = _rope(k, cfg.rope_theta, pos)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        kk, vv = ck, cv
+        if KV != H:
+            kk = jnp.repeat(kk, H // KV, axis=2)
+            vv = jnp.repeat(vv, H // KV, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / math.sqrt(D)
+        valid = (jnp.arange(ck.shape[1])[None, :]
+                 <= pos + jnp.arange(S)[:, None])
+        scores = jnp.where(valid[None, None, :, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att.astype(x.dtype), vv)
+        x = x + out.reshape(B, S, cfg.n_embd) @ lp["o_w"].T
+        h2 = _rms_norm(x, lp["ln2"], cfg.rms_eps)
+        return x, h2, ck, cv
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=16)
+def _moe_router(cfg):
+    def route(flat, gate_w):
+        # Mirrors moe._moe_block's routing exactly: f32 logits →
+        # softmax → top-k → renormalize by the selected mass.
+        logits = (flat @ gate_w.T).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)
+        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+        return gate_vals, gate_idx
+
+    return jax.jit(route)
+
+
+@jax.jit
+def _expert_ffn(h, w1, w3, w2):
+    """Per-expert SwiGLU over (N, E) tokens, HF [out, in] weights."""
+    return (jax.nn.silu(h @ w1.T) * (h @ w3.T)) @ w2.T
+
+
+@functools.lru_cache(maxsize=16)
+def _moe_head(cfg):
+    from zest_tpu.models.moe import _rms_norm
+
+    def head(x_last, norm_g, head_w):
+        return _rms_norm(x_last, norm_g, cfg.rms_eps) @ head_w.T
+
+    return jax.jit(head)
+
+
+# ── Expert pager ──
+
+
+class ExpertPager:
+    """Lazy (layer, expert) → HBM pager with an LRU inside the pool
+    budget.
+
+    Expert tensors stay on disk until a router actually selects the
+    expert; a page-in mmap-reads the three SwiGLU tensors, verifies
+    each against the BLAKE3 digest pinned at first read (the HBM-side
+    extension of the merkle boundary every pulled byte already
+    crossed — a disk flip between page-ins is caught, not served), and
+    device-puts them. The LRU evicts whole expert groups, never one
+    the current token still needs.
+    """
+
+    def __init__(self, reader, budget_bytes: int):
+        self._reader = reader          # name → np view (mmap-backed)
+        self.budget_bytes = int(budget_bytes)
+        self._lru: dict[tuple[int, int], dict] = {}  # insertion = LRU
+        self._sizes: dict[tuple[int, int], int] = {}
+        self._digests: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.peak_bytes = 0
+        self.total_expert_bytes = 0
+        self.page_ins = 0
+        self.hits = 0
+        self.evictions = 0
+        self.verified = 0
+
+    def _names(self, layer: int, expert: int) -> dict[str, str]:
+        pre = f"model.layers.{layer}.block_sparse_moe.experts.{expert}."
+        return {leaf: pre + leaf + ".weight"
+                for leaf in ("w1", "w3", "w2")}
+
+    def get(self, layer: int, expert: int) -> dict:
+        key = (layer, expert)
+        with self._lock:
+            grp = self._lru.get(key)
+            if grp is not None:
+                # Move to MRU position (dict preserves insertion order).
+                self._lru[key] = self._lru.pop(key)
+                self.hits += 1
+                _M_EXPERT_PAGES.inc(outcome="hit")
+                return grp
+        # Page-in outside the lock: mmap read + verify + device_put can
+        # overlap across layers; a duplicate race costs one redundant
+        # read, never a wrong result.
+        from zest_tpu.cas import hashing
+
+        grp, size = {}, 0
+        for leaf, name in self._names(layer, expert).items():
+            view = self._reader(name)
+            digest = hashing.blake3_hash(view.tobytes())
+            with self._lock:
+                pinned = self._digests.setdefault(name, digest)
+            if digest != pinned:
+                _M_EXPERT_PAGES.inc(outcome="corrupt")
+                raise RuntimeError(
+                    f"expert tensor {name} changed on disk since its "
+                    "digest was pinned — refusing to serve it")
+            self.verified += 1
+            grp[leaf] = jnp.asarray(view)
+            size += int(view.nbytes)
+        jax.block_until_ready(list(grp.values()))
+        with self._lock:
+            raced = self._lru.get(key)
+            if raced is not None:
+                for arr in grp.values():
+                    arr.delete()
+                return raced
+            # Make room BEFORE admitting, oldest first; the group being
+            # admitted is exempt (a single over-budget expert still
+            # serves — residency honesty over refusal).
+            while self._lru and self.bytes + size > self.budget_bytes:
+                old_key = next(iter(self._lru))
+                for arr in self._lru.pop(old_key).values():
+                    arr.delete()
+                self.bytes -= self._sizes.pop(old_key)
+                self.evictions += 1
+                _M_EXPERT_PAGES.inc(outcome="evict")
+            self._lru[key] = grp
+            self._sizes[key] = size
+            self.bytes += size
+            self.peak_bytes = max(self.peak_bytes, self.bytes)
+            self.page_ins += 1
+            _M_EXPERT_PAGES.inc(outcome="miss")
+        return grp
+
+    def clear(self) -> None:
+        with self._lock:
+            for grp in self._lru.values():
+                for arr in grp.values():
+                    arr.delete()
+            self._lru.clear()
+            self._sizes.clear()
+            self.bytes = 0
+
+    def stats(self) -> dict:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "bytes": self.bytes,
+            "peak_bytes": self.peak_bytes,
+            "total_expert_bytes": self.total_expert_bytes,
+            "residency": (self.peak_bytes / self.total_expert_bytes
+                          if self.total_expert_bytes else 0.0),
+            "page_ins": self.page_ins,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "verified": self.verified,
+        }
+
+
+# ── Pool entries ──
+
+
+class PoolEntry:
+    """One model tree in the pool. ``params``/``committed`` mutate in
+    place (the gated decoder closures capture the entry, so an evict →
+    re-land cycle is visible through the same objects)."""
+
+    def __init__(self, key: str, repo: str, model_type: str,
+                 cfg_json: dict):
+        self.key = key
+        self.repo = repo
+        self.model_type = model_type
+        self.cfg_json = cfg_json
+        self.state = "new"          # new|landing|resident|evicted|error
+        self.params: dict[str, jax.Array] = {}
+        self.committed: set[str] = set()
+        self.cond = threading.Condition()
+        self.bytes = 0              # committed dense-core bytes
+        self.reserved = 0           # expected full dense-core bytes
+        self.pins = 0
+        self.last_use = time.monotonic()
+        self.expected: frozenset[str] = frozenset()
+        self.first_layer: frozenset[str] = frozenset()
+        self.where: dict[str, Path] = {}   # tensor name → home shard
+        self.land_error: Exception | None = None
+        self.pager: ExpertPager | None = None
+        self.generate = None        # built once, survives evictions
+        self.lands = 0
+        self.gate_stall_s = 0.0
+        self.t_land_start: float | None = None
+        self.t_first_layer: float | None = None
+        self.t_land_end: float | None = None
+        self.t_decode_start: float | None = None
+
+    @property
+    def hbm_bytes(self) -> int:
+        pager = self.pager.bytes if self.pager is not None else 0
+        if self.state in ("landing", "resident"):
+            # A landing entry accounts its full reservation so
+            # admission pressure is computed against where the land is
+            # headed, not a mid-flight snapshot.
+            return max(self.bytes, self.reserved) + pager
+        return pager
+
+    def wait_for(self, names) -> float:
+        """Block until every name is committed; returns stalled
+        seconds. The committed set only grows during a land, so a
+        satisfied gate is lock-free on re-check."""
+        need = set(names)
+        if need <= self.committed:
+            return 0.0
+        t0 = time.perf_counter()
+        with self.cond:
+            while not need <= self.committed:
+                if self.state == "error":
+                    raise RuntimeError(
+                        f"landing {self.repo} failed"
+                    ) from self.land_error
+                if self.state == "evicted":
+                    raise RuntimeError(
+                        f"{self.repo} was evicted mid-decode — the "
+                        "pin that should prevent this is missing")
+                self.cond.wait(timeout=0.5)
+        stall = time.perf_counter() - t0
+        with self.cond:
+            self.gate_stall_s += stall
+        return stall
+
+    def summary_row(self) -> dict:
+        row = {
+            "repo": self.repo,
+            "model_type": self.model_type,
+            "state": self.state,
+            "bytes": self.hbm_bytes,
+            "pins": self.pins,
+            "lands": self.lands,
+            "gate_stall_s": round(self.gate_stall_s, 3),
+            "idle_s": round(time.monotonic() - self.last_use, 1),
+        }
+        if self.pager is not None:
+            row["experts"] = self.pager.stats()
+        return row
+
+
+# ── The pool ──
+
+
+class HbmPool:
+    """Process-wide managed HBM pool; construct via :func:`pool`."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.budget = int(getattr(cfg, "hbm_pool_bytes", 0))
+        self.group_bytes = DEFAULT_GROUP_BYTES
+        self.land_delay_s = 0.0     # test hook: sleep between flushes
+        self._lock = threading.RLock()
+        self._entries: dict[str, PoolEntry] = {}
+        self._rush = threading.Event()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.pinned_survivals = 0
+        self._register_hooks()
+
+    # ── wiring ──
+
+    def _register_hooks(self) -> None:
+        timeline.register_probe("hbm_pool.resident_bytes",
+                                lambda: float(self.used_bytes()))
+        timeline.register_probe("hbm_pool.pinned_bytes",
+                                lambda: float(self.pinned_bytes()))
+        timeline.register_probe("hbm_pool.models",
+                                lambda: float(len(self.resident())))
+        timeline.register_probe("hbm_pool.gate_stall_s",
+                                lambda: self._total_stall_s())
+        timeline.register_probe("hbm_pool.evictions",
+                                lambda: float(self.evictions))
+        timeline.register_probe("hbm_pool.landing",
+                                lambda: float(self._landing_count()))
+        remediate.register_target("pool_shed", self._shed_cmd)
+        remediate.register_target("pool_land", self._land_cmd)
+
+    def _unregister_hooks(self) -> None:
+        for name in ("hbm_pool.resident_bytes", "hbm_pool.pinned_bytes",
+                     "hbm_pool.models", "hbm_pool.gate_stall_s",
+                     "hbm_pool.evictions", "hbm_pool.landing"):
+            timeline.unregister_probe(name)
+        remediate.unregister_target("pool_shed")
+        remediate.unregister_target("pool_land")
+
+    def _shed_cmd(self, cmd: str) -> bool:
+        """Remediation target: pool thrash → drop the coldest unpinned
+        resident tree back to disk, freeing headroom."""
+        return self.shed_coldest(reason="shed") is not None
+
+    def _land_cmd(self, cmd: str) -> bool:
+        """Remediation target: a stalled land gate arms rush mode —
+        every layer boundary flushes immediately instead of batching
+        to ``group_bytes``, trading commit batching for gate latency.
+        Reversible: cleared when no land is in flight."""
+        if cmd == "rush":
+            self._rush.set()
+            return True
+        return False
+
+    # ── accounting ──
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(e.hbm_bytes for e in self._entries.values())
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return sum(e.hbm_bytes for e in self._entries.values()
+                       if e.pins > 0)
+
+    def _total_stall_s(self) -> float:
+        with self._lock:
+            return sum(e.gate_stall_s for e in self._entries.values())
+
+    def _landing_count(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries.values()
+                       if e.state == "landing")
+
+    def _update_gauges(self) -> None:
+        pinned = self.pinned_bytes()
+        _M_POOL_BYTES.set(float(pinned), state="pinned")
+        _M_POOL_BYTES.set(float(self.used_bytes() - pinned),
+                          state="resident")
+
+    # ── admission / eviction ──
+
+    @staticmethod
+    def supports(model_type: str | None) -> bool:
+        return (model_type or "") in POOL_FAMILIES
+
+    def acquire(self, snapshot_dir: str | Path,
+                repo: str | None = None) -> tuple[PoolEntry, bool]:
+        """Pin (and if needed admit/re-land) the model at
+        ``snapshot_dir``. Returns ``(entry, hot)`` — ``hot`` is True
+        iff the tree was fully resident before this call. The caller
+        MUST :meth:`release` the entry when its decode finishes."""
+        key = str(Path(snapshot_dir).resolve())
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                cfg_json = _snapshot_cfg(key)
+                model_type = cfg_json.get("model_type") or ""
+                if not self.supports(model_type):
+                    raise ValueError(
+                        f"model_type {model_type!r} is not pool-served "
+                        f"(families: {', '.join(POOL_FAMILIES)})")
+                entry = PoolEntry(key, repo or Path(key).name,
+                                  model_type, cfg_json)
+                self._entries[key] = entry
+            hot = entry.state == "resident"
+            if hot:
+                self.hits += 1
+            else:
+                self.misses += 1
+            entry.pins += 1
+            entry.last_use = time.monotonic()
+            if entry.state in ("new", "evicted", "error"):
+                try:
+                    self._start_land(entry)
+                except Exception:
+                    entry.pins -= 1
+                    raise
+            self._update_gauges()
+        return entry, hot
+
+    def release(self, entry: PoolEntry) -> None:
+        with self._lock:
+            entry.pins = max(0, entry.pins - 1)
+            entry.last_use = time.monotonic()
+            self._update_gauges()
+
+    def shed_coldest(self, reason: str = "shed") -> str | None:
+        """Evict the least-recently-used unpinned resident tree;
+        returns its repo name or None when nothing is evictable."""
+        with self._lock:
+            victims = [e for e in self._entries.values()
+                       if e.state == "resident" and e.pins == 0]
+            if not victims:
+                return None
+            victim = min(victims, key=lambda e: e.last_use)
+            self._evict_entry(victim, reason)
+            return victim.repo
+
+    def _evict_for(self, need: int) -> None:
+        """LRU-evict unpinned resident trees until ``need`` more bytes
+        fit under the watermark. Pinned (or landing) trees survive —
+        by design even if the pool stays over budget."""
+        if not self.budget:
+            return
+        while self.used_bytes() + need > self.budget:
+            victims = [e for e in self._entries.values()
+                       if e.state == "resident" and e.pins == 0]
+            if not victims:
+                if any(e.pins > 0 for e in self._entries.values()
+                       if e.state in ("resident", "landing")):
+                    self.pinned_survivals += 1
+                break
+            self._evict_entry(min(victims, key=lambda e: e.last_use),
+                              "pressure")
+
+    def _evict_entry(self, entry: PoolEntry, reason: str) -> None:
+        with entry.cond:
+            for arr in entry.params.values():
+                try:
+                    arr.delete()
+                except Exception:  # noqa: BLE001 - already deleted
+                    pass
+            entry.params.clear()
+            entry.committed.clear()
+            entry.bytes = 0
+            entry.state = "evicted"
+            entry.cond.notify_all()
+        if entry.pager is not None:
+            entry.pager.clear()
+        self.evictions += 1
+        _M_POOL_EVICTIONS.inc(reason=reason)
+        telemetry.record("pool_evict", repo=entry.repo, reason=reason)
+        self._update_gauges()
+
+    def evict(self, snapshot_dir: str | Path,
+              reason: str = "manual") -> bool:
+        key = str(Path(snapshot_dir).resolve())
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.state != "resident":
+                return False
+            if entry.pins > 0:
+                self.pinned_survivals += 1
+                return False
+            self._evict_entry(entry, reason)
+            return True
+
+    # ── landing ──
+
+    def _start_land(self, entry: PoolEntry) -> None:
+        """Begin a streaming re-land (caller holds the pool lock)."""
+        from zest_tpu.models.loader import snapshot_files
+        from zest_tpu.models.safetensors_io import SafetensorsFile
+
+        entry.state = "landing"
+        entry.land_error = None
+        entry.t_land_start = time.perf_counter()
+        entry.t_first_layer = None
+        entry.t_land_end = None
+        entry.lands += 1
+
+        files = snapshot_files(entry.key)
+        if not files:
+            entry.state = "error"
+            entry.land_error = FileNotFoundError(
+                f"no .safetensors under {entry.key}")
+            raise entry.land_error
+
+        # Header-only pass: name → home file, sizes, and the expert
+        # split — no tensor bytes move yet.
+        where: dict[str, Path] = {}
+        sizes: dict[str, int] = {}
+        for path in files:
+            with SafetensorsFile(path) as sf:
+                for name in sf.names():
+                    where[name] = path
+                    sizes[name] = sf.info(name).nbytes
+        paging = entry.model_type == "mixtral"
+        expected = frozenset(
+            n for n in where if not (paging and _is_expert_name(n)))
+        entry.expected = expected
+        entry.where = dict(where)
+        entry.reserved = sum(sizes[n] for n in expected)
+        from zest_tpu.models import registry
+        entry.first_layer = registry.first_layer_names(expected)
+
+        if paging and entry.pager is None:
+            def reader(name: str, _where=dict(where)):
+                with SafetensorsFile(_where[name]) as sf:
+                    return np.array(sf.tensor(name))  # copy: mmap dies
+
+            expert_total = sum(sizes[n] for n in where
+                               if n not in expected)
+            pager = ExpertPager(
+                reader, int(expert_total * EXPERT_BUDGET_FRACTION))
+            pager.total_expert_bytes = expert_total
+            entry.pager = pager
+
+        # Make room for where this land is headed before bytes fly —
+        # the entry is already in "landing" state, so its reservation
+        # is part of used_bytes() and pressure is computed against the
+        # land's destination, not its mid-flight snapshot.
+        self._evict_for(0)
+        telemetry.record("pool_land", repo=entry.repo,
+                         bytes=entry.reserved, land=entry.lands)
+        t = threading.Thread(target=self._land, args=(entry,),
+                             name=f"hbm-pool-land-{entry.repo}",
+                             daemon=True)
+        # The land thread holds its own pin so pressure from a
+        # concurrent admission can never evict a tree mid-land.
+        entry.pins += 1
+        t.start()
+
+    def _land(self, entry: PoolEntry) -> None:
+        from zest_tpu.models import registry
+        from zest_tpu.models.loader import commit_tensors
+        from zest_tpu.models.safetensors_io import SafetensorsFile
+
+        handles: dict[Path, SafetensorsFile] = {}
+
+        def flush(batch: dict) -> None:
+            if not batch:
+                return
+            committed = commit_tensors(batch, coalesce=True)
+            jax.block_until_ready(list(committed.values()))
+            size = sum(int(a.nbytes) for a in committed.values())
+            with entry.cond:
+                entry.params.update(committed)
+                entry.committed |= set(committed)
+                entry.bytes += size
+                if (entry.t_first_layer is None
+                        and entry.first_layer <= entry.committed):
+                    entry.t_first_layer = time.perf_counter()
+                entry.cond.notify_all()
+            if self.land_delay_s:
+                time.sleep(self.land_delay_s)
+
+        try:
+            with telemetry.span("hbm_pool.land", repo=entry.repo):
+                names = [n for n in registry.order_names(entry.expected)]
+                batch: dict[str, np.ndarray] = {}
+                batch_bytes = 0
+                last_prio: tuple | None = None
+                for name in names:
+                    prio = registry.layer_priority(name)
+                    at_boundary = (last_prio is not None
+                                   and prio != last_prio)
+                    if batch and at_boundary and (
+                            batch_bytes >= self.group_bytes
+                            or self._rush.is_set()):
+                        flush(batch)
+                        batch, batch_bytes = {}, 0
+                    last_prio = prio
+                    path = entry.where[name]
+                    if path not in handles:
+                        handles[path] = SafetensorsFile(path)
+                    view = handles[path].tensor(name)
+                    batch[name] = view
+                    batch_bytes += int(view.nbytes)
+                flush(batch)
+            with entry.cond:
+                entry.t_land_end = time.perf_counter()
+                entry.state = "resident"
+                entry.cond.notify_all()
+            telemetry.record(
+                "pool_land_done", repo=entry.repo,
+                wall_s=round(entry.t_land_end - entry.t_land_start, 3),
+                first_layer_s=round(
+                    (entry.t_first_layer or entry.t_land_end)
+                    - entry.t_land_start, 3))
+        except Exception as exc:  # noqa: BLE001 - recorded + re-raised at gates
+            # Abort cleanup: release every array this landing already
+            # committed (the satellite-1 contract, pool side) — a
+            # failed re-land must not strand partial-tree bytes.
+            with entry.cond:
+                for name in list(entry.params):
+                    try:
+                        entry.params.pop(name).delete()
+                    except Exception:  # noqa: BLE001
+                        pass
+                entry.committed.clear()
+                entry.bytes = 0
+                entry.land_error = exc
+                entry.state = "error"
+                entry.cond.notify_all()
+            telemetry.record("pool_land_error", repo=entry.repo,
+                             error=str(exc))
+        finally:
+            for sf in handles.values():
+                sf.close()
+            with self._lock:
+                entry.pins = max(0, entry.pins - 1)
+                if self._landing_count() == 0:
+                    self._rush.clear()
+                self._update_gauges()
+
+    # ── decoding ──
+
+    def generate_for(self, snapshot_dir: str | Path, repo: str,
+                     prompt_ids, steps: int, *, temperature: float = 0.0,
+                     top_k: int | None = None, top_p: float | None = None,
+                     seed: int = 0, stop_at_eos: bool = True,
+                     on_token=None):
+        """Serve one generate through the pool: pin → (re-)land →
+        gated decode starting at first-layer commit → release.
+        Returns ``(tokens, info)`` with TTFT/temperature facts."""
+        t_req = time.perf_counter()
+        entry, hot = self.acquire(snapshot_dir, repo)
+        try:
+            if entry.generate is None:
+                entry.generate = _build_gated_generate(entry)
+            first: dict[str, float] = {}
+
+            def tap(pos, tokens):
+                if "t" not in first:
+                    first["t"] = time.perf_counter()
+                if on_token is not None:
+                    on_token(pos, tokens)
+
+            out = entry.generate(
+                prompt_ids, steps, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                stop_at_eos=stop_at_eos, on_token=tap)
+            ttft = first.get("t", time.perf_counter()) - t_req
+            temp = "hot" if hot else "cold"
+            _M_TTFT.observe(ttft, temp=temp)
+            land_end = entry.t_land_end
+            info = {
+                "temp": temp,
+                "ttft_s": round(ttft, 4),
+                "gate_stall_s": round(entry.gate_stall_s, 4),
+                "decode_start_before_land_end": bool(
+                    entry.t_decode_start is not None
+                    and (land_end is None
+                         or entry.t_decode_start < land_end)),
+            }
+            if entry.pager is not None:
+                info["experts"] = entry.pager.stats()
+            self._check_ttft_slo(repo, ttft, temp)
+            timeline.post("hbm_pool.ttft_s", ttft)
+            return out, info
+        finally:
+            self.release(entry)
+
+    def _check_ttft_slo(self, repo: str, ttft: float, temp: str) -> None:
+        """Mirror of transfer.pull._check_slos for the serving tier:
+        ``ZEST_SLO_TTFT_S`` arms a budget on time-to-first-token."""
+        budget = getattr(self.cfg, "slo_ttft_s", None)
+        if not budget:
+            return
+        breached = ttft > budget
+        telemetry.session.SESSIONS.note_slo("ttft", breached)
+        if breached:
+            _M_SLO_BREACHES.inc(slo="ttft")
+            telemetry.record("slo_breach", slo="ttft", repo=repo,
+                             budget_s=budget, actual_s=round(ttft, 4),
+                             session=None, blamed_stage=temp)
+
+    # ── introspection ──
+
+    def digest(self, snapshot_dir: str | Path) -> str | None:
+        """``loader.params_digest`` over a resident tree (None when not
+        resident). O(model bytes) — verification, not the hot path."""
+        from zest_tpu.models.loader import params_digest
+
+        key = str(Path(snapshot_dir).resolve())
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.state != "resident":
+                return None
+            entry.pins += 1
+        try:
+            return params_digest(entry.params)
+        finally:
+            self.release(entry)
+
+    def resident(self) -> list[dict]:
+        with self._lock:
+            return [e.summary_row() for e in self._entries.values()
+                    if e.state in ("landing", "resident")]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "budget_bytes": self.budget,
+                "used_bytes": self.used_bytes(),
+                "pinned_bytes": self.pinned_bytes(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pinned_survivals": self.pinned_survivals,
+                "gate_stall_s": round(self._total_stall_s(), 3),
+                "rush": self._rush.is_set(),
+                "models": [e.summary_row()
+                           for e in self._entries.values()],
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            for entry in list(self._entries.values()):
+                if entry.state in ("resident", "landing"):
+                    self._evict_entry(entry, "reset")
+                # Leaving the pool for good: the disk tree loses its
+                # HBM-tree pin and becomes an ordinary disk-eviction
+                # candidate again (an *evicted* entry keeps it — the
+                # snapshot is what a re-land reads).
+                try:
+                    from zest_tpu.transfer import tenancy
+                    tenancy.release_tree(self.cfg, entry.repo)
+                except Exception:  # noqa: BLE001 - advisory cleanup
+                    pass
+            self._entries.clear()
+        self._unregister_hooks()
+
+
+# ── Gated generate builders ──
+
+
+def _build_gated_generate(entry: PoolEntry):
+    if entry.model_type == "mixtral":
+        return _build_moe_generate(entry)
+    return _build_llama_generate(entry)
+
+
+def _sample_row(logits_np, key_row, temperature, top_k, top_p):
+    """Batched host-side sampling matching the family key layout:
+    greedy is a plain argmax (identical tie-breaking to jnp.argmax);
+    temperature sampling reuses sampling.sample_token per row with the
+    same per-(position, row) key the cached loop would use."""
+    if temperature <= 0.0:
+        return np.argmax(logits_np, axis=-1).astype(np.int32)
+    from zest_tpu.models.sampling import sample_token
+
+    nxt = jax.vmap(
+        lambda l, k: sample_token(l, k, temperature, top_k, top_p)
+    )(jnp.asarray(logits_np), key_row)
+    return np.asarray(nxt, np.int32)
+
+
+def _build_llama_generate(entry: PoolEntry):
+    from zest_tpu.models.generate import _eos_token_ids, trim_at_eos
+    from zest_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.from_hf(entry.cfg_json)
+    eos_ids = _eos_token_ids(entry.cfg_json)
+    embed_name = "model.embed_tokens.weight"
+    norm_name = "model.norm.weight"
+    head_name = "lm_head.weight"
+
+    def layer_view(i: int) -> dict:
+        pre = f"model.layers.{i}."
+        P = entry.params
+        lp = {
+            "ln1": P[pre + "input_layernorm.weight"],
+            "q_w": P[pre + "self_attn.q_proj.weight"],
+            "k_w": P[pre + "self_attn.k_proj.weight"],
+            "v_w": P[pre + "self_attn.v_proj.weight"],
+            "o_w": P[pre + "self_attn.o_proj.weight"],
+            "ln2": P[pre + "post_attention_layernorm.weight"],
+            "gate_w": P[pre + "mlp.gate_proj.weight"],
+            "up_w": P[pre + "mlp.up_proj.weight"],
+            "down_w": P[pre + "mlp.down_proj.weight"],
+        }
+        for leaf, opt in (("q_b", "self_attn.q_proj.bias"),
+                          ("k_b", "self_attn.k_proj.bias"),
+                          ("v_b", "self_attn.v_proj.bias"),
+                          ("o_b", "self_attn.o_proj.bias")):
+            if pre + opt in P:
+                lp[leaf] = P[pre + opt]
+        return lp
+
+    step = _llama_layer_step(cfg)
+    head = _llama_head(cfg)
+
+    def generate(prompt_ids, steps, temperature=0.0, top_k=None,
+                 top_p=None, seed=0, stop_at_eos=True, on_token=None):
+        prompt = np.asarray(prompt_ids, np.int32)
+        batched = prompt.ndim == 2
+        if not batched:
+            prompt = prompt[None, :]
+        B, n0 = prompt.shape
+        total = n0 + steps
+        if total > cfg.n_ctx:
+            raise ValueError(
+                f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+                f"n_ctx {cfg.n_ctx}")
+        eos = eos_ids if stop_at_eos else None
+
+        # First-layer gate: decode officially starts here — embeddings
+        # + layer 0 resident, the rest still possibly on the wire.
+        entry.wait_for(entry.first_layer)
+        if entry.t_decode_start is None:
+            entry.t_decode_start = time.perf_counter()
+        present = set(entry.expected)
+        layer_names = [
+            _llama_layer_names(i, frozenset(present))
+            for i in range(cfg.n_layer)]
+        tied = head_name not in present
+        tail = {norm_name} | (set() if tied else {head_name})
+
+        wte = entry.params[embed_name]
+        dtype = wte.dtype
+        KV, D = cfg.n_kv_head, cfg.head_dim
+        ck = [jnp.zeros((B, total, KV, D), dtype)
+              for _ in range(cfg.n_layer)]
+        cv = [jnp.zeros((B, total, KV, D), dtype)
+              for _ in range(cfg.n_layer)]
+        buf = np.zeros((B, total), np.int32)
+        buf[:, :n0] = prompt
+        keys = None
+        if temperature > 0.0 and steps > 0:
+            keys = jax.random.split(
+                jax.random.key(seed), (total - 1) * B
+            ).reshape(total - 1, B)
+        done = np.zeros(B, bool)
+
+        def forward(tokens_np, pos):
+            x = entry.params[embed_name][jnp.asarray(tokens_np)]
+            for i in range(cfg.n_layer):
+                entry.wait_for(layer_names[i])
+                x, ck[i], cv[i] = step(layer_view(i), x, ck[i], cv[i],
+                                       pos)
+            entry.wait_for(tail)
+            hw = (entry.params[embed_name] if tied
+                  else entry.params[head_name])
+            logits = head(x[:, -1:, :], entry.params[norm_name], hw)
+            return np.asarray(logits[:, -1, :], np.float32)
+
+        for j in range(n0, total):
+            # Position j's token is sampled from logits of the window
+            # ending at j-1 — prefill covers positions 0..n0-1 in one
+            # dispatch, then one single-token step per position.
+            if j == n0:
+                logits = forward(buf[:, :n0], 0)
+            else:
+                logits = forward(buf[:, j - 1:j], j - 1)
+            nxt = _sample_row(logits,
+                              keys[j - 1] if keys is not None else None,
+                              temperature, top_k, top_p)
+            if eos is not None:
+                nxt = np.where(done, np.int32(eos[0]), nxt)
+                done = done | np.isin(nxt, eos)
+            buf[:, j] = nxt
+            if on_token is not None:
+                on_token(j, buf[:, j].copy())
+        out = buf
+        if eos is not None and steps > 0:
+            out = trim_at_eos(out, n0, eos)
+        return out if batched else out[0]
+
+    generate.eos_ids = eos_ids
+    return generate
+
+
+def _build_moe_generate(entry: PoolEntry):
+    from zest_tpu.models.generate import _eos_token_ids, trim_at_eos
+    from zest_tpu.models.moe import MoEConfig
+
+    cfg = MoEConfig.from_hf(entry.cfg_json)
+    eos_ids = _eos_token_ids(entry.cfg_json)
+    embed_name = "model.embed_tokens.weight"
+    norm_name = "model.norm.weight"
+    head_name = "lm_head.weight"
+
+    def layer_view(i: int) -> dict:
+        pre = f"model.layers.{i}."
+        P = entry.params
+        return {
+            "ln1": P[pre + "input_layernorm.weight"],
+            "q_w": P[pre + "self_attn.q_proj.weight"],
+            "k_w": P[pre + "self_attn.k_proj.weight"],
+            "v_w": P[pre + "self_attn.v_proj.weight"],
+            "o_w": P[pre + "self_attn.o_proj.weight"],
+            "ln2": P[pre + "post_attention_layernorm.weight"],
+        }
+
+    attn = _moe_attn_step(cfg)
+    route = _moe_router(cfg)
+    head = _moe_head(cfg)
+
+    def moe_ffn(h2, layer: int):
+        """Routed expert FFN over (B, S, E) with lazy paging: host
+        top-k routing (the exact moe._moe_block math), then only the
+        selected experts page in. Accumulation walks experts in
+        ascending index — the same order the dense dispatch einsum
+        reduces over — for bit-parity with the family path."""
+        B, S, E = h2.shape
+        flat = h2.reshape(B * S, E)
+        gate_w = entry.params[
+            f"model.layers.{layer}.block_sparse_moe.gate.weight"]
+        gate_vals, gate_idx = route(flat, gate_w)
+        gv = np.asarray(gate_vals)            # (N, k) f32
+        gi = np.asarray(gate_idx)             # (N, k)
+        out = jnp.zeros_like(flat)
+        for e in sorted(set(gi.flatten().tolist())):
+            weights = (gv * (gi == e)).sum(axis=-1)      # (N,)
+            grp = entry.pager.get(layer, e)
+            ffn = _expert_ffn(flat, grp["w1"], grp["w3"], grp["w2"])
+            out = out + jnp.asarray(weights).astype(flat.dtype)[:, None] * ffn
+        return out.reshape(B, S, E)
+
+    def generate(prompt_ids, steps, temperature=0.0, top_k=None,
+                 top_p=None, seed=0, stop_at_eos=True, on_token=None):
+        prompt = np.asarray(prompt_ids, np.int32)
+        batched = prompt.ndim == 2
+        if not batched:
+            prompt = prompt[None, :]
+        B, n0 = prompt.shape
+        total = n0 + steps
+        if total > cfg.n_ctx:
+            raise ValueError(
+                f"prompt ({n0}) + steps ({steps}) = {total} exceeds "
+                f"n_ctx {cfg.n_ctx}")
+        eos = eos_ids if stop_at_eos else None
+
+        entry.wait_for(entry.first_layer)
+        if entry.t_decode_start is None:
+            entry.t_decode_start = time.perf_counter()
+        present = frozenset(entry.expected)
+        layer_names = [_moe_layer_names(i, present)
+                       for i in range(cfg.n_layer)]
+        tail = {norm_name, head_name}
+
+        dtype = entry.params[embed_name].dtype
+        KV, D = cfg.n_kv_head, cfg.head_dim
+        ck = [jnp.zeros((B, total, KV, D), dtype)
+              for _ in range(cfg.n_layer)]
+        cv = [jnp.zeros((B, total, KV, D), dtype)
+              for _ in range(cfg.n_layer)]
+        buf = np.zeros((B, total), np.int32)
+        buf[:, :n0] = prompt
+        keys = None
+        if temperature > 0.0 and steps > 0:
+            keys = jax.random.split(
+                jax.random.key(seed), (total - 1) * B
+            ).reshape(total - 1, B)
+        done = np.zeros(B, bool)
+
+        def forward(tokens_np, pos):
+            x = entry.params[embed_name][jnp.asarray(tokens_np)]
+            for i in range(cfg.n_layer):
+                entry.wait_for(layer_names[i])
+                x, h2, ck[i], cv[i] = attn(layer_view(i), x, ck[i],
+                                           cv[i], pos)
+                x = x + moe_ffn(h2, i)
+            entry.wait_for(tail)
+            logits = head(x[:, -1:, :], entry.params[norm_name],
+                          entry.params[head_name])
+            return np.asarray(logits[:, -1, :], np.float32)
+
+        for j in range(n0, total):
+            if j == n0:
+                logits = forward(buf[:, :n0], 0)
+            else:
+                logits = forward(buf[:, j - 1:j], j - 1)
+            nxt = _sample_row(logits,
+                              keys[j - 1] if keys is not None else None,
+                              temperature, top_k, top_p)
+            if eos is not None:
+                nxt = np.where(done, np.int32(eos[0]), nxt)
+                done = done | np.isin(nxt, eos)
+            buf[:, j] = nxt
+            if on_token is not None:
+                on_token(j, buf[:, j].copy())
+        out = buf
+        if eos is not None and steps > 0:
+            out = trim_at_eos(out, n0, eos)
+        return out if batched else out[0]
+
+    generate.eos_ids = eos_ids
+    return generate
+
+
+# ── Module-level singleton ──
+
+_POOL: HbmPool | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def pool(cfg) -> HbmPool | None:
+    """The process pool, or None when ``ZEST_HBM_POOL=0`` — the
+    knob-off contract: with no pool, serving takes exactly the classic
+    single-model path (schema included)."""
+    global _POOL
+    if not getattr(cfg, "hbm_pool_enabled", False):
+        return None
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = HbmPool(cfg)
+        return _POOL
+
+
+def reset() -> None:
+    """Tear down the singleton (tests): evict everything, unregister
+    timeline probes and remediation targets."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is not None:
+            _POOL.close()
+        _POOL = None

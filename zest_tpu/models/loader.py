@@ -991,8 +991,16 @@ def _stage_streaming(
         nonlocal batch, batch_slots, batch_bytes
         if not batch:
             return
-        committed = commit_tensors(batch, mesh, rules, dtype=dtype,
-                                   donate=True, coalesce=False)
+        # Coalesce only on the re-land/hot-swap path (ROADMAP item 5):
+        # a delta or pool re-land of one checkpoint repeats the same
+        # small-tensor group layouts pull after pull, so the jitted
+        # splitter's per-layout cache amortizes — whereas a cold
+        # stream's group composition varies with wire timing and would
+        # pay an XLA compile per flush (the reason coalescing was
+        # bypassed here originally).
+        committed = commit_tensors(
+            batch, mesh, rules, dtype=dtype, donate=True,
+            coalesce=bool(preloaded or swap_from is not None))
         params.update(committed)
         pending.append((list(committed.values()), batch_slots,
                         list(batch)))
@@ -1091,6 +1099,20 @@ def _stage_streaming(
                             break
                         if isinstance(item, tuple):
                             item[2].release()
+                    # Release arrays this landing already committed:
+                    # the raised exception's frames keep ``params``
+                    # reachable until the pull exits, which would
+                    # strand the partial tree in HBM — fatal for a
+                    # pool re-land that aborts and retries under a
+                    # byte watermark. The preloaded reuse set is the
+                    # caller's base tree and must survive the abort.
+                    for n in list(params):
+                        if n in preloaded:
+                            continue
+                        try:
+                            params.pop(n).delete()
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
         for arr in params.values():
             arr.block_until_ready()
         dt = time.monotonic() - t0

@@ -217,6 +217,10 @@ class RemediationEngine:
         # the last nudge (t, dir) the damping rule checks.
         self._knobs: dict[str, dict] = {}
         self._ring_last: float | None = None
+        # HBM-pool tick state (ISSUE 18): last-seen gate-stall seconds
+        # and eviction count — growth between ticks is the evidence.
+        self._pool_stall_last: float | None = None
+        self._pool_evict_last: float | None = None
         # Decisions whose "after" snapshot settles on the next tick
         # (the /v1/remediations view; the flight event carries the
         # immediate post-action snapshot).
@@ -431,6 +435,7 @@ class RemediationEngine:
         self._scan_seeders(now)
         self._maybe_recover_shed()
         self._tune_ring(store, now)
+        self._pool_rules(store, now)
 
     def _settle_after(self) -> None:
         """Fill each recent decision's settled after-snapshot one tick
@@ -600,6 +605,64 @@ class RemediationEngine:
                                             now),
             detail={"knob": RING_KNOB, "from": cur, "to": new,
                     "dir": "up" if direction > 0 else "down"})
+
+    def _pool_rules(self, store, now: float) -> None:
+        """(f) HBM serving-pool rules (ISSUE 18), both tick-driven:
+
+        * **cold-land stall → hedge**: ``hbm_pool.gate_stall_s``
+          growing between ticks while a land is in flight means a
+          decode is blocked on its layer gates — arm the pool's rush
+          mode (``pool_land`` target), which flushes every layer
+          boundary immediately instead of batching commits. (A cold
+          re-land that needs a *network* pull rides the existing
+          per-session hedge machinery; this rule covers the local
+          landing tail the pool owns.)
+        * **pool thrash → shed**: evictions growing between ticks
+          means admissions are fighting over the watermark — shed the
+          coldest unpinned tree (``pool_shed`` target) so the hot set
+          stops churning.
+        """
+
+        def _last(name: str) -> float | None:
+            with store._lock:
+                s = store._series.get(name)
+                return (s.ring[-1][2]
+                        if s is not None and s.ring else None)
+
+        stall = _last("hbm_pool.gate_stall_s")
+        evictions = _last("hbm_pool.evictions")
+        landing = _last("hbm_pool.landing")
+        with self._lock:
+            stall_last, self._pool_stall_last = \
+                self._pool_stall_last, stall
+            evict_last, self._pool_evict_last = \
+                self._pool_evict_last, evictions
+            land_fn = self._targets.get("pool_land")
+            shed_fn = self._targets.get("pool_shed")
+        stall_grew = (stall is not None and stall_last is not None
+                      and stall > stall_last + 1e-9)
+        if stall_grew and landing and land_fn is not None:
+            self._decide(
+                "hedge",
+                reason=(f"pool gate stall grew to {stall:.2f}s with a "
+                        "land in flight — rushing layer flushes"),
+                series=("hbm_pool.gate_stall_s", "hbm_pool.landing",
+                        "hbm_pool.resident_bytes"),
+                execute=lambda: land_fn("rush"),
+                detail={"cmd": "rush", "gate_stall_s": round(stall, 3)})
+        evict_grew = (evictions is not None and evict_last is not None
+                      and evictions > evict_last)
+        if evict_grew and shed_fn is not None:
+            self._decide(
+                "shed",
+                reason=(f"pool thrash: evictions grew to "
+                        f"{int(evictions)} — shedding the coldest "
+                        "model"),
+                series=("hbm_pool.evictions", "hbm_pool.resident_bytes",
+                        "hbm_pool.pinned_bytes"),
+                execute=lambda: shed_fn("shed_coldest"),
+                detail={"cmd": "shed_coldest",
+                        "evictions": int(evictions)})
 
     def _apply_knob(self, knob: str, new: int, direction: int,
                     now: float) -> dict:

@@ -414,9 +414,13 @@ class PinBook:
                     held.add(h)
                     self._counts[h] = self._counts.get(h, 0) + 1
 
-    def release(self, owner: str) -> None:
+    def release(self, owner: str) -> bool:
+        """Returns whether the owner actually held pins — the pool's
+        release_tree reports a no-op release honestly."""
         with self._lock:
+            held = owner in self._owners
             self._release_locked(owner)
+            return held
 
     def _release_locked(self, owner: str) -> None:
         for h in self._owners.pop(owner, ()):
@@ -1028,6 +1032,19 @@ class admit:
             self._st.pins.release(self._owner)
         wall = (time.monotonic() - self._t0) if self._t0 else None
         self._st.controller.release(wall_s=wall)
+
+
+def release_tree(cfg, repo: str) -> bool:
+    """Drop the live-HBM-tree pin for ``repo`` (the inverse of
+    ``admit.pin_tree``). The HBM pool calls this when a model's tree
+    leaves the pool for good — its xorbs become ordinary eviction
+    candidates again instead of staying pinned for a swap that will
+    never come. No-op (False) when tenancy is off or nothing was
+    pinned."""
+    if not enabled(cfg):
+        return False
+    st = state(cfg)
+    return bool(st.pins.release(f"tree:{repo}"))
 
 
 def reset() -> None:
