@@ -152,6 +152,22 @@ CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
         ("tensors_reused", "ge", 1,
          "the per-tensor short-circuit reused nothing"),
     ],
+    "PUSH_r19.json": [
+        ("gates/all_ok", "truthy", None,
+         "recorded push/fan-out gate block flipped false"),
+        ("push/dedup_ratio", "ge", 0.90,
+         "a 1%-changed push re-uploaded more than 10% of checkpoint "
+         "bytes — CDC dedup against the base regressed"),
+        ("gates/byte_identical", "truthy", None,
+         "the subscriber's pulled revision stopped being "
+         "byte-identical to the pushed checkpoint"),
+        ("gates/watch_delivered", "truthy", None,
+         "the /v1/push notification no longer reaches /v1/watch "
+         "subscribers"),
+        ("fanout/propagation_s", "le", 60.0,
+         "trainer-to-resident propagation exceeded the loopback "
+         "bound"),
+    ],
 }
 
 

@@ -1,0 +1,201 @@
+"""CI smoke for the push write path + continuous fan-out (ISSUE 19).
+
+A loopback publisher/subscriber pair exercising the full trainer-to-
+fleet cycle with zero external network:
+
+- the publisher node pushes checkpoint A (``zest push`` internals:
+  gearhash CDC against an empty base, xorbs into its local cache,
+  manifest + refs/main), then serves it through its own daemon's
+  hub-shaped endpoint surface;
+- the subscriber node — an unmodified ``pull_model`` pointed at the
+  publisher daemon as its endpoint — cold-pulls A and lands it on the
+  (virtual) device mesh;
+- the subscriber then subscribes to ``POST /v1/watch``; the publisher
+  pushes checkpoint B (1 % of tensors mutated). The push's CDC dedup
+  against cached revision A must come out ≥ 0.90, the ``/v1/push``
+  notification must reach the watcher, and the watcher's automatic
+  delta pull + in-place hot-swap must complete — trainer ``pushed_at``
+  → swap-complete is the propagation latency;
+- byte identity is asserted file-for-file: the subscriber's rev-B
+  snapshot must equal the pushed checkpoint exactly.
+
+Writes ``PUSH_r19.json`` at the repo root (the committed record
+``scripts/bench_trend.py`` gates against). Exit 0 on success.
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+MUTATE_FRACTION = 0.01
+DEDUP_GATE = 0.90
+PROPAGATION_BOUND_S = 60.0   # loopback; generous for shared CI hosts
+REPO = "smoke/push"
+
+
+def fail(msg: str) -> int:
+    print(f"PUSH SMOKE FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+def write_checkpoint(root: pathlib.Path, name: str,
+                     files: dict) -> pathlib.Path:
+    d = root / name
+    d.mkdir()
+    for fname, data in files.items():
+        target = d / fname
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_bytes(data)
+    return d
+
+
+def main() -> int:
+    from zest_tpu.api.http_api import HttpApi
+    from zest_tpu.bench_scale import llama_checkpoint_files
+    from zest_tpu.config import Config
+    from zest_tpu.transfer import push as push_mod
+    from zest_tpu.transfer.pull import pull_model
+
+    quiet = {"log": lambda *a, **k: None}
+    files_a = llama_checkpoint_files(0.032, shard_bytes=8 * 1024 * 1024,
+                                     scale=8)
+    files_b = llama_checkpoint_files(0.032, shard_bytes=8 * 1024 * 1024,
+                                     scale=8,
+                                     mutate_fraction=MUTATE_FRACTION)
+    total_bytes = sum(len(b) for b in files_b.values())
+
+    with tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+        pub_cfg = Config(hf_home=rootp / "hf-pub",
+                         cache_dir=rootp / "zest-pub",
+                         hf_token="hf_test", http_port=0)
+        api = HttpApi(pub_cfg)
+        port = api.start()
+        url = f"http://127.0.0.1:{port}"
+        pub_cfg.http_port_file().parent.mkdir(parents=True, exist_ok=True)
+        pub_cfg.http_port_file().write_text(str(port))
+
+        # ── Publish revision A, cold (no base evidence). ──
+        ckpt_a = write_checkpoint(rootp, "ckpt_a", files_a)
+        res_a = push_mod.push_checkpoint(pub_cfg, REPO, ckpt_a, **quiet)
+        print(f"pushed A {res_a.revision[:12]}: {res_a.new_xorbs} xorbs, "
+              f"{res_a.new_xorb_bytes:,} bytes")
+
+        # ── Subscriber: unmodified pull against the publisher daemon. ──
+        sub_cfg = Config(hf_home=rootp / "hf-sub",
+                         cache_dir=rootp / "zest-sub",
+                         hf_token="hf_test", endpoint=url)
+        res1 = pull_model(sub_cfg, REPO, revision=res_a.revision,
+                          device="tpu", no_p2p=True, **quiet)
+        for fname, data in files_a.items():
+            if (res1.snapshot_dir / fname).read_bytes() != data:
+                return fail(f"cold pull of A corrupted {fname}")
+        print(f"subscriber cold-pulled A "
+              f"({res1.stats.get('total_bytes', total_bytes):,} bytes)")
+
+        # ── Watch + push B; the watcher auto-delta-pulls and swaps. ──
+        records: list = []
+        errors: list = []
+
+        def watcher():
+            try:
+                records.extend(push_mod.watch_and_swap(
+                    sub_cfg, REPO, publisher_url=url, device="tpu",
+                    base_params=res1.params,
+                    base_revision=res_a.revision, max_events=1,
+                    timeout_s=120.0, no_p2p=True, **quiet))
+            except Exception as exc:  # noqa: BLE001 - reported below
+                errors.append(exc)
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while api.watch_hub.watchers() == 0:
+            if time.monotonic() > deadline:
+                return fail("watcher never subscribed")
+            time.sleep(0.05)
+
+        ckpt_b = write_checkpoint(rootp, "ckpt_b", files_b)
+        res_b = push_mod.push_checkpoint(pub_cfg, REPO, ckpt_b, **quiet)
+        print(f"pushed B {res_b.revision[:12]}: dedup "
+              f"{res_b.dedup_ratio:.4f}, {res_b.new_xorb_bytes:,} new "
+              f"bytes, notified={res_b.notified}")
+        t.join(timeout=300)
+        if t.is_alive():
+            return fail("watcher did not complete its swap in time")
+        if errors:
+            return fail(f"watcher raised: {errors[0]!r}")
+
+        # ── Gates. ──
+        if res_b.parent != res_a.revision:
+            return fail("push B did not record A as parent")
+        if res_b.reused_bytes <= 0:
+            return fail("push B dedup was vacuous (zero reused bytes)")
+        if res_b.dedup_ratio < DEDUP_GATE:
+            return fail(f"dedup ratio {res_b.dedup_ratio:.4f} < "
+                        f"{DEDUP_GATE} at {MUTATE_FRACTION:.0%}-changed")
+        if not res_b.notified or res_b.notified.get("delivered") != 1:
+            return fail(f"fan-out notification lost: {res_b.notified}")
+        if len(records) != 1 or records[0].get("revision") != res_b.revision:
+            return fail(f"watcher swap records wrong: {records}")
+        rec = records[0]
+        propagation = rec.get("propagation_s")
+        if propagation is None or propagation > PROPAGATION_BOUND_S:
+            return fail(f"propagation {propagation} outside bound "
+                        f"{PROPAGATION_BOUND_S}s")
+        snap_b = sub_cfg.model_snapshot_dir(REPO, res_b.revision)
+        byte_identical = all(
+            (snap_b / fname).read_bytes() == data
+            for fname, data in files_b.items())
+        if not byte_identical:
+            return fail("subscriber rev-B snapshot not byte-identical "
+                        "to the pushed checkpoint")
+
+        api.close()
+        doc = {
+            "note": "zest push write path + continuous fan-out "
+                    "(ISSUE 19): loopback publisher/subscriber pair; "
+                    "regenerate with scripts/push_smoke.py",
+            "checkpoint_bytes": total_bytes,
+            "mutate_fraction": MUTATE_FRACTION,
+            "push": {
+                "revision": res_b.revision,
+                "parent": res_b.parent,
+                "files": res_b.files,
+                "new_xorbs": res_b.new_xorbs,
+                "new_xorb_bytes": res_b.new_xorb_bytes,
+                "reused_bytes": res_b.reused_bytes,
+                "dedup_ratio": round(res_b.dedup_ratio, 4),
+                "elapsed_s": round(res_b.elapsed_s, 3),
+            },
+            "fanout": {
+                "watchers": 1,
+                "propagation_s": round(propagation, 3),
+                "time_to_swap_s": rec.get("time_to_swap_s"),
+            },
+            "gates": {
+                "dedup_ratio_ge_0.90": res_b.dedup_ratio >= DEDUP_GATE,
+                "byte_identical": byte_identical,
+                "watch_delivered": True,
+                "propagation_under_bound":
+                    propagation <= PROPAGATION_BOUND_S,
+                "all_ok": True,
+            },
+        }
+        out = pathlib.Path(__file__).resolve().parent.parent \
+            / "PUSH_r19.json"
+        out.write_text(json.dumps(doc, indent=2) + "\n")
+        print(f"push smoke OK: dedup {res_b.dedup_ratio:.4f}, "
+              f"propagation {propagation:.2f}s -> {out.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,8 +20,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from zest_tpu.cas import hashing, reconstruction as recon
-from zest_tpu.cas.xorb import XorbBuilder
-from zest_tpu.cas import chunking
+from zest_tpu.cas.publish import XET_SUFFIXES as _XET_SUFFIXES, Publisher
 
 
 @dataclass
@@ -41,8 +40,10 @@ class _FileFixture:
 
 
 # File extensions stored in Xet CAS (everything else is a "regular" file
-# served via /resolve/, mirroring how HF stores configs vs weights).
-_XET_SUFFIXES = (".safetensors", ".bin", ".pt", ".h5", ".msgpack")
+# served via /resolve/) — the production list, re-exported for older
+# call sites; the CDC-dedup encode itself now lives in
+# zest_tpu.cas.publish (ISSUE 19 promoted it out of this fixture, the
+# same way _TokenBucket moved to zest_tpu.shaping).
 
 
 class FixtureRepo:
@@ -73,10 +74,10 @@ class FixtureRepo:
         self.files: dict[str, _FileFixture] = {}
         self.xorbs: dict[str, _XorbFixture] = {}
         self.reconstructions: dict[str, recon.Reconstruction] = {}
-        # chunk hash -> (xorb_hex, chunk_index, length): the dedup
-        # index add_revision consults (first occurrence wins — any
-        # occurrence serves identical bytes, by content addressing).
-        self._chunk_index: dict[bytes, tuple[str, int, int]] = {}
+        # The production CDC-dedup encoder (zest_tpu.cas.publish): owns
+        # the chunk index add_revision dedups against — tests and `zest
+        # push` share one implementation.
+        self._publisher = Publisher(chunks_per_xorb=chunks_per_xorb)
         for path, data in files.items():
             if path.endswith(_XET_SUFFIXES):
                 # dedup=False: the base revision packs every chunk into
@@ -128,87 +129,16 @@ class FixtureRepo:
         self.files = fileset
         return commit_sha
 
-    def _register_xorb(self, builder: XorbBuilder) -> str:
-        xh_hex = hashing.hash_to_hex(builder.xorb_hash())
-        if xh_hex not in self.xorbs:
-            self.xorbs[xh_hex] = _XorbFixture(
-                xh_hex, builder.serialize(), builder.frame_offsets(),
-                builder.serialize_full())
-            for idx, (ch, clen) in enumerate(builder.chunk_hashes()):
-                self._chunk_index.setdefault(ch, (xh_hex, idx, clen))
-        return xh_hex
-
     def _add_xet_file(self, path: str, data: bytes,
                       chunks_per_xorb: int, fileset: dict,
                       dedup: bool = False) -> None:
-        pieces = [(hashing.chunk_hash(piece), piece)
-                  for _, piece in chunking.chunk_stream(data)]
-        limit = chunks_per_xorb or len(pieces) or 1
-        terms: list[recon.Term] = []
-        all_chunk_hashes: list[tuple[bytes, int]] = []
-        fetch_info: dict[str, list[recon.FetchInfo]] = {}
-
-        def add_term(xh_hex: str, start: int, end: int,
-                     nbytes: int) -> None:
-            xh = hashing.hex_to_hash(xh_hex)
-            offs = self.xorbs[xh_hex].frame_offsets
-            terms.append(recon.Term(
-                xorb_hash=xh,
-                range=recon.ChunkRange(start, end),
-                unpacked_length=nbytes,
-            ))
-            fi = recon.FetchInfo(
-                url=f"/xorbs/{xh_hex}",
-                url_range_start=offs[start],
-                url_range_end=offs[end],
-                range=recon.ChunkRange(start, end),
-            )
-            entries = fetch_info.setdefault(xh_hex, [])
-            if fi not in entries:
-                entries.append(fi)
-
-        pending: list[tuple[bytes, bytes]] = []  # new chunks to pack
-
-        def flush_pending() -> None:
-            for i in range(0, len(pending), limit):
-                group = pending[i:i + limit]
-                builder = XorbBuilder()
-                for _h, piece in group:
-                    builder.add_chunk(piece)
-                xh_hex = self._register_xorb(builder)
-                add_term(xh_hex, 0, len(group),
-                         sum(len(p) for _h, p in group))
-            pending.clear()
-
-        i = 0
-        while i < len(pieces):
-            hit = self._chunk_index.get(pieces[i][0]) if dedup else None
-            if hit is None:
-                pending.append(pieces[i])
-                i += 1
-                continue
-            flush_pending()
-            # Extend a run of chunks that sit CONTIGUOUSLY in one
-            # existing xorb — the run becomes one referencing term.
-            xh_hex, idx, _len = hit
-            j, expect, run_bytes = i, idx, 0
-            while j < len(pieces):
-                nxt = self._chunk_index.get(pieces[j][0])
-                if nxt is None or nxt[0] != xh_hex or nxt[1] != expect:
-                    break
-                run_bytes += len(pieces[j][1])
-                expect += 1
-                j += 1
-            add_term(xh_hex, idx, expect, run_bytes)
-            i = j
-        flush_pending()
-        all_chunk_hashes = [(h, len(p)) for h, p in pieces]
-        file_hash = hashing.file_hash(all_chunk_hashes)
-        file_hex = hashing.hash_to_hex(file_hash)
-        fileset[path] = _FileFixture(path, data, file_hex, terms)
-        self.reconstructions[file_hex] = recon.Reconstruction(
-            file_hash=file_hash, terms=terms, fetch_info=fetch_info
-        )
+        pf = self._publisher.publish_file(path, data, dedup=dedup,
+                                          chunks_per_xorb=chunks_per_xorb)
+        for px in self._publisher.drain_new_xorbs():
+            self.xorbs[px.hash_hex] = _XorbFixture(
+                px.hash_hex, px.blob, px.frame_offsets, px.full)
+        fileset[path] = _FileFixture(path, data, pf.xet_hash, pf.terms)
+        self.reconstructions[pf.xet_hash] = pf.reconstruction
 
 
 # The hub's CDN shaper, promoted to production code (zest_tpu.shaping)

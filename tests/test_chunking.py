@@ -69,6 +69,67 @@ def test_native_matches_python():
         ) == _cut_points_py(memoryview(data))
 
 
+def _both_paths(data: bytes) -> list[list[int]]:
+    """cut_points results from every available implementation path."""
+    results = [_cut_points_py(memoryview(data)), cut_points(data)]
+    from zest_tpu.native import lib
+
+    if lib.available() and len(data) > 0:
+        results.append(
+            lib.gear_cut_points(data, MIN_CHUNK, MAX_CHUNK, chunking.MASK))
+    return results
+
+
+def test_edge_empty_input_both_paths():
+    # Contract: the empty stream has no chunks — [] on EVERY path, and
+    # chunk_stream yields nothing (no zero-length Chunk).
+    for cuts in _both_paths(b""):
+        assert cuts == []
+    assert list(chunking.chunk_stream(b"")) == []
+
+
+def test_edge_shorter_than_min_chunk_both_paths():
+    # Below MIN_CHUNK no mask cut can fire (the min-size skip), so the
+    # whole input is exactly one final chunk — on every path.
+    rng = random.Random(11)
+    for n in (1, 2, MIN_CHUNK - 1, MIN_CHUNK):
+        data = rng.randbytes(n)
+        py, dispatch, *native = _both_paths(data)
+        assert py == dispatch, f"n={n}"
+        for cuts in native:
+            assert cuts == py, f"n={n}"
+        assert py[-1] == n and py == sorted(set(py))
+        if n < MIN_CHUNK:
+            assert py == [n]
+        pieces = list(chunking.chunk_stream(data))
+        assert b"".join(p for _, p in pieces) == data
+
+
+def test_edge_exact_boundary_final_chunk_both_paths():
+    # Truncate a buffer exactly AT an interior cut: the final chunk's
+    # boundary lands on len(data) and must be emitted once — no
+    # trailing zero-length cut — and the cut list must be the exact
+    # prefix of the full buffer's (the CDC prefix property the dedup
+    # index relies on). Pinned identical across paths.
+    rng = random.Random(12)
+    data = rng.randbytes(1_000_000)
+    cuts = cut_points(data)
+    assert len(cuts) >= 3, "fixture buffer did not chunk"
+    boundary = cuts[1]  # interior mask/max cut, not the tail
+    trunc = data[:boundary]
+    expect = [c for c in cuts if c <= boundary]
+    for got in _both_paths(trunc):
+        assert got == expect
+        assert got[-1] == len(trunc)
+        assert got == sorted(set(got))  # no duplicate/zero-length tail
+    # MAX_CHUNK-boundary flavour: a max-size cut landing exactly on the
+    # end of input (constant bytes never satisfy the mask, so every cut
+    # is a MAX_CHUNK truncation).
+    flat = b"\x00" * (2 * MAX_CHUNK)
+    for got in _both_paths(flat):
+        assert got == [MAX_CHUNK, 2 * MAX_CHUNK]
+
+
 def test_chunk_stream_reassembles():
     data = os.urandom(400_000)
     pieces = list(chunking.chunk_stream(data))

@@ -132,6 +132,84 @@ def test_manifest_roundtrip_and_base_resolution(tmp_path):
     assert delta.load_manifest(cfg, "acme/delta", "x" * 40) is None
 
 
+# ── Base selection with MULTIPLE cached revisions (ISSUE 19): the
+# parent chain decides — closest ancestor wins, a descendant (newer
+# revision derived from the target) is never handed back as base, and
+# lineage-free manifests keep the historical newest-mtime order. ──
+
+
+def _write_manifest(cfg, repo, sha, parent=None, mtime=None):
+    doc = {"format": delta.MANIFEST_FORMAT, "repo": repo,
+           "revision": sha, "saved_at": 0.0,
+           "files": {"model.safetensors":
+                     {"size": 4, "xet_hash": "ab" * 32, "terms": []}}}
+    if parent:
+        doc["parent"] = parent
+    path = delta.manifest_path(cfg, repo, sha)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc))
+    if mtime is not None:
+        import os
+
+        os.utime(path, (mtime, mtime))
+
+
+def test_find_base_prefers_closest_ancestor_over_newest(tmp_path):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test")
+    repo = "acme/lineage"
+    A, B, C, D = ("a" * 40), ("b" * 40), ("c" * 40), ("d" * 40)
+    # Chain A <- B <- C <- D; A has the NEWEST mtime. Pulling/pushing D
+    # must pick C (the closest ancestor), never mtime-king A.
+    _write_manifest(cfg, repo, A, parent=None, mtime=1_000_300)
+    _write_manifest(cfg, repo, B, parent=A, mtime=1_000_010)
+    _write_manifest(cfg, repo, C, parent=B, mtime=1_000_020)
+    _write_manifest(cfg, repo, D, parent=C, mtime=1_000_030)
+    man = delta.find_base_manifest(cfg, repo, D)
+    assert man and man["revision"] == C
+    # First hop's manifest gone: its parent link is unknowable, so the
+    # chain walk ends and selection falls back to the newest
+    # non-descendant (A) rather than guessing at B.
+    delta.manifest_path(cfg, repo, C).unlink()
+    man = delta.find_base_manifest(cfg, repo, D)
+    assert man and man["revision"] == A
+
+
+def test_find_base_never_selects_a_descendant(tmp_path):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test")
+    repo = "acme/lineage"
+    A, B, C = ("a" * 40), ("b" * 40), ("c" * 40)
+    # Pulling B on a node that cached A (old) and C (C.parent == B — a
+    # NEWER revision derived from B). B itself has no local manifest
+    # (it is the revision being pulled). The descendant C must lose to
+    # the older A: a descendant base would let the plan "reuse" chunks
+    # the target revision predates.
+    _write_manifest(cfg, repo, A, parent=None, mtime=1_000_000)
+    _write_manifest(cfg, repo, C, parent=B, mtime=1_000_500)
+    man = delta.find_base_manifest(cfg, repo, B)
+    assert man and man["revision"] == A
+    # Only the descendant cached: no eligible base at all.
+    delta.manifest_path(cfg, repo, A).unlink()
+    assert delta.find_base_manifest(cfg, repo, B) is None
+
+
+def test_find_base_without_lineage_keeps_newest_mtime_order(tmp_path):
+    cfg = Config(hf_home=tmp_path / "hf", cache_dir=tmp_path / "zest",
+                 hf_token="hf_test")
+    repo = "acme/lineage"
+    X, Y, Z = ("1" * 40), ("2" * 40), ("9" * 40)
+    _write_manifest(cfg, repo, X, mtime=1_000_000)
+    _write_manifest(cfg, repo, Y, mtime=1_000_100)
+    man = delta.find_base_manifest(cfg, repo, Z)
+    assert man and man["revision"] == Y  # newest wins, pre-lineage rule
+    # A cyclic/corrupt parent chain must not hang or crash selection.
+    _write_manifest(cfg, repo, X, parent=Y, mtime=1_000_000)
+    _write_manifest(cfg, repo, Y, parent=X, mtime=1_000_100)
+    man = delta.find_base_manifest(cfg, repo, Z)
+    assert man and man["revision"] == Y
+
+
 def test_tensor_fingerprints_detect_exactly_the_changed_tensors():
     from zest_tpu.models.safetensors_io import parse_header_prefix
 

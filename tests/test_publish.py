@@ -1,0 +1,264 @@
+"""``zest push`` / cas.publish contracts (ISSUE 19).
+
+The write path promoted out of the test fixtures into production:
+
+- :class:`cas.publish.Publisher` — CDC chunk → dedup-index → xorb-pack
+  encoding: seeded base xorbs dedup byte-for-byte, minted xorbs drain
+  exactly once, referencing terms point into base xorbs at builder-
+  parity frame offsets;
+- :func:`transfer.push.push_checkpoint` — manifest + parent lineage +
+  refs bump + cache writes; content-defined revision ids (idempotent
+  re-push); dedup ratio ≥ 0.9 at a contiguous 1 %-changed checkpoint;
+  preview mode writes NOTHING;
+- the publisher daemon surface: a second node's unmodified
+  ``pull_model``, pointed at the daemon as its endpoint, reassembles
+  the pushed revision byte-identically; ``POST /v1/watch`` streams the
+  ``/v1/push`` notification (and 404s when ``ZEST_WATCH=0``).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zest_tpu.api.http_api import HttpApi, WatchHub
+from zest_tpu.cas.publish import Publisher, is_xet_path
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.config import Config
+from zest_tpu.transfer import delta
+from zest_tpu.transfer import push as push_mod
+from zest_tpu import storage
+
+REPO = "acme/push"
+
+
+def _cfg(root, **kw):
+    return Config(hf_home=root / "hf", cache_dir=root / "zest",
+                  hf_token="hf_test", **kw)
+
+
+def _quiet(*a, **k):
+    pass
+
+
+def _weights(n=4_000_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def _mutated(data: bytes, fraction=0.01, at=1_000_000) -> bytes:
+    """A contiguous ``fraction`` of bytes flipped — the shape real
+    1 %-changed checkpoints have (whole tensors change; scattered
+    single-byte noise would dirty every CDC chunk by construction)."""
+    buf = bytearray(data)
+    for i in range(at, at + int(len(buf) * fraction)):
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
+def _checkpoint(root, name, weights):
+    d = root / name
+    d.mkdir()
+    (d / "model.safetensors").write_bytes(weights)
+    (d / "config.json").write_text(json.dumps({"hidden": 64}))
+    return d
+
+
+# ── Publisher (the promoted encoder) ──
+
+
+def test_publisher_seeded_base_dedups_everything():
+    w = _weights(1_500_000)
+    first = Publisher()
+    pf = first.publish_file("model.safetensors", w)
+    minted = first.drain_new_xorbs()
+    assert minted and first.drain_new_xorbs() == []  # drain-once
+    # Second encoder seeded with the first's xorbs: identical bytes
+    # become 100% referencing terms — zero new xorbs.
+    second = Publisher()
+    for px in minted:
+        r = XorbReader(px.blob)
+        second.seed_xorb(px.hash_hex, r.frame_offsets(), r.chunk_hashes())
+    pf2 = second.publish_file("model.safetensors", w)
+    assert second.drain_new_xorbs() == []
+    assert pf2.new_bytes == 0 and pf2.reused_bytes == len(w)
+    assert pf2.dedup_ratio == 1.0
+    assert pf2.xet_hash == pf.xet_hash  # same content, same identity
+    # Referencing terms point into the SEEDED xorbs at builder-parity
+    # frame offsets (what fetch_info byte ranges are built from).
+    seeded = {px.hash_hex for px in minted}
+    assert {t.hash_hex for t in pf2.reconstruction.terms} <= seeded
+
+
+def test_is_xet_path_suffixes():
+    assert is_xet_path("model.safetensors")
+    assert is_xet_path("sub/dir/weights.bin")
+    assert not is_xet_path("config.json")
+    assert not is_xet_path("tokenizer.model")
+
+
+# ── push_checkpoint: durable writes + lineage + idempotence ──
+
+
+def test_push_first_revision_lands_everything(tmp_path):
+    cfg = _cfg(tmp_path)
+    ckpt = _checkpoint(tmp_path, "ckpt", _weights())
+    res = push_mod.push_checkpoint(cfg, REPO, ckpt, notify=False,
+                                   log=_quiet)
+    assert res.parent is None and len(res.revision) == 40
+    assert res.manifest_written
+    assert res.new_xorbs >= 1 and res.xorb_digests
+    # Ref, manifest, snapshot, and cache all agree.
+    assert storage.read_ref(cfg, REPO, "main") == res.revision
+    man = delta.load_manifest(cfg, REPO, res.revision)
+    assert man and "model.safetensors" in man["files"]
+    assert "parent" not in man
+    snap = cfg.model_snapshot_dir(REPO, res.revision)
+    assert (snap / "model.safetensors").stat().st_size == 4_000_000
+    cache = storage.XorbCache(cfg)
+    for hex_ in res.xorb_digests:
+        assert cache.has(hex_)
+
+
+def test_push_dedups_against_base_and_is_idempotent(tmp_path):
+    cfg = _cfg(tmp_path)
+    w = _weights()
+    a = push_mod.push_checkpoint(
+        cfg, REPO, _checkpoint(tmp_path, "a", w), notify=False,
+        log=_quiet)
+    ckpt_b = _checkpoint(tmp_path, "b", _mutated(w))
+    b = push_mod.push_checkpoint(cfg, REPO, ckpt_b, notify=False,
+                                 log=_quiet)
+    assert b.parent == a.revision
+    assert b.seeded_base_xorbs >= 1
+    assert b.dedup_ratio >= 0.90  # the ISSUE 19 headline gate
+    man = delta.load_manifest(cfg, REPO, b.revision)
+    assert man["parent"] == a.revision
+    # Content-defined revision id: re-pushing the same bytes over the
+    # same parent is the SAME revision (trainer retry safety)...
+    b2 = push_mod.push_checkpoint(cfg, REPO, ckpt_b, notify=False,
+                                  log=_quiet)
+    assert b2.revision == b.revision
+    # ...and with the base now cached, every chunk dedups.
+    assert b2.new_xorbs == 0 and b2.dedup_ratio == 1.0
+    # The next publish's base selection walks the lineage to B.
+    assert delta.find_base_manifest(
+        cfg, REPO, "f" * 40)["revision"] == b.revision
+
+
+def test_preview_writes_nothing(tmp_path):
+    cfg = _cfg(tmp_path)
+    ckpt = _checkpoint(tmp_path, "ckpt", _weights(1_000_000))
+    out = push_mod.preview_push(cfg, REPO, ckpt)
+    assert out["preview"] and out["new_xorbs"] >= 1
+    assert not delta.manifest_dir(cfg).exists() or \
+        not list(delta.manifest_dir(cfg).iterdir())
+    assert storage.read_ref(cfg, REPO, "main") is None
+    assert storage.list_cached_xorbs(cfg) == []
+
+
+# ── The publisher daemon surface + fan-out ──
+
+
+@pytest.fixture()
+def served(tmp_path):
+    cfg = _cfg(tmp_path, http_port=0)
+    api = HttpApi(cfg)
+    port = api.start()
+    cfg.http_port_file().parent.mkdir(parents=True, exist_ok=True)
+    cfg.http_port_file().write_text(str(port))
+    try:
+        yield cfg, api, f"http://127.0.0.1:{port}", tmp_path
+    finally:
+        api.close()
+
+
+def test_second_node_pull_reassembles_pushed_revision(served):
+    from zest_tpu.transfer.pull import pull_model
+
+    cfg, api, url, root = served
+    w = _weights()
+    push_mod.push_checkpoint(cfg, REPO, _checkpoint(root, "a", w),
+                             notify=False, log=_quiet)
+    w_b = _mutated(w)
+    b = push_mod.push_checkpoint(cfg, REPO, _checkpoint(root, "b", w_b),
+                                 notify=False, log=_quiet)
+    cfg2 = Config(hf_home=root / "hf2", cache_dir=root / "zest2",
+                  hf_token="hf_test", endpoint=url)
+    res = pull_model(cfg2, REPO, revision="main", no_p2p=True, log=_quiet)
+    snap = res.snapshot_dir
+    assert (snap / "model.safetensors").read_bytes() == w_b
+    assert json.loads((snap / "config.json").read_text()) == {"hidden": 64}
+    assert res.stats["revision"] == b.revision
+
+
+def test_watch_stream_delivers_push_notification(served):
+    cfg, api, url, root = served
+    events: list[dict] = []
+
+    def subscriber():
+        for ev in push_mod.watch_events(url, repos=[REPO], timeout_s=30):
+            events.append(ev)
+            if ev.get("event") == "revision":
+                return
+
+    t = threading.Thread(target=subscriber, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while api.watch_hub.watchers() == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    res = push_mod.push_checkpoint(
+        cfg, REPO, _checkpoint(root, "ckpt", _weights(1_000_000)),
+        log=_quiet)
+    assert res.notified and res.notified["delivered"] == 1
+    t.join(timeout=10)
+    assert [e["event"] for e in events] == ["hello", "revision"]
+    ev = events[-1]
+    assert ev["revision"] == res.revision
+    assert ev["repo"] == REPO and isinstance(ev["pushed_at"], float)
+
+
+def test_watch_hub_filters_by_repo():
+    hub = WatchHub()
+    got: list[dict] = []
+
+    def run():
+        for ev in hub.subscribe(repos=["acme/wanted"], ping_s=30):
+            got.append(ev)
+            if len(got) >= 2:
+                return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while hub.watchers() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hub.notify({"event": "revision", "repo": "acme/other"}) == 0
+    assert hub.notify({"event": "revision", "repo": "acme/wanted"}) == 1
+    t.join(timeout=5)
+    assert got[0]["event"] == "hello"
+    assert got[1]["repo"] == "acme/wanted"
+    deadline = time.monotonic() + 5
+    while hub.watchers() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hub.watchers() == 0  # subscriber unregistered on exit
+
+
+def test_watch_disabled_answers_404(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    cfg = _cfg(tmp_path, http_port=0, watch_enabled=False)
+    api = HttpApi(cfg)
+    port = api.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/watch", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc_info.value.code == 404
+    finally:
+        api.close()

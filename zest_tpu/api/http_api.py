@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -55,6 +56,64 @@ from zest_tpu.version import __version__
 _WARMED = threading.Event()  # process-global serve warm-up latch
 
 
+class WatchHub:
+    """Fan-out of push notifications to ``POST /v1/watch`` subscribers
+    (ISSUE 19).
+
+    One condition + per-subscriber event queues: ``notify()`` (called
+    from a ``/v1/push`` handler thread) appends to every matching
+    subscriber's queue and wakes them; each subscriber's ``subscribe()``
+    generator drains its own queue into the SSE stream, emitting a
+    ``ping`` keepalive when ``ping_s`` passes quietly (so dead clients
+    surface as BrokenPipe instead of idling forever). A disconnect
+    (GeneratorExit from ``_stream_sse``) unregisters the subscriber.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._subs: list[dict] = []
+
+    def watchers(self) -> int:
+        with self._cond:
+            return len(self._subs)
+
+    def notify(self, event: dict) -> int:
+        """Deliver ``event`` to matching subscribers; returns count."""
+        delivered = 0
+        with self._cond:
+            for sub in self._subs:
+                repos = sub["repos"]
+                if repos and event.get("repo") not in repos:
+                    continue
+                sub["queue"].append(dict(event))
+                delivered += 1
+            self._cond.notify_all()
+        return delivered
+
+    def subscribe(self, repos=None, ping_s: float = 15.0):
+        """SSE event generator for one subscriber. ``repos`` filters
+        (empty/None = all repos)."""
+        sub = {"queue": [], "repos": set(repos) if repos else None}
+        with self._cond:
+            self._subs.append(sub)
+        try:
+            yield {"event": "hello",
+                   "watching": sorted(sub["repos"] or [])}
+            while True:
+                with self._cond:
+                    if not sub["queue"]:
+                        self._cond.wait(timeout=ping_s)
+                    batch, sub["queue"] = sub["queue"], []
+                if not batch:
+                    yield {"event": "ping"}
+                for ev in batch:
+                    yield ev
+        finally:
+            with self._cond:
+                if sub in self._subs:
+                    self._subs.remove(sub)
+
+
 class HttpApi:
     """Control-plane server. ``run()`` blocks until ``/v1/stop``."""
 
@@ -67,6 +126,7 @@ class HttpApi:
         swarm=None,
         dcn_server=None,
         pod_peers: dict | None = None,
+        gossip_node=None,
     ):
         self.cfg = cfg
         self.bt_server = bt_server
@@ -74,6 +134,11 @@ class HttpApi:
         self.hbm_cache = hbm_cache
         self.swarm = swarm
         self.dcn_server = dcn_server
+        self.gossip_node = gossip_node
+        # Push fan-out (ISSUE 19): /v1/watch subscribers + the hub-
+        # shaped serving index a second node's `zest pull` reads.
+        self.watch_hub = WatchHub()
+        self._pub_index = None
         # host index → (host, http_port) of the OTHER pod daemons, for
         # the ?scope=pod aggregation (ZEST_POD_PEERS / --pod-peer).
         self.pod_peers = dict(pod_peers if pod_peers is not None
@@ -223,6 +288,63 @@ class HttpApi:
             if self._httpd
             else self.cfg.http_port
         )
+
+    # ── Push fan-out (ISSUE 19) ──
+
+    def publisher_index(self):
+        """The hub-shaped serving index (lazy: most daemons never get
+        asked to act as an endpoint)."""
+        if self._pub_index is None:
+            from zest_tpu.transfer.push import PublisherIndex
+
+            self._pub_index = PublisherIndex(self.cfg)
+        return self._pub_index
+
+    def push_notify(self, req: dict) -> dict:
+        """Handle a ``POST /v1/push`` from a local ``zest push``: make
+        the new xorbs seedable *now* (registry + swarm announce), bump
+        the revision on the gossip plane, chart the push, and wake
+        every ``/v1/watch`` subscriber. Raises ValueError on a
+        malformed notification (answered as 400)."""
+        repo, revision = req.get("repo"), req.get("revision")
+        if not repo or not revision:
+            raise ValueError("push notify needs repo and revision")
+        try:
+            xorbs = [(str(h), int(n)) for h, n in (req.get("xorbs") or [])]
+        except (TypeError, ValueError) as exc:
+            raise ValueError("xorbs must be [[hex, size], ...]") from exc
+        if self.registry is not None:
+            for h, n in xorbs:
+                self.registry.add(h, n)
+        if self.swarm is not None and xorbs:
+            try:
+                self.swarm.announce_xorbs([h for h, _ in xorbs])
+            except Exception:  # noqa: BLE001 - announce is best-effort
+                pass
+        if self.gossip_node is not None:
+            try:
+                self.gossip_node.announce_manifest(
+                    f"{repo}@{revision}",
+                    {"repo": repo, "revision": revision,
+                     "parent": req.get("parent"),
+                     "pushed_at": req.get("pushed_at")})
+            except Exception:  # noqa: BLE001 - gossip is best-effort
+                pass
+        telemetry.timeline.post("push.new_xorb_bytes",
+                                float(req.get("new_xorb_bytes") or 0))
+        if req.get("dedup_ratio") is not None:
+            telemetry.timeline.post("push.dedup_ratio",
+                                    float(req["dedup_ratio"]))
+        event = {"event": "revision", "repo": repo, "revision": revision,
+                 "parent": req.get("parent"),
+                 "pushed_at": req.get("pushed_at"),
+                 "dedup_ratio": req.get("dedup_ratio"),
+                 "new_xorb_bytes": req.get("new_xorb_bytes")}
+        delivered = self.watch_hub.notify(event)
+        telemetry.record("push_notify", repo=repo, revision=revision,
+                         xorbs=len(xorbs), delivered=delivered)
+        return {"ok": True, "watchers": self.watch_hub.watchers(),
+                "delivered": delivered}
 
     # ── Payloads ──
 
@@ -1084,8 +1206,41 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/":
             self._text(DASHBOARD_HTML.encode(),
                        "text/html; charset=utf-8")
+        # ── Publisher endpoint surface (ISSUE 19): hub + CAS shapes
+        # answered from local manifests/snapshots/xorb cache, so a
+        # second node's unmodified `zest pull` can use THIS daemon as
+        # its endpoint and reassemble pushed revisions. ──
+        elif path.startswith("/api/models/"):
+            self._hub_get(path)
+        elif path.startswith("/v1/reconstructions/"):
+            file_hex = path[len("/v1/reconstructions/"):].strip("/")
+            doc = self.api.publisher_index().reconstruction_doc(
+                file_hex, self.headers.get("Range"), self._base_url())
+            if doc is None:
+                self._json({"error": "unknown file"}, 404)
+            elif doc == "range":
+                self._json({"error": "range past EOF"}, 416)
+            else:
+                self._json(doc)
+        elif path.startswith("/xorbs/"):
+            blob = self.api.publisher_index().xorb_blob(
+                path[len("/xorbs/"):].strip("/"))
+            if blob is None:
+                self._json({"error": "unknown xorb"}, 404)
+            else:
+                self._bytes_ranged(blob, self.headers.get("Range"))
         else:
-            self._json({"error": "not found"}, 404)
+            parts = path.strip("/").split("/")
+            if len(parts) >= 5 and parts[2] == "resolve":
+                data = self.api.publisher_index().resolve_file(
+                    f"{parts[0]}/{parts[1]}", parts[3],
+                    "/".join(parts[4:]))
+                if data is None:
+                    self._json({"error": "not found"}, 404)
+                else:
+                    self._bytes_ranged(data, self.headers.get("Range"))
+            else:
+                self._json({"error": "not found"}, 404)
 
     def do_POST(self) -> None:  # noqa: N802
         self.api.http_requests += 1
@@ -1125,6 +1280,54 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._begin_sse()
             self._stream_sse(self.api.generate_events(req["repo_id"], req))
+        elif self.path == "/v1/watch":
+            # Continuous fan-out, subscriber side (ISSUE 19). 404 when
+            # ZEST_WATCH=0 — the rollback knob: pushes still land
+            # locally, nobody is notified.
+            if not getattr(self.api.cfg, "watch_enabled", True):
+                self._json({"error": "watch disabled"}, 404)
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                repos = [str(r) for r in (req.get("repos") or [])]
+            except (json.JSONDecodeError, AttributeError, TypeError):
+                self._json({"error": "body must be a JSON object"}, 400)
+                return
+            self._begin_sse()
+            self._stream_sse(self.api.watch_hub.subscribe(repos=repos))
+        elif self.path == "/v1/push":
+            # Push notification from a local `zest push` (ISSUE 19).
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise TypeError
+            except (json.JSONDecodeError, TypeError):
+                self._json({"error": "body must be a JSON object"}, 400)
+                return
+            try:
+                self._json(self.api.push_notify(req))
+            except ValueError as exc:
+                self._json({"error": str(exc)}, 400)
+        elif "/paths-info/" in self.path and \
+                self.path.startswith("/api/models/"):
+            parts = self.path[len("/api/models/"):].strip("/").split("/")
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                paths = [str(p) for p in (req.get("paths") or [])]
+            except (json.JSONDecodeError, AttributeError, TypeError):
+                self._json({"error": "body must be a JSON object"}, 400)
+                return
+            info = None
+            if len(parts) >= 4 and parts[2] == "paths-info":
+                info = self.api.publisher_index().paths_info(
+                    f"{parts[0]}/{parts[1]}", "/".join(parts[3:]), paths)
+            if info is None:
+                self._json({"error": "unknown revision"}, 404)
+            else:
+                self._json(info)
         elif self.path == "/v1/remediations":
             # ``zest heal --dry-run on|off``: flip decision-only mode on
             # the live engine (decisions are logged and counted, no
@@ -1150,6 +1353,64 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(payload, code)
         else:
             self._json({"error": "not found"}, 404)
+
+    def _base_url(self) -> str:
+        """This daemon's own URL — what fetch_info/casUrl absolutize to.
+        Prefer the Host header (what the client actually dialed; a
+        second node reaches us via a routable address, not loopback)."""
+        host = self.headers.get("Host")
+        return f"http://{host}" if host \
+            else f"http://127.0.0.1:{self.api.port}"
+
+    def _hub_get(self, path: str) -> None:
+        """Hub metadata GETs: ``/api/models/{org}/{name}/revision/{rev}``
+        and ``.../xet-read-token/{rev}`` (ISSUE 19 publisher surface)."""
+        parts = path[len("/api/models/"):].strip("/").split("/")
+        if len(parts) >= 4 and parts[2] == "revision":
+            doc = self.api.publisher_index().revision_doc(
+                f"{parts[0]}/{parts[1]}", "/".join(parts[3:]))
+            if doc is None:
+                self._json({"error": "unknown revision"}, 404)
+            else:
+                self._json(doc)
+        elif len(parts) >= 4 and parts[2] == "xet-read-token":
+            from zest_tpu.transfer.push import PUBLISHER_TOKEN
+
+            self._json({"casUrl": self._base_url(),
+                        "accessToken": PUBLISHER_TOKEN,
+                        "exp": int(time.time()) + 3600})
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def _bytes_ranged(self, blob, range_header: str | None) -> None:
+        """Serve bytes honoring an (inclusive, RFC 7233) Range header —
+        206 partial, 416 past-EOF — the CAS data-plane contract the
+        pull client and FixtureHub already speak."""
+        total = len(blob)
+        if range_header:
+            try:
+                spec = range_header.split("=", 1)[1]
+                a_s, _, b_s = spec.partition("-")
+                a = int(a_s or 0)
+                b = min(int(b_s), total - 1) if b_s else total - 1
+            except (IndexError, ValueError):
+                a, b = 0, total - 1
+            if a >= total or a > b:
+                self.send_response(416)
+                self.send_header("Content-Range", f"bytes */{total}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = memoryview(blob)[a:b + 1]
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {a}-{b}/{total}")
+        else:
+            body = blob
+            self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _read_json_body(self) -> dict | None:
         """JSON-object body with ``repo_id``, or None after a 400 (covers

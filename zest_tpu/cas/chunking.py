@@ -60,9 +60,27 @@ def _cut_points_py(data: memoryview) -> list[int]:
 
 
 def cut_points(data: bytes | memoryview) -> list[int]:
+    """Chunk end offsets (exclusive) covering ``data`` exactly.
+
+    Edge-case contract — pinned byte-identical across the python and
+    native paths by tests/test_chunking.py (the write path publishes
+    through this, so a divergence would fork content addresses):
+
+    - empty input → ``[]`` (no zero-length chunk; ``chunk_stream``
+      yields nothing),
+    - input shorter than MIN_CHUNK → exactly one cut at ``len(data)``
+      (the min-size skip means no mask cut can fire earlier),
+    - a mask/max cut landing exactly on ``len(data)`` is emitted once —
+      never followed by a trailing zero-length cut.
+    """
     data = memoryview(data)
+    if len(data) == 0:
+        # Explicit, not an artifact of dispatch: the empty stream has
+        # no chunks on EITHER path (previously this relied on the
+        # native branch being skipped for len 0).
+        return []
     native = _get_native()
-    if native is not None and len(data) > 0:
+    if native is not None:
         return native.gear_cut_points(bytes(data), MIN_CHUNK, MAX_CHUNK, MASK)
     return _cut_points_py(data)
 

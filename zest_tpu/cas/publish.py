@@ -1,0 +1,242 @@
+"""CDC-dedup publishing — the CAS *write* path (ISSUE 19).
+
+This is the server-side encoding the fixtures have exercised since the
+first pull test, promoted to production: a file becomes gearhash CDC
+chunks (:mod:`zest_tpu.cas.chunking`), every chunk is looked up in a
+first-occurrence-wins index over the xorb set the publisher already
+holds, and the file's reconstruction comes out as a term list where
+
+- a run of chunks that sit CONTIGUOUSLY in one existing xorb collapses
+  into a single *referencing* term (no bytes re-uploaded — that is the
+  dedup that makes revision-to-revision pushes structurally cheap), and
+- genuinely new chunks are packed into new :class:`XorbBuilder` frames
+  (respecting the xorb's chunk-count cap) and referenced by *defining*
+  terms.
+
+``tests/fixtures.py:FixtureRepo`` is now a thin wrapper over
+:class:`Publisher` (same promotion pattern as ``_TokenBucket`` →
+``zest_tpu.shaping``), so the loopback hub the integration tests pull
+from and the ``zest push`` write path share one implementation — the
+ISSUE 19 satellite contract.
+
+The publisher is transport-agnostic: it never touches the network or
+the disk cache. ``transfer/push.py`` feeds it base-revision xorbs from
+the local :class:`~zest_tpu.storage.XorbCache` (via :meth:`Publisher.
+seed_xorb`), collects the new xorbs it mints, and decides where the
+bytes go; the fixture keeps them in memory and serves them over HTTP.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from zest_tpu.cas import chunking, hashing
+from zest_tpu.cas import reconstruction as recon
+from zest_tpu.cas.xorb import XorbBuilder
+
+# File suffixes stored in Xet CAS (everything else is a "regular" file
+# carried verbatim), mirroring how HF stores configs vs weights.
+XET_SUFFIXES = (".safetensors", ".bin", ".pt", ".h5", ".msgpack")
+
+
+def is_xet_path(path: str) -> bool:
+    return path.endswith(XET_SUFFIXES)
+
+
+@dataclass
+class PublishedXorb:
+    """A xorb minted by this publisher (new bytes entering the CAS)."""
+
+    hash_hex: str
+    blob: bytes               # frame stream (the in-pipeline blob shape)
+    frame_offsets: list[int]  # len = num_chunks + 1
+    full: bytes               # frames + XETBLOB footer (the CDN artifact)
+
+
+@dataclass
+class PublishedFile:
+    """One file's publish outcome: identity, terms, and dedup split."""
+
+    path: str
+    size: int
+    xet_hash: str                      # LE-u64 hex of the merkle file hash
+    terms: list[recon.Term]
+    reconstruction: recon.Reconstruction
+    new_bytes: int = 0                 # bytes that entered NEW xorbs
+    reused_bytes: int = 0              # bytes served by referencing terms
+
+    @property
+    def dedup_ratio(self) -> float:
+        """Fraction of the file's bytes that did NOT become new xorbs."""
+        return (self.reused_bytes / self.size) if self.size else 1.0
+
+
+@dataclass
+class ChunkIndex:
+    """chunk hash → (xorb_hex, chunk_index, length), first-occurrence-wins.
+
+    Any occurrence serves identical bytes (content addressing), so the
+    first registered location is as good as any and keeps term runs
+    stable across re-registration.
+    """
+
+    _by_hash: dict[bytes, tuple[str, int, int]] = field(default_factory=dict)
+
+    def add_xorb(self, xorb_hex: str,
+                 chunk_hashes: list[tuple[bytes, int]]) -> None:
+        for idx, (ch, clen) in enumerate(chunk_hashes):
+            self._by_hash.setdefault(ch, (xorb_hex, idx, clen))
+
+    def lookup(self, chunk_hash: bytes) -> tuple[str, int, int] | None:
+        return self._by_hash.get(chunk_hash)
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def __contains__(self, chunk_hash: bytes) -> bool:
+        return chunk_hash in self._by_hash
+
+
+class Publisher:
+    """Stateful CDC-dedup encoder over a growing xorb set.
+
+    ``chunks_per_xorb`` forces files to split across several xorbs so
+    callers (fixtures, stress tests) exercise multi-term reconstruction;
+    0 means unlimited (one xorb per flush run, still bounded by the
+    format's own caps through :class:`XorbBuilder`).
+
+    ``url_prefix`` shapes the fetch_info URLs baked into emitted
+    reconstructions (``{url_prefix}{xorb_hex}``). Both the fixture hub
+    and the publisher daemon serve the ``/xorbs/{hex}`` route, and both
+    absolutize the URL at serve time, so the default is relative.
+    """
+
+    def __init__(self, chunks_per_xorb: int = 0,
+                 url_prefix: str = "/xorbs/"):
+        self.chunks_per_xorb = chunks_per_xorb
+        self.url_prefix = url_prefix
+        self.index = ChunkIndex()
+        # xorb_hex -> frame offsets; covers seeded (base) AND minted
+        # xorbs — referencing terms need the offsets to place their
+        # fetch_info byte ranges whichever side the xorb came from.
+        self._frame_offsets: dict[str, list[int]] = {}
+        self._minted: dict[str, PublishedXorb] = {}
+        self._drained: set[str] = set()
+
+    # ── xorb registration ──
+
+    def seed_xorb(self, xorb_hex: str, frame_offsets: list[int],
+                  chunk_hashes: list[tuple[bytes, int]]) -> None:
+        """Register an ALREADY-STORED xorb (e.g. the base revision's,
+        read back from the local cache) as dedup material. Its bytes
+        are never re-emitted; terms may reference into it."""
+        if xorb_hex in self._frame_offsets:
+            return
+        self._frame_offsets[xorb_hex] = list(frame_offsets)
+        self.index.add_xorb(xorb_hex, chunk_hashes)
+
+    def _register_built(self, builder: XorbBuilder) -> str:
+        xh_hex = hashing.hash_to_hex(builder.xorb_hash())
+        if xh_hex not in self._frame_offsets:
+            px = PublishedXorb(xh_hex, builder.serialize(),
+                               builder.frame_offsets(),
+                               builder.serialize_full())
+            self._frame_offsets[xh_hex] = px.frame_offsets
+            self.index.add_xorb(xh_hex, builder.chunk_hashes())
+            self._minted[xh_hex] = px
+        return xh_hex
+
+    def drain_new_xorbs(self) -> list[PublishedXorb]:
+        """Xorbs minted since the last drain — the bytes the caller
+        must now store/serve. Each xorb is handed out exactly once."""
+        fresh = [px for h, px in self._minted.items()
+                 if h not in self._drained]
+        self._drained.update(px.hash_hex for px in fresh)
+        return fresh
+
+    @property
+    def known_xorbs(self) -> set[str]:
+        return set(self._frame_offsets)
+
+    # ── the dedup encode ──
+
+    def publish_file(self, path: str, data: bytes, dedup: bool = True,
+                     chunks_per_xorb: int | None = None) -> PublishedFile:
+        """Encode ``data`` against the current xorb set.
+
+        With ``dedup=False`` every chunk is packed into new xorbs even
+        when the index already holds it — the base-revision behaviour
+        (fixture geometry is pinned by existing tests, and a cold push
+        has no base to reference anyway).
+        """
+        pieces = [(hashing.chunk_hash(piece), piece)
+                  for _, piece in chunking.chunk_stream(data)]
+        limit = (chunks_per_xorb if chunks_per_xorb is not None
+                 else self.chunks_per_xorb) or len(pieces) or 1
+        terms: list[recon.Term] = []
+        fetch_info: dict[str, list[recon.FetchInfo]] = {}
+        new_bytes = reused_bytes = 0
+
+        def add_term(xh_hex: str, start: int, end: int,
+                     nbytes: int) -> None:
+            offs = self._frame_offsets[xh_hex]
+            terms.append(recon.Term(
+                xorb_hash=hashing.hex_to_hash(xh_hex),
+                range=recon.ChunkRange(start, end),
+                unpacked_length=nbytes,
+            ))
+            fi = recon.FetchInfo(
+                url=f"{self.url_prefix}{xh_hex}",
+                url_range_start=offs[start],
+                url_range_end=offs[end],
+                range=recon.ChunkRange(start, end),
+            )
+            entries = fetch_info.setdefault(xh_hex, [])
+            if fi not in entries:
+                entries.append(fi)
+
+        pending: list[tuple[bytes, bytes]] = []  # new chunks to pack
+
+        def flush_pending() -> None:
+            nonlocal new_bytes
+            for i in range(0, len(pending), limit):
+                group = pending[i:i + limit]
+                builder = XorbBuilder()
+                for _h, piece in group:
+                    builder.add_chunk(piece)
+                xh_hex = self._register_built(builder)
+                add_term(xh_hex, 0, len(group),
+                         sum(len(p) for _h, p in group))
+                new_bytes += sum(len(p) for _h, p in group)
+            pending.clear()
+
+        i = 0
+        while i < len(pieces):
+            hit = self.index.lookup(pieces[i][0]) if dedup else None
+            if hit is None:
+                pending.append(pieces[i])
+                i += 1
+                continue
+            flush_pending()
+            # Extend a run of chunks that sit CONTIGUOUSLY in one
+            # existing xorb — the run becomes one referencing term.
+            xh_hex, idx, _len = hit
+            j, expect, run_bytes = i, idx, 0
+            while j < len(pieces):
+                nxt = self.index.lookup(pieces[j][0])
+                if nxt is None or nxt[0] != xh_hex or nxt[1] != expect:
+                    break
+                run_bytes += len(pieces[j][1])
+                expect += 1
+                j += 1
+            add_term(xh_hex, idx, expect, run_bytes)
+            reused_bytes += run_bytes
+            i = j
+        flush_pending()
+        file_hash = hashing.file_hash([(h, len(p)) for h, p in pieces])
+        file_hex = hashing.hash_to_hex(file_hash)
+        rec = recon.Reconstruction(
+            file_hash=file_hash, terms=terms, fetch_info=fetch_info)
+        return PublishedFile(path=path, size=len(data), xet_hash=file_hex,
+                             terms=terms, reconstruction=rec,
+                             new_bytes=new_bytes, reused_bytes=reused_bytes)

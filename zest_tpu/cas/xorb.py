@@ -335,6 +335,17 @@ class XorbReader:
     def __len__(self) -> int:
         return self._n
 
+    def frame_offsets(self) -> list[int]:
+        """Builder-parity offsets (len N+1): ``offsets[s]:offsets[e]``
+        is the byte range serving chunk range [s, e) within this blob —
+        what the write path (cas.publish / transfer.push) needs to aim
+        referencing terms' ``fetch_info`` at a cached base xorb."""
+        offs = [int(o) for o in self._frame_offs.tolist()]
+        if not offs:
+            return [0]
+        end = offs[-1] + FRAME_HEADER_LEN + int(self._comp_lens[-1])
+        return offs + [end]
+
     def chunk_hashes(self) -> list[tuple[bytes, int]]:
         """(hash, uncompressed length) per chunk — from the footer when
         present, else computed by decoding (the authoritative source)."""

@@ -481,7 +481,8 @@ def cmd_serve(args) -> int:
 
     _write_pid_file(cfg)
     api = HttpApi(cfg, bt_server=bt, registry=registry,
-                  dcn_server=dcn_server, swarm=swarm)
+                  dcn_server=dcn_server, swarm=swarm,
+                  gossip_node=gossip_node)
     api.start()
     # Record the BOUND port (http_port=0 binds ephemeral): status/stop/
     # the Python client resolve it via Config.effective_http_port.
@@ -1238,8 +1239,38 @@ def cmd_diff(args) -> int:
         return (repo, rev) if sep and rev else (repo, "main")
 
     repo_a, rev_a = parse_spec(args.base)
-    repo_b, rev_b = parse_spec(args.target)
     cfg = Config.load()
+    if args.push_preview:
+        # ``zest diff REPO[@BASE] --push-preview DIR`` (ISSUE 19): the
+        # would-be outcome of pushing DIR — dedup ratio + new-xorb
+        # bytes against the cached base — with zero writes.
+        from zest_tpu.transfer import push as push_mod
+
+        try:
+            out = push_mod.preview_push(
+                cfg, repo_a, args.push_preview,
+                base_revision=rev_a if "@" in args.base else None)
+        except (ValueError, OSError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            print(f"push preview: {repo_a} <- {args.push_preview}")
+            print(f"  base revision : {str(out['parent'])[:12] or '(none)'}")
+            print(f"  files         : {out['files']} "
+                  f"({out['xet_files']} xet)")
+            print(f"  total bytes   : {out['total_bytes']:,}")
+            print(f"  reused bytes  : {out['reused_bytes']:,}")
+            print(f"  new xorbs     : {out['new_xorbs']} "
+                  f"({out['new_xorb_bytes']:,} bytes)")
+            print(f"  dedup ratio   : {out['dedup_ratio']:.4f}")
+        return 0
+    if args.target is None:
+        print("error: diff needs a target revision "
+              "(or --push-preview DIR)", file=sys.stderr)
+        return 2
+    repo_b, rev_b = parse_spec(args.target)
     try:
         cfg.model_cache_dir(repo_a)
         cfg.model_cache_dir(repo_b)
@@ -1255,6 +1286,69 @@ def cmd_diff(args) -> int:
         print(json.dumps(out, indent=2))
     else:
         print(delta.format_diff(out))
+    return 0
+
+
+def cmd_push(args) -> int:
+    """``zest push REPO_ID CHECKPOINT_DIR`` (ISSUE 19): publish a
+    checkpoint directory as a new revision — gearhash-CDC dedup against
+    the cached base, new xorbs into the local (seedable) cache, a
+    lineage-carrying manifest, refs/main bump — then notify the local
+    daemon so every ``/v1/watch`` subscriber starts its delta pull."""
+    from zest_tpu.transfer import push as push_mod
+
+    cfg = Config.load()
+    try:
+        res = push_mod.push_checkpoint(
+            cfg, args.repo, args.checkpoint_dir,
+            base_revision=args.base, notify=not args.no_notify)
+    except (ValueError, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(res.summary(), indent=2))
+        return 0
+    print(f"✓ pushed {args.repo}@{res.revision[:12]} "
+          f"(parent {str(res.parent)[:12] if res.parent else '(none)'})")
+    print(f"  files {res.files} ({res.xet_files} xet), "
+          f"{res.total_bytes:,} bytes")
+    print(f"  new xorbs {res.new_xorbs} ({res.new_xorb_bytes:,} bytes), "
+          f"dedup ratio {res.dedup_ratio:.4f}")
+    if res.notified:
+        print(f"  fan-out: {res.notified.get('delivered', 0)} watcher(s) "
+              "notified")
+    elif not args.no_notify:
+        print("  fan-out: no daemon reachable (revision still "
+              "published locally)")
+    return 0
+
+
+def cmd_watch(args) -> int:
+    """``zest watch REPO_ID`` (ISSUE 19): subscribe to a publisher
+    daemon's ``/v1/watch`` and auto-delta-pull + hot-swap each pushed
+    revision — the serving-pod side of continuous weight fan-out."""
+    from zest_tpu.transfer import push as push_mod
+
+    cfg = Config.load()
+    try:
+        records = push_mod.watch_and_swap(
+            cfg, args.repo, publisher_url=args.publisher,
+            device=args.device, base_revision=args.base,
+            max_events=args.count, timeout_s=args.timeout,
+            no_p2p=args.no_p2p)
+    except (ValueError, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"swaps": records}, indent=2))
+        return 0
+    for r in records:
+        prop = r.get("propagation_s")
+        print(f"✓ swapped to {r['revision'][:12]}"
+              + (f"  propagation {prop:.2f}s" if prop is not None else ""))
+    if not records:
+        print("watch ended with no revision events", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -1554,10 +1648,50 @@ def build_parser() -> argparse.ArgumentParser:
                      "(dry-run; metadata only, no payload fetch)")
     diff_p.add_argument("base", metavar="REPO@REV",
                         help="base revision (what is cached/resident)")
-    diff_p.add_argument("target", metavar="REPO@REV",
+    diff_p.add_argument("target", metavar="REPO@REV", nargs="?",
+                        default=None,
                         help="target revision (what a pull would fetch)")
+    diff_p.add_argument("--push-preview", metavar="DIR", default=None,
+                        help="dry-run a push of checkpoint DIR against "
+                             "the cached base: dedup ratio + new-xorb "
+                             "bytes, no writes")
     diff_p.add_argument("--json", action="store_true")
     diff_p.set_defaults(fn=cmd_diff)
+
+    push_p = sub.add_parser(
+        "push", help="publish a checkpoint dir as a new revision "
+                     "(CDC dedup vs cached base) and notify watchers")
+    push_p.add_argument("repo", metavar="REPO_ID")
+    push_p.add_argument("checkpoint_dir", metavar="CHECKPOINT_DIR")
+    push_p.add_argument("--base", metavar="REV", default=None,
+                        help="base revision to dedup against "
+                             "(default: refs/main)")
+    push_p.add_argument("--no-notify", action="store_true",
+                        help="skip the daemon /v1/push notification "
+                             "(publish locally only)")
+    push_p.add_argument("--json", action="store_true")
+    push_p.set_defaults(fn=cmd_push)
+
+    watch_p = sub.add_parser(
+        "watch", help="subscribe to a publisher's /v1/watch and "
+                      "delta-pull + hot-swap each pushed revision")
+    watch_p.add_argument("repo", metavar="REPO_ID")
+    watch_p.add_argument("--publisher", metavar="URL", default=None,
+                         help="publisher daemon base URL "
+                              "(default: local daemon)")
+    watch_p.add_argument("--base", metavar="REV", default=None,
+                         help="currently-resident revision (delta "
+                              "evidence for the first swap)")
+    watch_p.add_argument("--device", default=None,
+                         help="land target (e.g. tpu) for hot-swap")
+    watch_p.add_argument("--count", type=int, default=1,
+                         help="stop after N revision events "
+                              "(default 1; 0 = until the stream ends)")
+    watch_p.add_argument("--timeout", type=float, default=120.0,
+                         help="idle-stream timeout seconds (default 120)")
+    watch_p.add_argument("--no-p2p", action="store_true")
+    watch_p.add_argument("--json", action="store_true")
+    watch_p.set_defaults(fn=cmd_watch)
 
     models_p = sub.add_parser(
         "models", help="list pulled models and xorb cache totals")

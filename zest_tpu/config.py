@@ -406,6 +406,16 @@ class Config:
     gossip_fanout: int = 0
     gossip_max_entries: int = 65536
     gossip_interval_s: float = 5.0
+    # Push / continuous fan-out (ISSUE 19): ``watch_enabled`` is the
+    # rollback knob (ZEST_WATCH, strict 0/1) for the daemon's
+    # ``POST /v1/watch`` subscribe/notify surface and the push-notify
+    # fan-out — 0 restores the read-only daemon bit-for-bit (404 on
+    # watch, pushes still publish locally but notify no one).
+    # ``push_chunks_per_xorb`` caps chunks packed per minted xorb
+    # (ZEST_PUSH_CHUNKS_PER_XORB; 0 = format caps only) — small values
+    # force multi-xorb layouts in tests/benches.
+    watch_enabled: bool = True
+    push_chunks_per_xorb: int = 0
     # Pod fleet observability (telemetry.fleet; ISSUE 7): HTTP API
     # endpoints of the OTHER hosts' daemons, ``ZEST_POD_PEERS=
     # "1=hostB:9847,2=hostC:9847"`` (same grammar as coop addrs). The
@@ -625,6 +635,13 @@ class Config:
                        else None),
             gossip_enabled=_strict_bool(
                 "ZEST_GOSSIP", env.get("ZEST_GOSSIP", "1")),
+            # Strict like ZEST_GOSSIP: ZEST_WATCH is the fan-out
+            # rollback knob — a typo must raise, never silently keep
+            # the watch surface on.
+            watch_enabled=_strict_bool(
+                "ZEST_WATCH", env.get("ZEST_WATCH", "1")),
+            push_chunks_per_xorb=_strict_nonneg_int(
+                env, "ZEST_PUSH_CHUNKS_PER_XORB"),
             gossip_fanout=_strict_nonneg_int(env, "ZEST_GOSSIP_FANOUT"),
             gossip_max_entries=_strict_nonneg_int(
                 env, "ZEST_GOSSIP_MAX", default=65536, floor=1),

@@ -680,6 +680,42 @@ class HbmPool:
         telemetry.record("pool_evict", repo=entry.repo, reason=reason)
         self._update_gauges()
 
+    def swap_to(self, old_snapshot_dir: str | Path | None,
+                new_snapshot_dir: str | Path,
+                repo: str | None = None,
+                wait: bool = True) -> tuple[PoolEntry, float]:
+        """Continuous fan-out hot-swap (ISSUE 19): land the NEW
+        revision's snapshot pinned (the same pin discipline that keeps
+        an in-flight decode's tree unevictable keeps the in-flight
+        REVISION unevictable here), wait until it is resident, then
+        evict the OLD revision's tree. Ordered land-then-evict so the
+        pool never holds zero revisions of the repo mid-swap: a decode
+        admitted while the swap runs serves whichever revision is
+        resident, never a gap. Returns ``(entry, swap_s)``; the entry
+        stays pinned — the caller :meth:`release`\\ s it when its
+        serving generation moves on."""
+        t0 = time.perf_counter()
+        entry, hot = self.acquire(new_snapshot_dir, repo)
+        if wait and not hot:
+            with entry.cond:
+                while entry.state == "landing":
+                    entry.cond.wait(timeout=0.5)
+            if entry.state == "error":
+                self.release(entry)
+                raise RuntimeError(
+                    f"landing {entry.repo} failed") from entry.land_error
+        if old_snapshot_dir is not None:
+            old_key = str(Path(old_snapshot_dir).resolve())
+            if old_key != entry.key:
+                # Best-effort: a pinned old tree survives (a decode is
+                # still reading it); the next swap or pressure pass
+                # collects it once the pin drops.
+                self.evict(old_key, reason="superseded")
+        swap_s = time.perf_counter() - t0
+        telemetry.record("pool_swap", repo=entry.repo,
+                         swap_s=round(swap_s, 4))
+        return entry, swap_s
+
     def evict(self, snapshot_dir: str | Path,
               reason: str = "manual") -> bool:
         key = str(Path(snapshot_dir).resolve())

@@ -76,6 +76,16 @@ DEFAULT_SAMPLES = 512      # ring capacity per series
 MAX_SERIES = 256           # hard cap on concurrent series
 _ANOMALY_RING = 64         # recent-anomalies ring on the store
 
+# Push fan-out series (ISSUE 19) — posted via ``post()``, charted by
+# the dashboard/`zest top` like any other series. The publisher daemon
+# posts the first two on every ``/v1/push`` notification; each watch
+# subscriber posts the third after its hot-swap completes, making
+# trainer-to-fleet propagation a live line, not a post-hoc number:
+#   ``push.new_xorb_bytes``  bytes minted by the last push
+#   ``push.dedup_ratio``     its CDC dedup ratio vs the base revision
+#   ``push.propagation_s``   trainer pushed_at -> swap-complete latency
+SERIES_PUSH_PREFIX = "push."
+
 # Throughput-collapse rule constants: the session's rate must fall
 # below COLLAPSE_FRACTION of its own EWMA — and the EWMA itself must be
 # above a noise floor, or an idle trickle would "collapse" constantly.

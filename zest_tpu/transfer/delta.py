@@ -82,7 +82,7 @@ def terms_of(rec: recon.Reconstruction) -> list[list]:
 
 
 def save_manifest(cfg, repo_id: str, commit_sha: str, entries,
-                  rec_of) -> bool:
+                  rec_of, parent: str | None = None) -> bool:
     """Persist this revision's file → term-list map (atomic write).
 
     ``rec_of(entry)`` returns the entry's resolved Reconstruction or
@@ -90,7 +90,13 @@ def save_manifest(cfg, repo_id: str, commit_sha: str, entries,
     known — a partial manifest would make a future delta plan classify
     the missing files' unchanged chunks as changed (costing re-fetch)
     or, worse, be mistaken for complete evidence. Returns whether a
-    manifest was written."""
+    manifest was written.
+
+    ``parent`` (ISSUE 19) records lineage: the sha this revision was
+    derived from — what ``zest push`` built its dedup index against, or
+    what a delta pull actually diffed with. Additive field (format
+    unchanged); :func:`find_base_manifest` uses the chain to prefer the
+    closest ancestor and to never hand back a descendant as base."""
     files: dict[str, dict] = {}
     for entry in entries:
         if not entry.is_xet:
@@ -110,6 +116,8 @@ def save_manifest(cfg, repo_id: str, commit_sha: str, entries,
         "saved_at": round(time.time(), 3),
         "files": files,
     }
+    if parent and parent != commit_sha:
+        doc["parent"] = parent
     from zest_tpu import storage
 
     storage.atomic_write(manifest_path(cfg, repo_id, commit_sha),
@@ -137,9 +145,19 @@ def find_base_manifest(cfg, repo_id: str, commit_sha: str,
     With an explicit ``base_revision`` (ref name or sha) only that
     revision's manifest qualifies — refs resolve through the HF refs
     file the previous pull wrote (``storage.read_ref``), which still
-    points at A because this pull updates it only at exit. Without one,
-    the newest manifest of the same repo that is NOT this revision wins
-    (the fine-tune-loop common case: the previous iteration)."""
+    points at A because this pull updates it only at exit.
+
+    Without one, selection is ancestry-aware (ISSUE 19 — ``zest push``
+    exercises this on every publish, when several revisions' manifests
+    coexist): the CLOSEST ANCESTOR of ``commit_sha`` along the recorded
+    ``parent`` chain wins outright, and a manifest whose own parent
+    chain passes through ``commit_sha`` (a DESCENDANT — i.e. a newer
+    revision derived from the one being pulled) is never selected — a
+    descendant base would make the plan "reuse" chunks the target
+    revision predates. Among the remaining candidates the newest
+    manifest wins (the fine-tune-loop common case: the previous
+    iteration); manifests without lineage keep the historical
+    newest-mtime behaviour bit-for-bit."""
     from zest_tpu import storage
 
     if base_revision:
@@ -155,7 +173,7 @@ def find_base_manifest(cfg, repo_id: str, commit_sha: str,
         return load_manifest(cfg, repo_id, sha)
     prefix = "models--" + repo_id.replace("/", "--") + "@"
     root = manifest_dir(cfg)
-    best: tuple[float, Path] | None = None
+    shas: dict[str, float] = {}
     try:
         candidates = list(root.iterdir())
     except OSError:
@@ -167,15 +185,50 @@ def find_base_manifest(cfg, repo_id: str, commit_sha: str,
         if sha == commit_sha:
             continue
         try:
-            mtime = p.stat().st_mtime
+            shas[sha] = p.stat().st_mtime
         except OSError:
             continue
-        if best is None or mtime > best[0]:
-            best = (mtime, p)
-    if best is None:
+    if not shas:
         return None
-    sha = best[1].name[len(prefix):-len(".json")]
-    return load_manifest(cfg, repo_id, sha)
+
+    docs: dict[str, dict | None] = {}
+
+    def _parent(sha: str) -> str | None:
+        if sha not in docs:
+            docs[sha] = load_manifest(cfg, repo_id, sha)
+        doc = docs[sha]
+        par = doc.get("parent") if doc else None
+        return par if isinstance(par, str) and par else None
+
+    # Closest ancestor wins: walk commit_sha's own recorded lineage
+    # (its manifest exists on the publishing node) and return the first
+    # hop that has evidence. Visited set + candidate bound guard
+    # against a corrupt/cyclic chain.
+    hops = 0
+    seen = {commit_sha}
+    cur = _parent(commit_sha)
+    while cur and cur not in seen and hops <= len(shas) + 1:
+        if cur in shas:
+            return load_manifest(cfg, repo_id, cur)
+        seen.add(cur)
+        cur = _parent(cur)
+        hops += 1
+
+    def _descends_from_target(sha: str) -> bool:
+        walked = {sha}
+        cur = _parent(sha)
+        while cur and cur not in walked and len(walked) <= len(shas) + 1:
+            if cur == commit_sha:
+                return True
+            walked.add(cur)
+            cur = _parent(cur)
+        return False
+
+    eligible = [s for s in shas if not _descends_from_target(s)]
+    if not eligible:
+        return None
+    best = max(eligible, key=lambda s: shas[s])
+    return load_manifest(cfg, repo_id, best)
 
 
 # ── Canonical segments + per-tensor fingerprints ──

@@ -1457,8 +1457,12 @@ def _pull_model(
                 return None
 
         try:
-            delta_mod.save_manifest(cfg, repo_id, commit_sha, files,
-                                    _rec_of)
+            # Lineage (ISSUE 19): record which revision this pull
+            # actually diffed against, so find_base_manifest can prefer
+            # the closest ancestor (and refuse descendants) next time.
+            delta_mod.save_manifest(
+                cfg, repo_id, commit_sha, files, _rec_of,
+                parent=(delta_base or {}).get("revision"))
         except Exception as exc:  # noqa: BLE001 - evidence is advisory
             log(f"delta manifest not saved ({exc})", file=sys.stderr)
 

@@ -1,0 +1,710 @@
+"""``zest push`` — the write path: CDC-dedup checkpoint publishing and
+continuous weight fan-out (ISSUE 19).
+
+"The package IS the seeder" has been half true since PR 1: every pulled
+byte seeds, but training output could not *enter* the swarm. This
+module closes the loop. A push takes a checkpoint directory (safetensors
++ sidecars — a trainer's save, or a live mesh's tree written through the
+loader), encodes it with the production CDC-dedup encoder
+(:mod:`zest_tpu.cas.publish` — the same implementation the test
+fixtures serve from) against the *cached base revision's* xorb set, and
+lands the result exactly where a pull would have:
+
+- new xorbs → the local :class:`~zest_tpu.storage.XorbCache`
+  (immediately seedable: BtServer serves from this cache, the daemon
+  notify below registers + gossips them),
+- a revision manifest → :mod:`transfer.delta`'s manifest store, with
+  ``parent`` lineage so :func:`delta.find_base_manifest` prefers the
+  closest ancestor on the next publish,
+- a snapshot + refs update → the normal HF cache layout, so the local
+  daemon can serve (and decode) the new revision like any pulled one.
+
+Every minted xorb is re-verified chunk-by-chunk through the existing
+``ops/blake3`` hasher path before its bytes are written — published
+bytes carry the same provenance guarantee pulls enforce — and the
+xorb-blob BLAKE3 digests ride in the :class:`PushResult`.
+
+**Continuous fan-out**: a push POSTs ``/v1/push`` to the local daemon,
+which registers the new xorbs, gossip-announces the revision bump
+(``KIND_MANIFEST``), and broadcasts to every ``POST /v1/watch``
+subscriber. :func:`watch_and_swap` is the subscriber engine serving
+pods run: on each revision event it delta-pulls rev B against the
+resident rev-A evidence and hot-swaps — the PR-9 in-place swap for a
+caller-held param tree, the PR-18 :meth:`HbmPool.swap_to` re-land for
+pool-served models — posting trainer→resident propagation latency as a
+live timeline series (``push.propagation_s``).
+
+:class:`PublisherIndex` is the read side of the publisher: it answers
+the exact Hub/CAS API shapes (``revision`` / ``paths-info`` /
+``xet-read-token`` / ``reconstructions`` / ``xorbs`` / ``resolve``)
+from local manifests, snapshots, and the xorb cache — so a *normal*
+``zest pull`` on a second node, pointed at this daemon as its endpoint,
+reassembles the pushed revision byte-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from pathlib import Path
+from types import SimpleNamespace
+
+from zest_tpu import storage, telemetry
+from zest_tpu.cas import hashing
+from zest_tpu.cas import reconstruction as recon
+from zest_tpu.cas.publish import Publisher, is_xet_path
+from zest_tpu.cas.xorb import XorbReader
+from zest_tpu.config import Config
+from zest_tpu.transfer import delta
+
+# Bearer token the publisher daemon accepts/issues for its CAS routes.
+# Loopback/DCN trust domain (same as the BT wire): the token exists for
+# API-shape parity with the real hub, not as a secret.
+PUBLISHER_TOKEN = "zest-publisher-token"
+
+# Timeline series posted by the subscriber on every completed swap —
+# the PR-14 live chart of trainer-to-fleet propagation.
+SERIES_PROPAGATION = "push.propagation_s"
+
+
+@dataclass
+class PushResult:
+    """What one publish did (also the ``--json`` CLI payload)."""
+
+    repo_id: str
+    revision: str
+    parent: str | None
+    preview: bool
+    files: int = 0
+    xet_files: int = 0
+    total_bytes: int = 0
+    xet_bytes: int = 0
+    reused_bytes: int = 0
+    new_xorbs: int = 0
+    new_xorb_bytes: int = 0
+    elapsed_s: float = 0.0
+    manifest_written: bool = False
+    seeded_base_xorbs: int = 0
+    xorb_digests: dict[str, str] = field(default_factory=dict)
+    notified: dict | None = None
+
+    @property
+    def dedup_ratio(self) -> float:
+        """Fraction of xet bytes that did NOT become new xorb payload —
+        the headline: 1%-changed weights should land ≥ 0.90 here."""
+        if not self.xet_bytes:
+            return 1.0
+        return max(0.0, 1.0 - (self.new_xorb_bytes / self.xet_bytes))
+
+    def summary(self) -> dict:
+        return {
+            "repo": self.repo_id,
+            "revision": self.revision,
+            "parent": self.parent,
+            "preview": self.preview,
+            "files": self.files,
+            "xet_files": self.xet_files,
+            "total_bytes": self.total_bytes,
+            "xet_bytes": self.xet_bytes,
+            "reused_bytes": self.reused_bytes,
+            "new_xorbs": self.new_xorbs,
+            "new_xorb_bytes": self.new_xorb_bytes,
+            "dedup_ratio": round(self.dedup_ratio, 4),
+            "manifest_written": self.manifest_written,
+            "seeded_base_xorbs": self.seeded_base_xorbs,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "notified": self.notified,
+        }
+
+
+def read_checkpoint_dir(checkpoint_dir: str | Path) -> dict[str, bytes]:
+    """A checkpoint directory as {relative posix path: bytes}, sorted —
+    deterministic walk order keeps the revision sha content-defined."""
+    root = Path(checkpoint_dir)
+    if not root.is_dir():
+        raise ValueError(f"not a checkpoint directory: {root}")
+    files: dict[str, bytes] = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file():
+            files[p.relative_to(root).as_posix()] = p.read_bytes()
+    if not files:
+        raise ValueError(f"checkpoint directory is empty: {root}")
+    return files
+
+
+def _resolve_base_sha(cfg: Config, repo_id: str,
+                      base_revision: str | None) -> str | None:
+    """The revision-A sha a push dedups against: explicit sha/ref, else
+    whatever ``refs/main`` points at (the fine-tune-loop common case)."""
+    if base_revision:
+        if delta.manifest_path(cfg, repo_id, base_revision).exists():
+            return base_revision
+        return storage.read_ref(cfg, repo_id, base_revision) or base_revision
+    return storage.read_ref(cfg, repo_id, "main")
+
+
+def _seed_from_base(cfg: Config, pub: Publisher, base_man: dict,
+                    cache: storage.XorbCache) -> int:
+    """Feed the base revision's locally-cached xorbs into the dedup
+    index. Only FULL cache entries qualify (a partial entry's chunk
+    indices are rebased — offsets would lie); a missing xorb just means
+    its chunks can't dedup, never a failed push."""
+    seeded = 0
+    seen: set[str] = set()
+    for rec in (base_man.get("files") or {}).values():
+        for term in rec.get("terms") or []:
+            xh_hex = term[0]
+            if xh_hex in seen:
+                continue
+            seen.add(xh_hex)
+            blob = cache.get(xh_hex)
+            if blob is None:
+                continue
+            try:
+                reader = XorbReader(blob)
+                pub.seed_xorb(xh_hex, reader.frame_offsets(),
+                              reader.chunk_hashes())
+                seeded += 1
+            except Exception:  # noqa: BLE001 - a bad cache entry only costs dedup
+                continue
+    return seeded
+
+
+def _revision_identities(cfg: Config, repo_id: str, sha: str,
+                         man: dict | None) -> dict[str, str] | None:
+    """Per-file identity map of an already-published revision (xet hash
+    from its manifest, BLAKE3 for sidecars) — None when local state is
+    too incomplete to compare. Feeds the no-op-push check."""
+    try:
+        snap = cfg.model_snapshot_dir(repo_id, sha)
+    except ValueError:
+        return None
+    if not snap.is_dir():
+        return None
+    out: dict[str, str] = {}
+    for p in sorted(snap.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(snap).as_posix()
+        if is_xet_path(rel):
+            rec = ((man or {}).get("files") or {}).get(rel)
+            if rec is None:
+                return None
+            out[rel] = rec["xet_hash"]
+        else:
+            out[rel] = hashing.blake3_hash(p.read_bytes()).hex()
+    return out
+
+
+def _content_sha(parent: str | None, identities: dict[str, str]) -> str:
+    """Content-defined revision id: BLAKE3 over (parent, per-file
+    identity), 40 hex chars like a git sha. Re-pushing identical
+    content over the same parent is the same revision — idempotent."""
+    doc = json.dumps({"parent": parent or "", "files": identities},
+                     sort_keys=True, separators=(",", ":"))
+    return hashing.blake3_hash(doc.encode()).hex()[:40]
+
+
+def _verify_minted(pub_xorbs) -> dict[str, str]:
+    """Provenance gate (tentpole): re-hash every minted xorb's chunks
+    through the ops/blake3 hasher path and compare against the chunk
+    hashes the encoder packed — published bytes get the same BLAKE3
+    verification pulls enforce on fetched bytes. Returns {xorb_hex:
+    blob blake3 hex} digests. Raises on any mismatch: corrupt bytes
+    must never enter the seedable cache."""
+    from zest_tpu import ops
+
+    hasher = ops.unit_verify_hasher(hashing.CHUNK_KEY)
+    digests: dict[str, str] = {}
+    for px in pub_xorbs:
+        reader = XorbReader(px.blob)
+        chunks = [reader.extract_chunk(i, verify=False)
+                  for i in range(len(reader))]
+        got = hasher.hash_batch(chunks)
+        want = [h for h, _len in reader.chunk_hashes()]
+        if got != want:
+            raise RuntimeError(
+                f"minted xorb {px.hash_hex[:12]} failed BLAKE3 "
+                "verification — refusing to publish corrupt bytes")
+        digests[px.hash_hex] = hashing.blake3_hash(px.blob).hex()
+    return digests
+
+
+def notify_daemon(cfg: Config, payload: dict,
+                  timeout_s: float = 5.0) -> dict | None:
+    """POST the push notification to the local daemon's ``/v1/push``.
+    Best-effort: no daemon (or watch off, 404) returns None — the push
+    itself has already durably landed; only the live fan-out is lost."""
+    port = cfg.effective_http_port()
+    url = f"http://127.0.0.1:{port}/v1/push"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode() or "{}")
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def push_checkpoint(cfg: Config, repo_id: str,
+                    checkpoint_dir: str | Path | None = None,
+                    files: dict[str, bytes] | None = None,
+                    base_revision: str | None = None,
+                    preview: bool = False,
+                    notify: bool = True,
+                    log=print) -> PushResult:
+    """Publish a checkpoint as a new revision of ``repo_id`` (tentpole).
+
+    ``files`` may be passed directly (a live mesh's serialized tree);
+    otherwise ``checkpoint_dir`` is read. With ``preview=True`` the full
+    CDC-dedup encode runs but NOTHING is written, announced, or
+    notified — the ``zest diff --push-preview`` dry-run reporting the
+    would-be dedup ratio and new-xorb byte count.
+    """
+    t0 = time.monotonic()
+    cfg.model_cache_dir(repo_id)  # repo-id validation (raises ValueError)
+    if files is None:
+        if checkpoint_dir is None:
+            raise ValueError("push needs a checkpoint_dir or files dict")
+        files = read_checkpoint_dir(checkpoint_dir)
+
+    cache = storage.XorbCache(cfg)
+    base_sha = _resolve_base_sha(cfg, repo_id, base_revision)
+    base_man = (delta.load_manifest(cfg, repo_id, base_sha)
+                if base_sha else None)
+    if base_sha and base_man is None and base_revision:
+        # An explicit base the caller believes exists but has no local
+        # evidence: proceed cold, but loudly — dedup against nothing is
+        # a full upload, probably not what a trainer loop intended.
+        telemetry.record("push_degraded", repo=repo_id,
+                         reason="missing base manifest")
+        log(f"push: no manifest for base {base_sha[:12]} — publishing "
+            "without dedup evidence")
+
+    pub = Publisher(chunks_per_xorb=getattr(cfg, "push_chunks_per_xorb", 0))
+    seeded = _seed_from_base(cfg, pub, base_man, cache) if base_man else 0
+
+    with telemetry.span("push", repo=repo_id):
+        published: dict[str, object] = {}
+        identities: dict[str, str] = {}
+        result = PushResult(repo_id=repo_id, revision="",
+                            parent=base_sha if base_man else None,
+                            preview=preview, files=len(files),
+                            seeded_base_xorbs=seeded)
+        for path, data in files.items():
+            result.total_bytes += len(data)
+            if is_xet_path(path):
+                pf = pub.publish_file(path, data, dedup=True)
+                published[path] = pf
+                identities[path] = pf.xet_hash
+                result.xet_files += 1
+                result.xet_bytes += pf.size
+                result.reused_bytes += pf.reused_bytes
+            else:
+                identities[path] = hashing.blake3_hash(data).hex()
+
+        minted = pub.drain_new_xorbs()
+        result.new_xorbs = len(minted)
+        result.new_xorb_bytes = sum(len(px.blob) for px in minted)
+        result.revision = _content_sha(result.parent, identities)
+
+        # No-op push (trainer retry safety): bytes identical to the
+        # resolved base ARE the base revision — report it, write and
+        # notify nothing, so a re-push after a crashed ack can't mint a
+        # spurious self-parented revision.
+        if result.parent and identities == _revision_identities(
+                cfg, repo_id, result.parent, base_man):
+            result.revision = result.parent
+            result.parent = (base_man or {}).get("parent")
+            result.elapsed_s = time.monotonic() - t0
+            telemetry.record("push_noop", repo=repo_id,
+                             revision=result.revision)
+            return result
+
+        if preview:
+            result.elapsed_s = time.monotonic() - t0
+            return result
+
+        # ── Provenance, then durable writes (xorbs → snapshot → manifest
+        # → refs): a crash mid-push leaves extra cache bytes, never a
+        # ref pointing at an unserveable revision. ──
+        result.xorb_digests = _verify_minted(minted)
+        for px in minted:
+            if not cache.has(px.hash_hex):
+                cache.put(px.hash_hex, px.blob)
+
+        snap = cfg.model_snapshot_dir(repo_id, result.revision)
+        snap.mkdir(parents=True, exist_ok=True)
+        for path, data in files.items():
+            target = snap / path
+            target.parent.mkdir(parents=True, exist_ok=True)
+            storage.atomic_write(target, data)
+
+        entries = [SimpleNamespace(is_xet=True, path=p, size=pf.size,
+                                   xet_hash=pf.xet_hash)
+                   for p, pf in published.items()]
+        result.manifest_written = delta.save_manifest(
+            cfg, repo_id, result.revision, entries,
+            lambda e: published[e.path].reconstruction,
+            parent=result.parent)
+        storage.write_ref(cfg, repo_id, "main", result.revision)
+
+        telemetry.record("push_published", repo=repo_id,
+                         revision=result.revision,
+                         new_xorbs=result.new_xorbs,
+                         dedup_ratio=round(result.dedup_ratio, 4))
+        if notify:
+            result.notified = notify_daemon(cfg, {
+                "repo": repo_id,
+                "revision": result.revision,
+                "parent": result.parent,
+                "pushed_at": time.time(),
+                "dedup_ratio": round(result.dedup_ratio, 4),
+                "new_xorb_bytes": result.new_xorb_bytes,
+                "xorbs": [[px.hash_hex, len(px.blob)] for px in minted],
+            })
+    result.elapsed_s = time.monotonic() - t0
+    return result
+
+
+def preview_push(cfg: Config, repo_id: str,
+                 checkpoint_dir: str | Path,
+                 base_revision: str | None = None) -> dict:
+    """``zest diff --push-preview``: the would-be dedup outcome of
+    pushing ``checkpoint_dir``, without writing anything."""
+    res = push_checkpoint(cfg, repo_id, checkpoint_dir,
+                          base_revision=base_revision, preview=True,
+                          notify=False, log=lambda *a, **k: None)
+    return res.summary()
+
+
+# ── The watch client: continuous fan-out, subscriber side ──
+
+
+def watch_events(base_url: str, repos: list[str] | None = None,
+                 timeout_s: float | None = None):
+    """Generator over a daemon's ``POST /v1/watch`` SSE stream.
+
+    Yields event dicts (``hello`` once, then ``revision`` bumps;
+    ``ping`` keepalives are swallowed). ``timeout_s`` bounds the
+    per-read socket wait — expiry ends the stream, it is not an error.
+    """
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/v1/watch",
+        data=json.dumps({"repos": repos or []}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = urllib.request.urlopen(req, timeout=timeout_s)
+    try:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue
+            try:
+                ev = json.loads(line[len("data: "):])
+            except ValueError:
+                continue
+            if ev.get("event") == "ping":
+                continue
+            yield ev
+    finally:
+        resp.close()
+
+
+def watch_and_swap(cfg: Config, repo_id: str,
+                   publisher_url: str | None = None,
+                   device: str | None = None,
+                   base_params: dict | None = None,
+                   base_revision: str | None = None,
+                   max_events: int = 1,  # 0/None = until stream ends
+                   timeout_s: float | None = 120.0,
+                   swarm=None, no_p2p: bool = False,
+                   log=print) -> list[dict]:
+    """Subscriber engine (tentpole): auto-delta-pull + hot-swap on
+    every pushed revision.
+
+    Connects to the publisher daemon's ``/v1/watch``; each ``revision``
+    event triggers a delta pull of the new sha with the resident rev-A
+    evidence (``base_params``/``base_revision`` — the PR-9 in-place
+    swap; the updated tree becomes the base for the NEXT event, so a
+    long-running subscriber tracks the trainer at one-tree HBM peak).
+    When the HBM serving pool holds the repo (PR 18), the swap also
+    re-lands the new snapshot through :meth:`HbmPool.swap_to` — pinned
+    in flight, old revision evicted after.
+
+    Per event, posts ``push.propagation_s`` (trainer ``pushed_at`` →
+    swap complete) to the live timeline and returns a record list:
+    ``{revision, parent, propagation_s, time_to_swap_s, dedup_ratio}``.
+    """
+    from zest_tpu.transfer.pull import pull_model
+
+    if publisher_url is None:
+        publisher_url = f"http://127.0.0.1:{cfg.effective_http_port()}"
+    # Pull FROM the daemon being watched: the publisher serves the full
+    # hub/CAS read surface (PublisherIndex), so the subscriber's pulls
+    # must target it — not whatever cfg.endpoint defaults to.
+    if cfg.endpoint.rstrip("/") != publisher_url.rstrip("/"):
+        cfg = dataclasses.replace(cfg, endpoint=publisher_url.rstrip("/"))
+    telemetry.timeline.ensure_started()
+    records: list[dict] = []
+    for ev in watch_events(publisher_url, repos=[repo_id],
+                           timeout_s=timeout_s):
+        if ev.get("event") != "revision" or ev.get("repo") != repo_id:
+            continue
+        sha = ev.get("revision")
+        if not sha or sha == base_revision:
+            continue
+        log(f"watch: {repo_id} bumped to {sha[:12]} "
+            f"(parent {str(ev.get('parent'))[:12]}) — delta pulling")
+        old_snap = None
+        if base_revision:
+            try:
+                old_snap = cfg.model_snapshot_dir(repo_id, base_revision)
+            except ValueError:
+                old_snap = None
+        result = pull_model(
+            cfg, repo_id, revision=sha, device=device, swarm=swarm,
+            no_p2p=no_p2p, base_params=base_params,
+            base_revision=base_revision if base_params else None,
+            log=log)
+        record = {
+            "revision": sha,
+            "parent": ev.get("parent"),
+            "dedup_ratio": ev.get("dedup_ratio"),
+            "time_to_swap_s": result.stats.get(
+                "time_to_swap_s", result.stats.get("elapsed_s")),
+        }
+        # PR-18 re-land path: pool-served models swap inside the pool
+        # (pinned land → evict old), not via caller-held params.
+        from zest_tpu.models import hbm_pool as pool_mod
+
+        pool = pool_mod.pool(cfg)
+        if pool is not None and old_snap is not None:
+            try:
+                # Only re-land when the OLD snapshot is actually pool-
+                # resident — digest() is the residency probe.
+                if pool.digest(old_snap) is not None:
+                    new_snap = cfg.model_snapshot_dir(repo_id, sha)
+                    entry, swap_s = pool.swap_to(
+                        old_snap, new_snap, repo=repo_id)
+                    pool.release(entry)
+                    record["pool_swap_s"] = round(swap_s, 4)
+            except Exception as exc:  # noqa: BLE001 - pool swap advisory
+                record["pool_swap_error"] = type(exc).__name__
+        pushed_at = ev.get("pushed_at")
+        if isinstance(pushed_at, (int, float)):
+            propagation = max(0.0, time.time() - pushed_at)
+            record["propagation_s"] = round(propagation, 4)
+            telemetry.timeline.post(SERIES_PROPAGATION, propagation)
+        records.append(record)
+        base_params = result.params if result.params else base_params
+        base_revision = sha
+        if max_events and len(records) >= max_events:
+            break
+    return records
+
+
+# ── The publisher's read side: hub-shaped serving index ──
+
+
+class PublisherIndex:
+    """Answers the Hub/CAS API shapes from local state (manifests,
+    snapshots, xorb cache) so the daemon can serve pushed revisions to
+    a second node's *unmodified* ``zest pull``.
+
+    Used by ``api.http_api``: ``GET /api/models/{repo}/revision/{rev}``,
+    ``POST .../paths-info/{rev}``, ``GET .../xet-read-token/{rev}``,
+    ``GET /v1/reconstructions/{hex}`` (with Range pagination +
+    ``offset_into_first_range``, 416 past EOF), ``GET /xorbs/{hex}``
+    (ranged), ``GET /{org}/{name}/resolve/{rev}/{file}`` — the same
+    shapes (and pagination semantics) the loopback FixtureHub speaks,
+    which are the shapes the production client speaks.
+    """
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.cache = storage.XorbCache(cfg)
+        self._offsets: dict[str, list[int]] = {}
+
+    # ── revision / file metadata ──
+
+    def resolve_sha(self, repo_id: str, rev: str | None) -> str | None:
+        rev = rev or "main"
+        if delta.manifest_path(self.cfg, repo_id, rev).exists() \
+                or self._snapshot_dir(repo_id, rev) is not None:
+            return rev
+        return storage.read_ref(self.cfg, repo_id, rev)
+
+    def _snapshot_dir(self, repo_id: str, sha: str) -> Path | None:
+        try:
+            snap = self.cfg.model_snapshot_dir(repo_id, sha)
+        except ValueError:
+            return None
+        return snap if snap.is_dir() else None
+
+    def files_for(self, repo_id: str,
+                  sha: str) -> dict[str, dict] | None:
+        """{path: {size, xetHash?}} for a revision — snapshot listing
+        for sizes/sidecars, manifest for xet identities. None when the
+        revision is unknown locally."""
+        man = delta.load_manifest(self.cfg, repo_id, sha)
+        snap = self._snapshot_dir(repo_id, sha)
+        if man is None and snap is None:
+            return None
+        out: dict[str, dict] = {}
+        if snap is not None:
+            for p in sorted(snap.rglob("*")):
+                if p.is_file():
+                    rel = p.relative_to(snap).as_posix()
+                    out[rel] = {"size": p.stat().st_size}
+        for path, rec in ((man or {}).get("files") or {}).items():
+            entry = out.setdefault(path, {"size": int(rec["size"])})
+            entry["size"] = int(rec["size"])
+            entry["xetHash"] = rec["xet_hash"]
+        return out
+
+    def revision_doc(self, repo_id: str, rev: str | None) -> dict | None:
+        sha = self.resolve_sha(repo_id, rev)
+        if sha is None:
+            return None
+        files = self.files_for(repo_id, sha)
+        if files is None:
+            return None
+        return {"sha": sha,
+                "siblings": [{"rfilename": p} for p in sorted(files)]}
+
+    def paths_info(self, repo_id: str, rev: str | None,
+                   paths: list[str]) -> list[dict] | None:
+        sha = self.resolve_sha(repo_id, rev)
+        files = self.files_for(repo_id, sha) if sha else None
+        if files is None:
+            return None
+        out = []
+        for p in paths:
+            meta = files.get(p)
+            if meta is None:
+                continue
+            item = {"path": p, "size": meta["size"], "type": "file"}
+            if meta.get("xetHash"):
+                item["xetHash"] = meta["xetHash"]
+            out.append(item)
+        return out
+
+    def resolve_file(self, repo_id: str, rev: str,
+                     filename: str) -> bytes | None:
+        sha = self.resolve_sha(repo_id, rev)
+        snap = self._snapshot_dir(repo_id, sha) if sha else None
+        if snap is None:
+            return None
+        target = (snap / filename)
+        try:
+            target = target.resolve()
+            target.relative_to(snap.resolve())  # no traversal
+            return target.read_bytes()
+        except (OSError, ValueError):
+            return None
+
+    # ── CAS data plane ──
+
+    def xorb_blob(self, xorb_hex: str) -> bytes | None:
+        return self.cache.get(xorb_hex)
+
+    def _frame_offsets(self, xorb_hex: str) -> list[int] | None:
+        offs = self._offsets.get(xorb_hex)
+        if offs is not None:
+            return offs
+        blob = self.cache.get(xorb_hex)
+        if blob is None:
+            return None
+        try:
+            offs = XorbReader(blob).frame_offsets()
+        except Exception:  # noqa: BLE001 - corrupt entry = unserveable
+            return None
+        self._offsets[xorb_hex] = offs
+        return offs
+
+    def _find_file_record(self, file_hex: str) -> dict | None:
+        """Locate ``file_hex``'s term list in any local manifest."""
+        root = delta.manifest_dir(self.cfg)
+        try:
+            paths = sorted(root.iterdir(),
+                           key=lambda p: p.stat().st_mtime, reverse=True)
+        except OSError:
+            return None
+        for p in paths:
+            try:
+                doc = json.loads(p.read_text())
+            except (OSError, ValueError):
+                continue
+            for rec in (doc.get("files") or {}).values():
+                if rec.get("xet_hash") == file_hex:
+                    return rec
+        return None
+
+    def reconstruction_doc(self, file_hex: str,
+                           range_header: str | None,
+                           base_url: str):
+        """The reconstruction JSON for ``file_hex`` — or the string
+        ``"range"`` for a 416 window, or None when unknown/unserveable
+        (a term's xorb missing from the local cache)."""
+        rec_doc = self._find_file_record(file_hex)
+        if rec_doc is None:
+            return None
+        terms: list[recon.Term] = []
+        fetch_info: dict[str, list[recon.FetchInfo]] = {}
+        for t in rec_doc.get("terms") or []:
+            xh_hex, start, end, nbytes = t[0], int(t[1]), int(t[2]), int(t[3])
+            offs = self._frame_offsets(xh_hex)
+            if offs is None or end > len(offs) - 1:
+                return None
+            terms.append(recon.Term(
+                xorb_hash=hashing.hex_to_hash(xh_hex),
+                range=recon.ChunkRange(start, end),
+                unpacked_length=nbytes))
+            fi = recon.FetchInfo(
+                url=f"/xorbs/{xh_hex}",
+                url_range_start=offs[start], url_range_end=offs[end],
+                range=recon.ChunkRange(start, end))
+            entries = fetch_info.setdefault(xh_hex, [])
+            if fi not in entries:
+                entries.append(fi)
+        rec_obj = recon.Reconstruction(
+            file_hash=hashing.hex_to_hash(file_hex), terms=terms,
+            fetch_info=fetch_info)
+
+        total = sum(t.unpacked_length for t in rec_obj.terms)
+        lo, hi = 0, total
+        if range_header:
+            spec = range_header.split("=", 1)[-1]
+            start_s, _, end_s = spec.partition("-")
+            try:
+                lo = int(start_s or 0)
+                hi = min(int(end_s) + 1 if end_s else total, total)
+            except ValueError:
+                lo, hi = 0, total
+            if lo >= total and total > 0:
+                return "range"
+        doc = recon.to_json(rec_obj)
+        if lo > 0 or hi < total:
+            kept, off, offset_into_first = [], 0, 0
+            for t, tj in zip(rec_obj.terms, doc["terms"]):
+                t_lo, t_hi = off, off + t.unpacked_length
+                if t_hi > lo and t_lo < hi:
+                    if not kept:
+                        offset_into_first = lo - t_lo
+                    kept.append(tj)
+                off = t_hi
+            doc["terms"] = kept
+            doc["offset_into_first_range"] = offset_into_first
+            keep = {t["hash"] for t in kept}
+            doc["fetch_info"] = {h: v for h, v in doc["fetch_info"].items()
+                                 if h in keep}
+        for entries in doc["fetch_info"].values():
+            for fi in entries:
+                if fi["url"].startswith("/"):
+                    fi["url"] = base_url + fi["url"]
+        return doc
