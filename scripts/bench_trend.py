@@ -2,8 +2,8 @@
 
 The committed bench artifacts (``SWARM_r12.json``, ``TENANT_r13.json``,
 ``MULTIHOST_r14.json``, ``DELTA_r10.json``, ``FLEET_r16.json``,
-``MTTR_r17.json``, ``SERVE_r18.json``) carry
-the numbers each PR
+``MTTR_r17.json``, ``SERVE_r18.json``, ``PUSH_r19.json``,
+``COLLECTIVE_r20.json``) carry the numbers each PR
 was accepted on — but nothing re-checked them: a later PR regenerating
 an artifact with a worse number (a peer-served ratio under its gate, a
 speedup that quietly halved, a duplicate-fetch ratio creeping off zero)
@@ -151,6 +151,33 @@ CHECKS: dict[str, list[tuple[str, str, object, str]]] = {
          "the hot-swapped tree is no longer byte-identical to cold"),
         ("tensors_reused", "ge", 1,
          "the per-tensor short-circuit reused nothing"),
+    ],
+    "COLLECTIVE_r20.json": [
+        ("gates/all_ok", "truthy", None,
+         "recorded transport-split gate block flipped false"),
+        ("gates/digest_identical", "truthy", None,
+         "a byte-exact backend (wire/split) stopped reconstructing "
+         "source-identical digests on every host"),
+        ("lossy/speedup_vs_wire", "ge", 1.2,
+         "the lossy cross-slice tier no longer beats the byte-exact "
+         "wire >=1.2x under WAN-class DCN shaping (recorded 1.4x)"),
+        ("lossy/bits_saved_ratio", "ge", 0.5,
+         "the ZQLS int8 tier stopped saving at least half the bytes "
+         "on the payloads it quantizes (recorded 0.73)"),
+        ("gates/lossy_cache_untouched", "truthy", None,
+         "lossy units stopped landing in the HBM staging overlay"),
+        ("gates/peer_served_ratio_equal", "truthy", None,
+         "the lossy leg's peer-served ratio diverged from the wire "
+         "leg — the speedup is no longer like-for-like"),
+        ("gates/split_used_ici_lane", "truthy", None,
+         "the jax backend moved zero intra-slice bytes through the "
+         "ICI lane — the split quietly degraded to all-wire"),
+        ("gates/preadv_identity", "truthy", None,
+         "the preadv decode lane stopped being byte-identical"),
+        ("gates/preadv_engaged", "truthy", None,
+         "the preadv lane disengaged (zero stored-scheme terms)"),
+        ("legs/lossy/fallbacks", "eq", 0,
+         "lossy-leg units fell back to CDN in the clean shaped run"),
     ],
     "PUSH_r19.json": [
         ("gates/all_ok", "truthy", None,

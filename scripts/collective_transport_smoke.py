@@ -1,0 +1,243 @@
+"""CI smoke for the transport/schedule split (ISSUE 20).
+
+A 4-host, two-slice (``0,0,1,1``) in-process exchange — every host's
+plan share pre-warmed, loopback DCN servers AND the loopback fabric
+registered under the same addresses — runs once per exchange backend
+(``ZEST_COLLECTIVE_BACKEND`` = ``dcn`` / ``loopback`` / ``jax``) and
+asserts, per backend:
+
+- the round completes collectively: no abort, zero exchange fallbacks,
+  zero per-unit round trips;
+- **digest identity in byte-exact mode**: every file reconstructs on
+  every host, from that host's own cache with NO bridge (a missing
+  unit fails loudly instead of healing from the CDN), to the same
+  sha256 the fixture was generated with — the transport swap must
+  never change a byte;
+- the stats schema keeps the restore-pre-split pin: ``backend`` only
+  appears off the default, never ``lossy``.
+
+Then the degradation leg: with ``dcn_reset:1.0`` installed, the SAME
+round on the jax backend must abort the collective mid-phase and walk
+the PR-6 ladder (point-to-point also resets, the CDN waterfall heals)
+— the fault fires, the collective stats record the abort, and every
+file STILL lands byte-identical.
+
+Exit 0 on success; prints the offending stats block otherwise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tests"))
+
+N_HOSTS = 4
+TOPOLOGY = "0,0,1,1"
+REPO_ID = "smoke/transport-split"
+BACKENDS = ("dcn", "loopback", "jax")
+
+
+def main() -> int:
+    import numpy as np
+
+    from fixtures import FixtureHub, FixtureRepo
+    from zest_tpu import faults
+    from zest_tpu.cas.hub import HubClient
+    from zest_tpu.config import Config, parse_topology
+    from zest_tpu.models.direct import CachedFileReader
+    from zest_tpu.transfer import transport
+    from zest_tpu.transfer.bridge import XetBridge
+    from zest_tpu.transfer.coop import CoopPlan, coop_round
+    from zest_tpu.transfer.dcn import DcnServer
+    from zest_tpu.transfer.federated import warm_units_parallel
+
+    rng = np.random.default_rng(21)
+    files = {
+        "shard0.f32.bin":
+            rng.standard_normal(1_000_000).astype("<f4").tobytes(),
+        "blob.bin": rng.bytes(2_000_000),
+    }
+    source_sha = {k: hashlib.sha256(v).hexdigest()
+                  for k, v in files.items()}
+    repo = FixtureRepo(REPO_ID, files, chunks_per_xorb=4)
+    topo = parse_topology(TOPOLOGY)
+
+    def fail(msg: str, blob=None) -> int:
+        print(f"TRANSPORT SMOKE FAILED: {msg}", file=sys.stderr)
+        if blob is not None:
+            print(json.dumps(blob, indent=2, default=str),
+                  file=sys.stderr)
+        return 1
+
+    def run_round(hub, rootp, tag: str, backend: str):
+        """One prewarmed 4-host collective round on ``backend``;
+        returns (per-host stats, per-host digest-ok, hosts)."""
+        transport.reset_loopback()
+        hosts = []
+        for i in range(N_HOSTS):
+            cfg = Config(hf_home=rootp / f"{tag}{i}/hf",
+                         cache_dir=rootp / f"{tag}{i}/zest",
+                         hf_token="hf_test", endpoint=hub.url,
+                         dcn_port=0, coop_collective=True,
+                         coop_topology=topo,
+                         collective_backend=backend)
+            bridge = XetBridge(cfg)
+            bridge.authenticate(REPO_ID)
+            recs = [bridge.get_reconstruction(e.xet_hash)
+                    for e in HubClient(cfg).list_files(REPO_ID)
+                    if e.is_xet]
+            hosts.append((bridge, recs))
+        servers, addrs = [], {}
+        for i, (bridge, _recs) in enumerate(hosts):
+            s = DcnServer(bridge.cfg, bridge.cache)
+            addrs[i] = ("127.0.0.1", s.start())
+            servers.append(s)
+            transport.register_loopback(addrs[i], bridge.cfg,
+                                        bridge.cache)
+        try:
+            def warm(i):
+                bridge, recs = hosts[i]
+                plan = CoopPlan.build(recs, N_HOSTS)
+                warm_units_parallel(bridge, recs,
+                                    units=plan.for_host(i))
+
+            ws = [threading.Thread(target=warm, args=(i,))
+                  for i in range(N_HOSTS)]
+            for t in ws:
+                t.start()
+            for t in ws:
+                t.join()
+
+            results: list[dict | None] = [None] * N_HOSTS
+            errs: list[str] = []
+
+            def run(i):
+                bridge, recs = hosts[i]
+                try:
+                    results[i] = coop_round(bridge, recs, i, N_HOSTS,
+                                            addrs, server=servers[i])
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(f"host {i}: {exc!r}")
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(N_HOSTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                return None, errs, hosts
+
+            digests_ok = True
+            for i, (bridge, recs) in enumerate(hosts):
+                entries = [e for e in
+                           HubClient(bridge.cfg).list_files(REPO_ID)
+                           if e.is_xet]
+                for e in entries:
+                    rec = bridge.get_reconstruction(e.xet_hash)
+                    reader = CachedFileReader(bridge.cache, rec)
+                    sha = hashlib.sha256(
+                        reader.read(0, reader.size)).hexdigest()
+                    if sha != source_sha[e.path]:
+                        digests_ok = False
+                        errs.append(f"host {i}: {e.path} digest "
+                                    "mismatch from own cache")
+            return results, (digests_ok, errs), hosts
+        finally:
+            for s in servers:
+                s.shutdown()
+            transport.reset_loopback()
+
+    with FixtureHub(repo) as hub, tempfile.TemporaryDirectory() as root:
+        rootp = pathlib.Path(root)
+
+        # — Per-backend conformance: same round, three transports. —
+        for backend in BACKENDS:
+            faults.install(None)
+            results, (digests_ok, errs), hosts = run_round(
+                hub, rootp, f"b_{backend}", backend)
+            for b, _r in hosts:
+                b.close()
+            if results is None:
+                return fail(f"[{backend}] round raised", errs)
+            done = [r for r in results if r]
+            if len(done) != N_HOSTS:
+                return fail(f"[{backend}] only {len(done)}/{N_HOSTS} "
+                            "hosts completed", results)
+            for i, r in enumerate(done):
+                cx = r.get("collective")
+                if not cx:
+                    return fail(f"[{backend}] host {i} ran without "
+                                "the collective schedule", r)
+                if cx.get("aborted"):
+                    return fail(f"[{backend}] host {i} aborted the "
+                                "clean round", cx)
+                if cx["unit_round_trips"] != 0:
+                    return fail(f"[{backend}] host {i} re-grew "
+                                "per-unit round trips", cx)
+                if r["fallbacks"] != 0:
+                    return fail(f"[{backend}] host {i} fell back on "
+                                "the healthy path", r)
+                if "lossy" in cx:
+                    return fail(f"[{backend}] lossy armed without "
+                                "opt-in", cx)
+                want = None if backend == "dcn" else backend
+                if cx.get("backend") != want:
+                    return fail(f"[{backend}] stats backend pin "
+                                f"broken (got {cx.get('backend')!r}, "
+                                f"want {want!r})", cx)
+            if not digests_ok:
+                return fail(f"[{backend}] digest identity broken",
+                            errs)
+            ratio = min(r["peer_served_ratio"] for r in done)
+            print(f"[{backend}] ok: 4-host round collective, "
+                  f"peer_served_ratio>={ratio}, digests identical "
+                  "on every host from its own cache")
+
+        # — Degradation: dcn_reset:1.0 on the jax backend must abort
+        #   the collective and heal down the PR-6 ladder to CDN. —
+        faults.install("dcn_reset:1.0", 1337)
+        try:
+            results, (digests_ok, errs), hosts = run_round(
+                hub, rootp, "chaos", "jax")
+            fired = dict(faults.counters())
+        finally:
+            faults.install(None)
+        for b, _r in hosts:
+            b.close()
+        if results is None:
+            return fail("chaos leg raised instead of degrading", errs)
+        if not fired.get("dcn_reset"):
+            return fail("chaos leg: dcn_reset never fired", fired)
+        aborted = sum(1 for r in results
+                      if r and r.get("collective", {}).get("aborted"))
+        healed = sum(r["fallbacks"] for r in results if r)
+        if not aborted:
+            return fail("chaos leg: no host recorded a collective "
+                        "abort", results)
+        if not healed:
+            return fail("chaos leg: nothing walked the fallback "
+                        "ladder", results)
+        if not digests_ok:
+            return fail("chaos leg: ladder healed to wrong bytes",
+                        errs)
+        print(f"chaos ok: dcn_reset fired {fired['dcn_reset']}x, "
+              f"{aborted} host(s) aborted the collective, "
+              f"{healed} unit(s) healed down the ladder, digests "
+              "identical")
+
+    print("transport smoke OK: dcn/loopback/jax rounds digest-"
+          "identical; jax degrades down the PR-6 ladder under "
+          "dcn_reset")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

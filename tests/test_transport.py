@@ -1,0 +1,720 @@
+"""Transport/schedule split + lossy tier (transfer.transport,
+transfer.lossy; ISSUE 20).
+
+Covers the ISSUE-20 acceptance surface:
+
+- the shared transport-conformance suite, run against all three
+  backends (dcn wire, in-process loopback, jax ICI lanes): tagged
+  window round-trip with byte-identical payloads, NOT_FOUND for
+  unknown hashes, abort on a mid-phase ``dcn_reset``, clock-offset
+  reporting, and plan-fingerprint lane agreement for the jax backend;
+- the ``ZEST_COLLECTIVE_BACKEND=dcn`` restore-pre-split pin: the
+  round stats schema is bit-for-bit PR-13's (no ``backend`` key, the
+  exact exchange key set) and every window the transport issues hits
+  ``DcnPool.request_many`` with exactly the pre-split argument shape
+  (no ``flags`` kwarg) — plus a golden-bytes pin on the default
+  REQUEST wire encoding;
+- strict env parsing for both knobs (typos raise, never fall back);
+- the ZQLS lossy codec: bounded per-block quantization error,
+  declines on non-float/already-byte-cheap blobs, exact_len
+  round-trip;
+- the lossy serving tier: byte-exact by default, quantizes fresh
+  cache data only when invited (FLAG_QUANT_OK), forwards a staged
+  container only to a requester that opted in (FLAG_LOSSY_OK);
+- lossy end-to-end: a cross-slice round lands quantized payloads
+  HBM-only (staging populated, not one ZQLS byte in the xorb cache),
+  reports ``lossy_bytes``/``bits_saved_ratio``, bounds the landed
+  float error, and byte-exact needs refetch through the verified
+  waterfall;
+- the preadv cold-read lane: batched stored-scheme reads land bytes
+  identical to the decode path, and the lane actually engages.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from fixtures import FixtureHub, FixtureRepo
+
+from zest_tpu import faults
+from zest_tpu.cas import hashing
+from zest_tpu.cas.hub import HubClient
+from zest_tpu.config import Config
+from zest_tpu.models.direct import CachedFileReader, DirectLandingError
+from zest_tpu.transfer import lossy
+from zest_tpu.transfer.coop import CoopPlan, coop_round
+from zest_tpu.transfer.dcn import (
+    FLAG_LOSSY,
+    FLAG_LOSSY_OK,
+    FLAG_QUANT_OK,
+    DcnNotFound,
+    DcnPool,
+    DcnRequest,
+    DcnResponse,
+    DcnServer,
+    encode_message,
+    serve_chunk_range,
+)
+from zest_tpu.transfer.federated import warm_units_parallel
+from zest_tpu.transfer.transport import (
+    LINK_ICI,
+    TransportUnavailable,
+    make_transport,
+    register_loopback,
+    reset_loopback,
+)
+
+REPO_ID = "acme/transport-model"
+
+# weights.bin: random-normal float32 — BG4-compressible, the shape the
+# lossy tier targets. blob.bin: incompressible bytes — every chunk
+# lands stored-scheme (Scheme.NONE), the shape the preadv lane
+# targets. config.json: the tiny non-float file that must always ship
+# byte-exact.
+_RNG = np.random.default_rng(11)
+_FLOATS = _RNG.standard_normal(300_000).astype("<f4")
+FILES = {
+    "config.json": b'{"model_type": "transport"}',
+    "weights.bin": _FLOATS.tobytes(),
+    "blob.bin": _RNG.bytes(1_200_000),
+}
+
+BACKENDS = ("dcn", "loopback", "jax")
+
+# The PR-6/PR-13 pinned stats schema (test_collective pins the
+# knob-off variant; the dcn-backend pin below must match it exactly).
+_TOP_KEYS = {"host", "hosts", "trace_id", "plan", "fetch", "exchange",
+             "fallbacks", "own_server", "peer_served_ratio",
+             "elapsed_s", "clock_offsets"}
+_EX_KEYS = {"units", "wire_bytes", "unpacked_bytes", "fallback_units",
+            "fallback_bytes", "verify_rejected", "retries",
+            "budget_bytes", "inflight_peak_bytes"}
+
+
+@pytest.fixture(scope="module")
+def hub():
+    repo = FixtureRepo(REPO_ID, FILES, chunks_per_xorb=2)
+    with FixtureHub(repo) as h:
+        yield h
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    reset_loopback()
+    lossy.reset_stagings()
+    yield
+    faults.reset()
+    reset_loopback()
+    lossy.reset_stagings()
+
+
+def _bridge(hub, root, **cfg_kwargs):
+    from zest_tpu.transfer.bridge import XetBridge
+
+    cfg = Config(hf_home=root / "hf", cache_dir=root / "zest",
+                 hf_token="hf_test", endpoint=hub.url, dcn_port=0,
+                 **cfg_kwargs)
+    b = XetBridge(cfg)
+    b.authenticate(REPO_ID)
+    return b
+
+
+def _recs(bridge):
+    return [bridge.get_reconstruction(e.xet_hash)
+            for e in HubClient(bridge.cfg).list_files(REPO_ID)
+            if e.is_xet]
+
+
+def _rec_for(bridge, path):
+    for e in HubClient(bridge.cfg).list_files(REPO_ID):
+        if e.is_xet and e.path == path:
+            return bridge.get_reconstruction(e.xet_hash)
+    raise AssertionError(f"no xet file {path}")
+
+
+def _units(rec):
+    out = []
+    for hh, entries in rec.fetch_info.items():
+        for fi in entries:
+            out.append((hh, fi))
+    return out
+
+
+# ── Shared conformance fixture: one fully-warmed owner host, served
+# over a real DCN socket AND registered in the loopback fabric under
+# the same address, so every backend answers the same windows. ──
+
+
+@pytest.fixture
+def owner(hub, tmp_path):
+    b = _bridge(hub, tmp_path / "owner")
+    recs = _recs(b)
+    warm_units_parallel(b, recs)
+    plan = CoopPlan.build(recs, 2)
+    server = DcnServer(b.cfg, b.cache)
+    addr = ("127.0.0.1", server.start())
+    register_loopback(addr, b.cfg, b.cache)
+    yield b, recs, plan, addr
+    server.shutdown()
+    b.close()
+
+
+def _wants(bridge, rec, k=3):
+    """(hash, start, end) triples for ``rec``'s first ``k`` units,
+    with the expected byte-exact serve for each."""
+    wants, expect = [], []
+    for hh, fi in _units(rec)[:k]:
+        wants.append((hashing.hex_to_hash(hh), fi.range.start,
+                      fi.range.end))
+        found = serve_chunk_range(bridge.cfg, bridge.cache,
+                                  hashing.hex_to_hash(hh),
+                                  fi.range.start, fi.range.end)
+        assert found is not None, "owner cache must be warm"
+        expect.append(found)
+    return wants, expect
+
+
+# ── Transport conformance (one suite, all three backends) ──
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_tagged_window_roundtrip(hub, owner, backend):
+    b, _recs_, plan, addr = owner
+    pool = DcnPool()
+    try:
+        t = make_transport(backend, pool, plan=plan)
+        assert t.name == backend
+        wants, expect = _wants(b, _rec_for(b, "weights.bin"))
+        wants.append((b"\xab" * 32, 0, 1))  # unknown hash → NOT_FOUND
+        link = LINK_ICI if backend == "jax" else "dcn"
+        tag = t.window_tag()
+        assert 0 < tag <= 0xFFFF
+        replies = t.request_window(0, addr, wants, timeout=10.0,
+                                   tag=tag, link=link)
+        assert len(replies) == len(wants)
+        for reply, (off, blob, flags) in zip(replies, expect):
+            assert isinstance(reply, DcnResponse), reply
+            assert reply.chunk_offset == off
+            assert reply.data == blob, "payload must survive the lane"
+            assert reply.flags == flags == 0
+        assert isinstance(replies[-1], DcnNotFound)
+        c = t.counters
+        assert c["tagged_windows"] >= 1
+        assert c["untagged_windows"] == 0
+        assert c["requests"] >= len(wants)
+        if backend == "jax":
+            assert c["ici_windows"] == 1
+            assert c["ici_lane_bytes"] > 0
+            assert c["ici_lane_bytes"] % t.lane_bytes == 0
+            assert c["lane_overflows"] == 0
+    finally:
+        pool.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_abort_mid_phase_raises_connection_error(hub, owner, backend):
+    b, _recs_, plan, addr = owner
+    faults.install("dcn_reset:1.0", seed=1)
+    pool = DcnPool()
+    try:
+        t = make_transport(backend, pool, plan=plan)
+        wants, _ = _wants(b, _rec_for(b, "weights.bin"), k=1)
+        link = LINK_ICI if backend == "jax" else "dcn"
+        with pytest.raises((ConnectionError, TimeoutError, OSError)):
+            t.request_window(0, addr, wants, timeout=5.0,
+                             tag=t.window_tag(), link=link)
+    finally:
+        pool.close()
+    assert faults.counters().get("dcn_reset", 0) > 0
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_clock_offsets_shape(hub, owner, backend):
+    b, _recs_, plan, addr = owner
+    pool = DcnPool()
+    try:
+        t = make_transport(backend, pool, plan=plan)
+        wants, _ = _wants(b, _rec_for(b, "weights.bin"), k=1)
+        t.request_window(0, addr, wants, timeout=10.0,
+                         tag=t.window_tag(),
+                         link="dcn" if backend != "jax" else LINK_ICI)
+        offs = t.clock_offsets()
+        assert isinstance(offs, dict)
+        if backend == "dcn":
+            # the wire backend dialed a v2 channel → one offset sample
+            assert offs, "dcn backend must report peer clock offsets"
+            for row in offs.values():
+                assert isinstance(row["offset_s"], float)
+                assert isinstance(row["rtt_s"], float)
+    finally:
+        pool.close()
+
+
+def test_jax_lane_width_agrees_across_hosts(hub, owner):
+    """The lane width is a pure function of the fingerprint-identical
+    plan: two hosts building plans from independently-ordered recs
+    compile the same lane shape with zero negotiation."""
+    b, recs, _plan, _addr = owner
+    pool = DcnPool()
+    try:
+        t1 = make_transport("jax", pool, plan=CoopPlan.build(recs, 4))
+        t2 = make_transport(
+            "jax", pool, plan=CoopPlan.build(list(reversed(recs)), 4))
+        assert t1.lane_bytes == t2.lane_bytes
+        assert t1.lane_bytes % (64 * 1024) == 0
+        biggest = max(fi.url_range_end - fi.url_range_start
+                      for _k, fi in CoopPlan.build(recs, 4).units)
+        assert t1.lane_bytes >= biggest
+    finally:
+        pool.close()
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(TransportUnavailable):
+        make_transport("carrier-pigeon", None)
+
+
+# ── End-to-end rounds per backend ──
+
+
+def _run_hosts(hub, tmp_path, n, pools=None, fabric=True, **cfg_kwargs):
+    """n concurrent in-process hosts, each with its own cache, DCN
+    server, and (when ``fabric``) a loopback registration under the
+    same address — so dcn/loopback/jax backends all resolve."""
+    bridges, servers, addrs = [], [], {}
+    for i in range(n):
+        b = _bridge(hub, tmp_path / f"h{i}", **cfg_kwargs)
+        bridges.append(b)
+        s = DcnServer(b.cfg, b.cache)
+        addrs[i] = ("127.0.0.1", s.start())
+        servers.append(s)
+        if fabric:
+            register_loopback(addrs[i], b.cfg, b.cache)
+    results: list = [None] * n
+    errors: list = []
+
+    def run(i):
+        try:
+            kwargs = {}
+            if pools and i in pools:
+                kwargs["dcn_pool"] = pools[i]
+            results[i] = coop_round(bridges[i], _recs(bridges[i]), i, n,
+                                    addrs, server=servers[i], **kwargs)
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for s in servers:
+        s.shutdown()
+    assert not errors, errors
+    return bridges, results
+
+
+def _assert_fully_cached(bridge, root):
+    before = bridge.stats.bytes_from_cdn
+    for e in HubClient(bridge.cfg).list_files(REPO_ID):
+        if e.is_xet:
+            out = root / "check.bin"
+            bridge.reconstruct_to_file(e.xet_hash, out)
+            assert out.read_bytes() == FILES[e.path]
+    assert bridge.stats.bytes_from_cdn == before, \
+        "reconstruction hit CDN: cache incomplete after the round"
+
+
+@pytest.mark.parametrize("backend", ["loopback", "jax"])
+def test_collective_round_end_to_end_per_backend(hub, tmp_path, backend):
+    bridges, results = _run_hosts(hub, tmp_path, 4,
+                                  collective_backend=backend)
+    for i, (b, r) in enumerate(zip(bridges, results)):
+        cx = r.get("collective")
+        assert cx, r
+        assert cx["backend"] == backend, cx
+        assert "aborted" not in cx, cx
+        assert "lossy" not in cx
+        assert r["fallbacks"] == 0, r
+        assert r["exchange"]["units"] > 0
+        assert sum(cx["link_bytes"].values()) \
+            == r["exchange"]["wire_bytes"]
+        _assert_fully_cached(b, tmp_path / f"h{i}")
+
+
+# ── The restore-pre-split pin (ZEST_COLLECTIVE_BACKEND=dcn) ──
+
+
+class _SpyPool(DcnPool):
+    """Records the exact keyword shape of every window call — the
+    pre-split transport called ``request_many(host, port, wants,
+    timeout=..., tag=...)`` and nothing else; any extra kwarg (flags)
+    would change wire bytes for default-mode rounds."""
+
+    def __init__(self):
+        super().__init__()
+        self.window_kwargs: list[dict] = []
+
+    def request_many(self, host, port, wants, **kwargs):
+        self.window_kwargs.append(dict(kwargs))
+        return super().request_many(host, port, wants, **kwargs)
+
+
+def test_dcn_backend_restores_pre_split_exchange(hub, tmp_path):
+    """Default backend: stats schema bit-for-bit PR-13's (no backend
+    or lossy keys anywhere, exact key sets) and every collective
+    window reaches the pool with exactly the pre-split call shape."""
+    spies = {i: _SpyPool() for i in range(2)}
+    try:
+        bridges, results = _run_hosts(hub, tmp_path, 2, pools=spies,
+                                      fabric=False)
+        for i, (b, r) in enumerate(zip(bridges, results)):
+            assert set(r) == _TOP_KEYS | {"collective"}, sorted(r)
+            assert set(r["exchange"]) == _EX_KEYS, sorted(r["exchange"])
+            cx = r["collective"]
+            assert "backend" not in cx, cx
+            assert "lossy" not in cx, cx
+            assert "aborted" not in cx, cx
+            _assert_fully_cached(b, tmp_path / f"h{i}")
+        for i, spy in spies.items():
+            assert spy.window_kwargs, f"host {i} issued no windows"
+            for kw in spy.window_kwargs:
+                assert set(kw) == {"timeout", "tag"}, kw
+                assert kw["tag"], "pre-split windows were all tagged"
+    finally:
+        for spy in spies.values():
+            spy.close()
+
+
+def test_default_request_wire_bytes_pinned():
+    """Golden bytes: a default (flags=0) REQUEST encodes identically
+    to the pre-ISSUE-20 header — the u8 the flag bits ride stays 0."""
+    h = bytes(range(32))
+    req = DcnRequest(7, h, 3, 9, tag=5)
+    body = struct.pack("<32sQQ", h, 3, 9)
+    assert encode_message(req) == \
+        struct.pack("<BBHII", 1, 0, 5, 7, len(body)) + body
+    resp = DcnResponse(7, 42, b"abc")
+    assert encode_message(resp) == \
+        struct.pack("<BBHII", 2, 0, 0, 7, 8 + 3) \
+        + struct.pack("<Q", 42) + b"abc"
+
+
+# ── Strict env parsing (satellite: typos raise) ──
+
+
+def _env(tmp_path, **extra):
+    base = {"HF_HOME": str(tmp_path / "hf"),
+            "ZEST_CACHE_DIR": str(tmp_path / "zest")}
+    base.update(extra)
+    return base
+
+
+def test_collective_env_defaults(tmp_path):
+    cfg = Config.load(env=_env(tmp_path))
+    assert cfg.collective_backend == "dcn"
+    assert cfg.collective_lossy == "0"
+
+
+@pytest.mark.parametrize("value", ["dcn", "jax", "loopback"])
+def test_collective_backend_env_values(tmp_path, value):
+    cfg = Config.load(env=_env(tmp_path,
+                               ZEST_COLLECTIVE_BACKEND=value))
+    assert cfg.collective_backend == value
+
+
+@pytest.mark.parametrize("value", ["0", "dcn", "wan"])
+def test_collective_lossy_env_values(tmp_path, value):
+    cfg = Config.load(env=_env(tmp_path, ZEST_COLLECTIVE_LOSSY=value))
+    assert cfg.collective_lossy == value
+
+
+@pytest.mark.parametrize("knob,bad", [
+    ("ZEST_COLLECTIVE_BACKEND", "jxa"),
+    ("ZEST_COLLECTIVE_BACKEND", "DCN"),
+    ("ZEST_COLLECTIVE_BACKEND", "1"),
+    ("ZEST_COLLECTIVE_LOSSY", "yes"),
+    ("ZEST_COLLECTIVE_LOSSY", "dcn,wan"),
+    ("ZEST_COLLECTIVE_LOSSY", "lossy"),
+])
+def test_collective_env_typos_raise(tmp_path, knob, bad):
+    with pytest.raises(ValueError):
+        Config.load(env=_env(tmp_path, **{knob: bad}))
+
+
+# ── ZQLS codec ──
+
+
+def _float_frames(n_chunks=3, chunk_vals=16384, seed=3):
+    from zest_tpu.cas.xorb import encode_frame
+
+    rng = np.random.default_rng(seed)
+    frames, raws = [], []
+    for _ in range(n_chunks):
+        raw = rng.standard_normal(chunk_vals).astype("<f4").tobytes()
+        frame, _h = encode_frame(raw)
+        frames.append(frame)
+        raws.append(raw)
+    return b"".join(frames), raws
+
+
+def test_quantize_roundtrip_bounded_error():
+    from zest_tpu.cas.xorb import XorbReader
+
+    blob, raws = _float_frames()
+    container = lossy.quantize_blob(blob)
+    assert container is not None
+    assert lossy.is_lossy_container(container)
+    assert not lossy.is_lossy_container(blob)
+    assert len(container) < len(blob) * 0.5, \
+        "int8+scales must beat BG4 on random floats by ~2x+"
+    assert lossy.exact_len(container) == len(blob)
+
+    out = lossy.dequantize_blob(container)
+    reader = XorbReader(out)
+    assert len(reader) == len(raws)
+    for i, raw in enumerate(raws):
+        got = np.frombuffer(reader.extract_chunk(i, verify=False),
+                            dtype="<f4")
+        want = np.frombuffer(raw, dtype="<f4")
+        assert got.shape == want.shape
+        # per-block bound: chunks start block-aligned, so each
+        # 256-value block's error is <= absmax(block)/127
+        for s in range(0, want.size, lossy.BLOCK_VALUES):
+            blk = slice(s, s + lossy.BLOCK_VALUES)
+            bound = np.max(np.abs(want[blk])) / 127.0 + 1e-6
+            assert np.max(np.abs(got[blk] - want[blk])) <= bound
+
+
+def test_quantize_declines_non_float_blobs():
+    from zest_tpu.cas.xorb import encode_frame
+
+    # stored-scheme chunk (incompressible bytes): nothing to quantize
+    frame, _h = encode_frame(np.random.default_rng(5).bytes(100_000))
+    assert lossy.quantize_blob(frame) is None
+    # LZ4 text chunk: compressible but not BG4 → decline
+    frame2, _h2 = encode_frame(b'{"k": 1}' * 20_000)
+    assert lossy.quantize_blob(frame2) is None
+    # garbage that doesn't parse as frames
+    assert lossy.quantize_blob(b"\xff" * 64) is None
+    with pytest.raises(ValueError):
+        lossy.dequantize_blob(b"not a container")
+
+
+def test_staging_registry_and_rebase(tmp_path):
+    st = lossy.staging_for(tmp_path / "zest")
+    assert st is lossy.staging_for(tmp_path / "zest")
+    assert st is not lossy.staging_for(tmp_path / "other")
+    blob, _raws = _float_frames(n_chunks=1)
+    container = lossy.quantize_blob(blob)
+    st.put("ab" * 32, 4, container)
+    assert st.units() == 1 and st.total_bytes() == len(container)
+    got = st.get_with_range("ab" * 32, 6)  # rebase: offset 4 covers 6
+    assert got == (container, 4)
+    assert st.get_with_range("ab" * 32, 2) is None
+    lossy.reset_stagings()
+    assert lossy.staging_for(tmp_path / "zest").units() == 0
+
+
+# ── Lossy serving tier (serve_chunk_range decision tree) ──
+
+
+def test_serve_byte_exact_by_default_and_quantizes_on_invite(hub, owner):
+    b, _recs_, _plan, _addr = owner
+    hh, fi = _units(_rec_for(b, "weights.bin"))[0]
+    h = hashing.hex_to_hash(hh)
+    off, blob, flags = serve_chunk_range(
+        b.cfg, b.cache, h, fi.range.start, fi.range.end)
+    assert flags == 0 and not lossy.is_lossy_container(blob)
+    # LOSSY_OK alone must NOT quantize fresh cache data
+    off2, blob2, flags2 = serve_chunk_range(
+        b.cfg, b.cache, h, fi.range.start, fi.range.end, FLAG_LOSSY_OK)
+    assert (off2, blob2, flags2) == (off, blob, 0)
+    # QUANT_OK invites quantization of the byte-exact cache hit
+    off3, blob3, flags3 = serve_chunk_range(
+        b.cfg, b.cache, h, fi.range.start, fi.range.end,
+        FLAG_LOSSY_OK | FLAG_QUANT_OK)
+    assert off3 == off
+    assert flags3 & FLAG_LOSSY
+    assert lossy.is_lossy_container(blob3)
+    assert len(blob3) < len(blob)
+    assert lossy.exact_len(blob3) == len(blob)
+    # non-float payloads stay byte-exact even when invited
+    ch, cfi = _units(_rec_for(b, "blob.bin"))[0]
+    _o, cblob, cflags = serve_chunk_range(
+        b.cfg, b.cache, hashing.hex_to_hash(ch), cfi.range.start,
+        cfi.range.end, FLAG_LOSSY_OK | FLAG_QUANT_OK)
+    assert cflags == 0 and not lossy.is_lossy_container(cblob)
+
+
+def test_serve_forwards_staged_container_only_on_opt_in(hub, owner,
+                                                       tmp_path):
+    """Store-and-forward: a host holding only a staged (lossy) copy
+    serves the container VERBATIM — no re-quantization compounding —
+    and only to a requester that advertised FLAG_LOSSY_OK."""
+    b, _recs_, _plan, _addr = owner
+    hh, fi = _units(_rec_for(b, "weights.bin"))[0]
+    h = hashing.hex_to_hash(hh)
+    off, container, flags = serve_chunk_range(
+        b.cfg, b.cache, h, fi.range.start, fi.range.end,
+        FLAG_LOSSY_OK | FLAG_QUANT_OK)
+    assert flags & FLAG_LOSSY
+
+    puller = _bridge(hub, tmp_path / "staged-only")
+    try:
+        lossy.staging_for(puller.cfg.cache_dir).put(hh, off, container)
+        # cache miss + no opt-in → NOT_FOUND (never a surprise lossy)
+        assert serve_chunk_range(puller.cfg, puller.cache, h,
+                                 fi.range.start, fi.range.end) is None
+        got = serve_chunk_range(puller.cfg, puller.cache, h,
+                                fi.range.start, fi.range.end,
+                                FLAG_LOSSY_OK)
+        assert got is not None
+        g_off, g_blob, g_flags = got
+        assert g_flags & FLAG_LOSSY
+        assert g_off == off and g_blob == container, \
+            "staged containers must forward byte-verbatim"
+    finally:
+        puller.close()
+
+
+# ── Lossy end-to-end (cross-slice round, HBM-only admission) ──
+
+
+def test_lossy_round_lands_hbm_only(hub, tmp_path):
+    """2 hosts in different slices (every exchange link is dcn) with
+    ZEST_COLLECTIVE_LOSSY=dcn: float payloads cross quantized and land
+    in the staging overlay only; the xorb cache stays merkle-pure; the
+    stats ledger reports the saved bits; landed floats are within the
+    quantization bound; byte-exact needs heal through the waterfall."""
+    bridges, results = _run_hosts(hub, tmp_path, 2, fabric=False,
+                                  coop_topology=(0, 1),
+                                  collective_lossy="dcn")
+    want = np.frombuffer(FILES["weights.bin"], dtype="<f4")
+    for i, (b, r) in enumerate(zip(bridges, results)):
+        cx = r["collective"]
+        assert cx["lossy"] == "dcn", cx
+        assert "aborted" not in cx, cx
+        ex = r["exchange"]
+        assert set(ex) == _EX_KEYS | {"lossy_bytes",
+                                      "bits_saved_ratio"}, sorted(ex)
+        assert ex["lossy_bytes"] > 0
+        assert 0.0 < ex["bits_saved_ratio"] < 1.0
+        # lossy payloads landed in the staging overlay...
+        st = lossy.staging_for(b.cfg.cache_dir)
+        assert st.units() > 0 and st.total_bytes() > 0
+        # ...and not one ZQLS byte entered the merkle-verified cache
+        xorb_dir = b.cfg.cache_dir / "xorbs"
+        cached = [p for p in xorb_dir.rglob("*") if p.is_file()]
+        assert cached, "own share must still be cached byte-exact"
+        for p in cached:
+            assert not lossy.is_lossy_container(p.read_bytes()), p
+
+        # HBM-landing view: the lossy overlay serves the foreign share
+        # within the per-block quantization bound
+        rec = _rec_for(b, "weights.bin")
+        reader = CachedFileReader(b.cache, rec, allow_lossy=True)
+        got = np.frombuffer(reader.read(0, len(FILES["weights.bin"])),
+                            dtype="<f4")
+        assert got.shape == want.shape
+        err = np.abs(got - want)
+        assert np.max(err) <= np.max(np.abs(want)) / 127.0 + 1e-6
+        assert np.any(err > 0), "the lossy tier never engaged"
+
+        # without the overlay the foreign share is simply not there —
+        # a byte-exact read must go through the verified waterfall
+        strict = CachedFileReader(b.cache, rec)
+        with pytest.raises(DirectLandingError):
+            strict.read(0, len(FILES["weights.bin"]))
+        before = b.stats.bytes_from_cdn
+        healed = CachedFileReader(b.cache, rec, bridge=b)
+        assert healed.read(0, len(FILES["weights.bin"])) \
+            == FILES["weights.bin"]
+        assert b.stats.bytes_from_cdn > before, \
+            "byte-exact heal must refetch, not trust lossy bytes"
+        # non-float files crossed byte-exact, no heal needed
+        blob_reader = CachedFileReader(b.cache,
+                                       _rec_for(b, "blob.bin"))
+        assert blob_reader.read(0, len(FILES["blob.bin"])) \
+            == FILES["blob.bin"]
+
+
+# ── preadv cold-read lane ──
+
+
+def test_preadv_lane_identity_and_engagement(hub, tmp_path):
+    b = _bridge(hub, tmp_path)
+    try:
+        warm_units_parallel(b, _recs(b))
+        rec = _rec_for(b, "blob.bin")
+        want = FILES["blob.bin"]
+
+        r1 = CachedFileReader(b.cache, rec)
+        got = r1.read(0, len(want))
+        assert got == want
+        assert r1.preadv_stats["terms"] > 0, \
+            "stored-scheme cold reads must take the preadv lane"
+        assert r1.preadv_stats["bytes"] > 0
+        assert r1.preadv_stats["syscalls"] >= 1
+
+        r2 = CachedFileReader(b.cache, rec, use_preadv=False)
+        assert r2.read(0, len(want)) == want
+        assert r2.preadv_stats == {"terms": 0, "bytes": 0,
+                                   "syscalls": 0}
+
+        # unaligned interior slice: both lanes byte-identical
+        a, z = 1234, len(want) - 777
+        assert CachedFileReader(b.cache, rec).read(a, z) == want[a:z]
+        assert CachedFileReader(b.cache, rec,
+                                use_preadv=False).read(a, z) \
+            == want[a:z]
+    finally:
+        b.close()
+
+
+def test_lossy_overlay_reader_gate(hub, tmp_path):
+    """The decode overlay honors the same trust boundary as the wire:
+    a staged container is readable only with allow_lossy=True (within
+    the quantization bound); the default reader refuses."""
+    owner_b = _bridge(hub, tmp_path / "o")
+    puller = _bridge(hub, tmp_path / "p")
+    try:
+        from zest_tpu.cas.xorb import XorbReader
+
+        warm_units_parallel(owner_b, _recs(owner_b))
+        rec = _rec_for(puller, "weights.bin")
+        st = lossy.staging_for(puller.cfg.cache_dir)
+        staged = 0
+        for hh, fi in _units(rec):
+            entry = owner_b.cache.get_with_range(hh, fi.range.start)
+            assert entry is not None
+            # re-slice so the blob starts exactly at the unit's chunk
+            # offset — partial cache entries are keyed by it
+            lo = fi.range.start - entry.chunk_offset
+            hi = fi.range.end - entry.chunk_offset
+            blob = XorbReader(entry.data).slice_range(lo, hi)
+            container = lossy.quantize_blob(blob)
+            if container is not None:
+                st.put(hh, fi.range.start, container)
+                staged += 1
+            else:
+                puller.cache.put_partial(hh, fi.range.start, blob)
+        assert staged > 0, "no weights unit quantized"
+        want = np.frombuffer(FILES["weights.bin"], dtype="<f4")
+        reader = CachedFileReader(puller.cache, rec, allow_lossy=True)
+        got = np.frombuffer(
+            reader.read(0, len(FILES["weights.bin"])), dtype="<f4")
+        assert np.max(np.abs(got - want)) \
+            <= np.max(np.abs(want)) / 127.0 + 1e-6
+        strict = CachedFileReader(puller.cache, rec)
+        with pytest.raises(DirectLandingError):
+            strict.read(0, len(FILES["weights.bin"]))
+    finally:
+        owner_b.close()
+        puller.close()

@@ -268,6 +268,22 @@ def _strict_bool(name: str, value: str) -> bool:
     return v == "1"
 
 
+def _strict_choice(env: dict[str, str], name: str, default: str,
+                   choices: tuple[str, ...]) -> str:
+    """Enumerated knob: unset/empty = ``default``; anything outside
+    ``choices`` raises (the _strict_bool typo discipline — a mistyped
+    ``ZEST_COLLECTIVE_BACKEND=jxa`` must not silently fall back to the
+    default transport)."""
+    raw = env.get(name)
+    if raw is None or not raw.strip():
+        return default
+    v = raw.strip()
+    if v not in choices:
+        raise ValueError(
+            f"{name} must be one of {'|'.join(choices)}, got {raw!r}")
+    return v
+
+
 def _expand(p: str) -> Path:
     return Path(os.path.expanduser(p))
 
@@ -392,6 +408,18 @@ class Config:
     # from the JAX runtime, else one flat slice.
     coop_collective: bool = True
     coop_topology: tuple[int, ...] | None = None
+    # Transport/schedule split (transfer.transport, ISSUE 20):
+    # ``collective_backend`` picks how phase windows move
+    # (ZEST_COLLECTIVE_BACKEND=dcn|jax|loopback, strict) — "dcn" is
+    # the pre-split pooled DcnChannel path bit-for-bit, "jax" moves
+    # intra-slice phases as device-to-device uint8 lane permutes,
+    # "loopback" is the zero-socket in-process fabric the big sims
+    # ride. ``collective_lossy`` arms the EQuARX-style quantized tier
+    # on the named link classes (ZEST_COLLECTIVE_LOSSY=dcn|wan|0,
+    # strict; "dcn" also covers wan) — lossy payloads land HBM-only
+    # and never enter the merkle-verified cache.
+    collective_backend: str = "dcn"
+    collective_lossy: str = "0"
     # Fleet topology (ISSUE 16): ``coop_pods`` is the pod id per coop
     # host (ZEST_COOP_PODS="0,0,1,1", same grammar as the slice map) —
     # names the third link class (wan, cross-pod) and arms the
@@ -630,6 +658,12 @@ class Config:
             coop_topology=(parse_topology(env["ZEST_COOP_TOPOLOGY"])
                            if env.get("ZEST_COOP_TOPOLOGY", "").strip()
                            else None),
+            collective_backend=_strict_choice(
+                env, "ZEST_COLLECTIVE_BACKEND", "dcn",
+                ("dcn", "jax", "loopback")),
+            collective_lossy=_strict_choice(
+                env, "ZEST_COLLECTIVE_LOSSY", "0",
+                ("0", "dcn", "wan")),
             coop_pods=(parse_topology(env["ZEST_COOP_PODS"])
                        if env.get("ZEST_COOP_PODS", "").strip()
                        else None),

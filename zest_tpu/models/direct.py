@@ -74,6 +74,45 @@ def _shared_decode_pool(workers: int) -> ThreadPoolExecutor | None:
         return _decode_pool
 
 
+# preadv batching limits: Linux UIO_MAXIOV is 1024 (POSIX guarantees
+# only 16, but every platform with os.preadv ships far more); holes
+# between adjacent chunk payloads are the 8-byte frame headers, so a
+# page-sized gap cap keeps runs long without reading skipped chunks.
+_IOV_MAX = 1024
+_PREADV_GAP_MAX = 4096
+
+
+def _preadv_into(fd, iovecs, offset: int) -> tuple[int, int]:
+    """Fill ``iovecs`` (writable memoryviews) from ``fd`` starting at
+    file ``offset`` — os.preadv with IOV_MAX splitting and short-read
+    resume. Returns ``(bytes_read, syscalls)``; short only when the
+    file itself is short (the caller then falls back to the decode
+    path, which attributes and heals)."""
+    want = sum(v.nbytes for v in iovecs)
+    total = 0
+    calls = 0
+    idx = 0
+    sub = 0  # bytes already filled of iovecs[idx]
+    while total < want:
+        batch = [iovecs[idx][sub:] if sub else iovecs[idx]]
+        batch.extend(iovecs[idx + 1:idx + _IOV_MAX])
+        got = os.preadv(fd, batch, offset + total)
+        calls += 1
+        if got <= 0:
+            break
+        total += got
+        while got:
+            rem = iovecs[idx].nbytes - sub
+            if got >= rem:
+                got -= rem
+                idx += 1
+                sub = 0
+            else:
+                sub += got
+                got = 0
+    return total, calls
+
+
 class CachedFileReader:
     """Random-access byte reads over a file that exists only as cached
     xorb units + a reconstruction.
@@ -91,10 +130,18 @@ class CachedFileReader:
     """
 
     def __init__(self, cache, rec: recon.Reconstruction, bridge=None,
-                 workers: int | None = None):
+                 workers: int | None = None, allow_lossy: bool = False,
+                 use_preadv: bool = True):
         self.cache = cache
         self.rec = rec
         self.bridge = bridge
+        # Lossy staging overlay (ISSUE 20): readers on the HBM landing
+        # path may consume lossy-admitted exchange containers staged
+        # beside the cache. Default OFF — file materialization and
+        # serving must stay byte-exact, so only the loader opts in.
+        self.allow_lossy = allow_lossy
+        self.use_preadv = bool(use_preadv) and hasattr(os, "preadv")
+        self.preadv_stats = {"terms": 0, "bytes": 0, "syscalls": 0}
         self._spans: list[tuple[int, int, recon.Term]] = []
         off = 0
         for t in rec.terms:
@@ -142,20 +189,54 @@ class CachedFileReader:
         if entry is None:
             entry = self.cache.get_with_range(hash_hex, range_start)
         if entry is None:
-            return None
-        reader = XorbReader(entry.data)
-        nbytes = len(entry.data)
+            got = self._lossy_reader(hash_hex, range_start)
+            if got is None:
+                return None
+            reader, chunk_offset, nbytes = got
+        else:
+            reader = XorbReader(entry.data)
+            chunk_offset = entry.chunk_offset
+            nbytes = len(entry.data)
         if self._reader_cache_cap > 0:
             with self._readers_lock:
                 if key not in self._readers:
-                    self._readers[key] = (reader, entry.chunk_offset,
+                    self._readers[key] = (reader, chunk_offset,
                                           nbytes)
                     self._readers_bytes += nbytes
                 while (self._readers_bytes > self._reader_cache_cap
                        and len(self._readers) > 1):
                     _, (_r, _o, dropped) = self._readers.popitem(last=False)
                     self._readers_bytes -= dropped
-        return reader, entry.chunk_offset
+        return reader, chunk_offset
+
+    def _lossy_reader(self, hash_hex: str, range_start: int):
+        """Reader over a lossy-staged exchange container, or None.
+
+        The collective's lossy tier (transfer.lossy) stages quantized
+        cross-slice payloads BESIDE the cache, never in it: their bytes
+        cannot match the merkle tree. Only readers constructed with
+        ``allow_lossy=True`` — the loader's device-landing path, never
+        file materialization or serving — overlay the staging, and only
+        after a genuine cache miss, so byte-exact data always wins."""
+        if not self.allow_lossy:
+            return None
+        cache_dir = getattr(getattr(self.cache, "cfg", None),
+                            "cache_dir", None)
+        if cache_dir is None:
+            return None
+        from zest_tpu.transfer import lossy
+
+        staged = lossy.staging_for(cache_dir).get_with_range(
+            hash_hex, range_start)
+        if staged is None:
+            return None
+        container, chunk_offset = staged
+        try:
+            data = lossy.dequantize_blob(container)
+        except ValueError:
+            return None  # malformed container: treat as a cache miss
+        _M_READER_EVENTS.inc(event="lossy")
+        return XorbReader(data), chunk_offset, len(data)
 
     def _drop_reader(self, hash_hex: str, range_start: int) -> None:
         """Invalidate a memoized reader whose blob failed to decode: the
@@ -255,6 +336,119 @@ class CachedFileReader:
         data = self._decode_term(i)
         dest[:] = data
         return len(data)
+
+    def _preadv_batch(self, jobs, lo: int, hi: int, view):
+        """The stored-chunk syscall lane: a term whose cached entry is
+        an on-disk file, carries no footer, and is all stored-scheme in
+        range reads its payload bytes STRAIGHT from the entry file into
+        the destination — one ``preadv`` per contiguous payload run,
+        dest-view slices interleaved with throwaway gap buffers for the
+        8-byte frame headers between chunks — instead of materializing
+        (or page-faulting across) the whole entry just to memcpy slices
+        back out. That was the landing's last full-buffer host pass for
+        incompressible tensors (ISSUE 20). Eligibility mirrors
+        ``copy_plan``'s trust rule exactly — the lane never skips a
+        check the decode lane makes. Returns ``(bytes_written,
+        leftover_jobs)``; any failure (short entry, EIO, raced eviction)
+        hands the affected jobs back to the decode path, which
+        attributes corruption and self-heals as before."""
+        import numpy as np
+
+        locate = getattr(self.cache, "locate_with_range", None)
+        if locate is None:
+            return 0, jobs
+        with self._memo_lock:
+            memoized = set(self._term_bytes)
+        per_path: dict[str, tuple[list, list]] = {}
+        leftover = []
+        for job in jobs:
+            i, d_lo, _d_hi = job
+            t_lo, t_hi, term = self._spans[i]
+            if not (lo <= t_lo and t_hi <= hi) or i in memoized:
+                leftover.append(job)
+                continue
+            fi = self.rec.find_fetch_info(term)
+            if fi is None:
+                raise DirectLandingError(
+                    f"no fetch_info covers term {term.hash_hex}"
+                )
+            located = locate(term.hash_hex, fi.range.start)
+            got = self._entry_reader(term.hash_hex, fi.range.start)
+            if located is None or got is None:
+                leftover.append(job)
+                continue
+            path, path_chunk_offset = located
+            reader, chunk_offset = got
+            if (path_chunk_offset != chunk_offset
+                    or reader.xorb_hash_footer is not None):
+                leftover.append(job)
+                continue
+            local = (term.range.start - chunk_offset,
+                     term.range.end - chunk_offset)
+            try:
+                cols = reader.decode_columns(*local)
+            except ValueError:
+                self._drop_reader(term.hash_hex, fi.range.start)
+                leftover.append(job)
+                continue
+            if cols is None:
+                leftover.append(job)  # footer-hashed: verify per chunk
+                continue
+            src_offs, src_lens, schemes, dst_lens = cols
+            if (schemes.any()  # any compressed chunk needs the decoder
+                    or int(dst_lens.sum(dtype=np.uint64))
+                    != term.unpacked_length):
+                leftover.append(job)
+                continue
+            triples, pjobs = per_path.setdefault(str(path), ([], []))
+            dst = d_lo + _exclusive_cumsum(dst_lens).astype(np.int64)
+            triples.extend(zip(src_offs.tolist(), dst.tolist(),
+                               dst_lens.tolist()))
+            pjobs.append(job)
+
+        written = 0
+        gap_buf = bytearray(_PREADV_GAP_MAX)  # contents discarded
+        for path, (triples, pjobs) in per_path.items():
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except OSError:
+                leftover.extend(pjobs)  # raced eviction: decode heals
+                continue
+            try:
+                triples.sort()
+                ok = True
+                k = 0
+                while k < len(triples):
+                    run_start = pos = triples[k][0]
+                    iovecs = []
+                    while k < len(triples):
+                        src, dst, ln = triples[k]
+                        gap = src - pos
+                        if gap < 0 or gap > _PREADV_GAP_MAX:
+                            break
+                        if gap:
+                            iovecs.append(
+                                memoryview(gap_buf)[:gap])
+                        iovecs.append(view[dst:dst + ln])
+                        pos = src + ln
+                        k += 1
+                    got, calls = _preadv_into(fd, iovecs, run_start)
+                    self.preadv_stats["syscalls"] += calls
+                    if got != sum(v.nbytes for v in iovecs):
+                        ok = False  # short entry: decode path heals
+                        break
+            except OSError:
+                ok = False
+            finally:
+                os.close(fd)
+            if not ok:
+                leftover.extend(pjobs)
+                continue
+            payload = sum(t[2] for t in triples)
+            written += payload
+            self.preadv_stats["terms"] += len(pjobs)
+            self.preadv_stats["bytes"] += payload
+        return written, leftover
 
     def _decode_batch(self, jobs, lo: int, hi: int, view):
         """The whole-read batch lane: collect chunk descriptors for every
@@ -439,6 +633,13 @@ class CachedFileReader:
             jobs.append((i, max(lo, t_lo) - lo, min(hi, t_hi) - lo))
 
         written = 0
+        if jobs and self.use_preadv:
+            # Stored-chunk terms with an on-disk entry skip the decode
+            # engine entirely: their payload bytes preadv straight from
+            # the entry file into ``view`` (no whole-entry buffer, no
+            # per-page fault walk). Everything else falls through.
+            w, jobs = self._preadv_batch(jobs, lo, hi, view)
+            written += w
         if len(jobs) > 1 and compression.native_batch_available():
             # Whole-read descriptor batch: every wholly-contained cached
             # term's chunks submit as ONE native call (GIL released,
@@ -446,7 +647,8 @@ class CachedFileReader:
             # per-chunk Python. Terms the batch can't take (cache miss,
             # memoized, boundary-shared, footer-hashed) fall through to
             # the per-term lanes below.
-            written, jobs = self._decode_batch(jobs, lo, hi, view)
+            w, jobs = self._decode_batch(jobs, lo, hi, view)
+            written += w
         if not jobs:
             return written
 
@@ -521,6 +723,7 @@ def land_tensors(
     predicate=None,
     bridge=None,
     workers: int | None = None,
+    allow_lossy: bool = False,
 ):
     """Decode selected tensors of one safetensors file from the cache.
 
@@ -537,13 +740,15 @@ def land_tensors(
     with telemetry.span("land.decode", file=rec.file_hash.hex(),
                         tensors=len(header.tensors)) as _sp:
         out = _land_tensors_inner(cache, rec, header, predicate, bridge,
-                                  workers, np)
+                                  workers, allow_lossy, np)
         _sp.add_bytes(sum(int(a.nbytes) for a in out.values()))
         return out
 
 
-def _land_tensors_inner(cache, rec, header, predicate, bridge, workers, np):
-    reader = CachedFileReader(cache, rec, bridge=bridge, workers=workers)
+def _land_tensors_inner(cache, rec, header, predicate, bridge, workers,
+                        allow_lossy, np):
+    reader = CachedFileReader(cache, rec, bridge=bridge, workers=workers,
+                              allow_lossy=allow_lossy)
     out: dict[str, np.ndarray] = {}
     if predicate is None and header.tensors:
         # Whole-shard lane: ONE read spanning every tensor, so the whole
@@ -596,10 +801,11 @@ class StreamingShardReader:
 
     def __init__(self, cache, rec: recon.Reconstruction,
                  header: SafetensorsHeader, bridge=None,
-                 workers: int | None = None):
+                 workers: int | None = None, allow_lossy: bool = False):
         self.header = header
         self.reader = CachedFileReader(cache, rec, bridge=bridge,
-                                       workers=workers)
+                                       workers=workers,
+                                       allow_lossy=allow_lossy)
 
     def decode_range_into(self, lo: int, hi: int, dest,
                           label: str = "") -> None:

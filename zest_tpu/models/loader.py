@@ -738,6 +738,7 @@ def _stage_streaming(
     stream_file_sink=None,
     preloaded=None,
     swap_from=None,
+    exchange_landed: bool = False,
 ) -> tuple[dict[str, jax.Array], dict]:
     """The ring scheduler: decode of tensor N+k overlaps the device
     transfer of tensor N, in layer order, through a :class:`HostRing`
@@ -830,9 +831,15 @@ def _stage_streaming(
                     return
                 if prefetch_next is not None:
                     prefetch_next(i)
+                # Lossy-staged exchange payloads (ISSUE 20) are HBM-
+                # only: the overlay arms exactly when no file sink will
+                # share the decoded bytes — a write-behind landing must
+                # stay byte-exact, so it refetches through the verified
+                # waterfall instead.
                 sr = StreamingShardReader(
                     bridge.cache, rec, header, bridge=bridge,
-                    workers=decode_workers)
+                    workers=decode_workers,
+                    allow_lossy=stream_file_sink is None)
                 sink = (stream_file_sink(i, sr)
                         if stream_file_sink is not None else None)
                 # Term boundaries (cumulative unpacked offsets): each
@@ -997,10 +1004,16 @@ def _stage_streaming(
         # splitter's per-layout cache amortizes — whereas a cold
         # stream's group composition varies with wire timing and would
         # pay an XLA compile per flush (the reason coalescing was
-        # bypassed here originally).
+        # bypassed here originally). Exchange-received landings
+        # (ISSUE 20) coalesce too: the collective completes before the
+        # landing starts, so the whole working set decodes from a warm
+        # cache and group cuts land on the same deterministic layer
+        # boundaries pull after pull — same amortization, no wire
+        # timing in the group composition.
         committed = commit_tensors(
             batch, mesh, rules, dtype=dtype, donate=True,
-            coalesce=bool(preloaded or swap_from is not None))
+            coalesce=bool(preloaded or swap_from is not None
+                          or exchange_landed))
         params.update(committed)
         pending.append((list(committed.values()), batch_slots,
                         list(batch)))
@@ -1162,6 +1175,7 @@ def stage_cached_to_hbm(
     stream_file_sink=None,
     preloaded=None,
     swap_from=None,
+    exchange_landed: bool = False,
 ) -> tuple[dict[str, jax.Array], dict]:
     """Direct-path HBM commit: land tensors straight from cached xorb
     units — zero file reads on the landing path (SURVEY.md §7 hard part
@@ -1233,6 +1247,11 @@ def stage_cached_to_hbm(
     two. ``stats["swap"]`` records the reused/landed split. Both paths
     (streaming and shard-level) honor them; byte identity with a cold
     landing of the new revision is pinned by ``params_digest`` tests.
+
+    ``exchange_landed`` marks a landing whose working set a completed
+    collective exchange prewarmed (ISSUE 20): group composition is then
+    deterministic (no wire timing), so the streaming flush coalesces
+    small-tensor groups exactly like the re-land path.
     """
     import contextlib
     from concurrent.futures import ThreadPoolExecutor
@@ -1274,7 +1293,8 @@ def stage_cached_to_hbm(
             ring_bytes, ring_slots,
             tensor_gate=tensor_gate, on_first_layer=on_first_layer,
             stream_file_sink=stream_file_sink,
-            preloaded=preloaded, swap_from=swap_from)
+            preloaded=preloaded, swap_from=swap_from,
+            exchange_landed=exchange_landed)
 
     t0 = time.monotonic()
     preloaded = preloaded or {}
@@ -1297,7 +1317,8 @@ def stage_cached_to_hbm(
               else contextlib.nullcontext()):
             host = land_tensors(bridge.cache, rec, header, bridge=bridge,
                                 workers=decode_workers,
-                                predicate=predicate)
+                                predicate=predicate,
+                                allow_lossy=on_host_ready is None)
         if clock is not None:
             clock.note_bytes("decode",
                              sum(int(a.nbytes) for a in host.values()))

@@ -69,6 +69,16 @@ _CATEGORY_PREFIXES = (
     ("coop.collective.", "fetch"),
     ("coop.exchange", "exchange"),
     ("coop.", "fetch"),
+    # Transport-split spans (ISSUE 20): the pluggable exchange backends
+    # emit bare ``collective.*`` names (lane packing, loopback serve)
+    # that are NOT nested under a ``coop.collective.`` phase prefix —
+    # they are exchange work and must blame as such, not vanish into
+    # "other". Checkpoint fan-out spans (``push`` and any ``push.*``
+    # child) get their own stage for the same reason: a publisher
+    # process's wall is push work, and "other" at 90% tells the
+    # operator nothing.
+    ("collective.", "exchange"),
+    ("push", "push"),
     ("federated.", "fetch"),
     ("pod.", "fetch"),
     ("warm.", "fetch"),

@@ -24,9 +24,17 @@ Three properties carried through from the papers this leans on:
   planar compressed form — and the receiving host expands+verifies
   with the fused Pallas pass (``ops.decode_pallas.FusedBg4Verifier``
   via ``transfer.pod.make_unit_verifier``) before anything reaches the
-  cache. The interconnect never carries expanded bytes; an
-  EQuARX-style *lossy* tier is explicitly out of scope — verification
-  here is byte-exact.
+  cache. The interconnect never carries expanded bytes. On top of the
+  byte-exact tier, ``ZEST_COLLECTIVE_LOSSY=dcn|wan`` arms the
+  EQuARX-style *lossy* tier (transfer.lossy): BG4 float payloads on
+  the named bandwidth-starved link classes quantize to int8 + one
+  fp32 scale per 256-value block before the wire and dequantize on
+  receipt, with bounded error (≤ absmax/127 per block). Lossy units
+  land in the HBM staging overlay ONLY — the merkle-verified xorb
+  cache, and every admission path into it, is untouched — and the
+  exchange stats report ``lossy_bytes``/``bits_saved_ratio``. The
+  default (``0``) keeps the exchange byte-exact, wire- and
+  schema-identical.
 - **Topology awareness**: hosts are ranked slice-major (slice topology
   from ``ZEST_COOP_TOPOLOGY`` — the sim override — or the JAX
   runtime's ``slice_index``, transfer.pod.local_slice_groups), so the
@@ -34,6 +42,13 @@ Three properties carried through from the papers this leans on:
   links and only the few large top-bit phases cross slices on DCN.
   Phase bytes are attributed per link class
   (``zest_coop_collective_bytes_total{link=ici|dcn}``).
+- **Transport-agnostic** (ISSUE 20): the planner executes against the
+  :class:`~zest_tpu.transfer.transport.ExchangeTransport` protocol —
+  the pooled ``DcnChannel`` wire path (``ZEST_COLLECTIVE_BACKEND=dcn``,
+  the default, argument-identical to the pre-split code), the jax ICI
+  backend (intra-slice phases as device-to-device uint8 lane permutes,
+  DCN/WAN phases on the wire), or the in-process loopback fabric the
+  big simulations ride.
 - **Degradation, never a stall**: the schedule is pull-based over the
   existing :class:`~zest_tpu.transfer.dcn.DcnChannel` transport, so a
   lagging partner is a bounded barrier wait (NOT_FOUND → whole-window
@@ -63,7 +78,10 @@ from dataclasses import dataclass
 from zest_tpu import faults, telemetry
 from zest_tpu.cas import hashing
 from zest_tpu.config import parse_topology
-from zest_tpu.transfer.dcn import DcnResponse
+from zest_tpu.transfer.dcn import FLAG_LOSSY, DcnResponse
+from zest_tpu.transfer.transport import (
+    TransportUnavailable, make_transport,
+)
 
 _M_PHASE_SECONDS = telemetry.histogram(
     "zest_coop_collective_phase_seconds",
@@ -462,18 +480,23 @@ def run_collective(bridge, plan, host_index: int,
                    entries_map: dict | None = None,
                    health=None,
                    pods: tuple[int, ...] | None = None,
+                   transport=None,
                    ) -> tuple[dict, dict[int, list]]:
     """Execute this host's phase schedule. Returns
     ``(stats, leftover_by_owner)`` — ``leftover_by_owner`` is empty on
     success; after an abort it maps TRUE owner host → undelivered
     units, ready for the point-to-point exchange ladder.
 
+    ``transport`` overrides the configured exchange backend
+    (``ZEST_COLLECTIVE_BACKEND`` → ``Config.collective_backend`` →
+    :func:`~zest_tpu.transfer.transport.make_transport` over ``pool``).
+
     Raises :class:`CollectiveUnavailable` (before any wire traffic)
-    when a scheduled partner has no address — the caller runs the full
-    P2P exchange instead.
+    when a scheduled partner has no address or the configured backend
+    cannot be built — the caller runs the full P2P exchange instead.
     """
     from zest_tpu.transfer.coop import (
-        _admit, _already_cached, _fallback, _layer_order,
+        _admit, _admit_lossy, _already_cached, _fallback, _layer_order,
     )
 
     sched = CollectiveSchedule.build(plan, host_index, topology, pods)
@@ -482,6 +505,21 @@ def run_collective(bridge, plan, host_index: int,
             raise CollectiveUnavailable(
                 f"phase {ph.index} partner host {ph.partner} has no "
                 "DCN address")
+    if transport is None:
+        backend = getattr(bridge.cfg, "collective_backend", "dcn")
+        try:
+            transport = make_transport(backend, pool, plan=plan)
+        except TransportUnavailable as exc:
+            raise CollectiveUnavailable(str(exc)) from exc
+    # Lossy arming (ZEST_COLLECTIVE_LOSSY): which link classes may
+    # carry quantized payloads. Once ANY link is armed, every window
+    # also advertises "lossy acceptable" (FLAG_LOSSY_OK) so a partner
+    # can forward a staged container it received over an armed link —
+    # store-and-forward schedules re-serve imported blocks on links
+    # that would not quantize FRESH data themselves.
+    mode = str(getattr(bridge.cfg, "collective_lossy", "0") or "0")
+    lossy_links = {"dcn": {LINK_DCN, LINK_WAN},
+                   "wan": {LINK_WAN}}.get(mode, set())
     blocks = units_by_owner(plan)
     mtx = transfer_matrix(plan, topology, pods)
 
@@ -512,6 +550,13 @@ def run_collective(bridge, plan, host_index: int,
         "unit_round_trips": 0,
         "barrier_wait_s": 0.0,
     }
+    if transport.name != "dcn":
+        # Present only off the default backend — with
+        # ZEST_COLLECTIVE_BACKEND=dcn the stats schema stays
+        # bit-for-bit PR-13's (the restore-pre-split pin).
+        stats["backend"] = transport.name
+    if lossy_links:
+        stats["lossy"] = mode
 
     def finish(aborted: str | None = None,
                dead_host: int | None = None) -> dict:
@@ -606,12 +651,15 @@ def run_collective(bridge, plan, host_index: int,
                     try:
                         if faults.fire("peer_timeout", key=f"{host}:{port}"):
                             raise TimeoutError("injected peer_timeout")
-                        replies = pool.request_many(
-                            host, port,
+                        replies = transport.request_window(
+                            ph.partner, (host, port),
                             [(hashing.hex_to_hash(hh), fi.range.start,
                               fi.range.end) for hh, fi in window],
                             timeout=max(1.0, deadline - time.monotonic()),
-                            tag=pool.window_tag(),
+                            tag=transport.window_tag(),
+                            link=ph.link,
+                            lossy_ok=bool(lossy_links),
+                            quant_ok=ph.link in lossy_links,
                         )
                         windows += 1
                         requests += len(window)
@@ -639,6 +687,35 @@ def run_collective(bridge, plan, host_index: int,
                     missing = []
                     try:
                         for (hh, fi), reply in zip(window, replies):
+                            if isinstance(reply, DcnResponse) \
+                                    and reply.flags & FLAG_LOSSY:
+                                # Quantized container: admissible to
+                                # the HBM staging overlay only — never
+                                # the merkle-verified cache. A partner
+                                # can only send this after we opted in
+                                # (FLAG_LOSSY_OK on the request).
+                                admitted, wire, unpacked, exact = \
+                                    _admit_lossy(bridge, hh, fi, reply)
+                                if admitted:
+                                    bridge.stats.record("peer", wire)
+                                    ex.book_exchange(
+                                        (hh, fi.range.start), wire,
+                                        unpacked, link=ph.link,
+                                        lossy_exact=exact)
+                                    link_bytes[ph.link] += wire
+                                    _M_COLLECTIVE_BYTES.inc(
+                                        wire, link=ph.link)
+                                else:
+                                    with ex.lock:
+                                        ex.verify_rejected += 1
+                                    telemetry.record(
+                                        "verify_rejected", unit=hh[:16],
+                                        owner=ph.partner,
+                                        tier="collective")
+                                    _fallback(bridge, entries_map,
+                                              [(hh, fi)], ex,
+                                              owner=ph.partner)
+                                continue
                             admitted, wire, unpacked = _admit(
                                 bridge, entries_map, hh, fi, reply, verify)
                             if admitted:

@@ -58,6 +58,7 @@ from dataclasses import dataclass
 
 from zest_tpu import faults, telemetry
 from zest_tpu.cas import hashing
+from zest_tpu.cas.compression import CompressionError
 from zest_tpu.cas.reconstruction import FetchInfo, Reconstruction
 from zest_tpu.cas.xorb import XorbReader
 from zest_tpu.parallel.plan import collect_units
@@ -272,11 +273,19 @@ class _ExchangeStats:
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
-        # key (hash_hex, range_start) -> (kind, tier, bytes, unpacked)
+        # key (hash_hex, range_start) ->
+        #   (kind, tier, bytes, unpacked, lossy_exact)
         self._booked: dict[tuple[str, int], tuple] = {}
         self.units = 0
         self.wire_bytes = 0
         self.unpacked_bytes = 0
+        # Lossy-tier slice of the wire bytes (ZEST_COLLECTIVE_LOSSY):
+        # quantized container bytes actually shipped, and the
+        # byte-exact bytes they replaced — bits_saved_ratio derives
+        # from the pair. Both stay 0 (and out of the summary) on
+        # byte-exact rounds.
+        self.lossy_bytes = 0
+        self.lossy_exact_bytes = 0
         self.fallback_units = 0
         self.fallback_bytes = 0
         # Fallback bytes by the tier that ACTUALLY served them (the
@@ -290,21 +299,27 @@ class _ExchangeStats:
         self.dead_hosts: set[int] = set()
 
     def book_exchange(self, key: tuple[str, int], wire: int,
-                      unpacked: int, link: str = "dcn") -> None:
-        """Attribute one exchange-delivered unit to the wire tier."""
+                      unpacked: int, link: str = "dcn",
+                      lossy_exact: int | None = None) -> None:
+        """Attribute one exchange-delivered unit to the wire tier.
+        ``lossy_exact`` (the byte-exact length a quantized container
+        replaced) marks the unit as lossy-delivered."""
         with self.lock:
             self._unbook(key)
-            self._booked[key] = ("x", link, wire, unpacked)
+            self._booked[key] = ("x", link, wire, unpacked, lossy_exact)
             self.units += 1
             self.wire_bytes += wire
             self.unpacked_bytes += unpacked
+            if lossy_exact is not None:
+                self.lossy_bytes += wire
+                self.lossy_exact_bytes += lossy_exact
 
     def book_fallback(self, key: tuple[str, int], source: str,
                       nbytes: int) -> None:
         """Attribute one fallback-delivered unit to its serving tier."""
         with self.lock:
             self._unbook(key)
-            self._booked[key] = ("f", source, nbytes, 0)
+            self._booked[key] = ("f", source, nbytes, 0, None)
             self.fallback_units += 1
             self.fallback_bytes += nbytes
             self.fallback_tiers[source] = (
@@ -314,12 +329,15 @@ class _ExchangeStats:
         prev = self._booked.pop(key, None)
         if prev is None:
             return
-        kind, tier, nbytes, unpacked = prev
+        kind, tier, nbytes, unpacked, lossy_exact = prev
         self.reattributed += 1
         if kind == "x":
             self.units -= 1
             self.wire_bytes -= nbytes
             self.unpacked_bytes -= unpacked
+            if lossy_exact is not None:
+                self.lossy_bytes -= nbytes
+                self.lossy_exact_bytes -= lossy_exact
         else:
             self.fallback_units -= 1
             self.fallback_bytes -= nbytes
@@ -339,6 +357,13 @@ class _ExchangeStats:
             "verify_rejected": self.verify_rejected,
             "retries": self.retries,
         }
+        if self.lossy_bytes:
+            # Present only when lossy traffic actually flowed — the
+            # byte-exact default keeps the schema bit-identical.
+            out["lossy_bytes"] = self.lossy_bytes
+            if self.lossy_exact_bytes:
+                out["bits_saved_ratio"] = round(
+                    1.0 - self.lossy_bytes / self.lossy_exact_bytes, 4)
         if self.fallback_tiers:
             out["fallback_tiers"] = dict(sorted(self.fallback_tiers.items()))
         if self.reattributed:
@@ -871,6 +896,35 @@ def _admit(bridge, entries_map, hh, fi, reply, verify):
     _cache_unit(bridge, entries_map, hh, fi, reply.chunk_offset,
                 reply.data)
     return True, len(reply.data), _unpacked_bytes(reply.data)
+
+
+def _admit_lossy(bridge, hh, fi, reply):
+    """Gate one LOSSY exchange reply (a ZQLS container, dcn.FLAG_LOSSY)
+    into the HBM staging overlay — NEVER the xorb cache. The container
+    must parse, dequantize into frames in the right coordinate frame,
+    and structurally cover the unit; content verification is
+    impossible by construction (the bytes are not the bytes the merkle
+    tree committed to), which is exactly why the landing is staged:
+    lossy data reaches HBM through the explicitly opted-in decode
+    overlay and nothing else, and any later byte-exact need refetches
+    through the verified waterfall. The CONTAINER is what gets staged,
+    so re-serving it to a later phase partner forwards the original
+    quantization verbatim instead of compounding error. Returns
+    (admitted, wire_bytes, unpacked_bytes, exact_bytes)."""
+    from zest_tpu.transfer import lossy
+
+    try:
+        frames = lossy.dequantize_blob(reply.data)
+        exact = lossy.exact_len(reply.data)
+    except (ValueError, CompressionError):
+        return False, 0, 0, 0
+    if reply.chunk_offset > fi.range.start:
+        return False, 0, 0, 0
+    if not _blob_covers(frames, fi.range.end - reply.chunk_offset):
+        return False, 0, 0, 0
+    lossy.staging_for(bridge.cfg.cache_dir).put(
+        hh, reply.chunk_offset, reply.data)
+    return True, len(reply.data), _unpacked_bytes(frames), exact
 
 
 def _fallback(bridge, entries_map, units, ex: _ExchangeStats,

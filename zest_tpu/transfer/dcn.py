@@ -102,6 +102,20 @@ MSG_ERROR = 4
 MSG_GOSSIP = 5
 MSG_GOSSIP_REPLY = 6
 
+# Per-message flag bits (ISSUE 20), carried in the u8 the v1 header
+# reserved and always sent as 0 — a v1 peer never reads it, so setting
+# a bit is wire-compatible in both directions. On a REQUEST,
+# FLAG_LOSSY_OK means "I accept lossy payloads" (the server may
+# forward a staged quantized container it received itself) and
+# FLAG_QUANT_OK additionally invites the server to quantize fresh
+# byte-exact cache data for this bandwidth-starved link. On a
+# RESPONSE, FLAG_LOSSY marks a quantized "ZQLS" container (see
+# transfer.lossy). Defaults of 0 keep every wire byte identical to
+# the pre-lossy protocol.
+FLAG_LOSSY_OK = 0x01
+FLAG_QUANT_OK = 0x02
+FLAG_LOSSY = 0x01
+
 # A silent peer (half-open connection, port scanner that said hello)
 # releases its serving thread after this long; clients hold channels
 # with in-flight traffic, and an expired channel just reconnects.
@@ -130,6 +144,8 @@ class DcnRequest:
     # requester's ``dcn.request_many`` window so the server's serve
     # span flow-links to it in the merged trace. 0 = untagged.
     tag: int = 0
+    # Per-message flag bits (FLAG_LOSSY_OK). 0 = byte-exact only.
+    flags: int = 0
 
 
 @dataclass(frozen=True)
@@ -137,6 +153,9 @@ class DcnResponse:
     request_id: int
     chunk_offset: int
     data: bytes
+    # FLAG_LOSSY set ⇒ ``data`` is a quantized "ZQLS" container, not
+    # frame bytes — admissible to HBM staging only, never the cache.
+    flags: int = 0
 
 
 @dataclass(frozen=True)
@@ -172,7 +191,7 @@ _OFFSET = struct.Struct("<Q")
 
 
 def encode_response_prefix(
-    request_id: int, chunk_offset: int, data_len: int
+    request_id: int, chunk_offset: int, data_len: int, flags: int = 0
 ) -> bytes:
     """Header + chunk_offset prefix of a RESPONSE carrying ``data_len``
     payload bytes. The single source of truth for RESPONSE framing: both
@@ -182,7 +201,8 @@ def encode_response_prefix(
     body_len = _OFFSET.size + data_len
     if body_len > MAX_MESSAGE_SIZE:
         raise DcnProtocolError(f"payload of {body_len} bytes over cap")
-    return (_HEADER.pack(MSG_RESPONSE, 0, 0, request_id, body_len)
+    return (_HEADER.pack(MSG_RESPONSE, flags & 0xFF, 0, request_id,
+                         body_len)
             + _OFFSET.pack(chunk_offset))
 
 
@@ -191,11 +211,12 @@ def encode_message(msg: DcnMessage) -> bytes:
         body = _REQ_BODY.pack(msg.chunk_hash, msg.range_start, msg.range_end)
         if len(body) > MAX_MESSAGE_SIZE:
             raise DcnProtocolError(f"payload of {len(body)} bytes over cap")
-        return _HEADER.pack(MSG_REQUEST, 0, msg.tag & 0xFFFF,
+        return _HEADER.pack(MSG_REQUEST, msg.flags & 0xFF,
+                            msg.tag & 0xFFFF,
                             msg.request_id, len(body)) + body
     elif isinstance(msg, DcnResponse):
         return encode_response_prefix(
-            msg.request_id, msg.chunk_offset, len(msg.data)
+            msg.request_id, msg.chunk_offset, len(msg.data), msg.flags
         ) + msg.data
     elif isinstance(msg, DcnNotFound):
         body = msg.chunk_hash
@@ -224,12 +245,12 @@ def decode_message(header: bytes, body: bytes) -> DcnMessage:
         if len(body) != _REQ_BODY.size:
             raise DcnProtocolError("bad REQUEST body")
         h, start, end = _REQ_BODY.unpack(body)
-        return DcnRequest(req_id, h, start, end, tag)
+        return DcnRequest(req_id, h, start, end, tag, _flags)
     if mtype == MSG_RESPONSE:
         if len(body) < 8:
             raise DcnProtocolError("bad RESPONSE body")
         (offset,) = struct.unpack_from("<Q", body)
-        return DcnResponse(req_id, offset, body[8:])
+        return DcnResponse(req_id, offset, body[8:], _flags)
     if mtype == MSG_NOT_FOUND:
         if len(body) != hashing.HASH_LEN:
             raise DcnProtocolError("bad NOT_FOUND body")
@@ -385,6 +406,49 @@ def lookup_chunk_range(
     except XorbFormatError:
         pass  # serve the whole entry; requester re-slices
     return offset, blob
+
+
+def serve_chunk_range(
+    cfg: Config,
+    cache: XorbCache,
+    chunk_hash: bytes,
+    range_start: int,
+    range_end: int,
+    flags: int = 0,
+) -> tuple[int, bytes, int] | None:
+    """:func:`lookup_chunk_range` plus the lossy-tier serving decision,
+    shared by the socket server and the in-process loopback transport
+    so every backend answers identically. Returns ``(chunk_offset,
+    blob, response_flags)`` or None.
+
+    Byte-exact cache data always wins. With FLAG_QUANT_OK the exact
+    blob may be replaced by a quantized container when that shrinks the
+    wire bytes; with FLAG_LOSSY_OK a cache miss falls through to the
+    host's lossy staging (a container this host itself received over a
+    lossy link earlier in the round — forwarded VERBATIM, so the
+    quantization error never compounds across store-and-forward hops).
+    Either way the response is flagged FLAG_LOSSY, and a requester that
+    set neither bit can never receive lossy bytes."""
+    found = lookup_chunk_range(cfg, cache, chunk_hash,
+                               range_start, range_end)
+    if found is not None:
+        offset, blob = found
+        if flags & FLAG_QUANT_OK:
+            from zest_tpu.transfer import lossy as _lossy
+
+            packed = _lossy.quantize_blob(blob)
+            if packed is not None and len(packed) < len(blob):
+                return offset, packed, FLAG_LOSSY
+        return offset, blob, 0
+    if flags & FLAG_LOSSY_OK:
+        from zest_tpu.transfer import lossy as _lossy
+
+        staged = _lossy.staging_for(cfg.cache_dir).get_with_range(
+            hashing.hash_to_hex(chunk_hash), range_start)
+        if staged is not None:
+            blob, offset = staged
+            return offset, blob, FLAG_LOSSY
+    return None
 
 
 # ── Server ──
@@ -674,9 +738,9 @@ class DcnServer:
                 f"invalid range [{req.range_start},{req.range_end})",
             )))
             return
-        found = lookup_chunk_range(
+        found = serve_chunk_range(
             self.cfg, self.cache, req.chunk_hash,
-            req.range_start, req.range_end,
+            req.range_start, req.range_end, req.flags,
         )
         if found is None:
             with self._stats_lock:
@@ -686,7 +750,7 @@ class DcnServer:
                 DcnNotFound(req.request_id, req.chunk_hash)
             ))
             return
-        offset, blob = found
+        offset, blob, resp_flags = found
         if _OFFSET.size + len(blob) > MAX_MESSAGE_SIZE:
             # An over-cap cached entry (e.g. served whole after a footer
             # parse failure) must fail as a clean ERROR, not stream an
@@ -708,7 +772,8 @@ class DcnServer:
         # Scatter-gather send: the blob can be a whole 64 MiB xorb, and
         # encode_message would memcpy it twice building one bytestring.
         _sendmsg_all(conn, [
-            encode_response_prefix(req.request_id, offset, len(blob)), blob,
+            encode_response_prefix(req.request_id, offset, len(blob),
+                                   resp_flags), blob,
         ])
 
 
@@ -785,12 +850,13 @@ class DcnChannel:
 
     def send_request(
         self, chunk_hash: bytes, range_start: int, range_end: int,
-        tag: int = 0,
+        tag: int = 0, flags: int = 0,
     ) -> "_Waiter":
         """Fire one request; returns a waiter to collect later — callers
         batch N sends then collect N waits to pipeline. ``tag`` is the
         v2 window tag (0 = untagged; a v1 server reads it as the
-        reserved bytes it always ignored)."""
+        reserved bytes it always ignored); ``flags`` rides the reserved
+        flag byte (FLAG_LOSSY_OK — a v1 server ignores it too)."""
         if faults.fire("dcn_reset",
                        key=f"{self.address[0]}:{self.address[1]}"):
             self.dead = True
@@ -806,7 +872,7 @@ class DcnChannel:
             try:
                 self._sock.sendall(encode_message(
                     DcnRequest(req_id, chunk_hash, range_start, range_end,
-                               tag)
+                               tag, flags)
                 ))
             except OSError as exc:
                 with self._pending_lock:
@@ -856,13 +922,15 @@ class DcnChannel:
         self, wants: list[tuple[bytes, int, int]],
         timeout: float | None = None,
         tag: int = 0,
+        flags: int = 0,
     ) -> list[DcnMessage]:
         """Pipelined batch: all requests go out before any response is
         awaited; results come back in ``wants`` order. ``timeout``
         overrides the channel default per call — the cooperative
         exchange bounds each window by its round deadline's remainder
         instead of letting one silent owner hold a 30 s default."""
-        waiters = [self.send_request(*w, tag=tag) for w in wants]
+        waiters = [self.send_request(*w, tag=tag, flags=flags)
+                   for w in wants]
         t = self.timeout if timeout is None else timeout
         return [w.wait(t) for w in waiters]
 
@@ -975,6 +1043,7 @@ class DcnPool:
         self, host: str, port: int, wants: list[tuple[bytes, int, int]],
         timeout: float | None = None,
         tag: int | None = None,
+        flags: int = 0,
     ) -> list[DcnMessage]:
         """Pipelined batch through a pooled channel, transparently
         reconnecting and retrying ONCE when a previously pooled channel
@@ -984,7 +1053,8 @@ class DcnPool:
         propagates — that's a real peer problem, not staleness.
         ``timeout`` caps each response wait for this call only.
         ``tag`` stamps an explicit window tag on every REQUEST of this
-        batch (callers allocate via :meth:`window_tag`)."""
+        batch (callers allocate via :meth:`window_tag`); ``flags``
+        stamps the per-message flag byte (FLAG_LOSSY_OK)."""
         # Forwarded only when set: injected channel doubles (tests,
         # wrappers) predate the parameters. Without an explicit ``tag``
         # the window tag is allocated only while a trace is actually
@@ -993,6 +1063,8 @@ class DcnPool:
         # wire bytes (and the doubles' call shape) identical to the
         # untraced path.
         kw = {} if timeout is None else {"timeout": timeout}
+        if flags:
+            kw["flags"] = flags
         if tag is None and telemetry.enabled() \
                 and telemetry.trace.active() is not None:
             tag = self._alloc_tag()

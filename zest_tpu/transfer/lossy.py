@@ -1,0 +1,299 @@
+"""EQuARX-style lossy payload tier for the collective exchange.
+
+Cross-slice DCN/WAN links are the scarce plane in every shaped run, and
+most checkpoint bytes are float tensors whose BG4+LZ4 frames still ship
+close to raw size. EQuARX (PAPERS.md) shows all-reduce-style exchanges
+tolerate a bounded-error quantized wire format on exactly those links;
+this module is the codec half of that tier: BG4 float chunks quantize
+to int8 with one fp32 scale per 256-value block (~26% of raw, error
+bounded by absmax/127 per block) and everything else rides verbatim.
+
+The TRUST BOUNDARY is deliberately brutal: a quantized payload can
+never reproduce the chunk bytes the merkle tree committed to, so lossy
+containers are admissible to the HBM staging overlay ONLY — they never
+enter the xorb cache, are never re-served to peers, and any later
+byte-exact need (file materialization, re-serving) refetches through
+the verified waterfall. The container self-describes (magic "ZQLS") so
+a receiver can never confuse it with frame bytes; the wire marks it
+redundantly via the RESPONSE flag byte (dcn.FLAG_LOSSY).
+
+Container layout (little-endian)::
+
+    header:    "ZQLS" u8 version(1) u8 rsvd u16 nchunks
+               u32 block_values u64 exact_len
+    per chunk: u8 kind  u32 payload_len  u32 raw_len
+      kind 0 (VERBATIM): payload = the chunk's original frame bytes
+      kind 1 (QUANT):    payload = u8 phase  u8 tail_len
+                                   + phase head bytes + tail_len tail bytes
+                                   + nblocks x f32 scales
+                                   + nvals x i8 values
+        where nvals = (raw_len - phase - tail_len) / 4: CDC chunk
+        boundaries fall on arbitrary BYTES of the float stream, so the
+        codec detects the float grid's byte phase (the fully-finite
+        reinterpretation with the best blockwise-int8 SNR) and carries
+        the sub-float head/tail bytes verbatim — without this, three
+        out of four chunks of a real checkpoint would decline.
+
+``exact_len`` records the byte-exact blob length the container
+replaced, which is what ``bits_saved_ratio`` in the exchange stats is
+computed against.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+import numpy as np
+
+from zest_tpu.cas import compression
+from zest_tpu.cas.xorb import XorbFormatError, XorbReader, encode_frame
+
+MAGIC = b"ZQLS"
+VERSION = 1
+# fp32 values per quantization block (one f32 scale each): 256 keeps
+# the scale overhead at ~1.6% of raw while staying planar-friendly
+# (a block never straddles more than one cache line of scales).
+BLOCK_VALUES = 256
+
+_HDR = struct.Struct("<4sBBHIQ")
+_CHDR = struct.Struct("<BII")
+
+KIND_VERBATIM = 0
+KIND_QUANT = 1
+
+
+class LossyFormatError(ValueError):
+    pass
+
+
+def is_lossy_container(blob: bytes) -> bool:
+    return len(blob) >= _HDR.size and blob[:4] == MAGIC
+
+
+# Minimum blockwise-int8 SNR (signal power / quantization error power)
+# for a phase candidate to count as "this IS a float stream". True-phase
+# normal/uniform float data reconstructs well above 30 dB; a misphased
+# reinterpretation (exponent bytes drawn from mantissa noise) or
+# non-float content lands near 0 dB — so one threshold both picks the
+# grid phase and declines unquantizable chunks.
+_MIN_SNR = 100.0
+
+
+def _quantize_chunk(raw: bytes) -> bytes | None:
+    """int8 + per-block scale payload for one raw float chunk, or None
+    when no byte phase of the chunk reads as a quantizable float
+    stream (non-finite values, or below the SNR floor)."""
+    if len(raw) < 8:
+        return None
+    best = None
+    for phase in range(4):
+        nvals = (len(raw) - phase) // 4
+        if nvals <= 0:
+            continue
+        vals = np.frombuffer(raw, dtype="<f4", offset=phase,
+                             count=nvals)
+        if not np.isfinite(vals).all():
+            continue
+        nblocks = -(-nvals // BLOCK_VALUES)
+        padded = np.zeros(nblocks * BLOCK_VALUES, dtype=np.float32)
+        padded[:nvals] = vals
+        blocks = padded.reshape(nblocks, BLOCK_VALUES)
+        absmax = np.abs(blocks).max(axis=1)
+        scales = (absmax / 127.0).astype("<f4")
+        safe = np.where(scales > 0.0, scales, 1.0)
+        q = np.rint(blocks / safe[:, None]).clip(-127, 127) \
+            .astype(np.int8)
+        err = float(np.square(q.astype(np.float32) * safe[:, None]
+                              - blocks).sum())
+        power = float(np.square(blocks).sum())
+        snr = power / err if err > 0.0 else float("inf")
+        if best is None or snr > best[0]:
+            tail = raw[phase + nvals * 4:]
+            best = (snr, bytes([phase, len(tail)]) + raw[:phase]
+                    + tail + scales.tobytes()
+                    + q.reshape(-1)[:nvals].tobytes())
+    if best is None or best[0] < _MIN_SNR:
+        return None
+    return best[1]
+
+
+def _dequantize_chunk(payload: bytes, raw_len: int) -> bytes:
+    if len(payload) < 2:
+        raise LossyFormatError("quant payload too short")
+    phase, tail_len = payload[0], payload[1]
+    body = raw_len - phase - tail_len
+    if phase > 3 or body < 0 or body % 4:
+        raise LossyFormatError("bad quant phase/tail")
+    nvals = body // 4
+    nblocks = -(-nvals // BLOCK_VALUES)
+    pos = 2
+    head = payload[pos:pos + phase]
+    pos += phase
+    tail = payload[pos:pos + tail_len]
+    pos += tail_len
+    want = pos + nblocks * 4 + nvals
+    if len(payload) != want:
+        raise LossyFormatError(
+            f"quant payload {len(payload)}B, expected {want}B")
+    scales = np.frombuffer(payload, dtype="<f4", offset=pos,
+                           count=nblocks)
+    q = np.frombuffer(payload, dtype=np.int8, offset=pos + nblocks * 4)
+    vals = q.astype(np.float32) * np.repeat(scales, BLOCK_VALUES)[:nvals]
+    return bytes(head) + vals.astype("<f4").tobytes() + bytes(tail)
+
+
+def quantize_blob(blob: bytes) -> bytes | None:
+    """Quantize a response blob (concatenated xorb frames) into a ZQLS
+    container. Returns None when the blob isn't parseable frames, has
+    no BG4 float chunk worth quantizing, or wouldn't shrink — the
+    caller then ships the byte-exact blob with flags 0."""
+    try:
+        reader = XorbReader(blob)
+    except XorbFormatError:
+        return None
+    n = len(reader)
+    if n == 0 or n > 0xFFFF:
+        return None
+    schemes = reader.chunk_schemes
+    if not (schemes == int(compression.Scheme.BG4_LZ4)).any():
+        return None
+    parts = [b""] * (n + 1)
+    parts[0] = _HDR.pack(MAGIC, VERSION, 0, n, BLOCK_VALUES, len(blob))
+    gained = False
+    for i in range(n):
+        frame = reader.slice_range(i, i + 1)
+        payload = None
+        if int(schemes[i]) == int(compression.Scheme.BG4_LZ4):
+            try:
+                raw = reader.extract_chunk(i, verify=False)
+            except (XorbFormatError, compression.CompressionError):
+                return None
+            payload = _quantize_chunk(raw)
+            if payload is not None \
+                    and _CHDR.size + len(payload) < len(frame):
+                parts[i + 1] = _CHDR.pack(KIND_QUANT, len(payload),
+                                          len(raw)) + payload
+                gained = True
+                continue
+        parts[i + 1] = _CHDR.pack(KIND_VERBATIM, len(frame),
+                                  len(frame)) + frame
+    if not gained:
+        return None
+    return b"".join(parts)
+
+
+def dequantize_blob(container: bytes) -> bytes:
+    """Rebuild a frames blob from a ZQLS container. Quantized chunks
+    re-frame their DEQUANTIZED bytes (``encode_frame`` of the lossy
+    raw), so the result parses exactly like a normal response blob —
+    but its chunk hashes no longer match the merkle tree, which is why
+    callers must route it to staging, never the cache."""
+    if not is_lossy_container(container):
+        raise LossyFormatError("not a ZQLS container")
+    magic, version, _rsvd, n, block, exact_len = \
+        _HDR.unpack_from(container)
+    if version != VERSION:
+        raise LossyFormatError(f"unsupported ZQLS version {version}")
+    if block != BLOCK_VALUES:
+        raise LossyFormatError(f"unsupported block size {block}")
+    pos = _HDR.size
+    frames = []
+    for _ in range(n):
+        if pos + _CHDR.size > len(container):
+            raise LossyFormatError("truncated chunk header")
+        kind, plen, raw_len = _CHDR.unpack_from(container, pos)
+        pos += _CHDR.size
+        payload = container[pos:pos + plen]
+        if len(payload) != plen:
+            raise LossyFormatError("truncated chunk payload")
+        pos += plen
+        if kind == KIND_VERBATIM:
+            frames.append(payload)
+        elif kind == KIND_QUANT:
+            frame, _h = encode_frame(_dequantize_chunk(payload, raw_len))
+            frames.append(frame)
+        else:
+            raise LossyFormatError(f"unknown chunk kind {kind}")
+    if pos != len(container):
+        raise LossyFormatError("trailing bytes after last chunk")
+    return b"".join(frames)
+
+
+def exact_len(container: bytes) -> int:
+    """The byte-exact blob length this container replaced (for
+    ``bits_saved_ratio`` accounting)."""
+    if not is_lossy_container(container):
+        raise LossyFormatError("not a ZQLS container")
+    return _HDR.unpack_from(container)[5]
+
+
+class LossyStaging:
+    """HBM-only landing zone for lossy-admitted exchange units.
+
+    Holds dequantized (re-framed) blobs keyed by xorb hash, mirroring
+    the xorb cache's ``get_with_range`` lookup shape so the decode
+    engine can overlay it transparently — without ever writing a byte
+    to the merkle-verified cache. Entries live for one load: the
+    loader drains the staging once tensors are committed to HBM, and
+    any later byte-exact need refetches through the verified waterfall.
+    """
+
+    def __init__(self) -> None:
+        self._blobs: dict[tuple[str, int], bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, hash_hex: str, chunk_offset: int, blob: bytes) -> None:
+        with self._lock:
+            self._blobs[(hash_hex, int(chunk_offset))] = blob
+
+    def get_with_range(self, hash_hex: str,
+                       range_start: int) -> tuple[bytes, int] | None:
+        """``(blob, chunk_offset)`` for the staged entry of ``hash_hex``
+        whose chunk range starts at or before ``range_start`` (the same
+        rebasing contract as ``XorbCache.get_with_range``)."""
+        with self._lock:
+            best = None
+            for (hh, off), blob in self._blobs.items():
+                if hh != hash_hex or off > range_start:
+                    continue
+                if best is None or off > best[1]:
+                    best = (blob, off)
+            return best
+
+    def units(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._blobs.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blobs.clear()
+
+
+# Staging registry keyed by cache dir: one LossyStaging per host
+# identity, reachable from anything that knows the host's cache
+# location (the bridge's admit path, the DcnServer's serve path, the
+# decode engine's overlay) with zero constructor plumbing — and still
+# correctly per-host in the in-process multi-host simulations, where
+# every simulated host has its own cache dir.
+_STAGINGS: dict[str, LossyStaging] = {}
+_STAGINGS_LOCK = threading.Lock()
+
+
+def staging_for(cache_dir) -> LossyStaging:
+    key = str(cache_dir)
+    with _STAGINGS_LOCK:
+        st = _STAGINGS.get(key)
+        if st is None:
+            st = _STAGINGS[key] = LossyStaging()
+        return st
+
+
+def reset_stagings() -> None:
+    """Drop every registered staging (tests/bench isolation)."""
+    with _STAGINGS_LOCK:
+        _STAGINGS.clear()

@@ -1395,6 +1395,8 @@ def _pull_model(
                 early_cfg=early_cfg,
                 delta_state=((delta_base, base_params, delta_plan)
                              if delta_plan is not None else None),
+                exchange_landed=bool(((coop_stats or {}).get("exchange")
+                                      or {}).get("units")),
             )
             authenticated = authenticated or bridge.cas is not None
             if hbm_stats is not None:
@@ -1630,6 +1632,7 @@ def _try_direct_stage(
     ensure_auth=None,
     early_cfg=None,
     delta_state=None,
+    exchange_landed: bool = False,
 ):
     """Direct cache→HBM landing for every safetensors file, before any
     file write. Returns ``(None, None)`` when ineligible — non-xet
@@ -1818,6 +1821,7 @@ def _try_direct_stage(
                     stream_file_sink=stream_file_sink,
                     preloaded=preloaded or None,
                     swap_from=swap_from,
+                    exchange_landed=exchange_landed,
                 )
             if first_layer_at:
                 # Monotonic instant the first-token-capable set became

@@ -1,0 +1,368 @@
+"""Pluggable exchange transports for the collective phase planner.
+
+The PR-13 collective (transfer.collective) derives a deterministic
+phase schedule from the shared plan; until ISSUE 20 the only way to
+*execute* a phase was the hardwired ``DcnPool.request_many`` path.
+This module splits transport from schedule: the planner speaks the
+:class:`ExchangeTransport` protocol — open a tagged window, send/recv
+the phase payloads, expose clock offsets and the wire-tag counters,
+abort by raising the connection-error family — and three backends
+implement it:
+
+- :class:`DcnWireTransport` — the existing pooled ``DcnChannel``
+  path, now one implementation instead of the default. With the
+  default knobs its calls into the pool are ARGUMENT-IDENTICAL to the
+  pre-split code (same positional shape, same tag allocator, no flag
+  byte), which is what lets ``ZEST_COLLECTIVE_BACKEND=dcn`` pin the
+  old exchange bit-for-bit.
+- :class:`JaxIciTransport` — intra-slice (ICI-class) phases move
+  their payloads as device-to-device ``jax.Array`` permutes: the
+  ragged frame blobs of a window pack into a fixed uint8 lane whose
+  width derives from the SHARED plan (so every host compiles the
+  identical program; a blob that outgrows the lane — a whole-entry
+  serve after a footer-parse failure — passes through host-side and
+  is counted in ``lane_overflows``). Cross-slice DCN/WAN phases keep
+  the wire transport untouched.
+- :class:`LoopbackTransport` — in-process serving against a
+  registered fabric of ``(cfg, cache)`` per address: the 256–1024-host
+  simulations exchange through direct :func:`~zest_tpu.transfer.dcn.
+  serve_chunk_range` calls with zero sockets and zero serialization,
+  while still honoring the ``dcn_reset`` fault hook and the tagged
+  window discipline so the conformance suite can drive all three
+  backends through one set of assertions.
+
+Backend selection: ``ZEST_COLLECTIVE_BACKEND`` → ``Config.
+collective_backend`` → :func:`make_transport`. An unbuildable backend
+raises :class:`TransportUnavailable` before any wire traffic; the
+collective turns that into ``CollectiveUnavailable`` and the round
+degrades down the PR-6 point-to-point ladder exactly as before.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from zest_tpu import faults, telemetry
+from zest_tpu.transfer.dcn import (
+    DcnNotFound,
+    DcnResponse,
+    FLAG_LOSSY_OK,
+    FLAG_QUANT_OK,
+    serve_chunk_range,
+)
+
+LINK_ICI = "ici"
+
+
+class TransportUnavailable(RuntimeError):
+    """The configured backend cannot run here (missing runtime, no
+    fabric entry): raised BEFORE any wire traffic so the caller can
+    degrade to the point-to-point exchange."""
+
+
+def _request_flags(lossy_ok: bool, quant_ok: bool) -> int:
+    return ((FLAG_LOSSY_OK if lossy_ok else 0)
+            | (FLAG_QUANT_OK if quant_ok else 0))
+
+
+class ExchangeTransport:
+    """Protocol the phase planner executes against.
+
+    ``request_window`` issues one tagged phase sub-window to a partner
+    and returns per-want replies (``DcnResponse`` / ``DcnNotFound``) in
+    request order; a dead partner is signalled by raising
+    ``ConnectionError`` / ``TimeoutError`` / ``OSError``, which is the
+    planner's abort hook. ``counters`` exposes the wire-tag accounting
+    the no-per-unit-round-trips gate reads; ``clock_offsets`` feeds
+    the merged-trace clock normalization."""
+
+    name = "?"
+
+    def window_tag(self) -> int:
+        raise NotImplementedError
+
+    def request_window(self, partner: int, addr: tuple[str, int],
+                       wants: list[tuple[bytes, int, int]], *,
+                       timeout: float, tag: int,
+                       link: str = "dcn",
+                       lossy_ok: bool = False,
+                       quant_ok: bool = False) -> list:
+        raise NotImplementedError
+
+    @property
+    def counters(self) -> dict:
+        raise NotImplementedError
+
+    def clock_offsets(self) -> dict:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+
+class DcnWireTransport(ExchangeTransport):
+    """The pooled ``DcnChannel`` path — PR-13's hardwired transport as
+    one implementation. Every request with default (byte-exact) knobs
+    reaches ``pool.request_many`` with the exact argument shape the
+    pre-split collective used."""
+
+    name = "dcn"
+
+    def __init__(self, pool) -> None:
+        self.pool = pool
+
+    def window_tag(self) -> int:
+        return self.pool.window_tag()
+
+    @property
+    def counters(self) -> dict:
+        return self.pool.counters
+
+    def clock_offsets(self) -> dict:
+        return self.pool.clock_offsets()
+
+    def request_window(self, partner, addr, wants, *, timeout, tag,
+                       link="dcn", lossy_ok=False, quant_ok=False):
+        host, port = addr
+        flags = _request_flags(lossy_ok, quant_ok)
+        if flags:
+            return self.pool.request_many(host, port, wants,
+                                          timeout=timeout, tag=tag,
+                                          flags=flags)
+        # No kwargs beyond the pre-split ones: the dcn-backend
+        # bit-for-bit pin intercepts this call shape.
+        return self.pool.request_many(host, port, wants,
+                                      timeout=timeout, tag=tag)
+
+
+# ── In-process loopback fabric ──
+#
+# The simulations register each simulated host's (cfg, cache) under
+# its advertised address; loopback/jax transports serve against the
+# registry directly instead of dialing sockets.
+
+_FABRIC: dict[tuple[str, int], tuple] = {}
+_FABRIC_LOCK = threading.Lock()
+
+
+def register_loopback(addr: tuple[str, int], cfg, cache) -> None:
+    with _FABRIC_LOCK:
+        _FABRIC[(str(addr[0]), int(addr[1]))] = (cfg, cache)
+
+
+def fabric_entry(addr: tuple[str, int]):
+    with _FABRIC_LOCK:
+        return _FABRIC.get((str(addr[0]), int(addr[1])))
+
+
+def reset_loopback() -> None:
+    """Drop every fabric registration (tests/bench isolation)."""
+    with _FABRIC_LOCK:
+        _FABRIC.clear()
+
+
+class _TagAlloc:
+    """Nonzero u16 window-tag allocator (mirrors DcnPool's)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def alloc(self) -> int:
+        with self._lock:
+            self._next = (self._next % 0xFFFF) + 1
+            return self._next
+
+
+def _serve_window(entry, addr, wants, flags: int) -> list:
+    """Answer one window against a fabric entry — the same
+    ``serve_chunk_range`` decision tree the socket server runs, so
+    every backend serves identically (including the lossy tier)."""
+    cfg, cache = entry
+    out = []
+    for i, (chunk_hash, start, end) in enumerate(wants):
+        found = serve_chunk_range(cfg, cache, chunk_hash, start, end,
+                                  flags)
+        if found is None:
+            out.append(DcnNotFound(i, chunk_hash))
+        else:
+            offset, blob, resp_flags = found
+            out.append(DcnResponse(i, offset, blob, resp_flags))
+    return out
+
+
+class LoopbackTransport(ExchangeTransport):
+    """Zero-socket exchange against the registered fabric — the
+    256–1024-host simulation backend. Keeps the tagged-window
+    counters and the ``dcn_reset`` fault hook so the conformance
+    suite and the fault ladder behave exactly as over the wire."""
+
+    name = "loopback"
+
+    def __init__(self) -> None:
+        self._counters = {"windows": 0, "requests": 0,
+                          "tagged_windows": 0, "untagged_windows": 0}
+        self._lock = threading.Lock()
+        self._tags = _TagAlloc()
+
+    def window_tag(self) -> int:
+        return self._tags.alloc()
+
+    @property
+    def counters(self) -> dict:
+        return self._counters
+
+    def request_window(self, partner, addr, wants, *, timeout, tag,
+                       link="dcn", lossy_ok=False, quant_ok=False):
+        host, port = addr
+        if faults.fire("dcn_reset", key=f"{host}:{port}"):
+            raise ConnectionError("injected dcn_reset")
+        entry = fabric_entry(addr)
+        if entry is None:
+            raise ConnectionError(
+                f"no loopback fabric entry for {host}:{port}")
+        with self._lock:
+            self._counters["windows"] += 1
+            self._counters["requests"] += len(wants)
+            self._counters["tagged_windows" if tag
+                           else "untagged_windows"] += 1
+        # Bare ``collective.*`` span name: critpath blames transport
+        # work as "exchange" (the stage-prefix table learned these in
+        # the same PR that split the transports out).
+        with telemetry.span("collective.loopback", requests=len(wants),
+                            link=link) as _sp:
+            replies = _serve_window(entry, addr, wants,
+                                    _request_flags(lossy_ok, quant_ok))
+            _sp.add_bytes(sum(len(r.data) for r in replies
+                              if isinstance(r, DcnResponse)))
+        return replies
+
+
+# ICI lane sizing: lanes quantize to 64 KiB so minor per-unit size
+# variation never changes the compiled program shape, plus slack for
+# frame headers / whole-entry serves slightly over the plan estimate.
+_LANE_QUANTUM = 64 * 1024
+_LANE_SLACK = 4096
+
+
+class JaxIciTransport(ExchangeTransport):
+    """Intra-slice phases as device-to-device uint8 lane permutes.
+
+    The lane width derives from the shared plan's largest unit wire
+    estimate — a pure function of the fingerprint-identical plan, so
+    every host compiles the identical lane program without any
+    negotiation. Payload bytes for an ICI phase come from the loopback
+    fabric when the partner is registered (the in-process sims) or
+    from the wire transport otherwise, then round-trip through the
+    device as a ``jax.Array`` — the host-level stand-in for the real
+    multi-host ICI permute, exercising the exact pack/unpack and
+    shape-agreement machinery. DCN/WAN phases delegate to the wire
+    transport untouched."""
+
+    name = "jax"
+
+    def __init__(self, pool, plan=None) -> None:
+        try:
+            import jax
+        except Exception as exc:  # noqa: BLE001 - gated dependency
+            raise TransportUnavailable(f"jax unavailable: {exc}")
+        self._jax = jax
+        self._wire = DcnWireTransport(pool)
+        lane = _LANE_QUANTUM
+        if plan is not None:
+            biggest = max(
+                (fi.url_range_end - fi.url_range_start
+                 for _key, fi in plan.units), default=0)
+            lane = -(-(biggest + _LANE_SLACK) // _LANE_QUANTUM) \
+                * _LANE_QUANTUM
+        self.lane_bytes = lane
+        self._counters = {"windows": 0, "requests": 0,
+                          "tagged_windows": 0, "untagged_windows": 0,
+                          "ici_windows": 0, "ici_lane_bytes": 0,
+                          "lane_overflows": 0}
+        self._lock = threading.Lock()
+
+    def window_tag(self) -> int:
+        return self._wire.window_tag()
+
+    @property
+    def counters(self) -> dict:
+        return self._counters
+
+    def clock_offsets(self) -> dict:
+        return self._wire.clock_offsets()
+
+    def request_window(self, partner, addr, wants, *, timeout, tag,
+                       link="dcn", lossy_ok=False, quant_ok=False):
+        with self._lock:
+            self._counters["windows"] += 1
+            self._counters["requests"] += len(wants)
+            self._counters["tagged_windows" if tag
+                           else "untagged_windows"] += 1
+        if link != LINK_ICI:
+            return self._wire.request_window(
+                partner, addr, wants, timeout=timeout, tag=tag,
+                link=link, lossy_ok=lossy_ok, quant_ok=quant_ok)
+        host, port = addr
+        if faults.fire("dcn_reset", key=f"{host}:{port}"):
+            raise ConnectionError("injected dcn_reset")
+        entry = fabric_entry(addr)
+        if entry is not None:
+            replies = _serve_window(entry, addr, wants,
+                                    _request_flags(lossy_ok, quant_ok))
+        else:
+            replies = self._wire.request_window(
+                partner, addr, wants, timeout=timeout, tag=tag,
+                link=link, lossy_ok=lossy_ok, quant_ok=quant_ok)
+        with self._lock:
+            self._counters["ici_windows"] += 1
+        return self._lane_permute(replies)
+
+    def _lane_permute(self, replies: list) -> list:
+        import numpy as np
+
+        rows = [i for i, r in enumerate(replies)
+                if isinstance(r, DcnResponse)
+                and 0 < len(r.data) <= self.lane_bytes]
+        overflow = sum(1 for r in replies
+                       if isinstance(r, DcnResponse)
+                       and len(r.data) > self.lane_bytes)
+        if overflow:
+            with self._lock:
+                self._counters["lane_overflows"] += overflow
+        if not rows:
+            return replies
+        with telemetry.span("collective.lane", rows=len(rows),
+                            lane_bytes=self.lane_bytes) as _sp:
+            lanes = np.zeros((len(rows), self.lane_bytes),
+                             dtype=np.uint8)
+            for j, i in enumerate(rows):
+                data = replies[i].data
+                lanes[j, :len(data)] = np.frombuffer(data,
+                                                     dtype=np.uint8)
+            moved = np.asarray(self._jax.device_put(lanes))
+            _sp.add_bytes(int(lanes.nbytes))
+        with self._lock:
+            self._counters["ici_lane_bytes"] += int(lanes.nbytes)
+        out = list(replies)
+        for j, i in enumerate(rows):
+            r = replies[i]
+            out[i] = DcnResponse(r.request_id, r.chunk_offset,
+                                 moved[j, :len(r.data)].tobytes(),
+                                 r.flags)
+        return out
+
+
+def make_transport(backend: str | None, pool,
+                   plan=None) -> ExchangeTransport:
+    """Build the configured backend. ``pool`` is the round's DcnPool
+    (wire/jax backends share it — channels, tag allocator, counters);
+    ``plan`` sizes the jax backend's uint8 lanes."""
+    if backend in (None, "", "dcn"):
+        return DcnWireTransport(pool)
+    if backend == "loopback":
+        return LoopbackTransport()
+    if backend == "jax":
+        return JaxIciTransport(pool, plan=plan)
+    raise TransportUnavailable(
+        f"unknown collective backend {backend!r}")
